@@ -1,0 +1,45 @@
+"""pilosa_tpu — a TPU-native distributed bitmap index.
+
+A from-scratch rebuild of the capabilities of Pilosa (a distributed bitmap
+index, reference: bussiere/pilosa) designed TPU-first:
+
+- The hot path (bitwise set algebra + popcount, reference
+  ``roaring/assembly_amd64.s``) runs as fused XLA/Pallas kernels over dense
+  packed ``uint32`` bitmap arrays in HBM (`pilosa_tpu.ops`).
+- The per-slice scatter/gather query execution (reference ``executor.go``
+  mapReduce) becomes a single batched/sharded computation over a slice axis
+  with XLA collectives (`pilosa_tpu.parallel`).
+- Host-side storage keeps the roaring container format (array/bitmap
+  containers, cookie-12346 serialization) at the storage/serialization
+  boundary only (`pilosa_tpu.roaring`); on device everything is dense.
+
+Layer map (mirrors SURVEY.md §1):
+
+=====  =======================  =========================================
+Layer  Module                   Reference analog
+=====  =======================  =========================================
+L0/L1  ops/, roaring.py         roaring/ + assembly_amd64.s
+L2     core/fragment.py         fragment.go
+L3     core/{holder,index,      holder.go, index.go, frame.go, view.go
+       frame,view}.py
+L4     executor.py, pql/        executor.go, pql/
+L5     parallel/, cluster.py    cluster.go, broadcast.go, gossip/
+L6     server/handler.py        handler.go, client.go, internal/
+L7     server/server.py         server.go, server/server.go
+L8     cli/                     cmd/, ctl/
+=====  =======================  =========================================
+"""
+
+__version__ = "0.1.0"
+
+from pilosa_tpu.pilosa import (  # noqa: F401
+    PilosaError,
+    ErrIndexExists,
+    ErrIndexNotFound,
+    ErrFrameExists,
+    ErrFrameNotFound,
+    ErrFragmentNotFound,
+    ErrQueryRequired,
+    validate_name,
+    validate_label,
+)

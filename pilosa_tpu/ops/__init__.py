@@ -1,0 +1,39 @@
+"""TPU kernel layer: dense packed-bitmap set algebra and popcounts.
+
+This package replaces the reference's L0/L1 hot path — the AMD64 SIMD
+popcount kernels (roaring/assembly_amd64.s) and the per-container set-op
+kernels (roaring/roaring.go:1192-1558) — with XLA/Pallas computations over
+dense packed ``uint32`` arrays.
+
+- `bitwise` — jnp/XLA implementations (work on any backend; XLA fuses the
+  elementwise op + population_count + reduction into one HBM pass).
+- `pallas_kernels` — hand-written Pallas TPU kernels for the fused
+  op+popcount reductions (the `popcntAndSliceAsm` analog), used on TPU.
+- `dispatch` — picks Pallas on TPU, jnp elsewhere.
+"""
+
+from pilosa_tpu.ops.bitwise import (  # noqa: F401
+    WORD_BITS,
+    WORDS_PER_SLICE,
+    bit_and,
+    bit_or,
+    bit_xor,
+    bit_andnot,
+    popcount_words,
+    make_range_mask,
+    pack_positions,
+    unpack_positions,
+    pack_rows_matrix,
+)
+
+# The public fused-count entry points route through the backend dispatcher
+# (Pallas on TPU, jnp elsewhere); pilosa_tpu.ops.bitwise keeps the raw jnp
+# implementations as the portable fallback / ground-truth layer.
+from pilosa_tpu.ops.dispatch import (  # noqa: F401
+    count,
+    count_and,
+    count_or,
+    count_xor,
+    count_andnot,
+    batch_intersection_count,
+)

@@ -1,0 +1,75 @@
+"""Backend dispatch: Pallas kernels on TPU, jnp/XLA elsewhere.
+
+The analog of the reference's init-time CPUID gate
+(roaring/assembly_asm.go:17-23: use asm if POPCNT is available, else the Go
+SWAR fallback).  Here the "feature detect" is the JAX default backend; the
+jnp path also serves TPU-less CI (tests force JAX_PLATFORMS=cpu).
+
+Set ``PILOSA_TPU_NO_PALLAS=1`` (or ``true``) to force the jnp path on TPU;
+the variable is read on every call so it can be toggled for benchmarking.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+
+from pilosa_tpu.ops import bitwise
+from pilosa_tpu.ops.pallas_kernels import _tileable, fused_count1, fused_count2
+
+
+@functools.lru_cache(maxsize=None)
+def _backend_is_tpu() -> bool:
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+def use_pallas() -> bool:
+    if os.environ.get("PILOSA_TPU_NO_PALLAS", "").lower() in ("1", "true", "yes"):
+        return False
+    return _backend_is_tpu()
+
+
+def count(x):
+    if use_pallas() and _tileable(x.shape[-1]):
+        return fused_count1(x)
+    return bitwise.count(x)
+
+
+def count_and(a, b):
+    if use_pallas() and _tileable(a.shape[-1]):
+        return fused_count2("and", a, b)
+    return bitwise.count_and(a, b)
+
+
+def count_or(a, b):
+    if use_pallas() and _tileable(a.shape[-1]):
+        return fused_count2("or", a, b)
+    return bitwise.count_or(a, b)
+
+
+def count_xor(a, b):
+    if use_pallas() and _tileable(a.shape[-1]):
+        return fused_count2("xor", a, b)
+    return bitwise.count_xor(a, b)
+
+
+def count_andnot(a, b):
+    if use_pallas() and _tileable(a.shape[-1]):
+        return fused_count2("andnot", a, b)
+    return bitwise.count_andnot(a, b)
+
+
+def batch_intersection_count(rows, src):
+    """|rows[k] & src| for a stack of rows — TopN's exact-count hot loop.
+
+    On TPU this streams the single src block through the fused Pallas
+    kernel (no K-way broadcast in HBM).
+    """
+    if use_pallas() and rows.ndim >= 2 and _tileable(rows.shape[-1]):
+        return fused_count2("and", rows, src)
+    return bitwise.batch_intersection_count(rows, src)

@@ -1,0 +1,123 @@
+"""Hand-written Pallas TPU kernels for the fused popcount reductions.
+
+These are the TPU-native equivalents of the reference's hand-written AMD64
+SIMD loops (roaring/assembly_amd64.s:25-115): one pass over HBM that applies
+the bitwise op, popcounts each word on the VPU, and reduces to a scalar per
+row — no intermediate materialization.
+
+A packed row of one slice is 32768 uint32 words, viewed as a (256, 128)
+tile-aligned block (int32 min tile is (8, 128)).  The grid iterates over the
+leading (row/slice) axis; Pallas double-buffers the HBM→VMEM DMAs across
+grid steps, so the kernel streams at HBM bandwidth.
+
+Fallback: on non-TPU backends (or non-tileable word counts) `dispatch`
+routes to the jnp implementations in `bitwise`, the analog of the reference
+gating its asm path on a CPUID check (roaring/assembly_asm.go:20,
+assembly_generic.go).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_LANES = 128
+_SUBLANES = 8  # int32/uint32 min sublane count
+
+
+def _op_apply(op: str, a, b):
+    if op == "and":
+        return jnp.bitwise_and(a, b)
+    if op == "or":
+        return jnp.bitwise_or(a, b)
+    if op == "xor":
+        return jnp.bitwise_xor(a, b)
+    if op == "andnot":
+        return jnp.bitwise_and(a, jnp.bitwise_not(b))
+    raise ValueError(f"unknown op {op!r}")
+
+
+def _partial_tile(words):
+    # words: (1, sub, 128) uint32 -> (8, 128) int32 partial popcount sums.
+    # Reducing only across sublane groups keeps the store tile-aligned
+    # ((8,128) is the int32 min tile); the final (8,128)->scalar fold is left
+    # to XLA outside the kernel where it costs nothing.
+    pc = lax.population_count(words).astype(jnp.int32)
+    sub = words.shape[1]
+    return pc.reshape(sub // 8, 8, _LANES).sum(axis=0)
+
+
+def _count2_kernel(op, a_ref, b_ref, out_ref):
+    out_ref[0] = _partial_tile(_op_apply(op, a_ref[...], b_ref[...]))
+
+
+def _count1_kernel(a_ref, out_ref):
+    out_ref[0] = _partial_tile(a_ref[...])
+
+
+def _tileable(n_words: int) -> bool:
+    return n_words % (_LANES * _SUBLANES) == 0
+
+
+@functools.partial(jax.jit, static_argnames=("op", "interpret"))
+def fused_count2(op: str, a, b, interpret: bool = False):
+    """sum(popcount(op(a, b))) over the last axis via a Pallas kernel.
+
+    a: uint32[..., W] with W % 1024 == 0; b: same shape as a, OR uint32[W]
+    (a single shared operand, e.g. TopN's src row counted against a whole
+    stack of candidate rows).  The shared case streams the one b block into
+    VMEM once per grid step instead of materializing a K-way broadcast in
+    HBM.  Returns int32[...] (a's shape minus the word axis).
+    """
+    shape = a.shape
+    w = shape[-1]
+    m = 1
+    for d in shape[:-1]:
+        m *= d
+    sub = w // _LANES
+    a3 = a.reshape(m, sub, _LANES)
+    shared_b = b.ndim == 1 and a.ndim > 1
+    if shared_b:
+        b3 = b.reshape(1, sub, _LANES)
+        b_spec = pl.BlockSpec((1, sub, _LANES), lambda i: (0, 0, 0))
+    else:
+        b3 = jnp.broadcast_to(b, shape).reshape(m, sub, _LANES)
+        b_spec = pl.BlockSpec((1, sub, _LANES), lambda i: (i, 0, 0))
+    out = pl.pallas_call(
+        functools.partial(_count2_kernel, op),
+        out_shape=jax.ShapeDtypeStruct((m, 8, _LANES), jnp.int32),
+        grid=(m,),
+        in_specs=[
+            pl.BlockSpec((1, sub, _LANES), lambda i: (i, 0, 0)),
+            b_spec,
+        ],
+        out_specs=pl.BlockSpec((1, 8, _LANES), lambda i: (i, 0, 0)),
+        interpret=interpret,
+    )(a3, b3)
+    return out.sum(axis=(1, 2)).reshape(shape[:-1])
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def fused_count1(a, interpret: bool = False):
+    """sum(popcount(a)) over the last axis via a Pallas kernel."""
+    shape = a.shape
+    w = shape[-1]
+    m = 1
+    for d in shape[:-1]:
+        m *= d
+    sub = w // _LANES
+    a3 = a.reshape(m, sub, _LANES)
+    out = pl.pallas_call(
+        _count1_kernel,
+        out_shape=jax.ShapeDtypeStruct((m, 8, _LANES), jnp.int32),
+        grid=(m,),
+        in_specs=[pl.BlockSpec((1, sub, _LANES), lambda i: (i, 0, 0))],
+        out_specs=pl.BlockSpec((1, 8, _LANES), lambda i: (i, 0, 0)),
+        interpret=interpret,
+    )(a3)
+    return out.sum(axis=(1, 2)).reshape(shape[:-1])

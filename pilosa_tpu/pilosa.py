@@ -1,0 +1,97 @@
+"""Package-level errors, constants, and name validation.
+
+Reference analog: pilosa.go (sentinel errors pilosa.go:25-49, name/label
+validation regexes pilosa.go:52-55 and 111-124).
+"""
+
+from __future__ import annotations
+
+import re
+
+# Slice width: number of columns per slice. Reference: fragment.go:47
+# (SliceWidth = 1048576 = 2^20). Everything hangs off this constant.
+SLICE_WIDTH = 1 << 20
+
+
+class PilosaError(Exception):
+    """Base class for all framework errors."""
+
+
+class ErrIndexExists(PilosaError):
+    pass
+
+
+class ErrIndexNotFound(PilosaError):
+    pass
+
+
+class ErrFrameExists(PilosaError):
+    pass
+
+
+class ErrFrameNotFound(PilosaError):
+    pass
+
+
+class ErrFrameInverseDisabled(PilosaError):
+    pass
+
+
+class ErrFragmentNotFound(PilosaError):
+    pass
+
+
+class ErrQueryRequired(PilosaError):
+    pass
+
+
+class ErrInvalidView(PilosaError):
+    pass
+
+
+class ErrName(PilosaError):
+    pass
+
+
+class ErrLabel(PilosaError):
+    pass
+
+
+class ErrHostRequired(PilosaError):
+    pass
+
+
+class ErrFrameRequired(PilosaError):
+    pass
+
+
+class ErrColumnRowLabelEqual(PilosaError):
+    pass
+
+
+class ErrInvalidCacheType(PilosaError):
+    pass
+
+
+class ErrInvalidTimeQuantum(PilosaError):
+    pass
+
+
+class ErrTooManyWrites(PilosaError):
+    pass
+
+
+# Reference: pilosa.go:52-55 — names are lowercase alphanumeric with
+# dash/underscore, a leading letter, at most 65 chars total.
+_NAME_RE = re.compile(r"[a-z][a-z0-9_-]{0,64}")
+_LABEL_RE = re.compile(r"[A-Za-z][A-Za-z0-9_-]{0,64}")
+
+
+def validate_name(name: str) -> None:
+    if not isinstance(name, str) or _NAME_RE.fullmatch(name) is None:
+        raise ErrName(f"invalid index or frame name: {name!r}")
+
+
+def validate_label(label: str) -> None:
+    if not isinstance(label, str) or _LABEL_RE.fullmatch(label) is None:
+        raise ErrLabel(f"invalid row or column label: {label!r}")

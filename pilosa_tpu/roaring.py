@@ -1,0 +1,617 @@
+"""Roaring bitmap engine, numpy-native.
+
+Host-side compressed bitmap used at the storage/serialization boundary
+(snapshot files, WAL, wire format).  On device everything is dense packed
+uint32 (see pilosa_tpu.ops); this module is what feeds it.
+
+Reference analog: roaring/roaring.go (1856 LoC Go).  Semantics match —
+64-bit value space split into 2^16-bit containers keyed by ``value >> 16``,
+each container either a sorted array (≤ 4096 values) or a dense bitmap
+(1024 × u64 words) — but the implementation is vectorized numpy rather than
+a translation: container kernels are numpy set ops / bitwise ops, batch
+adds group by key with one sort, and dense-row extraction emits the packed
+uint32 arrays the TPU kernels consume.
+
+Serialization is byte-compatible with the reference file format
+(roaring.go:475-533 WriteTo / 536-614 UnmarshalBinary):
+
+    cookie u32le = 12346 | containerCount u32le
+    per container: key u64le, (n-1) u32le          (12-byte headers)
+    per container: absolute file offset u32le
+    payloads: array = n × u32le, bitmap = 1024 × u64le
+    trailing op log: records of [typ u8 | value u64le | fnv1a32 u32le]
+                     (checksum over the first 9 bytes; roaring.go:1586-1623)
+"""
+
+from __future__ import annotations
+
+import io
+from typing import Iterable, Iterator, Optional
+
+import numpy as np
+
+COOKIE = 12346
+HEADER_SIZE = 8
+ARRAY_MAX_SIZE = 4096
+BITMAP_N = (1 << 16) // 64  # 1024 u64 words per container
+CONTAINER_BITS = 1 << 16
+OP_SIZE = 13
+
+OP_ADD = 0
+OP_REMOVE = 1
+
+# Byte-popcount lookup table; np_count(words) = LUT[words.view(u8)].sum().
+_POPCNT8 = np.array([bin(i).count("1") for i in range(256)], dtype=np.uint32)
+
+
+def _popcount_words(words: np.ndarray) -> int:
+    return int(_POPCNT8[words.view(np.uint8)].sum())
+
+
+def fnv1a32(data: bytes) -> int:
+    """FNV-1a 32-bit hash (op-log checksums; hash/fnv analog)."""
+    h = 2166136261
+    for b in data:
+        h ^= b
+        h = (h * 16777619) & 0xFFFFFFFF
+    return h
+
+
+def highbits(v: int) -> int:
+    return v >> 16
+
+
+def lowbits(v: int) -> int:
+    return v & 0xFFFF
+
+
+class Container:
+    """One 2^16-bit container: sorted uint32 array or dense u64 bitmap.
+
+    ``array`` holds sorted unique lowbits values as uint32 (the file format
+    stores them as u32le).  ``bitmap`` is uint64[1024].  Exactly one is
+    non-None.  Conversion threshold matches the reference: arrays hold at
+    most ARRAY_MAX_SIZE=4096 values (roaring.go:833, 951-953).
+    """
+
+    __slots__ = ("array", "bitmap")
+
+    def __init__(self, array: Optional[np.ndarray] = None, bitmap: Optional[np.ndarray] = None):
+        if array is None and bitmap is None:
+            array = np.empty(0, dtype=np.uint32)
+        self.array = array
+        self.bitmap = bitmap
+
+    # -- constructors -------------------------------------------------
+
+    @classmethod
+    def from_values(cls, values: np.ndarray) -> "Container":
+        """Build from sorted unique lowbits values, picking representation."""
+        values = np.asarray(values, dtype=np.uint32)
+        if len(values) > ARRAY_MAX_SIZE:
+            return cls(bitmap=_values_to_bitmap(values))
+        return cls(array=values)
+
+    # -- basics -------------------------------------------------------
+
+    @property
+    def is_array(self) -> bool:
+        return self.array is not None
+
+    @property
+    def n(self) -> int:
+        if self.array is not None:
+            return len(self.array)
+        return _popcount_words(self.bitmap)
+
+    def values(self) -> np.ndarray:
+        """Sorted lowbits values as uint32."""
+        if self.array is not None:
+            return self.array
+        return _bitmap_to_values(self.bitmap)
+
+    def contains(self, v: int) -> bool:
+        if self.array is not None:
+            i = np.searchsorted(self.array, v)
+            return i < len(self.array) and self.array[i] == v
+        return bool((int(self.bitmap[v >> 6]) >> (v & 63)) & 1)
+
+    def add(self, v: int) -> bool:
+        """Insert lowbits value; True if it was newly added."""
+        if self.array is not None:
+            i = int(np.searchsorted(self.array, v))
+            if i < len(self.array) and self.array[i] == v:
+                return False
+            if len(self.array) >= ARRAY_MAX_SIZE:
+                self.bitmap = _values_to_bitmap(self.array)
+                self.array = None
+                self.bitmap[v >> 6] |= np.uint64(1 << (v & 63))
+                return True
+            self.array = np.insert(self.array, i, np.uint32(v))
+            return True
+        w, b = v >> 6, v & 63
+        if (int(self.bitmap[w]) >> b) & 1:
+            return False
+        self.bitmap[w] |= np.uint64(1 << b)
+        return True
+
+    def remove(self, v: int) -> bool:
+        if self.array is not None:
+            i = int(np.searchsorted(self.array, v))
+            if i >= len(self.array) or self.array[i] != v:
+                return False
+            self.array = np.delete(self.array, i)
+            return True
+        w, b = v >> 6, v & 63
+        if not (int(self.bitmap[w]) >> b) & 1:
+            return False
+        self.bitmap[w] &= np.uint64(~(1 << b) & 0xFFFFFFFFFFFFFFFF)
+        # Convert back to array when small enough (roaring.go remove path).
+        if self.n <= ARRAY_MAX_SIZE:
+            self.array = _bitmap_to_values(self.bitmap)
+            self.bitmap = None
+        return True
+
+    def add_many(self, values: np.ndarray) -> int:
+        """Bulk insert of sorted-or-not lowbits values; returns newly-added count."""
+        values = np.asarray(values, dtype=np.uint32)
+        if len(values) == 0:
+            return 0
+        before = self.n
+        merged = np.union1d(self.values(), values)
+        if len(merged) > ARRAY_MAX_SIZE:
+            self.bitmap = _values_to_bitmap(merged)
+            self.array = None
+        else:
+            self.array = merged.astype(np.uint32)
+            self.bitmap = None
+        return len(merged) - before
+
+    # -- range --------------------------------------------------------
+
+    def count_range(self, start: int, end: int) -> int:
+        """Count values in [start, end) within this container's lowbits space."""
+        if self.array is not None:
+            return int(np.searchsorted(self.array, end) - np.searchsorted(self.array, start))
+        vals = _bitmap_to_values(self.bitmap)
+        return int(np.searchsorted(vals, end) - np.searchsorted(vals, start))
+
+    # -- serialization ------------------------------------------------
+
+    def payload(self) -> bytes:
+        if self.array is not None:
+            return self.array.astype("<u4").tobytes()
+        return self.bitmap.astype("<u8").tobytes()
+
+    def payload_size(self) -> int:
+        if self.array is not None:
+            return 4 * len(self.array)
+        return 8 * BITMAP_N
+
+    def check(self) -> None:
+        if self.array is not None:
+            if len(self.array) > ARRAY_MAX_SIZE:
+                raise ValueError("array container too large")
+            if len(self.array) > 1 and not (np.diff(self.array.astype(np.int64)) > 0).all():
+                raise ValueError("array container not sorted/unique")
+            if len(self.array) and int(self.array[-1]) >= CONTAINER_BITS:
+                raise ValueError("array value out of range")
+
+
+def _values_to_bitmap(values: np.ndarray) -> np.ndarray:
+    bm = np.zeros(BITMAP_N, dtype=np.uint64)
+    v = values.astype(np.uint64)
+    np.bitwise_or.at(bm, (v >> np.uint64(6)).astype(np.int64), np.uint64(1) << (v & np.uint64(63)))
+    return bm
+
+
+def _bitmap_to_values(bitmap: np.ndarray) -> np.ndarray:
+    bits = np.unpackbits(bitmap.view(np.uint8), bitorder="little")
+    return np.nonzero(bits)[0].astype(np.uint32)
+
+
+class Bitmap:
+    """Sparse 64-bit-keyed roaring bitmap (reference roaring.go:42 Bitmap).
+
+    ``containers`` maps container key (value >> 16) -> Container.  A dict is
+    the Python-native replacement for the reference's parallel sorted
+    keys/containers slices; sorted key order is materialized on demand
+    (iteration/serialization) and set ops intersect key sets directly.
+
+    ``op_writer`` is the WAL hook (roaring.go:51 OpWriter): when set, every
+    successful add/remove appends a checksummed 13-byte op record.
+    """
+
+    def __init__(self, values: Optional[Iterable[int]] = None):
+        self.containers: dict[int, Container] = {}
+        self.op_writer = None  # file-like; WAL hook
+        self.op_n = 0
+        if values is not None:
+            self.add_many(np.fromiter(values, dtype=np.uint64))
+
+    # -- mutation -----------------------------------------------------
+
+    def add(self, v: int) -> bool:
+        v = int(v)
+        changed = self._container_for(v).add(lowbits(v))
+        if changed:
+            self._write_op(OP_ADD, v)
+        return changed
+
+    def remove(self, v: int) -> bool:
+        v = int(v)
+        c = self.containers.get(highbits(v))
+        if c is None:
+            return False
+        changed = c.remove(lowbits(v))
+        if changed:
+            if c.n == 0:
+                del self.containers[highbits(v)]
+            self._write_op(OP_REMOVE, v)
+        return changed
+
+    def add_many(self, values: np.ndarray) -> int:
+        """Vectorized bulk add (no WAL; callers snapshot after, like Import)."""
+        values = np.asarray(values, dtype=np.uint64)
+        if len(values) == 0:
+            return 0
+        values = np.unique(values)
+        keys = (values >> np.uint64(16)).astype(np.int64)
+        added = 0
+        for key in np.unique(keys):
+            lows = (values[keys == key] & np.uint64(0xFFFF)).astype(np.uint32)
+            c = self.containers.get(int(key))
+            if c is None:
+                c = Container.from_values(lows)
+                self.containers[int(key)] = c
+                added += c.n
+            else:
+                added += c.add_many(lows)
+        return added
+
+    def _container_for(self, v: int) -> Container:
+        key = highbits(v)
+        c = self.containers.get(key)
+        if c is None:
+            c = Container()
+            self.containers[key] = c
+        return c
+
+    def _write_op(self, typ: int, value: int) -> None:
+        if self.op_writer is None:
+            return
+        self.op_writer.write(encode_op(typ, value))
+        self.op_n += 1
+
+    # -- queries ------------------------------------------------------
+
+    def contains(self, v: int) -> bool:
+        v = int(v)
+        c = self.containers.get(highbits(v))
+        return c is not None and c.contains(lowbits(v))
+
+    def count(self) -> int:
+        return sum(c.n for c in self.containers.values())
+
+    def count_range(self, start: int, end: int) -> int:
+        """Count values in [start, end)."""
+        if end <= start:
+            return 0
+        total = 0
+        hk, he = highbits(start), highbits(end - 1)
+        for key in self.sorted_keys():
+            if key < hk or key > he:
+                continue
+            c = self.containers[key]
+            lo = lowbits(start) if key == hk else 0
+            hi = lowbits(end - 1) + 1 if key == he else CONTAINER_BITS
+            if lo == 0 and hi == CONTAINER_BITS:
+                total += c.n
+            else:
+                total += c.count_range(lo, hi)
+        return total
+
+    def slice_values(self, start: int, end: int) -> np.ndarray:
+        """All values in [start, end) as sorted uint64 (OffsetRange core)."""
+        out = []
+        hk, he = highbits(start), highbits(max(end - 1, 0))
+        for key in self.sorted_keys():
+            if key < hk or key > he:
+                continue
+            vals = self.containers[key].values().astype(np.uint64) | np.uint64(key << 16)
+            if key == hk or key == he:
+                vals = vals[(vals >= start) & (vals < end)]
+            out.append(vals)
+        if not out:
+            return np.empty(0, dtype=np.uint64)
+        return np.concatenate(out)
+
+    def offset_range(self, offset: int, start: int, end: int) -> "Bitmap":
+        """New bitmap holding values in [start, end) rebased to ``offset``.
+
+        Reference roaring.go:253-285: container keys are shifted whole —
+        offset/start/end must be container-aligned multiples of 2^16.
+        """
+        for name, v in (("offset", offset), ("start", start), ("end", end)):
+            if v & 0xFFFF:
+                raise ValueError(f"{name} must be a multiple of 2^16")
+        other = Bitmap()
+        off_key, hi0, hi1 = highbits(offset), highbits(start), highbits(end)
+        for key, c in self.containers.items():
+            if hi0 <= key < hi1:
+                other.containers[off_key + (key - hi0)] = Container(
+                    array=None if c.array is None else c.array.copy(),
+                    bitmap=None if c.bitmap is None else c.bitmap.copy(),
+                )
+        return other
+
+    def sorted_keys(self) -> list[int]:
+        return sorted(self.containers.keys())
+
+    def max(self) -> int:
+        """Largest value present (0 when empty; roaring.go Max analog)."""
+        if not self.containers:
+            return 0
+        key = self.sorted_keys()[-1]
+        vals = self.containers[key].values()
+        return (key << 16) | int(vals[-1]) if len(vals) else 0
+
+    # -- set algebra --------------------------------------------------
+
+    def intersect(self, other: "Bitmap") -> "Bitmap":
+        out = Bitmap()
+        for key in self.containers.keys() & other.containers.keys():
+            c = _c_intersect(self.containers[key], other.containers[key])
+            if c.n:
+                out.containers[key] = c
+        return out
+
+    def union(self, other: "Bitmap") -> "Bitmap":
+        out = Bitmap()
+        for key in self.containers.keys() | other.containers.keys():
+            a, b = self.containers.get(key), other.containers.get(key)
+            if a is None:
+                out.containers[key] = _c_copy(b)
+            elif b is None:
+                out.containers[key] = _c_copy(a)
+            else:
+                out.containers[key] = _c_union(a, b)
+        return out
+
+    def difference(self, other: "Bitmap") -> "Bitmap":
+        out = Bitmap()
+        for key, a in self.containers.items():
+            b = other.containers.get(key)
+            c = _c_copy(a) if b is None else _c_difference(a, b)
+            if c.n:
+                out.containers[key] = c
+        return out
+
+    def intersection_count(self, other: "Bitmap") -> int:
+        """|self ∩ other| without materializing (the popcntAndSlice host path)."""
+        total = 0
+        for key in self.containers.keys() & other.containers.keys():
+            total += _c_intersection_count(self.containers[key], other.containers[key])
+        return total
+
+    def xor(self, other: "Bitmap") -> "Bitmap":
+        out = Bitmap()
+        for key in self.containers.keys() | other.containers.keys():
+            a, b = self.containers.get(key), other.containers.get(key)
+            if a is None:
+                out.containers[key] = _c_copy(b)
+            elif b is None:
+                out.containers[key] = _c_copy(a)
+            else:
+                c = Container.from_values(np.setxor1d(a.values(), b.values()))
+                if c.n:
+                    out.containers[key] = c
+        return out
+
+    # -- iteration ----------------------------------------------------
+
+    def __iter__(self) -> Iterator[int]:
+        for key in self.sorted_keys():
+            base = key << 16
+            for v in self.containers[key].values():
+                yield base | int(v)
+
+    def to_array(self) -> np.ndarray:
+        """All values as a sorted uint64 array."""
+        keys = self.sorted_keys()
+        if not keys:
+            return np.empty(0, dtype=np.uint64)
+        return np.concatenate(
+            [self.containers[k].values().astype(np.uint64) | np.uint64(k << 16) for k in keys]
+        )
+
+    # -- dense bridge (device boundary) --------------------------------
+
+    def to_dense_words(self, start: int, n_bits: int) -> np.ndarray:
+        """Pack values in [start, start+n_bits) into uint32 words.
+
+        The bridge to the TPU side: a fragment row becomes
+        to_dense_words(row*SLICE_WIDTH, SLICE_WIDTH) → uint32[32768].
+        Requires container-aligned start (multiple of 2^16).
+        """
+        if start & 0xFFFF:
+            raise ValueError("start must be container-aligned")
+        n_words = (n_bits + 31) // 32
+        out = np.zeros(n_words, dtype=np.uint32)
+        k0, k1 = highbits(start), highbits(start + n_bits - 1)
+        for key in self.containers.keys():
+            if not (k0 <= key <= k1):
+                continue
+            c = self.containers[key]
+            word_off = ((key - k0) << 16) // 32
+            if c.bitmap is not None:
+                out[word_off : word_off + 2048] = c.bitmap.view(np.uint32)[: 2 * BITMAP_N]
+            elif len(c.array):
+                v = c.array.astype(np.int64)
+                np.bitwise_or.at(
+                    out, word_off + (v >> 5), (np.uint32(1) << (v & 31).astype(np.uint32))
+                )
+        return out
+
+    @classmethod
+    def from_dense_words(cls, words: np.ndarray, start: int = 0) -> "Bitmap":
+        """Inverse of to_dense_words (start container-aligned)."""
+        if start & 0xFFFF:
+            raise ValueError("start must be container-aligned")
+        bm = cls()
+        words = np.ascontiguousarray(words, dtype=np.uint32)
+        bits = np.unpackbits(words.view(np.uint8), bitorder="little")
+        positions = np.nonzero(bits)[0].astype(np.uint64) + np.uint64(start)
+        bm.add_many(positions)
+        return bm
+
+    # -- consistency ---------------------------------------------------
+
+    def check(self) -> None:
+        """Invariant check (roaring.go:653-674 Bitmap.Check analog)."""
+        for key, c in self.containers.items():
+            if key < 0 or key > (1 << 48):
+                raise ValueError(f"container key out of range: {key}")
+            c.check()
+
+    # -- serialization -------------------------------------------------
+
+    def write_to(self, w) -> int:
+        """Serialize in the reference's cookie-12346 format."""
+        keys = [k for k in self.sorted_keys() if self.containers[k].n > 0]
+        n = len(keys)
+        header = io.BytesIO()
+        header.write(np.uint32(COOKIE).astype("<u4").tobytes())
+        header.write(np.uint32(n).astype("<u4").tobytes())
+        for k in keys:
+            header.write(np.uint64(k).astype("<u8").tobytes())
+            header.write(np.uint32(self.containers[k].n - 1).astype("<u4").tobytes())
+        offset = HEADER_SIZE + n * 12 + n * 4
+        for k in keys:
+            header.write(np.uint32(offset).astype("<u4").tobytes())
+            offset += self.containers[k].payload_size()
+        data = header.getvalue()
+        written = w.write(data)
+        for k in keys:
+            written += w.write(self.containers[k].payload())
+        return written
+
+    def to_bytes(self) -> bytes:
+        buf = io.BytesIO()
+        self.write_to(buf)
+        return buf.getvalue()
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "Bitmap":
+        """Decode the reference format, applying any trailing op log."""
+        if len(data) < HEADER_SIZE:
+            raise ValueError("data too small")
+        head = np.frombuffer(data[:8], dtype="<u4")
+        if int(head[0]) != COOKIE:
+            raise ValueError("invalid roaring file")
+        n = int(head[1])
+        bm = cls()
+        hdr = np.frombuffer(data[8 : 8 + n * 12], dtype=np.uint8)
+        keys = hdr.reshape(n, 12)[:, :8].copy().view("<u8").ravel() if n else np.empty(0, "<u8")
+        counts = (hdr.reshape(n, 12)[:, 8:12].copy().view("<u4").ravel() + 1) if n else []
+        offsets = np.frombuffer(data[8 + n * 12 : 8 + n * 16], dtype="<u4")
+        ops_offset = HEADER_SIZE + n * 16
+        for i in range(n):
+            key, cnt, off = int(keys[i]), int(counts[i]), int(offsets[i])
+            if off >= len(data):
+                raise ValueError(f"offset out of bounds: off={off}, len={len(data)}")
+            if cnt <= ARRAY_MAX_SIZE:
+                arr = np.frombuffer(data[off : off + cnt * 4], dtype="<u4").astype(np.uint32)
+                bm.containers[key] = Container(array=arr)
+                ops_offset = off + cnt * 4
+            else:
+                words = np.frombuffer(data[off : off + BITMAP_N * 8], dtype="<u8").astype(np.uint64)
+                bm.containers[key] = Container(bitmap=words)
+                ops_offset = off + BITMAP_N * 8
+        # Trailing op log (roaring.go:590-611).
+        buf = data[ops_offset:]
+        while buf:
+            typ, value = decode_op(buf[:OP_SIZE])
+            if typ == OP_ADD:
+                bm._container_for(value).add(lowbits(value))
+            else:
+                c = bm.containers.get(highbits(value))
+                if c is not None and c.remove(lowbits(value)) and c.n == 0:
+                    del bm.containers[highbits(value)]
+            bm.op_n += 1
+            buf = buf[OP_SIZE:]
+        return bm
+
+
+def _c_copy(c: Container) -> Container:
+    return Container(
+        array=None if c.array is None else c.array.copy(),
+        bitmap=None if c.bitmap is None else c.bitmap.copy(),
+    )
+
+
+def _c_intersect(a: Container, b: Container) -> Container:
+    if a.bitmap is not None and b.bitmap is not None:
+        return Container.from_values(_bitmap_to_values(a.bitmap & b.bitmap))
+    if a.is_array and b.is_array:
+        return Container(array=np.intersect1d(a.array, b.array).astype(np.uint32))
+    arr, bmp = (a, b) if a.is_array else (b, a)
+    v = arr.array.astype(np.int64)
+    mask = ((bmp.bitmap[v >> 6] >> (v & 63).astype(np.uint64)) & np.uint64(1)).astype(bool)
+    return Container(array=arr.array[mask])
+
+
+def _c_intersection_count(a: Container, b: Container) -> int:
+    if a.bitmap is not None and b.bitmap is not None:
+        return _popcount_words(a.bitmap & b.bitmap)
+    if a.is_array and b.is_array:
+        return len(np.intersect1d(a.array, b.array))
+    arr, bmp = (a, b) if a.is_array else (b, a)
+    v = arr.array.astype(np.int64)
+    return int(((bmp.bitmap[v >> 6] >> (v & 63).astype(np.uint64)) & np.uint64(1)).sum())
+
+
+def _c_union(a: Container, b: Container) -> Container:
+    if a.bitmap is not None and b.bitmap is not None:
+        return Container(bitmap=a.bitmap | b.bitmap)
+    return Container.from_values(np.union1d(a.values(), b.values()))
+
+
+def _c_difference(a: Container, b: Container) -> Container:
+    if a.bitmap is not None and b.bitmap is not None:
+        return Container.from_values(
+            _bitmap_to_values(a.bitmap & ~b.bitmap)
+        )
+    if a.is_array:
+        if b.is_array:
+            return Container(array=np.setdiff1d(a.array, b.array).astype(np.uint32))
+        v = a.array.astype(np.int64)
+        mask = ((b.bitmap[v >> 6] >> (v & 63).astype(np.uint64)) & np.uint64(1)).astype(bool)
+        return Container(array=a.array[~mask])
+    # a bitmap, b array
+    out = a.bitmap.copy()
+    v = b.array.astype(np.int64)
+    np.bitwise_and.at(out, v >> 6, ~(np.uint64(1) << (v & 63).astype(np.uint64)))
+    return Container.from_values(_bitmap_to_values(out))
+
+
+# ---------------------------------------------------------------------------
+# Op-log records (roaring.go:1560-1626)
+# ---------------------------------------------------------------------------
+
+def encode_op(typ: int, value: int) -> bytes:
+    body = bytes([typ]) + np.uint64(value).astype("<u8").tobytes()
+    return body + np.uint32(fnv1a32(body)).astype("<u4").tobytes()
+
+
+def decode_op(data: bytes) -> tuple[int, int]:
+    if len(data) < OP_SIZE:
+        raise ValueError(f"op data out of bounds: len={len(data)}")
+    body, chk = data[:9], int(np.frombuffer(data[9:13], dtype="<u4")[0])
+    if fnv1a32(body) != chk:
+        raise ValueError(f"checksum mismatch: exp={fnv1a32(body):08x}, got={chk:08x}")
+    typ = data[0]
+    if typ not in (OP_ADD, OP_REMOVE):
+        raise ValueError(f"invalid op type: {typ}")
+    value = int(np.frombuffer(data[1:9], dtype="<u8")[0])
+    return typ, value

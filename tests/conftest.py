@@ -1,0 +1,34 @@
+"""Test configuration: force CPU JAX with 8 virtual devices.
+
+This is the CI analog of the reference's portable fallback path
+(roaring/assembly_generic.go) — everything must pass without a TPU.  The
+8 virtual CPU devices let the sharded/mesh tests (parallel/) exercise real
+GSPMD partitioning and collectives.
+
+Must run before jax is imported anywhere.
+"""
+
+import os
+
+if not os.environ.get("PILOSA_TPU_TEST_REAL_TPU"):
+    os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+def pytest_addoption(parser):
+    parser.addoption(
+        "--run-tpu",
+        action="store_true",
+        default=False,
+        help="run tests that require a real TPU (use with PILOSA_TPU_TEST_REAL_TPU=1)",
+    )
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)
