@@ -1,0 +1,98 @@
+"""Benchmark: PQL Intersect+Count throughput (the north-star metric).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Workload (BASELINE.md config 1/4 shape): a Star-Trace style index — a
+device-resident row matrix of ``n_slices`` slices × ``n_rows`` rows of
+packed SLICE_WIDTH-bit bitmaps — served a stream of
+``Count(Intersect(Bitmap(r1), Bitmap(r2)))`` queries.  Queries run in
+batches through ONE fused jit computation (gather rows → AND → popcount →
+reduce over slices+words), which is the TPU-native form of the
+reference's per-slice goroutine fan-out + SIMD loop.
+
+vs_baseline: ratio against a single-threaded numpy popcount loop on the
+same data on this host's CPU — the stand-in for the reference's Go+SIMD
+single-node path (the reference publishes no numbers in-tree; see
+BASELINE.md).  The numpy baseline uses the same vectorized
+AND+LUT-popcount per query, which is competitive with the reference's
+per-container loops.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    n_slices = int(os.environ.get("BENCH_SLICES", "16"))
+    n_rows = int(os.environ.get("BENCH_ROWS", "64"))
+    batch = int(os.environ.get("BENCH_BATCH", "256"))
+    iters = int(os.environ.get("BENCH_ITERS", "20"))
+    # Bit density ~2^-k via AND of k random words (throughput over packed
+    # words is density-independent; this just keeps counts realistic).
+    density_k = int(os.environ.get("BENCH_DENSITY_K", "4"))
+
+    from pilosa_tpu.ops.bitwise import WORDS_PER_SLICE
+
+    W = WORDS_PER_SLICE  # 32768 words = 2^20 bits per slice-row
+    rng = np.random.default_rng(42)
+    row_matrix = rng.integers(0, 1 << 32, size=(n_slices, n_rows, W), dtype=np.uint32)
+    for _ in range(density_k - 1):
+        row_matrix &= rng.integers(0, 1 << 32, size=(n_slices, n_rows, W), dtype=np.uint32)
+
+    pairs = rng.integers(0, n_rows, size=(iters, batch, 2), dtype=np.int32)
+
+    # ---- TPU path -------------------------------------------------------
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    @jax.jit
+    def query_batch(rm, prs):
+        a = jnp.take(rm, prs[:, 0], axis=1)
+        b = jnp.take(rm, prs[:, 1], axis=1)
+        return jnp.sum(lax.population_count(jnp.bitwise_and(a, b)).astype(jnp.int32), axis=(0, 2))
+
+    drm = jax.device_put(row_matrix)
+    dpairs = [jax.device_put(pairs[i]) for i in range(iters)]
+    # warmup/compile
+    query_batch(drm, dpairs[0]).block_until_ready()
+
+    t0 = time.perf_counter()
+    out = None
+    for i in range(iters):
+        out = query_batch(drm, dpairs[i])
+    out.block_until_ready()
+    dt = time.perf_counter() - t0
+    qps = iters * batch / dt
+
+    # ---- CPU numpy baseline (single-threaded popcount loop) -------------
+    from pilosa_tpu.roaring import _POPCNT8
+
+    base_iters = max(1, min(3, iters))
+    t0 = time.perf_counter()
+    for i in range(base_iters):
+        p = pairs[i]
+        a = row_matrix[:, p[:, 0], :]
+        b = row_matrix[:, p[:, 1], :]
+        inter = a & b
+        _ = _POPCNT8[inter.view(np.uint8)].reshape(n_slices, batch, -1).sum(axis=(0, 2))
+    base_dt = time.perf_counter() - t0
+    base_qps = base_iters * batch / base_dt
+
+    result = {
+        "metric": "intersect_count_qps",
+        "value": round(qps, 1),
+        "unit": f"queries/sec ({n_slices} slices x 2^20 cols, batch {batch}, backend {jax.default_backend()})",
+        "vs_baseline": round(qps / base_qps, 2),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,472 @@
+"""Fragment: the (index, frame, view, slice) unit of storage.
+
+Reference analog: fragment.go (1514 LoC).  A fragment owns one slice of one
+view's bitmap matrix: bit ``(rowID, columnID)`` lives at linear position
+``pos = rowID*SLICE_WIDTH + columnID % SLICE_WIDTH`` (fragment.go:1512-1514)
+inside a roaring bitmap, persisted as snapshot-file + appended WAL ops with
+re-snapshot after MaxOpN=2000 ops (fragment.go:63-65, 993-1057).
+
+TPU-first departures from the reference:
+
+- Row reads surface as dense packed ``uint32[SLICE_WIDTH/32]`` word arrays
+  (``row_dense``), the exact layout the device kernels consume; the roaring
+  form is only touched at the storage boundary.
+- TopN's per-candidate ``Src.IntersectionCount(f.Row(id))`` scalar loop
+  (fragment.go:553-560) becomes chunked *batched* popcount counts over a
+  stacked candidate matrix (`_batch_intersection_counts`) — same results,
+  same threshold-pruning semantics, but the hot loop is one vectorized
+  call per chunk instead of K scalar loops, so the executor can push it
+  through the fused TPU kernel.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import math
+import os
+import tempfile
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+import numpy as np
+
+from pilosa_tpu import roaring
+from pilosa_tpu.core import cache as cache_mod
+from pilosa_tpu.ops import bitwise as bw
+from pilosa_tpu.pilosa import SLICE_WIDTH
+
+# Number of rows in a checksum block (fragment.go:59 HashBlockSize).
+HASH_BLOCK_SIZE = 100
+
+# Snapshot after this many WAL ops (fragment.go:63-65 DefaultFragmentMaxOpN).
+DEFAULT_MAX_OPN = 2000
+
+DEFAULT_CACHE_SIZE = 50000
+
+_WORDS = SLICE_WIDTH // 32
+
+# Magic header for the sidecar .cache file (row-id list persisted so ranked
+# caches can be rebuilt by recount on open; fragment.go:236-274, 1073-1093).
+_CACHE_MAGIC = b"PTPC\x01"
+
+
+@dataclass
+class TopOptions:
+    """Options for Fragment.top (fragment.go:662-677)."""
+
+    n: int = 0
+    src: Optional[roaring.Bitmap] = None
+    # Pre-densified src (uint32[W] slice-local words); the executor's batched
+    # path passes this directly so the device-evaluated child bitmap never
+    # round-trips through a roaring conversion.
+    src_dense: Optional[np.ndarray] = None
+    row_ids: Sequence[int] = field(default_factory=list)
+    min_threshold: int = 0
+    filter_field: str = ""
+    filter_values: Sequence = field(default_factory=list)
+    tanimoto_threshold: int = 0
+
+    @property
+    def has_src(self) -> bool:
+        return self.src is not None or self.src_dense is not None
+
+
+def _batch_intersection_counts(rows: np.ndarray, src: np.ndarray) -> np.ndarray:
+    """|rows[k] & src| per row; numpy host path (device path in executor)."""
+    return bw.np_popcount(rows & src).reshape(rows.shape[0], -1).sum(axis=1)
+
+
+class Fragment:
+    """One slice of one view's row-major bitmap matrix."""
+
+    def __init__(
+        self,
+        path: str,
+        index: str,
+        frame: str,
+        view: str,
+        slice_i: int,
+        cache_type: str = cache_mod.DEFAULT_CACHE_TYPE,
+        cache_size: int = DEFAULT_CACHE_SIZE,
+        max_opn: int = DEFAULT_MAX_OPN,
+        row_attr_store=None,
+        stats=None,
+    ):
+        self.path = path
+        self.index = index
+        self.frame = frame
+        self.view = view
+        self.slice = slice_i
+        self.cache_type = cache_type
+        self.cache_size = cache_size
+        self.max_opn = max_opn
+        self.row_attr_store = row_attr_store
+        self.stats = stats
+
+        self.storage: roaring.Bitmap = roaring.Bitmap()
+        self.cache = cache_mod.new_cache(cache_type, cache_size)
+        self._wal = None  # append handle to the data file
+        self._row_cache: OrderedDict[int, np.ndarray] = OrderedDict()
+        self._row_cache_max = 64
+        self._checksums: dict[int, bytes] = {}
+        self._open = False
+
+    # -- lifecycle (fragment.go:151-274) --------------------------------
+
+    def open(self) -> None:
+        if self._open:
+            return
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        if os.path.exists(self.path):
+            with open(self.path, "rb") as f:
+                data = f.read()
+            if data:
+                self.storage = roaring.Bitmap.from_bytes(data)
+        self._attach_wal()
+        self._load_cache()
+        self._open = True
+
+    def close(self) -> None:
+        if self._wal is not None:
+            self._wal.close()
+            self._wal = None
+        self._save_cache()
+        self._open = False
+
+    def _attach_wal(self) -> None:
+        if self._wal is not None:
+            self._wal.close()
+        if not os.path.exists(self.path):
+            with open(self.path, "wb") as f:
+                self.storage.write_to(f)
+            self.storage.op_n = 0
+        self._wal = open(self.path, "ab")
+        self.storage.op_writer = self._wal
+
+    @property
+    def cache_path(self) -> str:
+        return self.path + ".cache"
+
+    def _load_cache(self) -> None:
+        try:
+            with open(self.cache_path, "rb") as f:
+                data = f.read()
+        except FileNotFoundError:
+            return
+        if not data.startswith(_CACHE_MAGIC):
+            return
+        ids = np.frombuffer(data[len(_CACHE_MAGIC) :], dtype="<u8")
+        for row_id in ids:
+            n = self.row_count(int(row_id))
+            if n:
+                self.cache.bulk_add(int(row_id), n)
+        self.cache.recalculate()
+
+    def _save_cache(self) -> None:
+        ids = np.asarray(self.cache.ids(), dtype="<u8")
+        tmp = self.cache_path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(_CACHE_MAGIC)
+            f.write(ids.tobytes())
+        os.replace(tmp, self.cache_path)
+
+    def flush_cache(self) -> None:
+        """Persist the rank cache sidecar (holder cache-flush loop target)."""
+        self._save_cache()
+
+    # -- positions ------------------------------------------------------
+
+    def pos(self, row_id: int, column_id: int) -> int:
+        """Linear bit position (fragment.go:1512-1514)."""
+        return row_id * SLICE_WIDTH + (column_id % SLICE_WIDTH)
+
+    # -- bit ops (fragment.go:371-459) ----------------------------------
+
+    def set_bit(self, row_id: int, column_id: int) -> bool:
+        changed = self.storage.add(self.pos(row_id, column_id))
+        if changed:
+            self._on_row_mutated(row_id)
+            self._increment_opn()
+        return changed
+
+    def clear_bit(self, row_id: int, column_id: int) -> bool:
+        changed = self.storage.remove(self.pos(row_id, column_id))
+        if changed:
+            self._on_row_mutated(row_id)
+            self._increment_opn()
+        return changed
+
+    def contains(self, row_id: int, column_id: int) -> bool:
+        return self.storage.contains(self.pos(row_id, column_id))
+
+    def _on_row_mutated(self, row_id: int) -> None:
+        self._row_cache.pop(row_id, None)
+        self._checksums.pop(row_id // HASH_BLOCK_SIZE, None)
+        self.cache.add(row_id, self.row_count(row_id))
+
+    def _increment_opn(self) -> None:
+        if self.storage.op_n >= self.max_opn:
+            self.snapshot()
+
+    # -- snapshotting (fragment.go:1017-1057) ---------------------------
+
+    def snapshot(self) -> None:
+        """Rewrite the data file from storage; temp-file + rename."""
+        dirname = os.path.dirname(self.path) or "."
+        fd, tmp = tempfile.mkstemp(prefix=os.path.basename(self.path), suffix=".snapshotting", dir=dirname)
+        try:
+            with os.fdopen(fd, "wb") as f:
+                self.storage.write_to(f)
+            os.replace(tmp, self.path)
+        except BaseException:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+            raise
+        self.storage.op_n = 0
+        self._attach_wal()
+
+    # -- row reads (fragment.go:332-367) --------------------------------
+
+    def row_dense(self, row_id: int) -> np.ndarray:
+        """One row of this slice as packed uint32 words (device layout)."""
+        cached = self._row_cache.get(row_id)
+        if cached is not None:
+            self._row_cache.move_to_end(row_id)
+            return cached
+        words = self.storage.to_dense_words(row_id * SLICE_WIDTH, SLICE_WIDTH)
+        self._row_cache[row_id] = words
+        while len(self._row_cache) > self._row_cache_max:
+            self._row_cache.popitem(last=False)
+        return words
+
+    def row(self, row_id: int) -> roaring.Bitmap:
+        """Row as a roaring bitmap of global column positions for this slice."""
+        return self.storage.offset_range(
+            self.slice * SLICE_WIDTH, row_id * SLICE_WIDTH, (row_id + 1) * SLICE_WIDTH
+        )
+
+    def row_count(self, row_id: int) -> int:
+        return self.storage.count_range(row_id * SLICE_WIDTH, (row_id + 1) * SLICE_WIDTH)
+
+    def max_row(self) -> int:
+        return self.storage.max() // SLICE_WIDTH
+
+    def count(self) -> int:
+        return self.storage.count()
+
+    # -- TopN (fragment.go:493-659) -------------------------------------
+
+    def top_pairs(self, row_ids: Sequence[int]) -> list[cache_mod.Pair]:
+        """Candidate (id, count) pairs, count-descending (topBitmapPairs)."""
+        if not row_ids:
+            self.cache.invalidate()
+            return list(self.cache.top())
+        pairs = []
+        for row_id in row_ids:
+            n = self.cache.get(row_id) or self.row_count(row_id)
+            if n > 0:
+                pairs.append(cache_mod.Pair(id=row_id, count=n))
+        return cache_mod.pairs_sorted(pairs)
+
+    def top(self, opt: TopOptions) -> list[cache_mod.Pair]:
+        pairs = self.top_pairs(list(opt.row_ids))
+        n = 0 if opt.row_ids else opt.n  # explicit ids -> no truncation
+
+        filters = set(opt.filter_values) if (opt.filter_field and opt.filter_values) else None
+
+        tanimoto = opt.tanimoto_threshold if (opt.tanimoto_threshold > 0 and opt.has_src) else 0
+        src_count = 0
+        if tanimoto:
+            src_count = (
+                opt.src.count()
+                if opt.src is not None
+                else int(bw.np_popcount(opt.src_dense).sum())
+            )
+        min_tan = (src_count * tanimoto) / 100.0 if tanimoto else 0.0
+        max_tan = (src_count * 100.0) / tanimoto if tanimoto else 0.0
+
+        # Pre-filter candidates on cached counts (cheap, host-side).
+        cands: list[cache_mod.Pair] = []
+        for p in pairs:
+            if p.count <= 0:
+                continue
+            if tanimoto:
+                if p.count <= min_tan or p.count >= max_tan:
+                    continue
+            elif p.count < opt.min_threshold:
+                continue
+            if filters is not None:
+                attrs = self.row_attr_store.attrs(p.id) if self.row_attr_store else None
+                if not attrs or attrs.get(opt.filter_field) not in filters:
+                    continue
+            cands.append(p)
+
+        if not opt.has_src:
+            # Counts are final; take the first n.
+            results = cands[:n] if n else cands
+            return cache_mod.pairs_sorted(results)
+
+        # Intersection-count phase: process candidates count-descending in
+        # chunks; batched popcount per chunk; heap-threshold pruning between
+        # candidates exactly as the reference does between iterations.
+        src_dense = (
+            opt.src_dense
+            if opt.src_dense is not None
+            else opt.src.to_dense_words(self.slice * SLICE_WIDTH, SLICE_WIDTH)
+        )
+        results: list[cache_mod.Pair] = []
+        chunk = 256
+        i = 0
+        while i < len(cands):
+            batch = cands[i : i + chunk]
+            i += chunk
+            rows = np.stack([self.row_dense(p.id) for p in batch])
+            counts = _batch_intersection_counts(rows, src_dense)
+            stop = False
+            for p, count in zip(batch, counts.tolist()):
+                if n and len(results) >= n:
+                    results.sort(key=lambda q: q.count)
+                    threshold = results[0].count
+                    if threshold < opt.min_threshold or p.count < threshold:
+                        stop = True
+                        break
+                    if count < threshold:
+                        continue
+                    results.pop(0)
+                    results.append(cache_mod.Pair(id=p.id, count=count))
+                    continue
+                if count == 0:
+                    continue
+                if tanimoto:
+                    t = math.ceil(count * 100.0 / (p.count + src_count - count))
+                    if t <= tanimoto:
+                        continue
+                elif count < opt.min_threshold:
+                    continue
+                results.append(cache_mod.Pair(id=p.id, count=count))
+            if stop:
+                break
+        return cache_mod.pairs_sorted(results)
+
+    # -- bulk import (fragment.go:924-989) ------------------------------
+
+    def import_bits(self, row_ids: Sequence[int], column_ids: Sequence[int]) -> None:
+        """Bulk load; WAL detached, one snapshot at the end."""
+        row_ids = np.asarray(row_ids, dtype=np.uint64)
+        column_ids = np.asarray(column_ids, dtype=np.uint64)
+        if len(row_ids) != len(column_ids):
+            raise ValueError("row/column id length mismatch")
+        positions = row_ids * np.uint64(SLICE_WIDTH) + (column_ids % np.uint64(SLICE_WIDTH))
+        self.storage.op_writer = None  # detach WAL during bulk load
+        try:
+            self.storage.add_many(positions)
+        finally:
+            self.storage.op_writer = self._wal
+        self._row_cache.clear()
+        self._checksums.clear()
+        for row_id in np.unique(row_ids):
+            self.cache.bulk_add(int(row_id), self.row_count(int(row_id)))
+        self.cache.recalculate()
+        self.snapshot()
+
+    # -- block checksums & merge (fragment.go:681-920) -------------------
+
+    def checksum(self) -> bytes:
+        """Checksum of the whole fragment: hash of block checksums in order."""
+        h = hashlib.sha1()
+        for block_id, chk in self.blocks():
+            h.update(chk)
+        return h.digest()
+
+    def blocks(self) -> list[tuple[int, bytes]]:
+        """(block id, sha1) for each non-empty block of HASH_BLOCK_SIZE rows."""
+        positions = self.storage.to_array()
+        if len(positions) == 0:
+            return []
+        block_ids = (positions // np.uint64(SLICE_WIDTH * HASH_BLOCK_SIZE)).astype(np.int64)
+        out = []
+        for bid in np.unique(block_ids):
+            bid = int(bid)
+            chk = self._checksums.get(bid)
+            if chk is None:
+                block = positions[block_ids == bid]
+                rel = block - np.uint64(bid * SLICE_WIDTH * HASH_BLOCK_SIZE)
+                chk = hashlib.sha1(rel.astype("<u8").tobytes()).digest()
+                self._checksums[bid] = chk
+            out.append((bid, chk))
+        return out
+
+    def block_data(self, block_id: int) -> tuple[np.ndarray, np.ndarray]:
+        """(row_ids, column_ids) of all bits in a block (fragment.go:785-794)."""
+        start = block_id * HASH_BLOCK_SIZE * SLICE_WIDTH
+        end = (block_id + 1) * HASH_BLOCK_SIZE * SLICE_WIDTH
+        positions = self.storage.slice_values(start, end)
+        rows = positions // np.uint64(SLICE_WIDTH)
+        cols = positions % np.uint64(SLICE_WIDTH)
+        return rows, cols
+
+    def merge_block(
+        self, block_id: int, pair_sets: list[tuple[np.ndarray, np.ndarray]]
+    ) -> list[tuple[tuple[np.ndarray, np.ndarray], tuple[np.ndarray, np.ndarray]]]:
+        """Majority-vote block merge (fragment.go:802-920).
+
+        ``pair_sets[i]`` is node i's (row_ids, column_ids) for this block;
+        pair_sets[0] must be the local node.  A bit is canonical when set on
+        >= (len(pair_sets)+1)//2 nodes.  Returns, per node, the diff
+        ((set_rows, set_cols), (clear_rows, clear_cols)) to converge, and
+        applies the local node's diff to storage.
+        """
+        m = len(pair_sets)
+        majority = (m + 1) // 2
+        pos_sets = []
+        for rows, cols in pair_sets:
+            rows = np.asarray(rows, dtype=np.uint64)
+            cols = np.asarray(cols, dtype=np.uint64)
+            pos_sets.append(rows * np.uint64(SLICE_WIDTH) + cols)
+        all_pos = np.concatenate(pos_sets) if pos_sets else np.empty(0, np.uint64)
+        uniq, counts = np.unique(all_pos, return_counts=True)
+        target = uniq[counts >= majority]
+
+        diffs = []
+        for pos in pos_sets:
+            sets = np.setdiff1d(target, pos)
+            clears = np.setdiff1d(pos, target)
+            diffs.append(
+                (
+                    (sets // np.uint64(SLICE_WIDTH), sets % np.uint64(SLICE_WIDTH)),
+                    (clears // np.uint64(SLICE_WIDTH), clears % np.uint64(SLICE_WIDTH)),
+                )
+            )
+
+        # Apply local diff (node 0) through the normal mutation path.
+        (set_rows, set_cols), (clear_rows, clear_cols) = diffs[0]
+        for r, c in zip(set_rows.tolist(), set_cols.tolist()):
+            self.set_bit(int(r), int(c))
+        for r, c in zip(clear_rows.tolist(), clear_cols.tolist()):
+            self.clear_bit(int(r), int(c))
+        return diffs
+
+    # -- backup payload (fragment.go:1096-1266) --------------------------
+
+    def write_to(self, w) -> int:
+        """Serialize current storage (snapshot format, no pending ops)."""
+        return self.storage.write_to(w)
+
+    def read_from(self, data: bytes) -> None:
+        """Replace contents from a snapshot byte string (restore path)."""
+        self.storage = roaring.Bitmap.from_bytes(data)
+        self.storage.op_n = 0
+        self._row_cache.clear()
+        self._checksums.clear()
+        self.snapshot()
+        self._rebuild_cache()
+
+    def _rebuild_cache(self) -> None:
+        self.cache = cache_mod.new_cache(self.cache_type, self.cache_size)
+        positions = self.storage.to_array()
+        if len(positions):
+            rows, counts = np.unique(positions // np.uint64(SLICE_WIDTH), return_counts=True)
+            for r, c in zip(rows.tolist(), counts.tolist()):
+                self.cache.bulk_add(int(r), int(c))
+        self.cache.recalculate()

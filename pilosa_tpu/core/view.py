@@ -1,0 +1,118 @@
+"""View: a named bitmap matrix within a frame, split into per-slice fragments.
+
+Reference analog: view.go.  Names: "standard", "inverse", and time-quantum
+suffixed forms like "standard_2017" (view.go:31-34).  A view routes global
+column ids to fragments by ``slice = columnID // SLICE_WIDTH``
+(view.go:266-283) and notifies the server (for CreateSliceMessage
+broadcast) when a fragment for a new max slice appears (view.go:219-254).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Optional
+
+from pilosa_tpu.core import cache as cache_mod
+from pilosa_tpu.core.fragment import DEFAULT_CACHE_SIZE, Fragment
+from pilosa_tpu.pilosa import SLICE_WIDTH
+
+VIEW_STANDARD = "standard"
+VIEW_INVERSE = "inverse"
+
+
+def is_valid_view(name: str) -> bool:
+    return name in (VIEW_STANDARD, VIEW_INVERSE)
+
+
+class View:
+    def __init__(
+        self,
+        path: str,
+        index: str,
+        frame: str,
+        name: str,
+        cache_type: str = cache_mod.DEFAULT_CACHE_TYPE,
+        cache_size: int = DEFAULT_CACHE_SIZE,
+        row_attr_store=None,
+        on_new_fragment: Optional[Callable[[str, str, str, int], None]] = None,
+        stats=None,
+    ):
+        self.path = path
+        self.index = index
+        self.frame = frame
+        self.name = name
+        self.cache_type = cache_type
+        self.cache_size = cache_size
+        self.row_attr_store = row_attr_store
+        self.on_new_fragment = on_new_fragment  # broadcast hook (CreateSliceMessage)
+        self.stats = stats
+        self.fragments: dict[int, Fragment] = {}
+
+    # -- lifecycle ------------------------------------------------------
+
+    def open(self) -> None:
+        frag_dir = os.path.join(self.path, "fragments")
+        os.makedirs(frag_dir, exist_ok=True)
+        for entry in sorted(os.listdir(frag_dir)):
+            if not entry.isdigit():
+                continue
+            self._open_fragment(int(entry))
+
+    def close(self) -> None:
+        for f in self.fragments.values():
+            f.close()
+        self.fragments.clear()
+
+    def flush_caches(self) -> None:
+        for f in self.fragments.values():
+            f.flush_cache()
+
+    def fragment_path(self, slice_i: int) -> str:
+        return os.path.join(self.path, "fragments", str(slice_i))
+
+    def _open_fragment(self, slice_i: int) -> Fragment:
+        f = Fragment(
+            self.fragment_path(slice_i),
+            self.index,
+            self.frame,
+            self.name,
+            slice_i,
+            cache_type=self.cache_type,
+            cache_size=self.cache_size,
+            row_attr_store=self.row_attr_store,
+            stats=self.stats,
+        )
+        f.open()
+        self.fragments[slice_i] = f
+        return f
+
+    # -- fragments ------------------------------------------------------
+
+    def fragment(self, slice_i: int) -> Optional[Fragment]:
+        return self.fragments.get(slice_i)
+
+    def create_fragment_if_not_exists(self, slice_i: int) -> Fragment:
+        f = self.fragments.get(slice_i)
+        if f is not None:
+            return f
+        is_new_max = not self.fragments or slice_i > self.max_slice()
+        f = self._open_fragment(slice_i)
+        if is_new_max and self.on_new_fragment is not None:
+            self.on_new_fragment(self.index, self.frame, self.name, slice_i)
+        return f
+
+    def max_slice(self) -> int:
+        return max(self.fragments.keys(), default=0)
+
+    # -- bit ops (view.go:266-283) ---------------------------------------
+
+    def set_bit(self, row_id: int, column_id: int) -> bool:
+        slice_i = column_id // SLICE_WIDTH
+        return self.create_fragment_if_not_exists(slice_i).set_bit(row_id, column_id)
+
+    def clear_bit(self, row_id: int, column_id: int) -> bool:
+        slice_i = column_id // SLICE_WIDTH
+        f = self.fragments.get(slice_i)
+        if f is None:
+            return False
+        return f.clear_bit(row_id, column_id)

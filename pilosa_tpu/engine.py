@@ -1,0 +1,118 @@
+"""Compute engines for batched slice evaluation.
+
+The executor evaluates a PQL bitmap-call tree over a *batch* of slices at
+once: leaves gather dense rows into a ``uint32[n_slices, W]`` matrix and
+set ops/counts apply to the whole stack in one call.  The engine decides
+where that matrix lives:
+
+- `JaxEngine` — jnp arrays on the default JAX backend; fused counts go
+  through pilosa_tpu.ops.dispatch (Pallas on TPU).  This is the production
+  path: one device dispatch per query stage for *all* local slices, the
+  TPU-native replacement for the reference's goroutine-per-slice fan-out
+  (executor.go:1209-1244).
+- `NumpyEngine` — pure numpy; used for tests, TPU-less hosts, and tiny
+  working sets where a device round-trip costs more than the op.
+
+Both satisfy the same small protocol; results surface as numpy.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from pilosa_tpu.roaring import _POPCNT8
+
+
+class NumpyEngine:
+    name = "numpy"
+
+    def stack(self, rows: list[np.ndarray]) -> np.ndarray:
+        return np.stack(rows) if rows else np.zeros((0, 0), dtype=np.uint32)
+
+    def asarray(self, x: np.ndarray):
+        return np.asarray(x)
+
+    def bit_and(self, a, b):
+        return a & b
+
+    def bit_or(self, a, b):
+        return a | b
+
+    def bit_xor(self, a, b):
+        return a ^ b
+
+    def bit_andnot(self, a, b):
+        return a & ~b
+
+    def zeros_like(self, a):
+        return np.zeros_like(a)
+
+    def count(self, batch) -> np.ndarray:
+        """Per-slice popcounts over the last axis (LUT-based, vectorized)."""
+        if batch.size == 0:
+            return np.zeros(batch.shape[:-1], dtype=np.int64)
+        counts = _POPCNT8[np.ascontiguousarray(batch).view(np.uint8)]
+        return counts.reshape(*batch.shape[:-1], -1).sum(axis=-1, dtype=np.int64)
+
+    def batch_intersection_count(self, rows, src) -> np.ndarray:
+        return self.count(rows & src)
+
+    def to_numpy(self, x) -> np.ndarray:
+        return np.asarray(x)
+
+
+class JaxEngine:
+    name = "jax"
+
+    def __init__(self):
+        import jax.numpy as jnp  # deferred so numpy-only paths never init jax
+
+        from pilosa_tpu.ops import dispatch
+
+        self._jnp = jnp
+        self._dispatch = dispatch
+
+    def stack(self, rows: list[np.ndarray]):
+        return self._jnp.asarray(np.stack(rows)) if rows else self._jnp.zeros((0, 0), dtype=self._jnp.uint32)
+
+    def asarray(self, x):
+        return self._jnp.asarray(x)
+
+    def bit_and(self, a, b):
+        return self._jnp.bitwise_and(a, b)
+
+    def bit_or(self, a, b):
+        return self._jnp.bitwise_or(a, b)
+
+    def bit_xor(self, a, b):
+        return self._jnp.bitwise_xor(a, b)
+
+    def bit_andnot(self, a, b):
+        return self._jnp.bitwise_and(a, self._jnp.bitwise_not(b))
+
+    def zeros_like(self, a):
+        return self._jnp.zeros_like(a)
+
+    def count(self, batch) -> np.ndarray:
+        if batch.size == 0:
+            return np.zeros(batch.shape[:-1], dtype=np.int64)
+        return np.asarray(self._dispatch.count(batch)).astype(np.int64)
+
+    def batch_intersection_count(self, rows, src) -> np.ndarray:
+        return np.asarray(self._dispatch.batch_intersection_count(rows, src)).astype(np.int64)
+
+    def to_numpy(self, x) -> np.ndarray:
+        return np.asarray(x)
+
+
+def new_engine(name: str = "auto"):
+    """Engine factory. "auto" honors PILOSA_TPU_ENGINE, defaulting to jax."""
+    if name == "auto":
+        name = os.environ.get("PILOSA_TPU_ENGINE", "jax")
+    if name == "numpy":
+        return NumpyEngine()
+    if name == "jax":
+        return JaxEngine()
+    raise ValueError(f"unknown engine: {name!r}")

@@ -1,0 +1,534 @@
+"""Query executor: PQL call trees over batched, slice-stacked bitmaps.
+
+Reference analog: executor.go (1305 LoC).  The reference maps every call
+over slices with a goroutine per slice and per node (executor.go:1115-1244)
+and reduces channel results.  Here the map phase over *local* slices is a
+single batched evaluation: bitmap leaves gather dense rows into a
+``uint32[n_slices, W]`` stack and each set-op/count applies to the whole
+stack in one engine call (XLA kernel on TPU — the per-slice loop becomes a
+vectorized axis, which is the TPU-native shape of the same mapReduce).
+
+Remote slices (multi-node) go through ``self.cluster`` /
+``self.client_factory`` exactly like the reference's remote exec
+(executor.go:1009-1091): the call tree is forwarded with opt.remote=True
+and the peer executes its own slice batch.
+
+Dispatch table (executor.go:156-179): Bitmap, Intersect, Union,
+Difference, Xor(n/a in reference v0 — kept local), Range, Count, TopN,
+SetBit, ClearBit, SetRowAttrs, SetColumnAttrs.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from datetime import datetime
+from typing import Any, Optional, Sequence
+
+import numpy as np
+
+from pilosa_tpu import pql
+from pilosa_tpu.core import cache as cache_mod
+from pilosa_tpu.core.fragment import TopOptions
+from pilosa_tpu.core import timequantum as tq
+from pilosa_tpu.core.frame import DEFAULT_ROW_LABEL
+from pilosa_tpu.core.index import DEFAULT_COLUMN_LABEL
+from pilosa_tpu.core.view import VIEW_INVERSE, VIEW_STANDARD
+from pilosa_tpu.engine import new_engine
+from pilosa_tpu.pilosa import (
+    ErrFrameNotFound,
+    ErrIndexNotFound,
+    ErrQueryRequired,
+    ErrTooManyWrites,
+    PilosaError,
+    SLICE_WIDTH,
+)
+
+# Frame used when a call doesn't specify one (executor.go:33-35).
+DEFAULT_FRAME = "general"
+
+_WORDS = SLICE_WIDTH // 32
+
+
+@dataclass
+class ExecOptions:
+    """Execution options (executor.go ExecOptions)."""
+
+    remote: bool = False
+    exclude_attrs: bool = False
+
+
+class QueryBitmap:
+    """A bitmap query result: per-slice dense segments + optional attrs.
+
+    Reference analog: bitmap.go's segment-list Bitmap (bitmap.go:27-134).
+    Segments map slice -> uint32[W] packed words in *slice-local* bit
+    positions; global column = slice*SLICE_WIDTH + local position.
+    """
+
+    def __init__(self, segments: Optional[dict[int, np.ndarray]] = None, attrs: Optional[dict] = None):
+        self.segments = segments or {}
+        self.attrs = attrs or {}
+
+    def bits(self) -> list[int]:
+        out = []
+        from pilosa_tpu.ops.bitwise import unpack_positions
+
+        for slice_i in sorted(self.segments):
+            pos = unpack_positions(self.segments[slice_i])
+            out.extend((pos + np.uint64(slice_i * SLICE_WIDTH)).tolist())
+        return out
+
+    def count(self) -> int:
+        from pilosa_tpu.roaring import _POPCNT8
+
+        total = 0
+        for words in self.segments.values():
+            total += int(_POPCNT8[np.ascontiguousarray(words).view(np.uint8)].sum())
+        return total
+
+    def merge(self, other: "QueryBitmap") -> "QueryBitmap":
+        """OR-merge segments (distributed reduce; bitmap.go Merge)."""
+        segs = dict(self.segments)
+        for s, words in other.segments.items():
+            segs[s] = (segs[s] | words) if s in segs else words
+        out = QueryBitmap(segs, dict(self.attrs) or dict(other.attrs))
+        return out
+
+    def to_json(self) -> dict:
+        return {"attrs": self.attrs, "bits": self.bits()}
+
+
+BITMAP_CALLS = frozenset({"Bitmap", "Intersect", "Union", "Difference", "Xor", "Range"})
+
+
+def needs_slices(calls: Sequence[pql.Call]) -> bool:
+    return any(c.name in BITMAP_CALLS or c.name in ("Count", "TopN") for c in calls)
+
+
+class Executor:
+    def __init__(
+        self,
+        holder,
+        engine: str = "auto",
+        cluster=None,
+        client_factory=None,
+        host: str = "",
+        max_writes_per_request: int = 0,
+    ):
+        self.holder = holder
+        self.engine = new_engine(engine) if isinstance(engine, str) else engine
+        self.cluster = cluster  # cluster.Cluster; None = single node
+        self.client_factory = client_factory  # host -> client with .query()
+        self.host = host
+        self.max_writes_per_request = max_writes_per_request
+
+    # -- top level (executor.go:65-153) ----------------------------------
+
+    def execute(
+        self,
+        index: str,
+        query,
+        slices: Optional[Sequence[int]] = None,
+        opt: Optional[ExecOptions] = None,
+    ) -> list[Any]:
+        if isinstance(query, str):
+            query = pql.parse(query)
+        if not query.calls:
+            raise ErrQueryRequired("query required")
+        if self.max_writes_per_request and query.write_call_n() > self.max_writes_per_request:
+            raise ErrTooManyWrites(
+                f"too many write commands: {query.write_call_n()} > {self.max_writes_per_request}"
+            )
+        idx = self.holder.index(index)
+        if idx is None:
+            raise ErrIndexNotFound(index)
+        opt = opt or ExecOptions()
+
+        std_slices = list(slices) if slices else None
+        inv_slices = None
+        if std_slices is None and needs_slices(query.calls):
+            std_slices = list(range(idx.max_slice() + 1))
+            inv_slices = list(range(idx.max_inverse_slice() + 1))
+
+        results = []
+        for call in query.calls:
+            call_slices = std_slices
+            if call.supports_inverse() and std_slices is not None and inv_slices is not None:
+                frame_name = call.string_arg("frame") or DEFAULT_FRAME
+                frame = self.holder.frame(index, frame_name)
+                if frame is None:
+                    raise ErrFrameNotFound(frame_name)
+                if call.is_inverse(frame.row_label, idx.column_label):
+                    call_slices = inv_slices
+            results.append(self._execute_call(index, call, call_slices, opt))
+        return results
+
+    # -- call dispatch (executor.go:156-179) ------------------------------
+
+    def _execute_call(self, index: str, c: pql.Call, slices, opt: ExecOptions) -> Any:
+        if c.name == "Count":
+            return self._execute_count(index, c, slices, opt)
+        if c.name == "TopN":
+            return self._execute_topn(index, c, slices, opt)
+        if c.name == "SetBit":
+            return self._execute_set_bit(index, c, opt)
+        if c.name == "ClearBit":
+            return self._execute_clear_bit(index, c, opt)
+        if c.name == "SetRowAttrs":
+            return self._execute_set_row_attrs(index, c, opt)
+        if c.name in ("SetColumnAttrs", "SetProfileAttrs"):
+            return self._execute_set_column_attrs(index, c, opt)
+        if c.name in BITMAP_CALLS:
+            return self._execute_bitmap_call(index, c, slices, opt)
+        raise PilosaError(f"unknown call: {c.name}")
+
+    # -- bitmap calls ------------------------------------------------------
+
+    def _execute_bitmap_call(self, index: str, c: pql.Call, slices, opt: ExecOptions) -> QueryBitmap:
+        def local_map(local_slices: list[int]) -> QueryBitmap:
+            batch = self._eval_stack(index, c, local_slices)
+            words = self.engine.to_numpy(batch)
+            segs = {
+                s: words[i]
+                for i, s in enumerate(local_slices)
+                if words[i].any()
+            }
+            return QueryBitmap(segs)
+
+        result = self._map_reduce(
+            index, c, slices, opt, local_map, lambda a, b: a.merge(b), QueryBitmap()
+        )
+
+        # Attach attributes at the coordinator (executor.go:166-177).
+        if c.name == "Bitmap" and not opt.remote and not opt.exclude_attrs:
+            idx = self.holder.index(index)
+            frame = self.holder.frame(index, c.string_arg("frame") or DEFAULT_FRAME)
+            if frame is not None:
+                try:
+                    row_id, row_ok = c.uint_arg(frame.row_label)
+                    col_id, col_ok = c.uint_arg(idx.column_label)
+                except TypeError:
+                    row_ok = col_ok = False
+                if row_ok:
+                    result.attrs = frame.row_attr_store.attrs(row_id) or {}
+                elif col_ok:
+                    result.attrs = idx.column_attr_store.attrs(col_id) or {}
+        return result
+
+    def _eval_stack(self, index: str, c: pql.Call, slices: list[int]):
+        """Evaluate a bitmap call tree to an engine batch uint32[k, W]."""
+        if c.name == "Bitmap":
+            return self._eval_bitmap_leaf(index, c, slices)
+        if c.name == "Range":
+            return self._eval_range(index, c, slices)
+        children = [self._eval_stack(index, ch, slices) for ch in c.children]
+        if c.name == "Intersect":
+            if not children:
+                raise PilosaError("empty Intersect query is currently not supported")
+            out = children[0]
+            for ch in children[1:]:
+                out = self.engine.bit_and(out, ch)
+            return out
+        if c.name == "Union":
+            if not children:
+                return self.engine.asarray(np.zeros((len(slices), _WORDS), dtype=np.uint32))
+            out = children[0]
+            for ch in children[1:]:
+                out = self.engine.bit_or(out, ch)
+            return out
+        if c.name == "Difference":
+            if not children:
+                raise PilosaError("empty Difference query is currently not supported")
+            out = children[0]
+            for ch in children[1:]:
+                out = self.engine.bit_andnot(out, ch)
+            return out
+        if c.name == "Xor":
+            if not children:
+                raise PilosaError("empty Xor query is currently not supported")
+            out = children[0]
+            for ch in children[1:]:
+                out = self.engine.bit_xor(out, ch)
+            return out
+        raise PilosaError(f"unknown bitmap call: {c.name}")
+
+    def _resolve_bitmap_leaf(self, index: str, c: pql.Call) -> tuple[str, str, int]:
+        """(frame, view, id) for a Bitmap() leaf (executor.go:428-473)."""
+        idx = self.holder.index(index)
+        if idx is None:
+            raise ErrIndexNotFound(index)
+        frame_name = c.string_arg("frame") or DEFAULT_FRAME
+        frame = self.holder.frame(index, frame_name)
+        if frame is None:
+            raise ErrFrameNotFound(frame_name)
+        row_id, row_ok = c.uint_arg(frame.row_label)
+        col_id, col_ok = c.uint_arg(idx.column_label)
+        if row_ok and col_ok:
+            raise PilosaError(
+                f"Bitmap() cannot specify both {frame.row_label} and {idx.column_label} values"
+            )
+        if not row_ok and not col_ok:
+            raise PilosaError(
+                f"Bitmap() must specify either {frame.row_label} or {idx.column_label} values"
+            )
+        if col_ok:
+            if not frame.inverse_enabled:
+                raise PilosaError("Bitmap() cannot retrieve columns unless inverse storage enabled")
+            return frame_name, VIEW_INVERSE, col_id
+        return frame_name, VIEW_STANDARD, row_id
+
+    def _gather_rows(self, index: str, frame: str, view: str, row_id: int, slices: list[int]):
+        rows = []
+        zeros = None
+        for s in slices:
+            frag = self.holder.fragment(index, frame, view, s)
+            if frag is None:
+                if zeros is None:
+                    zeros = np.zeros(_WORDS, dtype=np.uint32)
+                rows.append(zeros)
+            else:
+                rows.append(frag.row_dense(row_id))
+        return self.engine.stack(rows)
+
+    def _eval_bitmap_leaf(self, index: str, c: pql.Call, slices: list[int]):
+        frame, view, id = self._resolve_bitmap_leaf(index, c)
+        return self._gather_rows(index, frame, view, id, slices)
+
+    def _eval_range(self, index: str, c: pql.Call, slices: list[int]):
+        """Range(): union of time-view rows covering [start, end)
+        (executor.go:498-554)."""
+        frame_name = c.string_arg("frame") or DEFAULT_FRAME
+        frame = self.holder.frame(index, frame_name)
+        if frame is None:
+            raise ErrFrameNotFound(frame_name)
+        row_id, ok = c.uint_arg(frame.row_label)
+        if not ok:
+            raise PilosaError(f"Range() {frame.row_label} required")
+        start_s = c.string_arg("start")
+        end_s = c.string_arg("end")
+        if not start_s:
+            raise PilosaError("Range() start time required")
+        if not end_s:
+            raise PilosaError("Range() end time required")
+        try:
+            start = datetime.strptime(start_s, pql.TIME_FORMAT)
+            end = datetime.strptime(end_s, pql.TIME_FORMAT)
+        except ValueError:
+            raise PilosaError("cannot parse Range() time")
+        out = self.engine.asarray(np.zeros((len(slices), _WORDS), dtype=np.uint32))
+        if not frame.time_quantum:
+            return out
+        for view in tq.views_by_time_range(VIEW_STANDARD, start, end, frame.time_quantum):
+            out = self.engine.bit_or(out, self._gather_rows(index, frame_name, view, row_id, slices))
+        return out
+
+    # -- Count (executor.go:576-605) ---------------------------------------
+
+    def _execute_count(self, index: str, c: pql.Call, slices, opt: ExecOptions) -> int:
+        if len(c.children) == 0:
+            raise PilosaError("Count() requires an input bitmap")
+        if len(c.children) > 1:
+            raise PilosaError("Count() only accepts a single bitmap input")
+
+        def local_map(local_slices: list[int]) -> int:
+            batch = self._eval_stack(index, c.children[0], local_slices)
+            return int(self.engine.count(batch).sum())
+
+        return self._map_reduce(index, c, slices, opt, local_map, lambda a, b: a + b, 0)
+
+    # -- TopN (executor.go:281-404) ----------------------------------------
+
+    def _execute_topn(self, index: str, c: pql.Call, slices, opt: ExecOptions) -> list[cache_mod.Pair]:
+        row_ids, _ = c.uint_slice_arg("ids")
+        n, _ = c.uint_arg("n")
+        pairs = self._execute_topn_slices(index, c, slices, opt)
+        if not pairs or row_ids or opt.remote:
+            return pairs
+        # Phase 2: coordinator refetches exact counts for the merged id set
+        # across all slices, then truncates (executor.go:299-317).
+        other = c.clone()
+        other.args["ids"] = sorted(p.id for p in pairs)
+        trimmed = self._execute_topn_slices(index, other, slices, opt)
+        if n:
+            trimmed = trimmed[:n]
+        return trimmed
+
+    def _execute_topn_slices(self, index: str, c: pql.Call, slices, opt: ExecOptions) -> list[cache_mod.Pair]:
+        def local_map(local_slices: list[int]) -> list[cache_mod.Pair]:
+            return self._topn_local(index, c, local_slices)
+
+        pairs = self._map_reduce(index, c, slices, opt, local_map, cache_mod.pairs_add, [])
+        return cache_mod.pairs_sorted(pairs)
+
+    def _topn_local(self, index: str, c: pql.Call, slices: list[int]) -> list[cache_mod.Pair]:
+        frame_name = c.string_arg("frame") or DEFAULT_FRAME
+        n, _ = c.uint_arg("n")
+        field = c.string_arg("field")
+        row_ids, _ = c.uint_slice_arg("ids")
+        min_threshold, _ = c.uint_arg("threshold")
+        filters = c.args.get("filters") or []
+        tanimoto, _ = c.uint_arg("tanimotoThreshold")
+
+        src_batch = None
+        if c.children:
+            if len(c.children) > 1:
+                raise PilosaError("TopN() can only have one input bitmap")
+            src_batch = self.engine.to_numpy(self._eval_stack(index, c.children[0], slices))
+
+        merged: list[cache_mod.Pair] = []
+        for i, s in enumerate(slices):
+            frag = self.holder.fragment(index, frame_name, VIEW_STANDARD, s)
+            if frag is None:
+                continue
+            topt = TopOptions(
+                n=int(n),
+                src_dense=src_batch[i] if src_batch is not None else None,
+                row_ids=row_ids,
+                min_threshold=int(min_threshold),
+                filter_field=field,
+                filter_values=filters,
+                tanimoto_threshold=int(tanimoto),
+            )
+            merged = cache_mod.pairs_add(merged, frag.top(topt))
+        return merged
+
+    # -- writes (executor.go:702-805) --------------------------------------
+
+    def _set_bit_args(self, index: str, c: pql.Call):
+        idx = self.holder.index(index)
+        if idx is None:
+            raise ErrIndexNotFound(index)
+        frame_name = c.string_arg("frame")
+        if not frame_name:
+            raise PilosaError(f"{c.name}() field 'frame' required")
+        frame = self.holder.frame(index, frame_name)
+        if frame is None:
+            raise ErrFrameNotFound(frame_name)
+        row_id, ok = c.uint_arg(frame.row_label)
+        if not ok:
+            raise PilosaError(f"{c.name}() field '{frame.row_label}' required")
+        col_id, ok = c.uint_arg(idx.column_label)
+        if not ok:
+            raise PilosaError(f"{c.name}() field '{idx.column_label}' required")
+        timestamp = None
+        ts = c.string_arg("timestamp")
+        if ts:
+            timestamp = datetime.strptime(ts, pql.TIME_FORMAT)
+        return frame, row_id, col_id, timestamp
+
+    def _execute_set_bit(self, index: str, c: pql.Call, opt: ExecOptions) -> bool:
+        frame, row_id, col_id, timestamp = self._set_bit_args(index, c)
+        changed = frame.set_bit(VIEW_STANDARD, row_id, col_id, timestamp)
+        if frame.inverse_enabled:
+            if frame.set_bit(VIEW_INVERSE, col_id, row_id, timestamp):
+                changed = True
+        if not opt.remote:
+            changed = self._forward_write(index, c, col_id, changed, opt)
+        return changed
+
+    def _execute_clear_bit(self, index: str, c: pql.Call, opt: ExecOptions) -> bool:
+        frame, row_id, col_id, _ = self._set_bit_args(index, c)
+        changed = frame.clear_bit(VIEW_STANDARD, row_id, col_id)
+        if frame.inverse_enabled:
+            if frame.clear_bit(VIEW_INVERSE, col_id, row_id):
+                changed = True
+        if not opt.remote:
+            changed = self._forward_write(index, c, col_id, changed, opt)
+        return changed
+
+    def _forward_write(self, index: str, c: pql.Call, col_id: int, changed: bool, opt) -> bool:
+        """Forward a bit write to the other owners of its slice
+        (executor.go:780-805).  No-op on single-node clusters."""
+        if self.cluster is None or self.client_factory is None:
+            return changed
+        slice_i = col_id // SLICE_WIDTH
+        for node in self.cluster.fragment_nodes(index, slice_i):
+            if node.host == self.host:
+                continue
+            client = self.client_factory(node.host)
+            res = client.execute_remote(index, pql.Query(calls=[c]))
+            if res and res[0]:
+                changed = True
+        return changed
+
+    # -- attrs (executor.go:808-1006) --------------------------------------
+
+    def _execute_set_row_attrs(self, index: str, c: pql.Call, opt: ExecOptions) -> None:
+        frame_name = c.string_arg("frame")
+        if not frame_name:
+            raise PilosaError("SetRowAttrs() frame required")
+        frame = self.holder.frame(index, frame_name)
+        if frame is None:
+            raise ErrFrameNotFound(frame_name)
+        row_id, ok = c.uint_arg(frame.row_label)
+        if not ok:
+            raise PilosaError(f"SetRowAttrs() row field '{frame.row_label}' required")
+        attrs = dict(c.args)
+        attrs.pop("frame", None)
+        attrs.pop(frame.row_label, None)
+        frame.row_attr_store.set_attrs(row_id, attrs)
+        if not opt.remote:
+            self._broadcast_attrs(index, c)
+        return None
+
+    def _execute_set_column_attrs(self, index: str, c: pql.Call, opt: ExecOptions) -> None:
+        idx = self.holder.index(index)
+        if idx is None:
+            raise ErrIndexNotFound(index)
+        col_id, ok = c.uint_arg(idx.column_label)
+        if not ok:
+            raise PilosaError(f"SetColumnAttrs() field '{idx.column_label}' required")
+        attrs = dict(c.args)
+        attrs.pop(idx.column_label, None)
+        attrs.pop("frame", None)
+        idx.column_attr_store.set_attrs(col_id, attrs)
+        if not opt.remote:
+            self._broadcast_attrs(index, c)
+        return None
+
+    def _broadcast_attrs(self, index: str, c: pql.Call) -> None:
+        """Attr writes go to every node (executor.go:845-861)."""
+        if self.cluster is None or self.client_factory is None:
+            return
+        for node in self.cluster.nodes:
+            if node.host == self.host:
+                continue
+            self.client_factory(node.host).execute_remote(index, pql.Query(calls=[c]))
+
+    # -- mapReduce (executor.go:1115-1244) ----------------------------------
+
+    def _map_reduce(self, index: str, c: pql.Call, slices, opt: ExecOptions, local_map, reduce_fn, zero):
+        """Fan the call out over slice owners and reduce.
+
+        Local slices evaluate as ONE batched computation (local_map gets the
+        whole list); remote nodes get the call forwarded once each with
+        their slice list, mirroring the reference's per-node batching.
+        """
+        slices = list(slices or [])
+        if self.cluster is None or opt.remote or self.client_factory is None:
+            return reduce_fn(zero, local_map(slices)) if slices else reduce_fn(zero, local_map([]))
+
+        by_node = self.cluster.slices_by_node(index, slices, exclude_down=True)
+        result = zero
+        errors: list[Exception] = []
+        import concurrent.futures
+
+        def run_node(node, node_slices):
+            if node.host == self.host:
+                return local_map(node_slices)
+            client = self.client_factory(node.host)
+            return client.execute_remote_call(index, c, node_slices)
+
+        with concurrent.futures.ThreadPoolExecutor(max_workers=max(1, len(by_node))) as pool:
+            futs = {
+                pool.submit(run_node, node, node_slices): node
+                for node, node_slices in by_node.items()
+            }
+            for fut in concurrent.futures.as_completed(futs):
+                try:
+                    result = reduce_fn(result, fut.result())
+                except Exception as e:  # node failure → surface (retry in cluster layer)
+                    errors.append(e)
+        if errors:
+            raise errors[0]
+        return result

@@ -1,0 +1,17 @@
+"""Distribution: slice-axis sharding over a device mesh + cluster placement.
+
+Reference analog: the scatter-gather half of executor.go (mapReduce,
+executor.go:1115-1244) and cluster.go.  Inside one host/pod, the
+goroutine-per-slice fan-out becomes GSPMD: bitmap stacks are sharded along
+a ``slice`` mesh axis and XLA inserts the ICI collectives (psum for Count,
+all_gather for bitmap materialization, top-k merge for TopN).  Across
+hosts, placement stays hash-ring based (pilosa_tpu.cluster) with
+HTTP-forwarded remote execution, mirroring the reference's data plane.
+"""
+
+from pilosa_tpu.parallel.sharded import (  # noqa: F401
+    SliceMesh,
+    sharded_count_and,
+    sharded_count_call,
+    sharded_union_reduce,
+)

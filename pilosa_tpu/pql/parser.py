@@ -1,0 +1,208 @@
+"""PQL tokenizer + recursive-descent parser.
+
+Reference analog: pql/scanner.go + pql/parser.go.  Token inventory matches
+pql/token.go:22-46 (IDENT STRING INTEGER FLOAT EQ COMMA LPAREN RPAREN
+LBRACK RBRACK); the grammar matches parser.go:66-260:
+
+    query    := call*
+    call     := IDENT '(' children? args? ')'
+    children := call (',' call)*          (children precede args)
+    args     := IDENT '=' value (',' IDENT '=' value)*
+    value    := IDENT | STRING | INTEGER | FLOAT | '[' list ']'
+
+``true``/``false``/``null`` idents become Python True/False/None; other
+bare idents become strings (parser.go:172-183).  Identifiers may contain
+letters, digits, ``_ - .`` after a leading letter (scanner.go:274-280);
+numbers are integers or single-dot floats with optional leading minus
+(scanner.go:155-180).
+
+This implementation is a regex tokenizer + index-cursor parser (the
+Python-native shape) rather than a rune scanner with unread stacks.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import Any, Optional
+
+from pilosa_tpu.pql.ast import Call, Query
+
+
+class ParseError(Exception):
+    def __init__(self, message: str, line: int = 0, char: int = 0):
+        super().__init__(f"{message} (line {line}, char {char})")
+        self.message = message
+        self.line = line
+        self.char = char
+
+
+_TOKEN_RE = re.compile(
+    r"""
+    (?P<WS>\s+)
+  | (?P<IDENT>[A-Za-z][A-Za-z0-9_.-]*)
+  | (?P<FLOAT>-?\d+\.\d*|-?\.\d+)
+  | (?P<INTEGER>-?\d+)
+  | (?P<STRING>"(?:[^"\\]|\\.)*"|'(?:[^'\\]|\\.)*')
+  | (?P<EQ>=)
+  | (?P<COMMA>,)
+  | (?P<LPAREN>\()
+  | (?P<RPAREN>\))
+  | (?P<LBRACK>\[)
+  | (?P<RBRACK>\])
+  | (?P<ILLEGAL>.)
+    """,
+    re.VERBOSE | re.DOTALL,
+)
+
+
+@dataclass(frozen=True)
+class Token:
+    kind: str
+    lit: str
+    line: int
+    char: int
+
+
+def tokenize(src: str) -> list[Token]:
+    tokens: list[Token] = []
+    line, char = 1, 0
+    for m in _TOKEN_RE.finditer(src):
+        kind = m.lastgroup
+        lit = m.group()
+        tline, tchar = line, char
+        nl = lit.count("\n")
+        if nl:
+            line += nl
+            char = len(lit) - lit.rfind("\n") - 1
+        else:
+            char += len(lit)
+        if kind == "WS":
+            continue
+        if kind == "ILLEGAL":
+            raise ParseError(f"illegal character {lit!r}", tline, tchar)
+        if kind == "STRING":
+            lit = re.sub(r"\\(.)", r"\1", lit[1:-1])
+        tokens.append(Token(kind, lit, tline, tchar))
+    tokens.append(Token("EOF", "", line, char))
+    return tokens
+
+
+class _Parser:
+    def __init__(self, tokens: list[Token]):
+        self.tokens = tokens
+        self.i = 0
+
+    def peek(self) -> Token:
+        return self.tokens[self.i]
+
+    def next(self) -> Token:
+        t = self.tokens[self.i]
+        if t.kind != "EOF":
+            self.i += 1
+        return t
+
+    def expect(self, kind: str) -> Token:
+        t = self.next()
+        if t.kind != kind:
+            raise ParseError(f"expected {kind}, found {t.lit!r}", t.line, t.char)
+        return t
+
+    def parse_query(self) -> Query:
+        calls = []
+        while self.peek().kind != "EOF":
+            calls.append(self.parse_call())
+        return Query(calls=calls)
+
+    def parse_call(self) -> Call:
+        name_tok = self.next()
+        if name_tok.kind != "IDENT":
+            raise ParseError(
+                f"expected identifier, found: {name_tok.lit!r}", name_tok.line, name_tok.char
+            )
+        self.expect("LPAREN")
+        children = self.parse_children()
+        args: dict[str, Any] = {}
+        if self.peek().kind != "RPAREN":
+            if children and self.peek().kind == "COMMA":
+                self.next()
+            args = self.parse_args()
+        self.expect("RPAREN")
+        return Call(name=name_tok.lit, args=args, children=children)
+
+    def parse_children(self) -> list[Call]:
+        children: list[Call] = []
+        while (
+            self.peek().kind == "IDENT"
+            and self.i + 1 < len(self.tokens)
+            and self.tokens[self.i + 1].kind == "LPAREN"
+        ):
+            children.append(self.parse_call())
+            if self.peek().kind == "COMMA":
+                # Only consume the comma if another child follows; otherwise
+                # leave it for the args transition in parse_call.
+                if (
+                    self.i + 1 < len(self.tokens)
+                    and self.tokens[self.i + 1].kind == "IDENT"
+                    and self.i + 2 < len(self.tokens)
+                    and self.tokens[self.i + 2].kind == "LPAREN"
+                ):
+                    self.next()
+                else:
+                    break
+            else:
+                break
+        return children
+
+    def parse_args(self) -> dict[str, Any]:
+        args: dict[str, Any] = {}
+        while True:
+            if self.peek().kind == "RPAREN":
+                return args
+            key_tok = self.expect("IDENT")
+            eq = self.next()
+            if eq.kind != "EQ":
+                raise ParseError(f"expected equals sign, found {eq.lit!r}", eq.line, eq.char)
+            value = self.parse_value()
+            if key_tok.lit in args:
+                raise ParseError(
+                    f"argument key already used: {key_tok.lit}", key_tok.line, key_tok.char
+                )
+            args[key_tok.lit] = value
+            t = self.peek()
+            if t.kind == "RPAREN":
+                return args
+            if t.kind != "COMMA":
+                raise ParseError(f"expected comma or right paren, found {t.lit!r}", t.line, t.char)
+            self.next()
+
+    def parse_value(self, in_list: bool = False) -> Any:
+        t = self.next()
+        if t.kind == "IDENT":
+            if t.lit == "true":
+                return True
+            if t.lit == "false":
+                return False
+            if t.lit == "null" and not in_list:
+                return None
+            return t.lit
+        if t.kind == "STRING":
+            return t.lit
+        if t.kind == "INTEGER":
+            return int(t.lit)
+        if t.kind == "FLOAT":
+            return float(t.lit)
+        if t.kind == "LBRACK" and not in_list:
+            values = []
+            while True:
+                values.append(self.parse_value(in_list=True))
+                sep = self.next()
+                if sep.kind == "RBRACK":
+                    return values
+                if sep.kind != "COMMA":
+                    raise ParseError(f"expected comma, found {sep.lit!r}", sep.line, sep.char)
+        raise ParseError(f"invalid argument value: {t.lit!r}", t.line, t.char)
+
+
+def parse(src: str) -> Query:
+    return _Parser(tokenize(src)).parse_query()

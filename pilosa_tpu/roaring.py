@@ -258,12 +258,15 @@ class Bitmap:
         values = np.unique(values)
         keys = (values >> np.uint64(16)).astype(np.int64)
         added = 0
-        for key in np.unique(keys):
-            lows = (values[keys == key] & np.uint64(0xFFFF)).astype(np.uint32)
-            c = self.containers.get(int(key))
+        # values is sorted, so per-key groups are contiguous: one pass.
+        uniq_keys, starts = np.unique(keys, return_index=True)
+        groups = np.split(values, starts[1:])
+        for key, group in zip(uniq_keys.tolist(), groups):
+            lows = (group & np.uint64(0xFFFF)).astype(np.uint32)
+            c = self.containers.get(key)
             if c is None:
                 c = Container.from_values(lows)
-                self.containers[int(key)] = c
+                self.containers[key] = c
                 added += c.n
             else:
                 added += c.add_many(lows)
@@ -432,11 +435,13 @@ class Bitmap:
 
         The bridge to the TPU side: a fragment row becomes
         to_dense_words(row*SLICE_WIDTH, SLICE_WIDTH) → uint32[32768].
-        Requires container-aligned start (multiple of 2^16).
+        Requires container-aligned start and n_bits (multiples of 2^16).
         """
         if start & 0xFFFF:
             raise ValueError("start must be container-aligned")
-        n_words = (n_bits + 31) // 32
+        if n_bits <= 0 or n_bits & 0xFFFF:
+            raise ValueError("n_bits must be a positive multiple of 2^16")
+        n_words = n_bits // 32
         out = np.zeros(n_words, dtype=np.uint32)
         k0, k1 = highbits(start), highbits(start + n_bits - 1)
         for key in self.containers.keys():
@@ -518,16 +523,18 @@ class Bitmap:
         ops_offset = HEADER_SIZE + n * 16
         for i in range(n):
             key, cnt, off = int(keys[i]), int(counts[i]), int(offsets[i])
-            if off >= len(data):
-                raise ValueError(f"offset out of bounds: off={off}, len={len(data)}")
+            payload = cnt * 4 if cnt <= ARRAY_MAX_SIZE else BITMAP_N * 8
+            if off >= len(data) or off + payload > len(data):
+                raise ValueError(
+                    f"container payload out of bounds: off={off}, need={payload}, len={len(data)}"
+                )
             if cnt <= ARRAY_MAX_SIZE:
-                arr = np.frombuffer(data[off : off + cnt * 4], dtype="<u4").astype(np.uint32)
+                arr = np.frombuffer(data[off : off + payload], dtype="<u4").astype(np.uint32)
                 bm.containers[key] = Container(array=arr)
-                ops_offset = off + cnt * 4
             else:
-                words = np.frombuffer(data[off : off + BITMAP_N * 8], dtype="<u8").astype(np.uint64)
+                words = np.frombuffer(data[off : off + payload], dtype="<u8").astype(np.uint64)
                 bm.containers[key] = Container(bitmap=words)
-                ops_offset = off + BITMAP_N * 8
+            ops_offset = off + payload
         # Trailing op log (roaring.go:590-611).
         buf = data[ops_offset:]
         while buf:
@@ -550,9 +557,18 @@ def _c_copy(c: Container) -> Container:
     )
 
 
+def _c_from_words(words: np.ndarray) -> Container:
+    """Wrap a computed dense word array, demoting to an array container only
+    when small (no unpack/repack round trip for dense results)."""
+    n = _popcount_words(words)
+    if n > ARRAY_MAX_SIZE:
+        return Container(bitmap=words)
+    return Container(array=_bitmap_to_values(words))
+
+
 def _c_intersect(a: Container, b: Container) -> Container:
     if a.bitmap is not None and b.bitmap is not None:
-        return Container.from_values(_bitmap_to_values(a.bitmap & b.bitmap))
+        return _c_from_words(a.bitmap & b.bitmap)
     if a.is_array and b.is_array:
         return Container(array=np.intersect1d(a.array, b.array).astype(np.uint32))
     arr, bmp = (a, b) if a.is_array else (b, a)
@@ -579,9 +595,7 @@ def _c_union(a: Container, b: Container) -> Container:
 
 def _c_difference(a: Container, b: Container) -> Container:
     if a.bitmap is not None and b.bitmap is not None:
-        return Container.from_values(
-            _bitmap_to_values(a.bitmap & ~b.bitmap)
-        )
+        return _c_from_words(a.bitmap & ~b.bitmap)
     if a.is_array:
         if b.is_array:
             return Container(array=np.setdiff1d(a.array, b.array).astype(np.uint32))
@@ -592,7 +606,7 @@ def _c_difference(a: Container, b: Container) -> Container:
     out = a.bitmap.copy()
     v = b.array.astype(np.int64)
     np.bitwise_and.at(out, v >> 6, ~(np.uint64(1) << (v & 63).astype(np.uint64)))
-    return Container.from_values(_bitmap_to_values(out))
+    return _c_from_words(out)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,236 @@
+"""Holder/Index/Frame/View + time quantum + attr store tests.
+
+Reference analogs: holder_test.go, index_test.go, frame_test.go,
+view_test.go, time_test.go, attr_test.go.
+"""
+
+from datetime import datetime
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.core import timequantum as tq
+from pilosa_tpu.core.attr import ATTR_BLOCK_SIZE, AttrStore, blocks_diff
+from pilosa_tpu.core.frame import FrameOptions
+from pilosa_tpu.core.holder import Holder
+from pilosa_tpu.core.index import IndexOptions
+from pilosa_tpu.core.view import VIEW_INVERSE, VIEW_STANDARD
+from pilosa_tpu.pilosa import (
+    ErrColumnRowLabelEqual,
+    ErrFrameExists,
+    ErrIndexExists,
+    SLICE_WIDTH,
+)
+
+
+@pytest.fixture
+def holder(tmp_path):
+    h = Holder(str(tmp_path / "data"))
+    h.open()
+    yield h
+    h.close()
+
+
+def test_holder_create_and_reopen(tmp_path, holder):
+    idx = holder.create_index("i0")
+    f = idx.create_frame("f0", FrameOptions())
+    f.set_bit(VIEW_STANDARD, 10, 100)
+    f.set_bit(VIEW_STANDARD, 10, SLICE_WIDTH + 5)  # second slice
+    holder.close()
+
+    h2 = Holder(holder.path)
+    h2.open()
+    assert sorted(h2.indexes.keys()) == ["i0"]
+    frag0 = h2.fragment("i0", "f0", VIEW_STANDARD, 0)
+    frag1 = h2.fragment("i0", "f0", VIEW_STANDARD, 1)
+    assert frag0.contains(10, 100)
+    assert frag1.contains(10, SLICE_WIDTH + 5)
+    assert h2.index("i0").max_slice() == 1
+    h2.close()
+
+
+def test_holder_schema_and_errors(holder):
+    idx = holder.create_index("aaa", IndexOptions(column_label="col"))
+    idx.create_frame("fr", FrameOptions(row_label="row", time_quantum="YM"))
+    with pytest.raises(ErrIndexExists):
+        holder.create_index("aaa")
+    with pytest.raises(ErrFrameExists):
+        idx.create_frame("fr", FrameOptions())
+    schema = holder.schema()
+    assert schema[0]["name"] == "aaa"
+    assert schema[0]["columnLabel"] == "col"
+    assert schema[0]["frames"][0]["timeQuantum"] == "YM"
+
+
+def test_row_column_label_collision(holder):
+    idx = holder.create_index("i", IndexOptions(column_label="thing"))
+    with pytest.raises(ErrColumnRowLabelEqual):
+        idx.create_frame("f", FrameOptions(row_label="thing"))
+
+
+def test_frame_inverse_and_time_views(holder):
+    idx = holder.create_index("i")
+    f = idx.create_frame("f", FrameOptions(inverse_enabled=True, time_quantum="YMDH"))
+    ts = datetime(2017, 3, 2, 15)
+    f.set_bit(VIEW_STANDARD, 1, 2, timestamp=ts)
+    f.set_bit(VIEW_INVERSE, 2, 1, timestamp=ts)
+    names = set(f.views.keys())
+    assert {
+        "standard",
+        "inverse",
+        "standard_2017",
+        "standard_201703",
+        "standard_20170302",
+        "standard_2017030215",
+        "inverse_2017",
+    } <= names
+    assert f.view("standard_201703").fragment(0).contains(1, 2)
+    assert f.view(VIEW_INVERSE).fragment(0).contains(2, 1)
+
+
+def test_frame_import_with_inverse_and_time(holder):
+    idx = holder.create_index("i")
+    f = idx.create_frame("f", FrameOptions(inverse_enabled=True, time_quantum="Y"))
+    ts = datetime(2018, 6, 1)
+    f.import_bits([1, 2], [10, SLICE_WIDTH + 20], [ts, None])
+    assert f.view(VIEW_STANDARD).fragment(0).contains(1, 10)
+    assert f.view(VIEW_STANDARD).fragment(1).contains(2, SLICE_WIDTH + 20)
+    # inverse transposed: row=col, col=row
+    assert f.view(VIEW_INVERSE).fragment(0).contains(10, 1)
+    assert f.view(VIEW_INVERSE).fragment(0).contains(SLICE_WIDTH + 20, 2)
+    # time view only for the timestamped bit
+    assert f.view("standard_2018").fragment(0).contains(1, 10)
+    assert f.view("standard_2018").fragment(1) is None
+
+
+def test_frame_meta_persistence(tmp_path, holder):
+    idx = holder.create_index("i")
+    f = idx.create_frame(
+        "f", FrameOptions(row_label="rid", cache_type="ranked", cache_size=123, time_quantum="YM")
+    )
+    holder.close()
+    h2 = Holder(holder.path)
+    h2.open()
+    f2 = h2.frame("i", "f")
+    assert f2.row_label == "rid"
+    assert f2.cache_type == "ranked"
+    assert f2.cache_size == 123
+    assert f2.time_quantum == "YM"
+    h2.close()
+
+
+def test_new_fragment_hook(holder):
+    events = []
+    holder.on_new_fragment = lambda *a: events.append(a)
+    idx = holder.create_index("i")
+    f = idx.create_frame("f", FrameOptions())
+    f.set_bit(VIEW_STANDARD, 0, 0)
+    f.set_bit(VIEW_STANDARD, 0, 2 * SLICE_WIDTH + 1)
+    assert ("i", "f", VIEW_STANDARD, 0) in events
+    assert ("i", "f", VIEW_STANDARD, 2) in events
+
+
+def test_remote_max_slice(holder):
+    idx = holder.create_index("i")
+    assert idx.max_slice() == 0
+    idx.set_remote_max_slice(7)
+    assert idx.max_slice() == 7
+    idx.set_remote_max_slice(3)  # never decreases
+    assert idx.max_slice() == 7
+
+
+# -- time quantum -----------------------------------------------------------
+
+
+def test_views_by_time():
+    t = datetime(2017, 4, 9, 12)
+    assert tq.views_by_time("standard", t, "YMDH") == [
+        "standard_2017",
+        "standard_201704",
+        "standard_20170409",
+        "standard_2017040912",
+    ]
+
+
+def test_views_by_time_range_ymdh():
+    # Reference time_test.go style: partial-hour → day → month spans.
+    got = tq.views_by_time_range(
+        "std", datetime(2017, 1, 31, 22), datetime(2017, 2, 2, 2), "YMDH"
+    )
+    assert got == [
+        "std_2017013122",
+        "std_2017013123",
+        "std_20170201",
+        "std_2017020200",
+        "std_2017020201",
+    ]
+
+
+def test_views_by_time_range_year_span():
+    got = tq.views_by_time_range("std", datetime(2016, 11, 1), datetime(2018, 2, 1), "YMDH")
+    assert got == ["std_201611", "std_201612", "std_2017", "std_201801"]
+
+
+def test_views_by_time_range_only_days():
+    got = tq.views_by_time_range("std", datetime(2017, 5, 1), datetime(2017, 5, 4), "D")
+    assert got == ["std_20170501", "std_20170502", "std_20170503"]
+
+
+def test_parse_time_quantum():
+    from pilosa_tpu.pilosa import ErrInvalidTimeQuantum
+
+    assert tq.parse_time_quantum("ymdh") == "YMDH"
+    assert tq.parse_time_quantum("") == ""
+    with pytest.raises(ErrInvalidTimeQuantum):
+        tq.parse_time_quantum("XY")
+
+
+# -- attr store -------------------------------------------------------------
+
+
+@pytest.fixture
+def store(tmp_path):
+    s = AttrStore(str(tmp_path / "attrs.db"))
+    s.open()
+    yield s
+    s.close()
+
+
+def test_attr_set_get_merge(store):
+    assert store.attrs(1) is None
+    store.set_attrs(1, {"name": "alice", "n": 3, "ok": True, "x": 1.5})
+    assert store.attrs(1) == {"name": "alice", "n": 3, "ok": True, "x": 1.5}
+    store.set_attrs(1, {"n": 4, "name": None})  # merge + delete
+    assert store.attrs(1) == {"n": 4, "ok": True, "x": 1.5}
+
+
+def test_attr_persistence(tmp_path):
+    s = AttrStore(str(tmp_path / "a.db"))
+    s.open()
+    s.set_attrs(42, {"v": "x"})
+    s.close()
+    s2 = AttrStore(s.path)
+    s2.open()
+    assert s2.attrs(42) == {"v": "x"}
+    s2.close()
+
+
+def test_attr_rejects_bad_types(store):
+    with pytest.raises(TypeError):
+        store.set_attrs(1, {"bad": [1, 2]})
+
+
+def test_attr_blocks_and_diff(store, tmp_path):
+    store.set_attrs(1, {"a": 1})
+    store.set_attrs(ATTR_BLOCK_SIZE + 1, {"b": 2})
+    blocks = store.blocks()
+    assert [b for b, _ in blocks] == [0, 1]
+
+    other = AttrStore(str(tmp_path / "other.db"))
+    other.open()
+    other.set_attrs(1, {"a": 1})
+    other.set_attrs(ATTR_BLOCK_SIZE + 1, {"b": 999})
+    assert blocks_diff(store.blocks(), other.blocks()) == [1]
+    assert blocks_diff(store.blocks(), store.blocks()) == []
+    assert other.block_data(1) == {ATTR_BLOCK_SIZE + 1: {"b": 999}}
+    other.close()

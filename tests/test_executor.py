@@ -1,0 +1,168 @@
+"""Executor tests (reference analog: executor_test.go, local paths)."""
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.core.frame import FrameOptions
+from pilosa_tpu.core.holder import Holder
+from pilosa_tpu.core.index import IndexOptions
+from pilosa_tpu.executor import ExecOptions, Executor, QueryBitmap
+from pilosa_tpu.pilosa import PilosaError, ErrTooManyWrites, SLICE_WIDTH
+
+
+@pytest.fixture
+def env(tmp_path):
+    h = Holder(str(tmp_path / "data"))
+    h.open()
+    idx = h.create_index("i")
+    idx.create_frame("general", FrameOptions())
+    idx.create_frame("f", FrameOptions(inverse_enabled=True, time_quantum="YMDH"))
+    e = Executor(h, engine="numpy")
+    yield h, e
+    h.close()
+
+
+def test_setbit_bitmap_roundtrip(env):
+    h, e = env
+    (changed,) = e.execute("i", 'SetBit(rowID=10, frame="f", columnID=100)')
+    assert changed is True
+    (changed,) = e.execute("i", 'SetBit(rowID=10, frame="f", columnID=100)')
+    assert changed is False
+    (bm,) = e.execute("i", 'Bitmap(rowID=10, frame="f")')
+    assert bm.bits() == [100]
+    # inverse view was maintained
+    (inv,) = e.execute("i", 'Bitmap(columnID=100, frame="f")')
+    assert inv.bits() == [10]
+
+
+def test_multi_slice_count_intersect(env):
+    h, e = env
+    cols_a = [1, 2, 3, SLICE_WIDTH + 1, SLICE_WIDTH + 2, 3 * SLICE_WIDTH + 7]
+    cols_b = [2, 3, SLICE_WIDTH + 2, 2 * SLICE_WIDTH + 5]
+    for c in cols_a:
+        e.execute("i", f'SetBit(rowID=1, frame="f", columnID={c})')
+    for c in cols_b:
+        e.execute("i", f'SetBit(rowID=2, frame="f", columnID={c})')
+    (n,) = e.execute("i", 'Count(Intersect(Bitmap(rowID=1, frame="f"), Bitmap(rowID=2, frame="f")))')
+    assert n == 3  # {2, 3, W+2}
+    (bm,) = e.execute("i", 'Intersect(Bitmap(rowID=1, frame="f"), Bitmap(rowID=2, frame="f"))')
+    assert bm.bits() == [2, 3, SLICE_WIDTH + 2]
+
+
+def test_union_difference_xor(env):
+    h, e = env
+    for c in [1, 2]:
+        e.execute("i", f'SetBit(rowID=1, frame="f", columnID={c})')
+    for c in [2, 3]:
+        e.execute("i", f'SetBit(rowID=2, frame="f", columnID={c})')
+    (u,) = e.execute("i", 'Union(Bitmap(rowID=1, frame="f"), Bitmap(rowID=2, frame="f"))')
+    assert u.bits() == [1, 2, 3]
+    (d,) = e.execute("i", 'Difference(Bitmap(rowID=1, frame="f"), Bitmap(rowID=2, frame="f"))')
+    assert d.bits() == [1]
+    (x,) = e.execute("i", 'Xor(Bitmap(rowID=1, frame="f"), Bitmap(rowID=2, frame="f"))')
+    assert x.bits() == [1, 3]
+
+
+def test_range_time_views(env):
+    h, e = env
+    e.execute("i", 'SetBit(rowID=1, frame="f", columnID=7, timestamp="2017-03-02T15:00")')
+    e.execute("i", 'SetBit(rowID=1, frame="f", columnID=8, timestamp="2017-05-01T00:00")')
+    (bm,) = e.execute(
+        "i", 'Range(rowID=1, frame="f", start="2017-03-01T00:00", end="2017-04-01T00:00")'
+    )
+    assert bm.bits() == [7]
+    (bm2,) = e.execute(
+        "i", 'Range(rowID=1, frame="f", start="2017-01-01T00:00", end="2018-01-01T00:00")'
+    )
+    assert bm2.bits() == [7, 8]
+
+
+def test_topn_two_phase(env):
+    h, e = env
+    idx = h.index("i")
+    idx.create_frame("r", FrameOptions(cache_type="ranked"))
+    # row 1: bits in slices 0 and 1; row 2: fewer bits.
+    bits = [(1, c) for c in range(20)] + [(1, SLICE_WIDTH + c) for c in range(15)]
+    bits += [(2, c) for c in range(10)] + [(3, 2 * SLICE_WIDTH + 1)]
+    frame = h.frame("i", "r")
+    rows, cols = zip(*bits)
+    frame.import_bits(rows, cols)
+    (pairs,) = e.execute("i", 'TopN(frame="r", n=2)')
+    assert [(p.id, p.count) for p in pairs] == [(1, 35), (2, 10)]
+
+
+def test_topn_with_src(env):
+    h, e = env
+    idx = h.index("i")
+    idx.create_frame("r", FrameOptions(cache_type="ranked"))
+    frame = h.frame("i", "r")
+    frame.import_bits([1] * 10 + [2] * 10, list(range(10)) + list(range(5, 15)))
+    # src = row 1 of frame f
+    for c in range(8):
+        e.execute("i", f'SetBit(rowID=9, frame="f", columnID={c})')
+    (pairs,) = e.execute("i", 'TopN(Bitmap(rowID=9, frame="f"), frame="r", n=5)')
+    assert [(p.id, p.count) for p in pairs] == [(1, 8), (2, 3)]
+
+
+def test_topn_ids_and_threshold(env):
+    h, e = env
+    idx = h.index("i")
+    idx.create_frame("r", FrameOptions(cache_type="ranked"))
+    frame = h.frame("i", "r")
+    frame.import_bits([1] * 5 + [2] * 3 + [3] * 1, list(range(5)) + list(range(3)) + [0])
+    (pairs,) = e.execute("i", 'TopN(frame="r", ids=[2,3])')
+    assert {(p.id, p.count) for p in pairs} == {(2, 3), (3, 1)}
+    (pairs2,) = e.execute("i", 'TopN(frame="r", n=10, threshold=3)')
+    assert {(p.id, p.count) for p in pairs2} == {(1, 5), (2, 3)}
+
+
+def test_attrs(env):
+    h, e = env
+    e.execute("i", 'SetBit(rowID=1, frame="f", columnID=2)')
+    (res,) = e.execute("i", 'SetRowAttrs(rowID=1, frame="f", name="alice", active=true)')
+    assert res is None
+    (bm,) = e.execute("i", 'Bitmap(rowID=1, frame="f")')
+    assert bm.attrs == {"name": "alice", "active": True}
+    e.execute("i", 'SetColumnAttrs(columnID=2, info="x")')
+    (inv,) = e.execute("i", 'Bitmap(columnID=2, frame="f")')
+    assert inv.attrs == {"info": "x"}
+    # exclude_attrs opt
+    (bm2,) = e.execute("i", 'Bitmap(rowID=1, frame="f")', opt=ExecOptions(exclude_attrs=True))
+    assert bm2.attrs == {}
+
+
+def test_errors(env):
+    h, e = env
+    with pytest.raises(PilosaError):
+        e.execute("i", "Bogus(x=1)")
+    with pytest.raises(PilosaError):
+        e.execute("i", 'Bitmap(rowID=1, frame="nope")')
+    with pytest.raises(PilosaError):
+        e.execute("i", 'Bitmap(frame="f")')  # neither row nor col
+    with pytest.raises(PilosaError):
+        e.execute("i", 'Count(Bitmap(rowID=1, frame="f"), Bitmap(rowID=2, frame="f"))')
+    e2 = Executor(h, engine="numpy", max_writes_per_request=1)
+    with pytest.raises(ErrTooManyWrites):
+        e2.execute("i", 'SetBit(rowID=1, frame="f", columnID=1) SetBit(rowID=1, frame="f", columnID=2)')
+
+
+def test_count_on_general_default_frame(env):
+    h, e = env
+    e.execute("i", "SetBit(rowID=5, frame=general, columnID=9)")
+    (n,) = e.execute("i", "Count(Bitmap(rowID=5))")
+    assert n == 1
+
+
+def test_jax_engine_matches_numpy(env, tmp_path):
+    # Same queries through the JaxEngine (CPU backend under conftest).
+    h, e = env
+    for c in [1, 2, 3, SLICE_WIDTH + 4]:
+        e.execute("i", f'SetBit(rowID=1, frame="f", columnID={c})')
+    for c in [2, SLICE_WIDTH + 4]:
+        e.execute("i", f'SetBit(rowID=2, frame="f", columnID={c})')
+    ej = Executor(h, engine="jax")
+    q = 'Count(Intersect(Bitmap(rowID=1, frame="f"), Bitmap(rowID=2, frame="f")))'
+    assert e.execute("i", q) == ej.execute("i", q)
+    (bm_np,) = e.execute("i", 'Union(Bitmap(rowID=1, frame="f"), Bitmap(rowID=2, frame="f"))')
+    (bm_j,) = ej.execute("i", 'Union(Bitmap(rowID=1, frame="f"), Bitmap(rowID=2, frame="f"))')
+    assert bm_np.bits() == bm_j.bits()

@@ -1,0 +1,278 @@
+"""Fragment + cache tests (reference analog: fragment_test.go, cache tests)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from pilosa_tpu import roaring
+from pilosa_tpu.core import cache as cache_mod
+from pilosa_tpu.core.cache import LRUCache, Pair, RankCache, pairs_add, pairs_sorted
+from pilosa_tpu.core.fragment import DEFAULT_MAX_OPN, Fragment, TopOptions
+from pilosa_tpu.pilosa import SLICE_WIDTH
+
+
+@pytest.fixture
+def frag(tmp_path):
+    f = Fragment(str(tmp_path / "0"), "i", "f", "standard", 0, cache_type="ranked")
+    f.open()
+    yield f
+    f.close()
+
+
+def reopen(f: Fragment) -> Fragment:
+    f.close()
+    g = Fragment(f.path, f.index, f.frame, f.view, f.slice, cache_type=f.cache_type)
+    g.open()
+    return g
+
+
+def test_set_clear_contains(frag):
+    assert frag.set_bit(120, 1)
+    assert not frag.set_bit(120, 1)
+    assert frag.contains(120, 1)
+    assert frag.clear_bit(120, 1)
+    assert not frag.contains(120, 1)
+    assert not frag.clear_bit(120, 1)
+
+
+def test_wal_persistence(tmp_path):
+    f = Fragment(str(tmp_path / "0"), "i", "f", "standard", 0)
+    f.open()
+    f.set_bit(3, 100)
+    f.set_bit(3, 200)
+    f.set_bit(4, 50)
+    f.clear_bit(3, 200)
+    g = reopen(f)
+    assert g.contains(3, 100)
+    assert not g.contains(3, 200)
+    assert g.contains(4, 50)
+    assert g.row_count(3) == 1
+    g.close()
+
+
+def test_snapshot_at_max_opn(tmp_path):
+    f = Fragment(str(tmp_path / "0"), "i", "f", "standard", 0, max_opn=5)
+    f.open()
+    for i in range(7):
+        f.set_bit(0, i)
+    # After crossing max_opn the WAL was folded into a snapshot.
+    assert f.storage.op_n < 5
+    g = reopen(f)
+    assert g.row_count(0) == 7
+    g.close()
+
+
+def test_row_dense_and_row(frag):
+    cols = [0, 31, 32, 1000, SLICE_WIDTH - 1]
+    for c in cols:
+        frag.set_bit(7, c)
+    words = frag.row_dense(7)
+    from pilosa_tpu.ops import bitwise as bw
+
+    assert bw.np_count(words) == len(cols)
+    np.testing.assert_array_equal(bw.unpack_positions(words), np.array(cols, dtype=np.uint64))
+    # row() returns global columns for this slice (slice 0 → same values).
+    assert frag.row(7).to_array().tolist() == cols
+    # mutation invalidates the dense row cache
+    frag.set_bit(7, 5)
+    assert bw.np_count(frag.row_dense(7)) == len(cols) + 1
+
+
+def test_row_for_nonzero_slice(tmp_path):
+    f = Fragment(str(tmp_path / "2"), "i", "f", "standard", 2)
+    f.open()
+    f.set_bit(1, 2 * SLICE_WIDTH + 5)  # global column in slice 2
+    assert f.row(1).to_array().tolist() == [2 * SLICE_WIDTH + 5]
+    f.close()
+
+
+def test_import_bits_and_count(frag):
+    rows = np.repeat(np.arange(10, dtype=np.uint64), 100)
+    cols = np.tile(np.arange(100, dtype=np.uint64) * 7, 10)
+    frag.import_bits(rows, cols)
+    assert frag.count() == 1000
+    for r in range(10):
+        assert frag.row_count(r) == 100
+    assert frag.max_row() == 9
+
+
+def test_top_basic(frag):
+    # row 0: 3 bits, row 1: 2 bits, row 2: 1 bit
+    for r, n in [(0, 3), (1, 2), (2, 1)]:
+        for c in range(n):
+            frag.set_bit(r, c)
+    frag.cache.recalculate()
+    top = frag.top(TopOptions(n=2))
+    assert [(p.id, p.count) for p in top] == [(0, 3), (1, 2)]
+
+
+def test_top_with_src_intersection(frag):
+    for c in range(10):
+        frag.set_bit(0, c)  # 0..9
+    for c in range(5, 20):
+        frag.set_bit(1, c)  # 5..19
+    for c in range(100, 103):
+        frag.set_bit(2, c)
+    frag.cache.recalculate()
+    src = roaring.Bitmap(range(0, 8))  # intersects row0 by 8, row1 by 3
+    top = frag.top(TopOptions(n=5, src=src))
+    assert [(p.id, p.count) for p in top] == [(0, 8), (1, 3)]
+
+
+def test_top_row_ids_no_truncate(frag):
+    for r in range(5):
+        for c in range(r + 1):
+            frag.set_bit(r, c)
+    frag.cache.recalculate()
+    top = frag.top(TopOptions(n=1, row_ids=[0, 3]))
+    assert {p.id for p in top} == {0, 3}
+
+
+def test_top_min_threshold(frag):
+    for r, n in [(0, 10), (1, 2)]:
+        for c in range(n):
+            frag.set_bit(r, c)
+    frag.cache.recalculate()
+    top = frag.top(TopOptions(n=10, min_threshold=5))
+    assert [p.id for p in top] == [0]
+
+
+def test_top_tanimoto(frag):
+    # Reference fragment_test.go Tanimoto case: rows with known overlaps.
+    for c in [1, 2, 3]:
+        frag.set_bit(100, c)
+    for c in [1, 2]:
+        frag.set_bit(101, c)
+    for c in [1, 2, 3, 4]:
+        frag.set_bit(102, c)
+    frag.cache.recalculate()
+    src = roaring.Bitmap([1, 2, 3])
+    top = frag.top(TopOptions(tanimoto_threshold=70, src=src))
+    got = {p.id: p.count for p in top}
+    # row100: count 3/ union 3 → 100%; row102: 3/4 → 75%; row101: 2/3 → 67% (excluded)
+    assert got == {100: 3, 102: 3}
+
+
+def test_blocks_and_checksum_invalidation(frag):
+    frag.set_bit(0, 1)
+    frag.set_bit(150, 1)  # second block (rows 100-199)
+    blocks = dict(frag.blocks())
+    assert set(blocks.keys()) == {0, 1}
+    chk_all = frag.checksum()
+    frag.set_bit(0, 2)
+    blocks2 = dict(frag.blocks())
+    assert blocks2[1] == blocks[1]  # untouched block unchanged
+    assert blocks2[0] != blocks[0]
+    assert frag.checksum() != chk_all
+
+
+def test_block_data(frag):
+    frag.set_bit(105, 3)
+    frag.set_bit(105, 9)
+    rows, cols = frag.block_data(1)
+    assert rows.tolist() == [105, 105]
+    assert cols.tolist() == [3, 9]
+
+
+def test_merge_block_majority(frag):
+    # Local has {a}, two remotes have {a,b} and {b}: majority(2 of 3) → {a?, b}
+    # a on 2 nodes → keep; b on 2 nodes → set locally.
+    frag.set_bit(0, 1)  # a
+    local = frag.block_data(0)
+    remote1 = (np.array([0, 0], np.uint64), np.array([1, 2], np.uint64))  # a, b
+    remote2 = (np.array([0], np.uint64), np.array([2], np.uint64))  # b
+    diffs = frag.merge_block(0, [local, remote1, remote2])
+    assert frag.contains(0, 1) and frag.contains(0, 2)
+    # remote2's diff should say: set a, clear nothing
+    (set_r, set_c), (clr_r, clr_c) = diffs[2]
+    assert set_c.tolist() == [1] and clr_c.tolist() == []
+    # remote1 already canonical
+    (s1r, s1c), (c1r, c1c) = diffs[1]
+    assert s1c.tolist() == [] and c1c.tolist() == []
+
+
+def test_write_read_roundtrip(tmp_path, frag):
+    for r in range(3):
+        for c in range(10 * (r + 1)):
+            frag.set_bit(r, c)
+    import io
+
+    buf = io.BytesIO()
+    frag.write_to(buf)
+    g = Fragment(str(tmp_path / "restored"), "i", "f", "standard", 0, cache_type="ranked")
+    g.open()
+    g.read_from(buf.getvalue())
+    assert g.count() == frag.count()
+    assert g.row_count(2) == 30
+    assert [p.id for p in g.top(TopOptions(n=1))] == [2]
+    g.close()
+
+
+def test_cache_sidecar_persistence(tmp_path):
+    f = Fragment(str(tmp_path / "0"), "i", "f", "standard", 0, cache_type="ranked")
+    f.open()
+    for c in range(50):
+        f.set_bit(9, c)
+    f.close()
+    assert os.path.exists(f.cache_path)
+    g = Fragment(f.path, "i", "f", "standard", 0, cache_type="ranked")
+    g.open()
+    g.cache.recalculate()
+    assert g.cache.get(9) == 50
+    g.close()
+
+
+# -- cache unit tests -------------------------------------------------------
+
+
+def test_rank_cache_threshold_and_trim():
+    now = [0.0]
+    c = RankCache(3, _now=lambda: now[0])
+    for i, n in enumerate([10, 20, 30, 40, 50]):
+        c.bulk_add(i, n)
+    c.recalculate()
+    assert [p.id for p in c.top()] == [4, 3, 2]
+    assert c.threshold_value == 20  # count of first evicted rank
+    # Adds below threshold ignored.
+    c.add(99, 5)
+    assert c.get(99) == 0
+
+
+def test_rank_cache_debounce():
+    now = [0.0]
+    c = RankCache(10, _now=lambda: now[0])
+    c.add(1, 5)
+    assert [p.id for p in c.top()] == [1]
+    c.bulk_add(2, 50)
+    c.invalidate()  # within 10s — debounced
+    assert [p.id for p in c.top()] == [1]
+    now[0] += 11
+    c.invalidate()
+    assert [p.id for p in c.top()] == [2, 1]
+
+
+def test_lru_cache_eviction():
+    c = LRUCache(2)
+    c.add(1, 10)
+    c.add(2, 20)
+    c.get(1)
+    c.add(3, 30)  # evicts 2 (least recently used)
+    assert c.get(2) == 0
+    assert c.get(1) == 10 and c.get(3) == 30
+
+
+def test_pairs_add_merge():
+    a = [Pair(1, 10), Pair(2, 5)]
+    b = [Pair(2, 7), Pair(3, 1)]
+    merged = {p.id: p.count for p in pairs_add(a, b)}
+    assert merged == {1: 10, 2: 12, 3: 1}
+
+
+def test_new_cache_types():
+    assert isinstance(cache_mod.new_cache("ranked", 10), RankCache)
+    assert isinstance(cache_mod.new_cache("lru", 10), LRUCache)
+    from pilosa_tpu.pilosa import ErrInvalidCacheType
+
+    with pytest.raises(ErrInvalidCacheType):
+        cache_mod.new_cache("bogus", 10)

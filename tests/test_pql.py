@@ -1,0 +1,106 @@
+"""PQL parser tests (reference analog: pql/parser_test.go, ast_test.go)."""
+
+import pytest
+
+from pilosa_tpu.pql import Call, ParseError, Query, parse
+
+
+def test_simple_call():
+    q = parse("Bitmap(rowID=10, frame='stargazer')")
+    assert len(q.calls) == 1
+    c = q.calls[0]
+    assert c.name == "Bitmap"
+    assert c.args == {"rowID": 10, "frame": "stargazer"}
+    assert c.children == []
+
+
+def test_nested_calls():
+    q = parse("Count(Intersect(Bitmap(rowID=10, frame=a), Bitmap(rowID=5, frame=b)))")
+    count = q.calls[0]
+    assert count.name == "Count"
+    inter = count.children[0]
+    assert inter.name == "Intersect"
+    assert [c.name for c in inter.children] == ["Bitmap", "Bitmap"]
+    assert inter.children[0].args == {"rowID": 10, "frame": "a"}
+
+
+def test_children_then_args():
+    q = parse("TopN(Bitmap(rowID=1, frame=other), frame=f, n=20)")
+    c = q.calls[0]
+    assert c.children[0].name == "Bitmap"
+    assert c.args == {"frame": "f", "n": 20}
+
+
+def test_multiple_calls_whitespace_separated():
+    q = parse('SetBit(rowID=1, frame="f", columnID=2)\nCount(Bitmap(rowID=1, frame="f"))')
+    assert [c.name for c in q.calls] == ["SetBit", "Count"]
+    assert q.write_call_n() == 1
+
+
+def test_value_types():
+    q = parse('F(a=1, b=-2, c=3.5, d="str", e=bare, f=true, g=false, h=null, i=[1,2,"x",true])')
+    args = q.calls[0].args
+    assert args["a"] == 1 and args["b"] == -2
+    assert args["c"] == 3.5
+    assert args["d"] == "str"
+    assert args["e"] == "bare"
+    assert args["f"] is True and args["g"] is False
+    assert args["h"] is None
+    assert args["i"] == [1, 2, "x", True]
+
+
+def test_ident_with_dots_dashes():
+    q = parse("Range(rowID=1, frame=f, start=x, end=y)")
+    assert q.calls[0].args["start"] == "x"
+    q2 = parse('Bitmap(frame=my-frame.v2_x, rowID=1)')
+    assert q2.calls[0].args["frame"] == "my-frame.v2_x"
+
+
+def test_quoted_strings_with_escapes():
+    q = parse('F(a="hello \\"world\\"", b=\'it\')')
+    assert q.calls[0].args["a"] == 'hello "world"'
+    assert q.calls[0].args["b"] == "it"
+
+
+def test_parse_errors():
+    with pytest.raises(ParseError):
+        parse("Bitmap(")
+    with pytest.raises(ParseError):
+        parse("Bitmap(rowID=)")
+    with pytest.raises(ParseError):
+        parse("Bitmap(rowID=1,rowID=2)")  # duplicate key
+    with pytest.raises(ParseError):
+        parse("123(rowID=1)")
+    with pytest.raises(ParseError):
+        parse("Bitmap(rowID=1) !")
+
+
+def test_uint_arg_helpers():
+    c = parse("F(n=5, ids=[1,2,3], s=x)").calls[0]
+    assert c.uint_arg("n") == (5, True)
+    assert c.uint_arg("missing") == (0, False)
+    assert c.uint_slice_arg("ids") == ([1, 2, 3], True)
+    with pytest.raises(TypeError):
+        c.uint_arg("s")
+
+
+def test_is_inverse():
+    c = parse("Bitmap(columnID=5, frame=f)").calls[0]
+    assert c.is_inverse("rowID", "columnID")
+    c2 = parse("Bitmap(rowID=5, frame=f)").calls[0]
+    assert not c2.is_inverse("rowID", "columnID")
+    c3 = parse("Intersect(Bitmap(columnID=1, frame=f))").calls[0]
+    assert not c3.is_inverse("rowID", "columnID")
+
+
+def test_clone_and_str_roundtrip():
+    q = parse('TopN(Bitmap(rowID=1, frame=o), frame="f", n=2, filters=["a",2])')
+    c = q.calls[0]
+    clone = c.clone()
+    clone.args["n"] = 99
+    assert c.args["n"] == 2
+    # String form re-parses to the same structure.
+    q2 = parse(str(c))
+    assert q2.calls[0].name == "TopN"
+    assert q2.calls[0].args["n"] == 2
+    assert q2.calls[0].children[0].name == "Bitmap"

@@ -199,3 +199,36 @@ def test_max():
     assert bm.max() == 0
     bm.add_many(np.array([5, 100, 1 << 21], dtype=np.uint64))
     assert bm.max() == 1 << 21
+
+
+def test_from_bytes_rejects_truncation(rng):
+    vals = random_values(rng, 100)
+    bm = Bitmap()
+    bm.add_many(vals)
+    data = bm.to_bytes()
+    with pytest.raises(ValueError, match="out of bounds"):
+        Bitmap.from_bytes(data[:-8])
+
+
+def test_dense_words_validates_n_bits():
+    bm = Bitmap([5, 1010])
+    with pytest.raises(ValueError, match="n_bits"):
+        bm.to_dense_words(0, 1000)
+    words = bm.to_dense_words(0, 1 << 16)
+    assert bw_count(words) == 2
+
+
+def bw_count(words):
+    import numpy as _np
+
+    return int(roaring._POPCNT8[_np.ascontiguousarray(words).view(_np.uint8)].sum())
+
+
+def test_dense_container_ops_stay_dense(rng):
+    a, b = Bitmap(), Bitmap()
+    a.add_many(np.arange(0, 60000, dtype=np.uint64))
+    b.add_many(np.arange(30000, 90000, dtype=np.uint64))
+    inter = a.intersect(b)
+    assert inter.count() == 30000
+    # result containers holding >4096 values stay dense bitmaps
+    assert any(c.bitmap is not None for c in inter.containers.values())
