@@ -1,0 +1,130 @@
+"""Server configuration.
+
+Reference analog: config.go — TOML `Config` with data dir, host, cluster
+section (ReplicaN, type, hosts, internal hosts, polling interval, gossip
+seed), anti-entropy interval, max-writes-per-request, log path
+(config.go:37-64); defaults port 10101, internal port 14000
+(config.go:19-34).  Precedence (cmd/root.go:89-153): flags > env
+(PILOSA_*) > TOML file > defaults.
+"""
+
+from __future__ import annotations
+
+import os
+import tomllib
+from dataclasses import dataclass, field
+
+DEFAULT_HOST = "localhost:10101"
+DEFAULT_INTERNAL_PORT = 14000
+DEFAULT_ANTI_ENTROPY_INTERVAL = 600.0  # 10 min (server.go:186)
+DEFAULT_POLLING_INTERVAL = 60.0  # max-slice poll (server.go:221)
+DEFAULT_MAX_WRITES_PER_REQUEST = 5000
+
+CLUSTER_TYPE_STATIC = "static"
+CLUSTER_TYPE_HTTP = "http"
+CLUSTER_TYPE_GOSSIP = "gossip"
+
+
+@dataclass
+class ClusterConfig:
+    replica_n: int = 1
+    type: str = CLUSTER_TYPE_STATIC
+    hosts: list[str] = field(default_factory=list)
+    internal_hosts: list[str] = field(default_factory=list)
+    polling_interval: float = DEFAULT_POLLING_INTERVAL
+    internal_port: int = DEFAULT_INTERNAL_PORT
+    gossip_seed: str = ""
+
+
+@dataclass
+class Config:
+    data_dir: str = "~/.pilosa_tpu"
+    host: str = DEFAULT_HOST
+    cluster: ClusterConfig = field(default_factory=ClusterConfig)
+    anti_entropy_interval: float = DEFAULT_ANTI_ENTROPY_INTERVAL
+    max_writes_per_request: int = DEFAULT_MAX_WRITES_PER_REQUEST
+    log_path: str = ""
+    engine: str = "auto"
+
+    @classmethod
+    def from_toml(cls, path: str) -> "Config":
+        with open(path, "rb") as f:
+            raw = tomllib.load(f)
+        return cls.from_dict(raw)
+
+    @classmethod
+    def from_dict(cls, raw: dict) -> "Config":
+        cfg = cls()
+        cfg.data_dir = raw.get("data-dir", cfg.data_dir)
+        cfg.host = raw.get("host", cfg.host)
+        cfg.anti_entropy_interval = _interval(
+            raw.get("anti-entropy", {}).get("interval"), cfg.anti_entropy_interval
+        )
+        cfg.max_writes_per_request = raw.get(
+            "max-writes-per-request", cfg.max_writes_per_request
+        )
+        cfg.log_path = raw.get("log-path", cfg.log_path)
+        cfg.engine = raw.get("engine", cfg.engine)
+        cl = raw.get("cluster", {})
+        cfg.cluster.replica_n = cl.get("replicas", cfg.cluster.replica_n)
+        cfg.cluster.type = cl.get("type", cfg.cluster.type)
+        cfg.cluster.hosts = list(cl.get("hosts", cfg.cluster.hosts))
+        cfg.cluster.internal_hosts = list(cl.get("internal-hosts", cfg.cluster.internal_hosts))
+        cfg.cluster.polling_interval = _interval(
+            cl.get("polling-interval"), cfg.cluster.polling_interval
+        )
+        cfg.cluster.internal_port = cl.get("internal-port", cfg.cluster.internal_port)
+        cfg.cluster.gossip_seed = cl.get("gossip-seed", cfg.cluster.gossip_seed)
+        return cfg
+
+    def apply_env(self, env=None) -> "Config":
+        """PILOSA_* environment overrides (cmd/root.go:118-134 analog)."""
+        env = env if env is not None else os.environ
+        self.data_dir = env.get("PILOSA_DATA_DIR", self.data_dir)
+        self.host = env.get("PILOSA_HOST", self.host)
+        if "PILOSA_CLUSTER_HOSTS" in env:
+            self.cluster.hosts = [h.strip() for h in env["PILOSA_CLUSTER_HOSTS"].split(",") if h.strip()]
+        if "PILOSA_CLUSTER_REPLICAS" in env:
+            self.cluster.replica_n = int(env["PILOSA_CLUSTER_REPLICAS"])
+        if "PILOSA_CLUSTER_TYPE" in env:
+            self.cluster.type = env["PILOSA_CLUSTER_TYPE"]
+        if "PILOSA_ENGINE" in env:
+            self.engine = env["PILOSA_ENGINE"]
+        return self
+
+    def to_toml(self) -> str:
+        lines = [
+            f'data-dir = "{self.data_dir}"',
+            f'host = "{self.host}"',
+            "",
+            "[cluster]",
+            f'  type = "{self.cluster.type}"',
+            f"  replicas = {self.cluster.replica_n}",
+            f"  hosts = [{', '.join(repr(h) for h in self.cluster.hosts)}]".replace("'", '"'),
+            f"  internal-port = {self.cluster.internal_port}",
+            "",
+            "[anti-entropy]",
+            f'  interval = "{int(self.anti_entropy_interval)}s"',
+        ]
+        return "\n".join(lines) + "\n"
+
+
+def _interval(v, default: float) -> float:
+    """Parse '10m'/'600s'/number into seconds."""
+    if v is None:
+        return default
+    if isinstance(v, (int, float)):
+        return float(v)
+    v = str(v).strip()
+    try:
+        if v.endswith("ms"):
+            return float(v[:-2]) / 1000
+        if v.endswith("s") and not v.endswith("ms"):
+            return float(v[:-1])
+        if v.endswith("m"):
+            return float(v[:-1]) * 60
+        if v.endswith("h"):
+            return float(v[:-1]) * 3600
+        return float(v)
+    except ValueError:
+        return default

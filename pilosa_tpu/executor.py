@@ -79,12 +79,9 @@ class QueryBitmap:
         return out
 
     def count(self) -> int:
-        from pilosa_tpu.roaring import _POPCNT8
+        from pilosa_tpu.roaring import _popcount_words
 
-        total = 0
-        for words in self.segments.values():
-            total += int(_POPCNT8[np.ascontiguousarray(words).view(np.uint8)].sum())
-        return total
+        return sum(_popcount_words(words) for words in self.segments.values())
 
     def merge(self, other: "QueryBitmap") -> "QueryBitmap":
         """OR-merge segments (distributed reduce; bitmap.go Merge)."""
@@ -417,38 +414,42 @@ class Executor:
         return frame, row_id, col_id, timestamp
 
     def _execute_set_bit(self, index: str, c: pql.Call, opt: ExecOptions) -> bool:
-        frame, row_id, col_id, timestamp = self._set_bit_args(index, c)
-        changed = frame.set_bit(VIEW_STANDARD, row_id, col_id, timestamp)
-        if frame.inverse_enabled:
-            if frame.set_bit(VIEW_INVERSE, col_id, row_id, timestamp):
-                changed = True
-        if not opt.remote:
-            changed = self._forward_write(index, c, col_id, changed, opt)
-        return changed
+        return self._execute_bit_write(index, c, opt, clear=False)
 
     def _execute_clear_bit(self, index: str, c: pql.Call, opt: ExecOptions) -> bool:
-        frame, row_id, col_id, _ = self._set_bit_args(index, c)
-        changed = frame.clear_bit(VIEW_STANDARD, row_id, col_id)
-        if frame.inverse_enabled:
-            if frame.clear_bit(VIEW_INVERSE, col_id, row_id):
-                changed = True
-        if not opt.remote:
-            changed = self._forward_write(index, c, col_id, changed, opt)
-        return changed
+        return self._execute_bit_write(index, c, opt, clear=True)
 
-    def _forward_write(self, index: str, c: pql.Call, col_id: int, changed: bool, opt) -> bool:
-        """Forward a bit write to the other owners of its slice
-        (executor.go:780-805).  No-op on single-node clusters."""
-        if self.cluster is None or self.client_factory is None:
+    def _execute_bit_write(self, index: str, c: pql.Call, opt: ExecOptions, clear: bool) -> bool:
+        """Write a bit on every owner of its slice — locally only when this
+        node is an owner, forwarding to the others (executor.go:675-698,
+        780-805).  A forwarded call (opt.remote) only writes locally."""
+        frame, row_id, col_id, timestamp = self._set_bit_args(index, c)
+
+        def write_local() -> bool:
+            if clear:
+                changed = frame.clear_bit(VIEW_STANDARD, row_id, col_id)
+                if frame.inverse_enabled and frame.clear_bit(VIEW_INVERSE, col_id, row_id):
+                    changed = True
+            else:
+                changed = frame.set_bit(VIEW_STANDARD, row_id, col_id, timestamp)
+                if frame.inverse_enabled and frame.set_bit(VIEW_INVERSE, col_id, row_id, timestamp):
+                    changed = True
             return changed
+
+        if opt.remote or self.cluster is None or self.client_factory is None:
+            return write_local()
+
+        changed = False
         slice_i = col_id // SLICE_WIDTH
         for node in self.cluster.fragment_nodes(index, slice_i):
             if node.host == self.host:
-                continue
-            client = self.client_factory(node.host)
-            res = client.execute_remote(index, pql.Query(calls=[c]))
-            if res and res[0]:
-                changed = True
+                if write_local():
+                    changed = True
+            else:
+                client = self.client_factory(node.host)
+                res = client.execute_remote(index, pql.Query(calls=[c]))
+                if res and res[0]:
+                    changed = True
         return changed
 
     # -- attrs (executor.go:808-1006) --------------------------------------
@@ -506,7 +507,7 @@ class Executor:
         """
         slices = list(slices or [])
         if self.cluster is None or opt.remote or self.client_factory is None:
-            return reduce_fn(zero, local_map(slices)) if slices else reduce_fn(zero, local_map([]))
+            return reduce_fn(zero, local_map(slices))
 
         by_node = self.cluster.slices_by_node(index, slices, exclude_down=True)
         result = zero

@@ -1,0 +1,8 @@
+"""HTTP API, client, and server composition.
+
+Reference analogs: handler.go (route table + codecs), client.go (full
+HTTP client), server.go (wiring + background loops).
+"""
+
+from pilosa_tpu.server.handler import Handler  # noqa: F401
+from pilosa_tpu.server.server import Server  # noqa: F401

@@ -1,0 +1,310 @@
+"""HTTP client mirroring the full API (reference analog: client.go, 1053 LoC).
+
+Used by: remote query execution (executor mapReduce), write forwarding,
+bulk import (grouping bits by slice and POSTing protobuf to every owner
+node, client.go:304-390), backup/restore streaming, fragment block sync,
+attr-diff sync, and the ctl tools.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+from datetime import datetime
+from typing import Any, Optional, Sequence
+
+import numpy as np
+
+from pilosa_tpu import pql, wire
+from pilosa_tpu.core.cache import Pair
+from pilosa_tpu.executor import QueryBitmap
+from pilosa_tpu.ops.bitwise import pack_positions
+from pilosa_tpu.pilosa import SLICE_WIDTH, PilosaError
+
+PROTOBUF = "application/x-protobuf"
+
+
+class ClientError(PilosaError):
+    def __init__(self, status: int, message: str):
+        super().__init__(f"HTTP {status}: {message}")
+        self.status = status
+
+
+class Client:
+    def __init__(self, host: str, timeout: float = 30.0):
+        if "://" not in host:
+            host = "http://" + host
+        self.base = host.rstrip("/")
+        self.timeout = timeout
+
+    # -- low level -------------------------------------------------------
+
+    def _request(
+        self,
+        method: str,
+        path: str,
+        body: Optional[bytes] = None,
+        content_type: str = "application/json",
+        accept: str = "application/json",
+    ) -> tuple[int, bytes]:
+        req = urllib.request.Request(self.base + path, data=body, method=method)
+        if body is not None:
+            req.add_header("Content-Type", content_type)
+        req.add_header("Accept", accept)
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                return resp.status, resp.read()
+        except urllib.error.HTTPError as e:
+            return e.code, e.read()
+
+    def _json(self, method: str, path: str, obj: Any = None) -> dict:
+        body = json.dumps(obj).encode() if obj is not None else None
+        status, payload = self._request(method, path, body)
+        if status >= 400:
+            msg = payload.decode(errors="replace")
+            try:
+                msg = json.loads(msg).get("error", msg)
+            except Exception:
+                pass
+            raise ClientError(status, msg)
+        return json.loads(payload) if payload else {}
+
+    # -- queries (client.go:38-120) ---------------------------------------
+
+    def execute_query(
+        self,
+        index: str,
+        query: str,
+        slices: Optional[Sequence[int]] = None,
+        column_attrs: bool = False,
+        remote: bool = False,
+    ) -> dict:
+        """Execute PQL; returns the decoded QueryResponse dict."""
+        body = wire.encode_query_request(
+            query, slices=list(slices or []), column_attrs=column_attrs, remote=remote
+        )
+        status, payload = self._request(
+            "POST", f"/index/{index}/query", body, content_type=PROTOBUF, accept=PROTOBUF
+        )
+        if status >= 400:
+            msg = payload.decode(errors="replace")
+            try:
+                msg = wire.decode_query_response(payload).get("err") or msg
+            except ValueError:
+                try:
+                    msg = json.loads(msg).get("error", msg)
+                except Exception:
+                    pass
+            raise ClientError(status, msg)
+        resp = wire.decode_query_response(payload)
+        if resp.get("err"):
+            raise ClientError(status, resp["err"])
+        return resp
+
+    def execute_remote(self, index: str, query: "pql.Query", slices: Optional[Sequence[int]] = None) -> list:
+        """Forward a parsed query for remote execution; returns typed results
+        (the client half of executor.go:1009-1091).  proto3 omits
+        zero-valued fields, so each QueryResult is interpreted against its
+        call's expected type, as the reference does (executor.go:1068-1085).
+        """
+        resp = self.execute_query(index, str(query), slices=slices, remote=True)
+        return [
+            _result_from_wire(r, expect=c.name)
+            for r, c in zip(resp["results"], query.calls)
+        ]
+
+    def execute_remote_call(self, index: str, call: "pql.Call", slices: Sequence[int]):
+        results = self.execute_remote(index, pql.Query(calls=[call]), slices=slices)
+        return results[0]
+
+    # -- schema (client.go:392-460) ----------------------------------------
+
+    def schema(self) -> list[dict]:
+        return self._json("GET", "/schema")["indexes"]
+
+    def create_index(self, index: str, options: Optional[dict] = None) -> None:
+        self._json("POST", f"/index/{index}", {"options": options or {}})
+
+    def delete_index(self, index: str) -> None:
+        self._json("DELETE", f"/index/{index}")
+
+    def create_frame(self, index: str, frame: str, options: Optional[dict] = None) -> None:
+        self._json("POST", f"/index/{index}/frame/{frame}", {"options": options or {}})
+
+    def delete_frame(self, index: str, frame: str) -> None:
+        self._json("DELETE", f"/index/{index}/frame/{frame}")
+
+    def frame_views(self, index: str, frame: str) -> list[str]:
+        return self._json("GET", f"/index/{index}/frame/{frame}/views")["views"]
+
+    def max_slices(self, inverse: bool = False) -> dict[str, int]:
+        suffix = "?inverse=true" if inverse else ""
+        return self._json("GET", f"/slices/max{suffix}")["maxSlices"]
+
+    def hosts(self) -> list[dict]:
+        return self._json("GET", "/hosts")
+
+    def status(self) -> dict:
+        return self._json("GET", "/status")["status"]
+
+    def version(self) -> str:
+        return self._json("GET", "/version")["version"]
+
+    # -- import (client.go:304-390) ----------------------------------------
+
+    def import_bits(
+        self,
+        index: str,
+        frame: str,
+        bits: Sequence[tuple],
+        fragment_nodes=None,
+    ) -> None:
+        """Group (row, col[, timestamp]) bits by slice and POST each group to
+        every owner node (client.go:304-331)."""
+        groups: dict[int, list[tuple]] = {}
+        for bit in bits:
+            slice_i = int(bit[1]) // SLICE_WIDTH
+            groups.setdefault(slice_i, []).append(bit)
+        for slice_i, group in sorted(groups.items()):
+            rows = [int(b[0]) for b in group]
+            cols = [int(b[1]) for b in group]
+            ts = [int(b[2]) if len(b) > 2 and b[2] else 0 for b in group]
+            payload = wire.encode_import_request(
+                index, frame, slice_i, rows, cols, ts if any(ts) else None
+            )
+            hosts = [self.base]
+            if fragment_nodes is not None:
+                hosts = [n.host for n in fragment_nodes(index, slice_i)]
+            for host in hosts:
+                client = self if host == self.base else Client(host, self.timeout)
+                status, resp = client._request(
+                    "POST", "/import", payload, content_type=PROTOBUF, accept=PROTOBUF
+                )
+                if status >= 400:
+                    raise ClientError(status, resp.decode(errors="replace"))
+
+    # -- export / backup / restore (client.go:463-676) ----------------------
+
+    def export_csv(self, index: str, frame: str, view: str, slice_i: int) -> str:
+        status, payload = self._request(
+            "GET", f"/export?index={index}&frame={frame}&view={view}&slice={slice_i}"
+        )
+        if status >= 400:
+            raise ClientError(status, payload.decode(errors="replace"))
+        return payload.decode()
+
+    def fragment_data(self, index: str, frame: str, view: str, slice_i: int) -> Optional[bytes]:
+        status, payload = self._request(
+            "GET", f"/fragment/data?index={index}&frame={frame}&view={view}&slice={slice_i}"
+        )
+        if status == 404:
+            return None
+        if status >= 400:
+            raise ClientError(status, payload.decode(errors="replace"))
+        return payload
+
+    def restore_fragment(self, index: str, frame: str, view: str, slice_i: int, data: bytes) -> None:
+        status, payload = self._request(
+            "POST",
+            f"/fragment/data?index={index}&frame={frame}&view={view}&slice={slice_i}",
+            data,
+            content_type="application/octet-stream",
+        )
+        if status >= 400:
+            raise ClientError(status, payload.decode(errors="replace"))
+
+    def restore_frame(self, index: str, frame: str, host: str) -> None:
+        self._json("POST", f"/index/{index}/frame/{frame}/restore?host={host}")
+
+    # -- block sync (client.go:700-860) --------------------------------------
+
+    def fragment_blocks(self, index: str, frame: str, view: str, slice_i: int) -> list[tuple[int, bytes]]:
+        resp = self._json(
+            "GET", f"/fragment/blocks?index={index}&frame={frame}&view={view}&slice={slice_i}"
+        )
+        return [(b["id"], bytes.fromhex(b["checksum"])) for b in resp["blocks"]]
+
+    def block_data(self, index: str, frame: str, view: str, slice_i: int, block: int):
+        status, payload = self._request(
+            "GET",
+            f"/fragment/block/data?index={index}&frame={frame}&view={view}&slice={slice_i}&block={block}",
+            accept=PROTOBUF,
+        )
+        if status >= 400:
+            raise ClientError(status, payload.decode(errors="replace"))
+        rows, cols = wire.decode_block_data_response(payload)
+        return np.array(rows, dtype=np.uint64), np.array(cols, dtype=np.uint64)
+
+    def post_block_diff(
+        self,
+        index: str,
+        frame: str,
+        view: str,
+        slice_i: int,
+        set_bits: tuple[list[int], list[int]],
+        clear_bits: tuple[list[int], list[int]],
+    ) -> None:
+        payload = wire.encode_block_diff(set_bits[0], set_bits[1], clear_bits[0], clear_bits[1])
+        status, resp = self._request(
+            "POST",
+            f"/fragment/block/diff?index={index}&frame={frame}&view={view}&slice={slice_i}",
+            payload,
+            content_type=PROTOBUF,
+        )
+        if status >= 400:
+            raise ClientError(status, resp.decode(errors="replace"))
+
+    def column_attr_diff(self, index: str, blocks: list[tuple[int, bytes]]) -> dict[int, dict]:
+        resp = self._json(
+            "POST",
+            f"/index/{index}/attr/diff",
+            {"blocks": [{"id": b, "checksum": c.hex()} for b, c in blocks]},
+        )
+        return {int(k): v for k, v in resp["attrs"].items()}
+
+    def row_attr_diff(self, index: str, frame: str, blocks: list[tuple[int, bytes]]) -> dict[int, dict]:
+        resp = self._json(
+            "POST",
+            f"/index/{index}/frame/{frame}/attr/diff",
+            {"blocks": [{"id": b, "checksum": c.hex()} for b, c in blocks]},
+        )
+        return {int(k): v for k, v in resp["attrs"].items()}
+
+
+def _result_from_wire(r: dict, expect: str = ""):
+    """Decode one wire QueryResult into executor-level result types."""
+    if expect == "Count":
+        return int(r.get("n", 0))
+    if expect == "TopN":
+        return [Pair(id=p["id"], count=p["count"]) for p in r.get("pairs", [])]
+    if expect in ("SetBit", "ClearBit"):
+        return bool(r.get("changed", False))
+    if expect in ("SetRowAttrs", "SetColumnAttrs", "SetProfileAttrs"):
+        return None
+    if expect in ("Bitmap", "Intersect", "Union", "Difference", "Xor", "Range") and "bitmap" not in r:
+        return QueryBitmap({}, {})
+    if "bitmap" in r:
+        bits = np.array(r["bitmap"]["bits"], dtype=np.uint64)
+        segments: dict[int, np.ndarray] = {}
+        if len(bits):
+            slices = bits // np.uint64(SLICE_WIDTH)
+            for s in np.unique(slices):
+                local = bits[slices == s] % np.uint64(SLICE_WIDTH)
+                segments[int(s)] = pack_positions(local)
+        return QueryBitmap(segments, r["bitmap"].get("attrs") or {})
+    if "pairs" in r:
+        return [Pair(id=p["id"], count=p["count"]) for p in r["pairs"]]
+    if "changed" in r:
+        return r["changed"]
+    if "n" in r:
+        return r["n"]
+    return None
+
+
+def bits_group_by_slice(bits: Sequence[tuple]) -> dict[int, list[tuple]]:
+    """client.go:1027-1043 Bits.GroupBySlice."""
+    groups: dict[int, list[tuple]] = {}
+    for bit in bits:
+        groups.setdefault(int(bit[1]) // SLICE_WIDTH, []).append(bit)
+    return groups

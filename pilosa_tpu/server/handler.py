@@ -1,0 +1,581 @@
+"""HTTP API handler: the reference's full route table on stdlib http.server.
+
+Reference analog: handler.go (1429 LoC; route table handler.go:82-120).
+Routes:
+
+    GET    /                                        welcome / WebUI
+    GET    /index                                   list indexes
+    GET    /index/{index}                           index info
+    POST   /index/{index}                           create index
+    DELETE /index/{index}                           delete index
+    POST   /index/{index}/attr/diff                 column attr-diff (sync)
+    POST   /index/{index}/frame/{frame}             create frame
+    DELETE /index/{index}/frame/{frame}             delete frame
+    POST   /index/{index}/query                     PQL query (JSON or protobuf)
+    POST   /index/{index}/frame/{frame}/attr/diff   row attr-diff (sync)
+    POST   /index/{index}/frame/{frame}/restore     restore frame from peers
+    PATCH  /index/{index}/frame/{frame}/time-quantum
+    GET    /index/{index}/frame/{frame}/views
+    PATCH  /index/{index}/time-quantum
+    GET    /debug/vars                              expvar-style stats
+    GET    /debug/pprof/...                         thread/profile dump
+    GET    /export                                  CSV export
+    GET    /fragment/block/data                     block bit data (protobuf)
+    GET    /fragment/blocks                         block checksums
+    GET    /fragment/data                           raw fragment snapshot
+    POST   /fragment/data                           replace fragment (restore)
+    GET    /fragment/nodes                          owner nodes for a slice
+    POST   /import                                  bulk import (protobuf)
+    GET    /hosts                                   cluster hosts
+    GET    /schema                                  full schema
+    GET    /slices/max                              per-index max slice
+    GET    /status                                  cluster status
+    GET    /version
+
+Content negotiation mirrors handler.go:816-898: requests/responses use
+``application/x-protobuf`` when the Content-Type/Accept headers ask for
+it, JSON otherwise.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import re
+import threading
+import traceback
+from datetime import datetime
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+import numpy as np
+
+from pilosa_tpu import pilosa as errors
+from pilosa_tpu import pql, wire
+from pilosa_tpu.core.cache import Pair
+from pilosa_tpu.core.frame import FrameOptions
+from pilosa_tpu.core.index import IndexOptions
+from pilosa_tpu.core.view import VIEW_STANDARD
+from pilosa_tpu.executor import ExecOptions, QueryBitmap
+from pilosa_tpu.pilosa import SLICE_WIDTH, PilosaError
+
+VERSION = "0.1.0-tpu"
+
+PROTOBUF = "application/x-protobuf"
+
+
+class HTTPError(Exception):
+    def __init__(self, status: int, message: str):
+        super().__init__(message)
+        self.status = status
+        self.message = message
+
+
+def result_to_json(result):
+    if isinstance(result, QueryBitmap):
+        return result.to_json()
+    if isinstance(result, list) and (not result or isinstance(result[0], Pair)):
+        return [p.to_json() for p in result]
+    return result
+
+
+class Handler:
+    """Routes requests to the holder/executor; transport-agnostic core."""
+
+    def __init__(self, holder, executor, cluster=None, host="", broadcaster=None, stats=None, client_factory=None):
+        self.holder = holder
+        self.executor = executor
+        self.cluster = cluster
+        self.host = host
+        self.broadcaster = broadcaster  # schema-mutation broadcast hook
+        self.stats = stats
+        self.client_factory = client_factory
+        self.version = VERSION
+        self._routes = self._build_routes()
+
+    # -- routing -------------------------------------------------------
+
+    def _build_routes(self):
+        return [
+            ("GET", re.compile(r"^/$"), self.get_root),
+            ("GET", re.compile(r"^/index$"), self.get_indexes),
+            ("GET", re.compile(r"^/index/(?P<index>[^/]+)$"), self.get_index),
+            ("POST", re.compile(r"^/index/(?P<index>[^/]+)$"), self.post_index),
+            ("DELETE", re.compile(r"^/index/(?P<index>[^/]+)$"), self.delete_index),
+            ("POST", re.compile(r"^/index/(?P<index>[^/]+)/attr/diff$"), self.post_index_attr_diff),
+            ("POST", re.compile(r"^/index/(?P<index>[^/]+)/frame/(?P<frame>[^/]+)$"), self.post_frame),
+            ("DELETE", re.compile(r"^/index/(?P<index>[^/]+)/frame/(?P<frame>[^/]+)$"), self.delete_frame),
+            ("POST", re.compile(r"^/index/(?P<index>[^/]+)/query$"), self.post_query),
+            ("POST", re.compile(r"^/index/(?P<index>[^/]+)/frame/(?P<frame>[^/]+)/attr/diff$"), self.post_frame_attr_diff),
+            ("POST", re.compile(r"^/index/(?P<index>[^/]+)/frame/(?P<frame>[^/]+)/restore$"), self.post_frame_restore),
+            ("PATCH", re.compile(r"^/index/(?P<index>[^/]+)/frame/(?P<frame>[^/]+)/time-quantum$"), self.patch_frame_time_quantum),
+            ("GET", re.compile(r"^/index/(?P<index>[^/]+)/frame/(?P<frame>[^/]+)/views$"), self.get_frame_views),
+            ("PATCH", re.compile(r"^/index/(?P<index>[^/]+)/time-quantum$"), self.patch_index_time_quantum),
+            ("GET", re.compile(r"^/debug/vars$"), self.get_expvar),
+            ("GET", re.compile(r"^/debug/pprof(?:/.*)?$"), self.get_pprof),
+            ("GET", re.compile(r"^/export$"), self.get_export),
+            ("GET", re.compile(r"^/fragment/block/data$"), self.get_fragment_block_data),
+            ("POST", re.compile(r"^/fragment/block/diff$"), self.post_fragment_block_diff),
+            ("GET", re.compile(r"^/fragment/blocks$"), self.get_fragment_blocks),
+            ("GET", re.compile(r"^/fragment/data$"), self.get_fragment_data),
+            ("POST", re.compile(r"^/fragment/data$"), self.post_fragment_data),
+            ("GET", re.compile(r"^/fragment/nodes$"), self.get_fragment_nodes),
+            ("POST", re.compile(r"^/import$"), self.post_import),
+            ("GET", re.compile(r"^/hosts$"), self.get_hosts),
+            ("GET", re.compile(r"^/schema$"), self.get_schema),
+            ("GET", re.compile(r"^/slices/max$"), self.get_slices_max),
+            ("GET", re.compile(r"^/status$"), self.get_status),
+            ("GET", re.compile(r"^/version$"), self.get_version),
+        ]
+
+    def dispatch(self, method: str, path: str, params: dict, body: bytes, headers: dict):
+        """Returns (status, content_type, payload bytes)."""
+        matched_path = False
+        for m, pattern, fn in self._routes:
+            match = pattern.match(path)
+            if match is None:
+                continue
+            matched_path = True
+            if m != method:
+                continue
+            try:
+                return fn(params=params, body=body, headers=headers, **match.groupdict())
+            except HTTPError as e:
+                return e.status, "application/json", json.dumps({"error": e.message}).encode()
+            except errors.ErrIndexNotFound as e:
+                return 404, "application/json", json.dumps({"error": str(e)}).encode()
+            except errors.ErrFrameNotFound as e:
+                return 404, "application/json", json.dumps({"error": str(e)}).encode()
+            except (errors.ErrIndexExists, errors.ErrFrameExists) as e:
+                return 409, "application/json", json.dumps({"error": str(e)}).encode()
+            except (PilosaError, pql.ParseError, ValueError, TypeError) as e:
+                return 400, "application/json", json.dumps({"error": str(e)}).encode()
+            except Exception as e:  # internal error
+                traceback.print_exc()
+                return 500, "application/json", json.dumps({"error": str(e)}).encode()
+        if matched_path:
+            return 405, "text/plain", b"method not allowed"
+        return 404, "text/plain", b"not found"
+
+    # -- helpers --------------------------------------------------------
+
+    @staticmethod
+    def _json(obj, status=200):
+        return status, "application/json", (json.dumps(obj) + "\n").encode()
+
+    @staticmethod
+    def _wants_protobuf(headers) -> bool:
+        return PROTOBUF in headers.get("accept", "")
+
+    @staticmethod
+    def _sends_protobuf(headers) -> bool:
+        return PROTOBUF in headers.get("content-type", "")
+
+    @staticmethod
+    def _param(params, name, default=None):
+        v = params.get(name)
+        return v[0] if v else default
+
+    def _frag(self, params):
+        index = self._param(params, "index")
+        frame = self._param(params, "frame")
+        view = self._param(params, "view", VIEW_STANDARD)
+        slice_i = int(self._param(params, "slice", 0))
+        frag = self.holder.fragment(index, frame, view, slice_i)
+        if frag is None:
+            raise HTTPError(404, "fragment not found")
+        return frag
+
+    # -- root / misc -----------------------------------------------------
+
+    def get_root(self, **kw):
+        return (
+            200,
+            "text/plain",
+            b"Welcome. pilosa-tpu is running. POST PQL to /index/{index}/query.\n",
+        )
+
+    def get_version(self, **kw):
+        return self._json({"version": self.version})
+
+    def get_hosts(self, **kw):
+        nodes = self.cluster.nodes if self.cluster else []
+        return self._json([n.to_json() for n in nodes])
+
+    def get_schema(self, **kw):
+        return self._json({"indexes": self.holder.schema()})
+
+    def get_status(self, **kw):
+        status = {
+            "host": self.host,
+            "state": "UP",
+            "cluster": self.cluster.status_json() if self.cluster else {"nodes": []},
+            "indexes": self.holder.schema(),
+        }
+        return self._json({"status": status})
+
+    def get_slices_max(self, params=None, headers=None, **kw):
+        m = self.holder.max_slices()
+        if headers and self._wants_protobuf(headers):
+            return 200, PROTOBUF, wire.encode_max_slices_response(m)
+        inverse = self._param(params or {}, "inverse") == "true"
+        if inverse:
+            m = self.holder.max_inverse_slices()
+        return self._json({"maxSlices": m})
+
+    def get_expvar(self, **kw):
+        stats = {}
+        if self.stats is not None and hasattr(self.stats, "snapshot"):
+            stats = self.stats.snapshot()
+        return self._json(stats)
+
+    def get_pprof(self, **kw):
+        # Python analog of /debug/pprof: live thread stack dump.
+        import sys
+
+        out = io.StringIO()
+        frames = sys._current_frames()
+        for tid, frame in frames.items():
+            out.write(f"--- thread {tid} ---\n")
+            out.write("".join(traceback.format_stack(frame)))
+        return 200, "text/plain", out.getvalue().encode()
+
+    # -- index lifecycle --------------------------------------------------
+
+    def get_indexes(self, **kw):
+        return self._json({"indexes": self.holder.schema()})
+
+    def get_index(self, index=None, **kw):
+        idx = self.holder.index(index)
+        if idx is None:
+            raise errors.ErrIndexNotFound(index)
+        return self._json({"index": idx.schema_json()})
+
+    def post_index(self, index=None, body=b"", **kw):
+        opts = {}
+        if body:
+            opts = (json.loads(body) or {}).get("options", {})
+        self.holder.create_index(
+            index,
+            IndexOptions(
+                column_label=opts.get("columnLabel", ""),
+                time_quantum=opts.get("timeQuantum", ""),
+            ),
+        )
+        if self.broadcaster is not None:
+            self.broadcaster.create_index(index, opts)
+        return self._json({})
+
+    def delete_index(self, index=None, **kw):
+        self.holder.delete_index(index)
+        if self.broadcaster is not None:
+            self.broadcaster.delete_index(index)
+        return self._json({})
+
+    def patch_index_time_quantum(self, index=None, body=b"", **kw):
+        idx = self.holder.index(index)
+        if idx is None:
+            raise errors.ErrIndexNotFound(index)
+        q = (json.loads(body) or {}).get("timeQuantum", "")
+        idx.set_time_quantum(q)
+        return self._json({})
+
+    # -- frame lifecycle --------------------------------------------------
+
+    def post_frame(self, index=None, frame=None, body=b"", **kw):
+        idx = self.holder.index(index)
+        if idx is None:
+            raise errors.ErrIndexNotFound(index)
+        opts = {}
+        if body:
+            opts = (json.loads(body) or {}).get("options", {})
+        idx.create_frame(
+            frame,
+            FrameOptions(
+                row_label=opts.get("rowLabel", ""),
+                inverse_enabled=opts.get("inverseEnabled", False),
+                cache_type=opts.get("cacheType", ""),
+                cache_size=opts.get("cacheSize", 0),
+                time_quantum=opts.get("timeQuantum", ""),
+            ),
+        )
+        if self.broadcaster is not None:
+            self.broadcaster.create_frame(index, frame, opts)
+        return self._json({})
+
+    def delete_frame(self, index=None, frame=None, **kw):
+        idx = self.holder.index(index)
+        if idx is None:
+            raise errors.ErrIndexNotFound(index)
+        idx.delete_frame(frame)
+        if self.broadcaster is not None:
+            self.broadcaster.delete_frame(index, frame)
+        return self._json({})
+
+    def patch_frame_time_quantum(self, index=None, frame=None, body=b"", **kw):
+        f = self.holder.frame(index, frame)
+        if f is None:
+            raise errors.ErrFrameNotFound(frame)
+        q = (json.loads(body) or {}).get("timeQuantum", "")
+        f.set_time_quantum(q)
+        return self._json({})
+
+    def get_frame_views(self, index=None, frame=None, **kw):
+        f = self.holder.frame(index, frame)
+        if f is None:
+            raise errors.ErrFrameNotFound(frame)
+        return self._json({"views": sorted(f.views.keys())})
+
+    # -- query (handler.go:179-243) ----------------------------------------
+
+    def post_query(self, index=None, params=None, body=b"", headers=None, **kw):
+        headers = headers or {}
+        params = params or {}
+        if self._sends_protobuf(headers):
+            req = wire.decode_query_request(body)
+            query_str = req["query"]
+            slices = req["slices"] or None
+            column_attrs = req["column_attrs"]
+            remote = req["remote"]
+        else:
+            query_str = body.decode()
+            slices_param = self._param(params, "slices")
+            slices = [int(s) for s in slices_param.split(",")] if slices_param else None
+            column_attrs = self._param(params, "columnAttrs") == "true"
+            remote = self._param(params, "remote") == "true"
+
+        opt = ExecOptions(remote=remote)
+        try:
+            results = self.executor.execute(index, query_str, slices=slices, opt=opt)
+        except (PilosaError, pql.ParseError) as e:
+            if self._wants_protobuf(headers):
+                return 400, PROTOBUF, wire.encode_query_response(err=str(e))
+            return 400, "application/json", json.dumps({"error": str(e)}).encode()
+
+        column_attr_sets = []
+        if column_attrs:
+            idx = self.holder.index(index)
+            seen = set()
+            for r in results:
+                if isinstance(r, QueryBitmap):
+                    for col in r.bits():
+                        if col in seen:
+                            continue
+                        seen.add(col)
+                        attrs = idx.column_attr_store.attrs(col)
+                        if attrs:
+                            column_attr_sets.append((col, attrs))
+
+        if self._wants_protobuf(headers):
+            return 200, PROTOBUF, wire.encode_query_response(
+                results=results, column_attr_sets=column_attr_sets
+            )
+        out = {"results": [result_to_json(r) for r in results]}
+        if column_attr_sets:
+            out["columnAttrSets"] = [
+                {"id": id, "attrs": attrs} for id, attrs in column_attr_sets
+            ]
+        return self._json(out)
+
+    # -- import (handler.go:900-978) ---------------------------------------
+
+    def post_import(self, body=b"", headers=None, **kw):
+        req = wire.decode_import_request(body)
+        index_name, frame_name = req["index"], req["frame"]
+        slice_i = req["slice"]
+        idx = self.holder.index(index_name)
+        if idx is None:
+            raise errors.ErrIndexNotFound(index_name)
+        frame = idx.frame(frame_name)
+        if frame is None:
+            raise errors.ErrFrameNotFound(frame_name)
+        # Reject imports for slices this node doesn't own (412, handler.go:936).
+        if self.cluster is not None and self.host:
+            if not self.cluster.owns_fragment(self.host, index_name, slice_i):
+                raise HTTPError(412, f"host does not own slice {slice_i}")
+        timestamps = [
+            datetime.utcfromtimestamp(t) if t else None for t in req["timestamps"]
+        ] or None
+        frame.import_bits(req["rowIDs"], req["columnIDs"], timestamps)
+        return self._json({})
+
+    # -- export (handler.go:990-1030) --------------------------------------
+
+    def get_export(self, params=None, headers=None, **kw):
+        params = params or {}
+        index = self._param(params, "index")
+        frame = self._param(params, "frame")
+        view = self._param(params, "view", VIEW_STANDARD)
+        slice_i = int(self._param(params, "slice", 0))
+        frag = self.holder.fragment(index, frame, view, slice_i)
+        if frag is None:
+            raise HTTPError(404, "fragment not found")
+        out = io.StringIO()
+        positions = frag.storage.to_array()
+        rows = positions // np.uint64(SLICE_WIDTH)
+        cols = positions % np.uint64(SLICE_WIDTH) + np.uint64(slice_i * SLICE_WIDTH)
+        for r, c in zip(rows.tolist(), cols.tolist()):
+            out.write(f"{r},{c}\n")
+        return 200, "text/csv", out.getvalue().encode()
+
+    # -- fragment data / sync (handler.go:1053-1178) ------------------------
+
+    def get_fragment_data(self, params=None, **kw):
+        frag = self._frag(params or {})
+        buf = io.BytesIO()
+        frag.write_to(buf)
+        return 200, "application/octet-stream", buf.getvalue()
+
+    def post_fragment_data(self, params=None, body=b"", **kw):
+        params = params or {}
+        index = self._param(params, "index")
+        frame_name = self._param(params, "frame")
+        view_name = self._param(params, "view", VIEW_STANDARD)
+        slice_i = int(self._param(params, "slice", 0))
+        frame = self.holder.frame(index, frame_name)
+        if frame is None:
+            raise HTTPError(404, "frame not found")
+        view = frame.create_view_if_not_exists(view_name)
+        frag = view.create_fragment_if_not_exists(slice_i)
+        frag.read_from(body)
+        return self._json({})
+
+    def get_fragment_blocks(self, params=None, **kw):
+        frag = self._frag(params or {})
+        return self._json(
+            {"blocks": [{"id": bid, "checksum": chk.hex()} for bid, chk in frag.blocks()]}
+        )
+
+    def get_fragment_block_data(self, params=None, body=b"", headers=None, **kw):
+        headers = headers or {}
+        if body and self._sends_protobuf(headers):
+            req = wire.decode_block_data_request(body)
+            index, frame = req["index"], req["frame"]
+            view, slice_i, block = req["view"], req["slice"], req["block"]
+        else:
+            params = params or {}
+            index = self._param(params, "index")
+            frame = self._param(params, "frame")
+            view = self._param(params, "view", VIEW_STANDARD)
+            slice_i = int(self._param(params, "slice", 0))
+            block = int(self._param(params, "block", 0))
+        frag = self.holder.fragment(index, frame, view, slice_i)
+        if frag is None:
+            raise HTTPError(404, "fragment not found")
+        rows, cols = frag.block_data(block)
+        payload = wire.encode_block_data_response(rows.tolist(), cols.tolist())
+        return 200, PROTOBUF, payload
+
+    def post_fragment_block_diff(self, params=None, body=b"", **kw):
+        """Apply a sync diff directly to a fragment (any view) — the
+        receiving half of the anti-entropy push."""
+        frag = self._frag(params or {})
+        set_rows, set_cols, clear_rows, clear_cols = wire.decode_block_diff(body)
+        for r, c in zip(set_rows, set_cols):
+            frag.set_bit(r, c)
+        for r, c in zip(clear_rows, clear_cols):
+            frag.clear_bit(r, c)
+        return self._json({})
+
+    def get_fragment_nodes(self, params=None, **kw):
+        params = params or {}
+        index = self._param(params, "index")
+        slice_i = int(self._param(params, "slice", 0))
+        if self.cluster is None:
+            return self._json([{"host": self.host, "internalHost": "", "state": "UP"}])
+        nodes = self.cluster.fragment_nodes(index, slice_i)
+        return self._json([n.to_json() for n in nodes])
+
+    # -- attr diff (handler.go:472-518, 735-782) -----------------------------
+
+    def post_index_attr_diff(self, index=None, body=b"", **kw):
+        idx = self.holder.index(index)
+        if idx is None:
+            raise errors.ErrIndexNotFound(index)
+        return self._attr_diff(idx.column_attr_store, body)
+
+    def post_frame_attr_diff(self, index=None, frame=None, body=b"", **kw):
+        f = self.holder.frame(index, frame)
+        if f is None:
+            raise errors.ErrFrameNotFound(frame)
+        return self._attr_diff(f.row_attr_store, body)
+
+    def _attr_diff(self, store, body: bytes):
+        # Requester posts its block checksums; we reply with our attrs for
+        # every block where our data differs (or they lack the block), and
+        # the requester merges what it's missing (attr.go:394-428).
+        req = json.loads(body or b"{}")
+        remote = {b["id"]: bytes.fromhex(b["checksum"]) for b in req.get("blocks", [])}
+        ids = [bid for bid, chk in store.blocks() if remote.get(bid) != chk]
+        attrs = {}
+        for bid in sorted(ids):
+            for id, a in store.block_data(bid).items():
+                attrs[str(id)] = a
+        return self._json({"attrs": attrs})
+
+    # -- frame restore (handler.go:1184-1271) --------------------------------
+
+    def post_frame_restore(self, index=None, frame=None, params=None, **kw):
+        params = params or {}
+        src_host = self._param(params, "host")
+        if not src_host:
+            raise HTTPError(400, "host required")
+        if self.client_factory is None:
+            raise HTTPError(500, "no client factory configured")
+        client = self.client_factory(src_host)
+        f = self.holder.frame(index, frame)
+        if f is None:
+            raise errors.ErrFrameNotFound(frame)
+        max_slices = client.max_slices()
+        max_slice = max_slices.get(index, 0)
+        for view_name in client.frame_views(index, frame):
+            view = f.create_view_if_not_exists(view_name)
+            for slice_i in range(max_slice + 1):
+                data = client.fragment_data(index, frame, view_name, slice_i)
+                if data is None:
+                    continue
+                frag = view.create_fragment_if_not_exists(slice_i)
+                frag.read_from(data)
+        return self._json({})
+
+
+class _HTTPRequestHandler(BaseHTTPRequestHandler):
+    handler: Handler = None  # set by serve()
+    protocol_version = "HTTP/1.1"
+
+    def _run(self, method: str):
+        parsed = urlparse(self.path)
+        params = parse_qs(parsed.query)
+        length = int(self.headers.get("Content-Length") or 0)
+        body = self.rfile.read(length) if length else b""
+        headers = {k.lower(): v for k, v in self.headers.items()}
+        status, ctype, payload = self.handler.dispatch(method, parsed.path, params, body, headers)
+        self.send_response(status)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def do_GET(self):
+        self._run("GET")
+
+    def do_POST(self):
+        self._run("POST")
+
+    def do_DELETE(self):
+        self._run("DELETE")
+
+    def do_PATCH(self):
+        self._run("PATCH")
+
+    def log_message(self, fmt, *args):  # quiet by default
+        pass
+
+
+def serve(handler: Handler, host: str = "127.0.0.1", port: int = 0) -> ThreadingHTTPServer:
+    """Start an HTTP server for the handler; returns the (running) server."""
+    cls = type("BoundHandler", (_HTTPRequestHandler,), {"handler": handler})
+    httpd = ThreadingHTTPServer((host, port), cls)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    return httpd

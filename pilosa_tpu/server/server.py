@@ -1,0 +1,248 @@
+"""Server composition: holder + executor + handler + cluster + loops.
+
+Reference analog: server.go (wiring + lifecycle server.go:42-158) and
+server/server.go (cluster-type selection).  Background loops:
+
+- anti-entropy every ``anti_entropy_interval`` (default 10 min,
+  server.go:186-218) via HolderSyncer,
+- max-slice polling of peers every ``polling_interval`` (default 60 s,
+  server.go:221-256) so reads span slices created elsewhere,
+- rank-cache flush every 60 s (holder.go:324-358).
+
+Broadcast receive (server.go:259-304): schema mutations arriving from
+peers are applied to the local holder.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional
+
+from pilosa_tpu import broadcast as bc
+from pilosa_tpu.cluster import Cluster, Node
+from pilosa_tpu.config import (
+    CLUSTER_TYPE_GOSSIP,
+    CLUSTER_TYPE_HTTP,
+    CLUSTER_TYPE_STATIC,
+    Config,
+)
+from pilosa_tpu.core.frame import FrameOptions
+from pilosa_tpu.core.holder import CACHE_FLUSH_INTERVAL, Holder
+from pilosa_tpu.core.index import IndexOptions
+from pilosa_tpu.executor import Executor
+from pilosa_tpu.server.client import Client
+from pilosa_tpu.server.handler import Handler, serve
+from pilosa_tpu.syncer import HolderSyncer
+
+
+class Server:
+    def __init__(self, config: Optional[Config] = None, stats=None):
+        self.config = config or Config()
+        self.stats = stats
+        self.host = self.config.host
+        self.data_dir = os.path.expanduser(self.config.data_dir)
+
+        self.holder = Holder(self.data_dir, stats=stats)
+        self.cluster = self._build_cluster()
+        self.client_factory = lambda host: Client(host)
+        self.executor = Executor(
+            self.holder,
+            engine=self.config.engine,
+            cluster=self.cluster if len(self.cluster.nodes) > 1 else None,
+            client_factory=self.client_factory,
+            host=self.host,
+            max_writes_per_request=self.config.max_writes_per_request,
+        )
+        self.broadcaster, self.receiver = self._build_broadcast()
+        self.handler = Handler(
+            self.holder,
+            self.executor,
+            cluster=self.cluster,
+            host=self.host,
+            broadcaster=bc.SchemaBroadcaster(self.broadcaster),
+            stats=stats,
+            client_factory=self.client_factory,
+        )
+        self.syncer = HolderSyncer(self.holder, self.cluster, self.host, self.client_factory)
+
+        self._httpd = None
+        self._closing = threading.Event()
+        self._threads: list[threading.Thread] = []
+
+    # -- wiring ----------------------------------------------------------
+
+    def _build_cluster(self) -> Cluster:
+        hosts = self.config.cluster.hosts or [self.config.host]
+        internal = self.config.cluster.internal_hosts
+        nodes = [
+            Node(host=h, internal_host=internal[i] if i < len(internal) else "")
+            for i, h in enumerate(hosts)
+        ]
+        return Cluster(nodes=nodes, replica_n=self.config.cluster.replica_n)
+
+    def _build_broadcast(self):
+        ctype = self.config.cluster.type
+        if ctype == CLUSTER_TYPE_STATIC or len(self.cluster.nodes) <= 1:
+            return bc.NopBroadcaster(), None
+        if ctype in (CLUSTER_TYPE_HTTP, CLUSTER_TYPE_GOSSIP):
+            # Gossip rides the same internal HTTP port in this build; the
+            # membership semantics of memberlist are approximated by the
+            # static host list + per-request failure marking.
+            me = self.cluster.node_by_host(self.host)
+            my_internal = me.internal_host if me else ""
+            internal_hosts = [n.internal_host or n.host for n in self.cluster.nodes]
+            broadcaster = bc.HTTPBroadcaster(internal_hosts, self_host=my_internal)
+            port = 0
+            if my_internal and ":" in my_internal:
+                port = int(my_internal.rsplit(":", 1)[1])
+            receiver = bc.HTTPBroadcastReceiver(port)
+            return broadcaster, receiver
+        raise ValueError(f"unknown cluster type: {ctype}")
+
+    # -- lifecycle (server.go:92-158) --------------------------------------
+
+    def open(self) -> None:
+        os.makedirs(self.data_dir, exist_ok=True)
+        self.holder.open()
+        self.holder.on_new_fragment = self._on_new_fragment
+        if self.receiver is not None:
+            self.receiver.start(self.receive_message)
+        host, port = self._split_host(self.host)
+        self._httpd = serve(self.handler, host=host, port=port)
+        actual_port = self._httpd.server_address[1]
+        if port == 0:
+            self.host = f"{host}:{actual_port}"
+            self.handler.host = self.host
+            self.executor.host = self.host
+            self.syncer.host = self.host
+            if self.cluster.nodes and self.cluster.nodes[0].host == self.config.host:
+                self.cluster.nodes[0].host = self.host
+        self._start_loop(self._monitor_anti_entropy, self.config.anti_entropy_interval)
+        self._start_loop(self._monitor_max_slices, self.config.cluster.polling_interval)
+        self._start_loop(self._flush_caches, CACHE_FLUSH_INTERVAL)
+
+    def close(self) -> None:
+        self._closing.set()
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd = None
+        if self.receiver is not None:
+            self.receiver.close()
+        self.holder.close()
+
+    @staticmethod
+    def _split_host(host: str) -> tuple[str, int]:
+        host = host.replace("http://", "")
+        if ":" in host:
+            name, port = host.rsplit(":", 1)
+            return name or "localhost", int(port)
+        return host, 10101
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1] if self._httpd else 0
+
+    # -- background loops ---------------------------------------------------
+
+    def _start_loop(self, fn, interval: float) -> None:
+        def loop():
+            while not self._closing.wait(interval):
+                try:
+                    fn()
+                except Exception:
+                    pass
+
+        t = threading.Thread(target=loop, daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    def _monitor_anti_entropy(self) -> None:
+        if len(self.cluster.nodes) > 1:
+            self.syncer.sync_holder()
+
+    def _monitor_max_slices(self) -> None:
+        """Poll peers' /slices/max so local reads span remote slices
+        (server.go:221-256)."""
+        if len(self.cluster.nodes) <= 1:
+            return
+        for node in self.cluster.nodes:
+            if node.host == self.host:
+                continue
+            client = self.client_factory(node.host)
+            try:
+                maxes = client.max_slices()
+                inverse_maxes = client.max_slices(inverse=True)
+            except Exception:
+                continue
+            for index_name, max_slice in maxes.items():
+                idx = self.holder.index(index_name)
+                if idx is not None:
+                    idx.set_remote_max_slice(max_slice)
+            for index_name, max_slice in inverse_maxes.items():
+                idx = self.holder.index(index_name)
+                if idx is not None:
+                    idx.set_remote_max_inverse_slice(max_slice)
+
+    def _flush_caches(self) -> None:
+        self.holder.flush_caches()
+
+    # -- broadcast integration ----------------------------------------------
+
+    def _on_new_fragment(self, index: str, frame: str, view: str, slice_i: int) -> None:
+        """New max slice created locally → async CreateSliceMessage
+        (view.go:219-254)."""
+        from pilosa_tpu.core.view import VIEW_INVERSE
+
+        try:
+            self.broadcaster.send_async(
+                bc.encode_create_slice(index, slice_i, is_inverse=(view == VIEW_INVERSE))
+            )
+        except Exception:
+            pass
+
+    def receive_message(self, data: bytes) -> None:
+        """Apply a peer's schema mutation (server.go:259-304)."""
+        typ, msg = bc.decode_message(data)
+        if typ == bc.MESSAGE_TYPE_CREATE_SLICE:
+            idx = self.holder.index(msg["index"])
+            if idx is not None:
+                if msg.get("isInverse"):
+                    idx.set_remote_max_inverse_slice(msg["slice"])
+                else:
+                    idx.set_remote_max_slice(msg["slice"])
+        elif typ == bc.MESSAGE_TYPE_CREATE_INDEX:
+            meta = msg.get("meta", {})
+            self.holder.create_index_if_not_exists(
+                msg["index"],
+                IndexOptions(
+                    column_label=meta.get("columnLabel", ""),
+                    time_quantum=meta.get("timeQuantum", ""),
+                ),
+            )
+        elif typ == bc.MESSAGE_TYPE_DELETE_INDEX:
+            try:
+                self.holder.delete_index(msg["index"])
+            except Exception:
+                pass
+        elif typ == bc.MESSAGE_TYPE_CREATE_FRAME:
+            idx = self.holder.index(msg["index"])
+            if idx is not None:
+                meta = msg.get("meta", {})
+                idx.create_frame_if_not_exists(
+                    msg["frame"],
+                    FrameOptions(
+                        row_label=meta.get("rowLabel", ""),
+                        inverse_enabled=meta.get("inverseEnabled", False),
+                        cache_type=meta.get("cacheType", ""),
+                        cache_size=meta.get("cacheSize", 0),
+                        time_quantum=meta.get("timeQuantum", ""),
+                    ),
+                )
+        elif typ == bc.MESSAGE_TYPE_DELETE_FRAME:
+            idx = self.holder.index(msg["index"])
+            if idx is not None:
+                try:
+                    idx.delete_frame(msg["frame"])
+                except Exception:
+                    pass

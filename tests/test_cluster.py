@@ -1,0 +1,107 @@
+"""Cluster placement + config + broadcast tests (cluster_test.go analog)."""
+
+import pytest
+
+from pilosa_tpu import broadcast as bc
+from pilosa_tpu.cluster import Cluster, Node, fnv1a64, jump_hash
+from pilosa_tpu.config import Config
+
+
+def make_cluster(n, replica_n=1):
+    return Cluster(nodes=[Node(host=f"host{i}:10101") for i in range(n)], replica_n=replica_n)
+
+
+def test_fnv1a64_known_vectors():
+    assert fnv1a64(b"") == 0xCBF29CE484222325
+    assert fnv1a64(b"a") == 0xAF63DC4C8601EC8C
+    assert fnv1a64(b"foobar") == 0x85944171F73967E8
+
+
+def test_jump_hash_properties():
+    # deterministic
+    assert jump_hash(12345, 10) == jump_hash(12345, 10)
+    # in range and uses all buckets over many keys
+    buckets = {jump_hash(k, 8) for k in range(1000)}
+    assert buckets == set(range(8))
+    # monotone stability: growing n only moves keys INTO the new bucket
+    for k in range(200):
+        b5, b6 = jump_hash(k, 5), jump_hash(k, 6)
+        assert b6 == b5 or b6 == 5
+
+
+def test_partition_stability():
+    c = make_cluster(3)
+    # partition depends only on (index, slice), not on nodes
+    p = c.partition("myindex", 7)
+    assert 0 <= p < 256
+    assert c.partition("myindex", 7) == p
+    assert c.partition("other", 7) != p or True  # different index may differ
+
+
+def test_fragment_nodes_and_replication():
+    c = make_cluster(4, replica_n=2)
+    nodes = c.fragment_nodes("i", 0)
+    assert len(nodes) == 2
+    assert nodes[0] is not nodes[1]
+    # consecutive ring placement
+    i0 = c.nodes.index(nodes[0])
+    assert c.nodes[(i0 + 1) % 4] is nodes[1]
+    # all slices covered, ownership deterministic
+    assert c.owns_fragment(nodes[0].host, "i", 0)
+    assert not c.owns_fragment("nobody:1", "i", 0)
+
+
+def test_owns_slices_partition_of_work():
+    c = make_cluster(3)
+    max_slice = 29
+    all_slices = []
+    for node in c.nodes:
+        all_slices += c.owns_slices("i", max_slice, node.host)
+    assert sorted(all_slices) == list(range(max_slice + 1))
+
+
+def test_slices_by_node_down_failover():
+    c = make_cluster(3, replica_n=2)
+    slices = list(range(12))
+    by_node = c.slices_by_node("i", slices)
+    assert sorted(s for ss in by_node.values() for s in ss) == slices
+    # kill one node: its slices must move to replicas
+    c.nodes[0].state = "DOWN"
+    by_node2 = c.slices_by_node("i", slices, exclude_down=True)
+    assert c.nodes[0] not in by_node2
+    assert sorted(s for ss in by_node2.values() for s in ss) == slices
+
+
+def test_broadcast_envelope_roundtrip():
+    for msg, typ, want in [
+        (bc.encode_create_slice("i", 5, True), bc.MESSAGE_TYPE_CREATE_SLICE, {"index": "i", "slice": 5, "isInverse": True}),
+        (bc.encode_delete_index("x"), bc.MESSAGE_TYPE_DELETE_INDEX, {"index": "x"}),
+        (bc.encode_delete_frame("x", "f"), bc.MESSAGE_TYPE_DELETE_FRAME, {"index": "x", "frame": "f"}),
+    ]:
+        t, payload = bc.decode_message(msg)
+        assert t == typ
+        for k, v in want.items():
+            assert payload[k] == v
+    t, payload = bc.decode_message(bc.encode_create_frame("i", "f", {"rowLabel": "r", "cacheSize": 9}))
+    assert payload["meta"]["rowLabel"] == "r"
+    assert payload["meta"]["cacheSize"] == 9
+    with pytest.raises(ValueError):
+        bc.decode_message(bytes([99]) + b"x")
+
+
+def test_config_toml_env_precedence(tmp_path, monkeypatch):
+    p = tmp_path / "cfg.toml"
+    p.write_text(
+        'data-dir = "/tmp/d"\nhost = "h:1"\n[cluster]\nreplicas = 2\nhosts = ["h:1", "h2:1"]\n'
+        '[anti-entropy]\ninterval = "5m"\n'
+    )
+    cfg = Config.from_toml(str(p))
+    assert cfg.data_dir == "/tmp/d"
+    assert cfg.cluster.replica_n == 2
+    assert cfg.anti_entropy_interval == 300.0
+    cfg.apply_env({"PILOSA_HOST": "env:9", "PILOSA_CLUSTER_REPLICAS": "3"})
+    assert cfg.host == "env:9"
+    assert cfg.cluster.replica_n == 3
+    # round-trip through to_toml parses again
+    cfg2 = Config.from_dict(__import__("tomllib").loads(cfg.to_toml()))
+    assert cfg2.cluster.replica_n == 3

@@ -1,0 +1,146 @@
+"""Wire codec tests against golden bytes from the official protobuf library.
+
+The golden constants below were produced by compiling the same schema
+(field numbers/types from the reference's internal/*.proto) with protoc
+and serializing with google.protobuf — so agreement here means real
+reference clients can talk to us.
+"""
+
+import numpy as np
+import pytest
+
+from pilosa_tpu import wire
+from pilosa_tpu.core.cache import Pair
+from pilosa_tpu.executor import QueryBitmap
+from pilosa_tpu.ops.bitwise import pack_positions
+
+QREQ = bytes.fromhex(
+    "0a16436f756e74284269746d617028726f7749443d312929120300010518012203594d442801"
+)
+BITMAP = bytes.fromhex(
+    "0a0801ac028080808020120c0a0661637469766510032801120f0a046e616d6510011a05616c696365"
+)
+QRESP = bytes.fromhex(
+    "122b0a290a0801ac028080808020120c0a0661637469766510032801120f0a046e616d6510011a05616c69"
+    "63651202102a120c1a04080710641a0408081032120220011a14080912100a0178100220fdffffffffffff"
+    "ffff01"
+)
+IMPORT = bytes.fromhex("0a01691201661802220201022a02030432060080dea0cb05")
+MAXSLICES = bytes.fromhex("0a070a0369647810040a050a01611000")
+
+
+def test_query_request_golden():
+    got = wire.encode_query_request(
+        "Count(Bitmap(rowID=1))", slices=[0, 1, 5], column_attrs=True, quantum="YMD", remote=True
+    )
+    assert got == QREQ
+    back = wire.decode_query_request(QREQ)
+    assert back == {
+        "query": "Count(Bitmap(rowID=1))",
+        "slices": [0, 1, 5],
+        "column_attrs": True,
+        "quantum": "YMD",
+        "remote": True,
+    }
+
+
+def test_bitmap_golden():
+    got = wire.encode_bitmap([1, 300, 1 << 33], {"active": True, "name": "alice"})
+    assert got == BITMAP
+    bits, attrs = wire.decode_bitmap(BITMAP)
+    assert bits == [1, 300, 1 << 33]
+    assert attrs == {"active": True, "name": "alice"}
+
+
+def test_query_response_golden():
+    seg = {0: pack_positions(np.array([1, 300], dtype=np.uint64))}
+    bm = QueryBitmap(seg, {"active": True, "name": "alice"})
+    # Build the equivalent response with our types (bits 1,300,2^33: use raw encode)
+    results = [
+        _RawBitmap([1, 300, 1 << 33], {"active": True, "name": "alice"}),
+        42,
+        [Pair(7, 100), Pair(8, 50)],
+        True,
+    ]
+    got = wire.encode_query_response(
+        results=results, column_attr_sets=[(9, {"x": -3})]
+    )
+    assert got == QRESP
+    back = wire.decode_query_response(QRESP)
+    assert back["err"] == ""
+    assert back["results"][0]["bitmap"]["bits"] == [1, 300, 1 << 33]
+    assert back["results"][1]["n"] == 42
+    assert back["results"][2]["pairs"] == [{"id": 7, "count": 100}, {"id": 8, "count": 50}]
+    assert back["results"][3]["changed"] is True
+    assert back["columnAttrSets"] == [{"id": 9, "attrs": {"x": -3}}]
+
+
+class _RawBitmap(QueryBitmap):
+    """QueryBitmap stand-in with explicit global bit values (for testing
+    values beyond one slice)."""
+
+    def __init__(self, bits, attrs):
+        super().__init__({}, attrs)
+        self._bits = bits
+
+    def bits(self):
+        return self._bits
+
+
+def test_import_request_golden():
+    got = wire.encode_import_request("i", "f", 2, [1, 2], [3, 4], [0, 1500000000])
+    assert got == IMPORT
+    back = wire.decode_import_request(IMPORT)
+    assert back == {
+        "index": "i",
+        "frame": "f",
+        "slice": 2,
+        "rowIDs": [1, 2],
+        "columnIDs": [3, 4],
+        "timestamps": [0, 1500000000],
+    }
+
+
+def test_max_slices_golden():
+    got = wire.encode_max_slices_response({"idx": 4, "a": 0})
+    assert got == MAXSLICES
+    assert wire.decode_max_slices_response(MAXSLICES) == {"idx": 4, "a": 0}
+
+
+def test_negative_int_attr_roundtrip():
+    raw = wire.encode_attr("n", -123456789)
+    assert wire.decode_attr(raw) == ("n", -123456789)
+
+
+def test_float_attr_roundtrip():
+    raw = wire.encode_attr("f", 2.75)
+    assert wire.decode_attr(raw) == ("f", 2.75)
+
+
+def test_frame_meta_roundtrip():
+    raw = wire.encode_frame_meta("rid", True, "ranked", 1000, "YMDH")
+    assert wire.decode_frame_meta(raw) == {
+        "rowLabel": "rid",
+        "inverseEnabled": True,
+        "cacheType": "ranked",
+        "cacheSize": 1000,
+        "timeQuantum": "YMDH",
+    }
+
+
+def test_block_data_roundtrip():
+    raw = wire.encode_block_data_response([1, 2, 3], [9, 8, 7])
+    assert wire.decode_block_data_response(raw) == ([1, 2, 3], [9, 8, 7])
+    req = wire.encode_block_data_request("i", "f", "standard", 3, 12)
+    assert wire.decode_block_data_request(req) == {
+        "index": "i",
+        "frame": "f",
+        "view": "standard",
+        "slice": 3,
+        "block": 12,
+    }
+
+
+def test_truncation_rejected():
+    with pytest.raises(ValueError):
+        list(wire.iter_fields(QREQ[:-3]))
