@@ -1,0 +1,177 @@
+// Native host-runtime kernels for pilosa_tpu.
+//
+// The reference accelerates its host hot loops with AMD64 assembly
+// (roaring/assembly_amd64.s); the TPU build's device hot path is
+// XLA/Pallas, and THIS library covers the host-side runtime loops that
+// stay on CPU: protobuf varint packing for the data plane, WAL op-record
+// encode/decode with FNV-1a checksums, CSV ingest parsing, and popcount
+// fallbacks.  Loaded from Python via ctypes (pilosa_tpu/native.py) with a
+// pure-Python fallback when the toolchain is unavailable.
+//
+// Build: make -C native   (produces libpilosa_native.so)
+
+#include <cstddef>
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// Hashes
+// ---------------------------------------------------------------------------
+
+uint64_t pn_fnv1a64(const uint8_t* data, size_t len) {
+    uint64_t h = 0xCBF29CE484222325ULL;
+    for (size_t i = 0; i < len; i++) {
+        h ^= data[i];
+        h *= 0x100000001B3ULL;
+    }
+    return h;
+}
+
+uint32_t pn_fnv1a32(const uint8_t* data, size_t len) {
+    uint32_t h = 2166136261u;
+    for (size_t i = 0; i < len; i++) {
+        h ^= data[i];
+        h *= 16777619u;
+    }
+    return h;
+}
+
+// ---------------------------------------------------------------------------
+// Popcount (host fallback; device path is lax.population_count)
+// ---------------------------------------------------------------------------
+
+uint64_t pn_popcount_u32(const uint32_t* words, size_t n) {
+    uint64_t total = 0;
+    for (size_t i = 0; i < n; i++) total += (uint64_t)__builtin_popcount(words[i]);
+    return total;
+}
+
+uint64_t pn_popcount_and_u32(const uint32_t* a, const uint32_t* b, size_t n) {
+    uint64_t total = 0;
+    for (size_t i = 0; i < n; i++) total += (uint64_t)__builtin_popcount(a[i] & b[i]);
+    return total;
+}
+
+// ---------------------------------------------------------------------------
+// Protobuf varint packing (wire.py data plane: packed repeated uint64)
+// ---------------------------------------------------------------------------
+
+// Encode n uint64 values as concatenated varints. Returns bytes written,
+// or -1 if cap is too small. Worst case 10 bytes/value.
+int64_t pn_varint_encode(const uint64_t* vals, size_t n, uint8_t* out, size_t cap) {
+    size_t o = 0;
+    for (size_t i = 0; i < n; i++) {
+        uint64_t v = vals[i];
+        do {
+            if (o >= cap) return -1;
+            uint8_t b = v & 0x7F;
+            v >>= 7;
+            out[o++] = v ? (b | 0x80) : b;
+        } while (v);
+    }
+    return (int64_t)o;
+}
+
+// Decode concatenated varints. Returns count decoded, or -1 on truncation
+// or overflow of the output buffer.
+int64_t pn_varint_decode(const uint8_t* buf, size_t len, uint64_t* out, size_t cap) {
+    size_t i = 0, n = 0;
+    while (i < len) {
+        uint64_t v = 0;
+        int shift = 0;
+        for (;;) {
+            if (i >= len || shift > 63) return -1;
+            uint8_t b = buf[i++];
+            v |= (uint64_t)(b & 0x7F) << shift;
+            if (!(b & 0x80)) break;
+            shift += 7;
+        }
+        if (n >= cap) return -1;
+        out[n++] = v;
+    }
+    return (int64_t)n;
+}
+
+// ---------------------------------------------------------------------------
+// WAL op records: [typ u8 | value u64le | fnv1a32(first 9 bytes) u32le]
+// (roaring.go:1560-1626 format)
+// ---------------------------------------------------------------------------
+
+void pn_oplog_encode(const uint8_t* types, const uint64_t* vals, size_t n, uint8_t* out) {
+    for (size_t i = 0; i < n; i++) {
+        uint8_t* rec = out + i * 13;
+        rec[0] = types[i];
+        uint64_t v = vals[i];
+        for (int j = 0; j < 8; j++) rec[1 + j] = (v >> (8 * j)) & 0xFF;
+        uint32_t chk = pn_fnv1a32(rec, 9);
+        for (int j = 0; j < 4; j++) rec[9 + j] = (chk >> (8 * j)) & 0xFF;
+    }
+}
+
+// Returns ops decoded, or -(index+1) of the first corrupt record.
+int64_t pn_oplog_decode(const uint8_t* buf, size_t len, uint8_t* types, uint64_t* vals) {
+    size_t n = len / 13;
+    for (size_t i = 0; i < n; i++) {
+        const uint8_t* rec = buf + i * 13;
+        uint32_t want = 0;
+        for (int j = 0; j < 4; j++) want |= (uint32_t)rec[9 + j] << (8 * j);
+        if (pn_fnv1a32(rec, 9) != want) return -(int64_t)(i + 1);
+        uint8_t t = rec[0];
+        if (t > 1) return -(int64_t)(i + 1);
+        types[i] = t;
+        uint64_t v = 0;
+        for (int j = 0; j < 8; j++) v |= (uint64_t)rec[1 + j] << (8 * j);
+        vals[i] = v;
+    }
+    return (int64_t)n;
+}
+
+// ---------------------------------------------------------------------------
+// CSV ingest: parse "row,col[,timestamp]\n" lines into arrays
+// (ctl/import.go hot loop)
+// ---------------------------------------------------------------------------
+
+// Returns rows parsed, or -(lineno) of the first malformed line.
+int64_t pn_parse_csv(const char* buf, size_t len, uint64_t* rows, uint64_t* cols,
+                     int64_t* ts, size_t cap) {
+    size_t i = 0, n = 0;
+    int64_t line = 1;
+    while (i < len) {
+        // skip blank lines
+        if (buf[i] == '\n' || buf[i] == '\r') {
+            if (buf[i] == '\n') line++;
+            i++;
+            continue;
+        }
+        if (n >= cap) return -line;
+        uint64_t vals[3] = {0, 0, 0};
+        int field = 0;
+        bool any_digit = false;
+        for (; i < len && buf[i] != '\n'; i++) {
+            char c = buf[i];
+            if (c >= '0' && c <= '9') {
+                vals[field] = vals[field] * 10 + (uint64_t)(c - '0');
+                any_digit = true;
+            } else if (c == ',') {
+                if (field >= 2) return -line;
+                field++;
+            } else if (c == '\r' || c == ' ') {
+                // ignore
+            } else {
+                return -line;
+            }
+        }
+        if (i < len) i++;  // consume newline
+        if (field < 1 || !any_digit) return -line;
+        rows[n] = vals[0];
+        cols[n] = vals[1];
+        ts[n] = (field >= 2) ? (int64_t)vals[2] : 0;
+        n++;
+        line++;
+    }
+    return (int64_t)n;
+}
+
+}  // extern "C"

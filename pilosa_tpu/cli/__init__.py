@@ -1,0 +1,8 @@
+"""Command-line interface.
+
+Reference analog: cmd/ (cobra root, cmd/root.go:36-78) + ctl/ tools.
+Subcommands: server, backup, restore, import, export, bench, check,
+inspect, sort, config — invoked as ``python -m pilosa_tpu <cmd>``.
+"""
+
+from pilosa_tpu.cli.main import main  # noqa: F401

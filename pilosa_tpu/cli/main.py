@@ -1,0 +1,301 @@
+"""CLI subcommands (reference: cmd/*.go + ctl/*.go).
+
+Config precedence matches cmd/root.go:89-153: flags > PILOSA_* env >
+TOML config file > defaults.
+"""
+
+from __future__ import annotations
+
+import argparse
+import io
+import json
+import sys
+import tarfile
+import time
+
+import numpy as np
+
+
+def _load_config(args) -> "Config":
+    from pilosa_tpu.config import Config
+
+    cfg = Config.from_toml(args.config) if getattr(args, "config", None) else Config()
+    cfg.apply_env()
+    # flags override
+    if getattr(args, "data_dir", None):
+        cfg.data_dir = args.data_dir
+    if getattr(args, "host", None):
+        cfg.host = args.host
+    return cfg
+
+
+# -- server (cmd/server.go) -------------------------------------------------
+
+def cmd_server(args) -> int:
+    from pilosa_tpu.server.server import Server
+
+    cfg = _load_config(args)
+    server = Server(cfg)
+    server.open()
+    print(f"pilosa-tpu serving on http://{server.host} (data: {server.data_dir})")
+    if args.test_exit:  # for CLI tests: start, report, stop
+        server.close()
+        return 0
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        print("shutting down")
+        server.close()
+    return 0
+
+
+# -- import/export (ctl/import.go, ctl/export.go) ---------------------------
+
+def cmd_import(args) -> int:
+    from pilosa_tpu import native
+    from pilosa_tpu.server.client import Client
+
+    client = Client(args.host)
+    total = 0
+    for path in args.paths:
+        data = sys.stdin.buffer.read() if path == "-" else open(path, "rb").read()
+        rows, cols, ts = native.parse_csv(data)
+        for start in range(0, len(rows), args.buffer_size):
+            end = start + args.buffer_size
+            bits = list(zip(rows[start:end].tolist(), cols[start:end].tolist(), ts[start:end].tolist()))
+            client.import_bits(args.index, args.frame, bits)
+            total += len(bits)
+    print(f"imported {total} bits into {args.index}/{args.frame}")
+    return 0
+
+
+def cmd_export(args) -> int:
+    from pilosa_tpu.server.client import Client
+
+    client = Client(args.host)
+    max_slice = client.max_slices().get(args.index, 0)
+    out = sys.stdout if args.output == "-" else open(args.output, "w")
+    for slice_i in range(max_slice + 1):
+        try:
+            out.write(client.export_csv(args.index, args.frame, args.view, slice_i))
+        except Exception:
+            continue
+    if out is not sys.stdout:
+        out.close()
+    return 0
+
+
+# -- backup/restore (ctl/backup.go, ctl/restore.go) -------------------------
+
+def cmd_backup(args) -> int:
+    from pilosa_tpu.server.client import Client
+
+    client = Client(args.host)
+    max_slice = client.max_slices().get(args.index, 0)
+    views = client.frame_views(args.index, args.frame)
+    with tarfile.open(args.output, "w") as tar:
+        for view in views:
+            for slice_i in range(max_slice + 1):
+                data = client.fragment_data(args.index, args.frame, view, slice_i)
+                if data is None:
+                    continue
+                info = tarfile.TarInfo(name=f"{view}/{slice_i}")
+                info.size = len(data)
+                tar.addfile(info, io.BytesIO(data))
+    print(f"backed up {args.index}/{args.frame} to {args.output}")
+    return 0
+
+
+def cmd_restore(args) -> int:
+    from pilosa_tpu.server.client import Client
+
+    client = Client(args.host)
+    n = 0
+    with tarfile.open(args.input) as tar:
+        for member in tar.getmembers():
+            view, slice_s = member.name.split("/", 1)
+            data = tar.extractfile(member).read()
+            client.restore_fragment(args.index, args.frame, view, int(slice_s), data)
+            n += 1
+    print(f"restored {n} fragments into {args.index}/{args.frame}")
+    return 0
+
+
+# -- bench (ctl/bench.go:71-102) --------------------------------------------
+
+def cmd_bench(args) -> int:
+    from pilosa_tpu.server.client import Client
+
+    client = Client(args.host)
+    rng = np.random.default_rng(args.seed)
+    rows = rng.integers(0, args.max_row_id, size=args.n)
+    cols = rng.integers(0, args.max_column_id, size=args.n)
+    if args.operation != "set-bit":
+        print(f"unknown bench op: {args.operation!r}", file=sys.stderr)
+        return 1
+    start = time.perf_counter()
+    batch = []
+    for r, c in zip(rows.tolist(), cols.tolist()):
+        batch.append(f'SetBit(rowID={r}, frame="{args.frame}", columnID={c})')
+        if len(batch) >= args.batch_size:
+            client.execute_query(args.index, " ".join(batch))
+            batch = []
+    if batch:
+        client.execute_query(args.index, " ".join(batch))
+    elapsed = time.perf_counter() - start
+    print(json.dumps({"n": args.n, "seconds": round(elapsed, 3), "ops_per_sec": round(args.n / elapsed, 1)}))
+    return 0
+
+
+# -- check/inspect (ctl/check.go, ctl/inspect.go) ----------------------------
+
+def cmd_check(args) -> int:
+    from pilosa_tpu.roaring import Bitmap
+
+    rc = 0
+    for path in args.paths:
+        try:
+            with open(path, "rb") as f:
+                bm = Bitmap.from_bytes(f.read())
+            bm.check()
+            print(f"{path}: ok ({bm.count()} bits, {len(bm.containers)} containers)")
+        except Exception as e:
+            print(f"{path}: FAILED: {e}", file=sys.stderr)
+            rc = 1
+    return rc
+
+
+def cmd_inspect(args) -> int:
+    from pilosa_tpu.roaring import Bitmap
+
+    for path in args.paths:
+        with open(path, "rb") as f:
+            bm = Bitmap.from_bytes(f.read())
+        n_array = sum(1 for c in bm.containers.values() if c.is_array)
+        n_bitmap = len(bm.containers) - n_array
+        print(f"{path}:")
+        print(f"  bits:       {bm.count()}")
+        print(f"  containers: {len(bm.containers)} ({n_array} array, {n_bitmap} bitmap)")
+        print(f"  ops logged: {bm.op_n}")
+        if args.verbose:
+            for key in bm.sorted_keys():
+                c = bm.containers[key]
+                kind = "array" if c.is_array else "bitmap"
+                print(f"    key={key:<8} type={kind:<6} n={c.n}")
+    return 0
+
+
+# -- sort (ctl/sort.go) ------------------------------------------------------
+
+def cmd_sort(args) -> int:
+    from pilosa_tpu.pilosa import SLICE_WIDTH
+
+    rows = []
+    f = sys.stdin if args.path == "-" else open(args.path)
+    for line in f:
+        line = line.strip()
+        if not line:
+            continue
+        parts = line.split(",")
+        rows.append((int(parts[0]), int(parts[1]), line))
+    if f is not sys.stdin:
+        f.close()
+    rows.sort(key=lambda t: (t[1] // SLICE_WIDTH, t[0], t[1]))
+    for _, _, line in rows:
+        print(line)
+    return 0
+
+
+# -- config (ctl/config.go) --------------------------------------------------
+
+def cmd_config(args) -> int:
+    cfg = _load_config(args)
+    print(cfg.to_toml(), end="")
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="pilosa-tpu", description="TPU-native distributed bitmap index")
+    p.add_argument("--config", help="path to TOML config file")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    s = sub.add_parser("server", help="run the server")
+    s.add_argument("--data-dir", help="data directory")
+    s.add_argument("--host", help="host:port to bind")
+    s.add_argument("--test-exit", action="store_true", help=argparse.SUPPRESS)
+    s.set_defaults(fn=cmd_server)
+
+    for name, fn in (("import", cmd_import),):
+        s = sub.add_parser(name, help="bulk-import CSV row,col[,timestamp] bits")
+        s.add_argument("--host", default="localhost:10101")
+        s.add_argument("--index", required=True, dest="index")
+        s.add_argument("--frame", required=True)
+        s.add_argument("--buffer-size", type=int, default=10_000_000)
+        s.add_argument("paths", nargs="+")
+        s.set_defaults(fn=fn)
+
+    s = sub.add_parser("export", help="export a frame as CSV")
+    s.add_argument("--host", default="localhost:10101")
+    s.add_argument("--index", required=True)
+    s.add_argument("--frame", required=True)
+    s.add_argument("--view", default="standard")
+    s.add_argument("-o", "--output", default="-")
+    s.set_defaults(fn=cmd_export)
+
+    s = sub.add_parser("backup", help="backup a frame to a tar archive")
+    s.add_argument("--host", default="localhost:10101")
+    s.add_argument("--index", required=True)
+    s.add_argument("--frame", required=True)
+    s.add_argument("-o", "--output", required=True)
+    s.set_defaults(fn=cmd_backup)
+
+    s = sub.add_parser("restore", help="restore a frame from a tar archive")
+    s.add_argument("--host", default="localhost:10101")
+    s.add_argument("--index", required=True)
+    s.add_argument("--frame", required=True)
+    s.add_argument("-i", "--input", required=True)
+    s.set_defaults(fn=cmd_restore)
+
+    s = sub.add_parser("bench", help="run a benchmark against a server")
+    s.add_argument("--host", default="localhost:10101")
+    s.add_argument("--index", required=True)
+    s.add_argument("--frame", required=True)
+    s.add_argument("-o", "--operation", default="set-bit")
+    s.add_argument("-n", type=int, default=1000, dest="n")
+    s.add_argument("--max-row-id", type=int, default=1000)
+    s.add_argument("--max-column-id", type=int, default=1000)
+    s.add_argument("--batch-size", type=int, default=100)
+    s.add_argument("--seed", type=int, default=0)
+    s.set_defaults(fn=cmd_bench)
+
+    s = sub.add_parser("check", help="verify fragment file consistency")
+    s.add_argument("paths", nargs="+")
+    s.set_defaults(fn=cmd_check)
+
+    s = sub.add_parser("inspect", help="dump fragment container stats")
+    s.add_argument("-v", "--verbose", action="store_true")
+    s.add_argument("paths", nargs="+")
+    s.set_defaults(fn=cmd_inspect)
+
+    s = sub.add_parser("sort", help="pre-sort an import CSV by slice position")
+    s.add_argument("path")
+    s.set_defaults(fn=cmd_sort)
+
+    s = sub.add_parser("config", help="print the effective configuration")
+    s.add_argument("--data-dir", help="data directory")
+    s.add_argument("--host", help="host:port")
+    s.set_defaults(fn=cmd_config)
+
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    try:
+        return args.fn(args)
+    except KeyboardInterrupt:
+        return 130
+    except Exception as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1

@@ -141,7 +141,10 @@ class Fragment:
             with open(self.path, "wb") as f:
                 self.storage.write_to(f)
             self.storage.op_n = 0
-        self._wal = open(self.path, "ab")
+        # Unbuffered: each op record reaches the kernel immediately, like the
+        # reference's direct file writes (a buffered handle would lose acked
+        # ops on crash).
+        self._wal = open(self.path, "ab", buffering=0)
         self.storage.op_writer = self._wal
 
     @property

@@ -108,11 +108,24 @@ class JaxEngine:
 
 
 def new_engine(name: str = "auto"):
-    """Engine factory. "auto" honors PILOSA_TPU_ENGINE, defaulting to jax."""
+    """Engine factory. "auto" honors PILOSA_TPU_ENGINE, defaulting to jax
+    with a numpy fallback when no jax backend can initialize."""
+    fallback_ok = False
     if name == "auto":
-        name = os.environ.get("PILOSA_TPU_ENGINE", "jax")
+        env = os.environ.get("PILOSA_TPU_ENGINE")
+        # Only a true default (no env override) may silently fall back; an
+        # explicit PILOSA_TPU_ENGINE=jax must surface jax failures.
+        fallback_ok = env is None
+        name = env or "jax"
     if name == "numpy":
         return NumpyEngine()
     if name == "jax":
+        if fallback_ok:
+            try:
+                eng = JaxEngine()
+                eng.count(eng.asarray(np.zeros(8, dtype=np.uint32)))  # backend probe
+                return eng
+            except Exception:
+                return NumpyEngine()
         return JaxEngine()
     raise ValueError(f"unknown engine: {name!r}")

@@ -1,0 +1,239 @@
+"""ctypes bridge to the C++ host-runtime kernels (native/pilosa_native.cpp).
+
+Auto-builds the shared library with the in-tree Makefile on first use when
+a toolchain is present; every entry point has a pure-Python/numpy fallback
+so the framework runs identically (slower) without it.  The analog of the
+reference's asm-vs-Go split (roaring/assembly_asm.go vs assembly.go) for
+the host side of this build.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libpilosa_native.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(
+            ["make", "-C", _NATIVE_DIR],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        return os.path.exists(_LIB_PATH)
+    except Exception:
+        return False
+
+
+def load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("PILOSA_TPU_NO_NATIVE", "").lower() in ("1", "true", "yes"):
+            return None
+        if not os.path.exists(_LIB_PATH) and not _build():
+            return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+        except OSError:
+            return None
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        u32p = ctypes.POINTER(ctypes.c_uint32)
+        u64p = ctypes.POINTER(ctypes.c_uint64)
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        lib.pn_fnv1a64.restype = ctypes.c_uint64
+        lib.pn_fnv1a64.argtypes = [u8p, ctypes.c_size_t]
+        lib.pn_fnv1a32.restype = ctypes.c_uint32
+        lib.pn_fnv1a32.argtypes = [u8p, ctypes.c_size_t]
+        lib.pn_popcount_u32.restype = ctypes.c_uint64
+        lib.pn_popcount_u32.argtypes = [u32p, ctypes.c_size_t]
+        lib.pn_popcount_and_u32.restype = ctypes.c_uint64
+        lib.pn_popcount_and_u32.argtypes = [u32p, u32p, ctypes.c_size_t]
+        lib.pn_varint_encode.restype = ctypes.c_int64
+        lib.pn_varint_encode.argtypes = [u64p, ctypes.c_size_t, u8p, ctypes.c_size_t]
+        lib.pn_varint_decode.restype = ctypes.c_int64
+        lib.pn_varint_decode.argtypes = [u8p, ctypes.c_size_t, u64p, ctypes.c_size_t]
+        lib.pn_oplog_encode.restype = None
+        lib.pn_oplog_encode.argtypes = [u8p, u64p, ctypes.c_size_t, u8p]
+        lib.pn_oplog_decode.restype = ctypes.c_int64
+        lib.pn_oplog_decode.argtypes = [u8p, ctypes.c_size_t, u8p, u64p]
+        lib.pn_parse_csv.restype = ctypes.c_int64
+        lib.pn_parse_csv.argtypes = [ctypes.c_char_p, ctypes.c_size_t, u64p, u64p, i64p, ctypes.c_size_t]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+def _u8(arr: np.ndarray):
+    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+
+
+def _u64(arr: np.ndarray):
+    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64))
+
+
+# ---------------------------------------------------------------------------
+# Public API with fallbacks
+# ---------------------------------------------------------------------------
+
+# Below this many values/bytes the ctypes call overhead beats the win;
+# the single dispatch point for wire.py's packed fields lives HERE.
+_VARINT_NATIVE_THRESHOLD = 64
+
+
+def varint_encode(values) -> bytes:
+    """Packed-varint encode uint64/int64 values (protobuf packed payload).
+
+    Negative values are masked to two's-complement uint64, matching
+    proto3 int64 varint encoding (e.g. ImportRequest timestamps).
+    """
+    try:
+        arr = np.ascontiguousarray(values, dtype=np.uint64)
+    except OverflowError:
+        mask = (1 << 64) - 1
+        arr = np.array([int(v) & mask for v in values], dtype=np.uint64)
+    lib = load() if len(arr) >= _VARINT_NATIVE_THRESHOLD else None
+    if lib is not None and len(arr):
+        out = np.empty(len(arr) * 10, dtype=np.uint8)
+        n = lib.pn_varint_encode(_u64(arr), len(arr), _u8(out), len(out))
+        if n >= 0:
+            return out[:n].tobytes()
+    from pilosa_tpu.wire import encode_varint
+
+    return b"".join(encode_varint(int(v)) for v in arr.tolist())
+
+
+def varint_decode(data: bytes) -> np.ndarray:
+    """Decode concatenated varints into a uint64 array."""
+    lib = load() if len(data) >= _VARINT_NATIVE_THRESHOLD else None
+    if lib is not None and data:
+        buf = np.frombuffer(data, dtype=np.uint8)
+        out = np.empty(len(data), dtype=np.uint64)  # <= one value per byte
+        n = lib.pn_varint_decode(_u8(buf), len(buf), _u64(out), len(out))
+        if n < 0:
+            raise ValueError("truncated varint stream")
+        return out[:n].copy()
+    from pilosa_tpu.wire import decode_varint
+
+    out_list = []
+    i = 0
+    while i < len(data):
+        v, i = decode_varint(data, i)
+        out_list.append(v)
+    return np.array(out_list, dtype=np.uint64)
+
+
+def oplog_encode(types: np.ndarray, values: np.ndarray) -> bytes:
+    types = np.ascontiguousarray(types, dtype=np.uint8)
+    values = np.ascontiguousarray(values, dtype=np.uint64)
+    lib = load()
+    if lib is not None and len(types):
+        out = np.empty(len(types) * 13, dtype=np.uint8)
+        lib.pn_oplog_encode(_u8(types), _u64(values), len(types), _u8(out))
+        return out.tobytes()
+    from pilosa_tpu.roaring import encode_op
+
+    return b"".join(encode_op(int(t), int(v)) for t, v in zip(types.tolist(), values.tolist()))
+
+
+def oplog_decode(data: bytes) -> tuple[np.ndarray, np.ndarray]:
+    """Decode + checksum-verify a WAL tail; raises ValueError on corruption."""
+    if len(data) % 13:
+        raise ValueError(f"op data out of bounds: len={len(data)}")
+    n = len(data) // 13
+    lib = load()
+    if lib is not None and n:
+        buf = np.frombuffer(data, dtype=np.uint8)
+        types = np.empty(n, dtype=np.uint8)
+        values = np.empty(n, dtype=np.uint64)
+        got = lib.pn_oplog_decode(_u8(buf), len(buf), _u8(types), _u64(values))
+        if got < 0:
+            raise ValueError(f"checksum mismatch at op {-got - 1}")
+        return types, values
+    from pilosa_tpu.roaring import decode_op
+
+    types_l, values_l = [], []
+    for i in range(n):
+        t, v = decode_op(data[i * 13 : (i + 1) * 13])
+        types_l.append(t)
+        values_l.append(v)
+    return np.array(types_l, dtype=np.uint8), np.array(values_l, dtype=np.uint64)
+
+
+def parse_csv(data: bytes) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Parse 'row,col[,timestamp]' lines → (rows, cols, timestamps)."""
+    lib = load()
+    if lib is not None and data:
+        cap = data.count(b"\n") + 2
+        rows = np.empty(cap, dtype=np.uint64)
+        cols = np.empty(cap, dtype=np.uint64)
+        ts = np.empty(cap, dtype=np.int64)
+        n = lib.pn_parse_csv(
+            data,
+            len(data),
+            _u64(rows),
+            _u64(cols),
+            ts.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            cap,
+        )
+        if n < 0:
+            raise ValueError(f"malformed CSV at line {-n}")
+        return rows[:n].copy(), cols[:n].copy(), ts[:n].copy()
+    rows_l, cols_l, ts_l = [], [], []
+    for lineno, line in enumerate(data.decode().splitlines(), 1):
+        line = line.strip()
+        if not line:
+            continue
+        parts = line.split(",")
+        if len(parts) < 2:
+            raise ValueError(f"malformed CSV at line {lineno}")
+        try:
+            rows_l.append(int(parts[0]))
+            cols_l.append(int(parts[1]))
+            ts_l.append(int(parts[2]) if len(parts) > 2 and parts[2] else 0)
+        except ValueError:
+            raise ValueError(f"malformed CSV at line {lineno}")
+    return (
+        np.array(rows_l, dtype=np.uint64),
+        np.array(cols_l, dtype=np.uint64),
+        np.array(ts_l, dtype=np.int64),
+    )
+
+
+def fnv1a64(data: bytes) -> int:
+    lib = load()
+    if lib is not None:
+        buf = np.frombuffer(data, dtype=np.uint8) if data else np.empty(0, dtype=np.uint8)
+        return int(lib.pn_fnv1a64(_u8(buf), len(data)))
+    from pilosa_tpu.cluster import fnv1a64 as py_fnv
+
+    return py_fnv(data)
+
+
+def popcount_words(words: np.ndarray) -> int:
+    words = np.ascontiguousarray(words, dtype=np.uint32)
+    lib = load()
+    if lib is not None:
+        return int(lib.pn_popcount_u32(words.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)), words.size))
+    from pilosa_tpu.roaring import _popcount_words
+
+    return _popcount_words(words)

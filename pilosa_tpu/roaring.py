@@ -535,18 +535,22 @@ class Bitmap:
                 words = np.frombuffer(data[off : off + payload], dtype="<u8").astype(np.uint64)
                 bm.containers[key] = Container(bitmap=words)
             ops_offset = off + payload
-        # Trailing op log (roaring.go:590-611).
+        # Trailing op log (roaring.go:590-611); decoded+verified in one
+        # native pass when the C++ kernels are available.
         buf = data[ops_offset:]
-        while buf:
-            typ, value = decode_op(buf[:OP_SIZE])
-            if typ == OP_ADD:
-                bm._container_for(value).add(lowbits(value))
-            else:
-                c = bm.containers.get(highbits(value))
-                if c is not None and c.remove(lowbits(value)) and c.n == 0:
-                    del bm.containers[highbits(value)]
-            bm.op_n += 1
-            buf = buf[OP_SIZE:]
+        if buf:
+            from pilosa_tpu import native
+
+            types, values = native.oplog_decode(bytes(buf))
+            for typ, value in zip(types.tolist(), values.tolist()):
+                value = int(value)
+                if typ == OP_ADD:
+                    bm._container_for(value).add(lowbits(value))
+                else:
+                    c = bm.containers.get(highbits(value))
+                    if c is not None and c.remove(lowbits(value)) and c.n == 0:
+                        del bm.containers[highbits(value)]
+                bm.op_n += 1
         return bm
 
 

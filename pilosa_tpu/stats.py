@@ -1,0 +1,185 @@
+"""Stats clients: counters/gauges/histograms with tag support.
+
+Reference analog: stats.go — the StatsClient interface
+(Count/Gauge/Histogram/Set/Timing/WithTags, stats.go:33-54), the
+expvar-backed client (stats.go:70-130), MultiStatsClient (stats.go:133-185)
+and the datadog statsd sink (datadog/datadog.go).  Here the statsd sink
+speaks the plain UDP statsd wire format (datadog-compatible with |#tags).
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+from collections import defaultdict
+from typing import Iterable, Optional
+
+
+class NopStatsClient:
+    def with_tags(self, *tags: str) -> "NopStatsClient":
+        return self
+
+    def count(self, name: str, value: int = 1) -> None:
+        pass
+
+    def gauge(self, name: str, value: float) -> None:
+        pass
+
+    def histogram(self, name: str, value: float) -> None:
+        pass
+
+    def set(self, name: str, value: str) -> None:
+        pass
+
+    def timing(self, name: str, value: float) -> None:
+        pass
+
+
+class ExpvarStatsClient:
+    """In-process stats exposed at /debug/vars (stats.go:70-130)."""
+
+    def __init__(self, tags: tuple[str, ...] = ()):
+        self._lock = threading.Lock()
+        self._counters: dict[str, int] = defaultdict(int)
+        self._gauges: dict[str, float] = {}
+        self._sets: dict[str, str] = {}
+        self._histograms: dict[str, list[float]] = defaultdict(list)
+        self._timings: dict[str, list[float]] = defaultdict(list)
+        self._tags = tags
+        self._children: dict[tuple[str, ...], ExpvarStatsClient] = {}
+
+    def _key(self, name: str) -> str:
+        return f"{name}[{','.join(self._tags)}]" if self._tags else name
+
+    def with_tags(self, *tags: str) -> "ExpvarStatsClient":
+        key = tuple(sorted(set(self._tags) | set(tags)))
+        child = self._children.get(key)
+        if child is None:
+            child = ExpvarStatsClient(tags=key)
+            # share the top-level maps so /debug/vars sees everything
+            child._lock = self._lock
+            child._counters = self._counters
+            child._gauges = self._gauges
+            child._sets = self._sets
+            child._histograms = self._histograms
+            child._timings = self._timings
+            self._children[key] = child
+        return child
+
+    def count(self, name: str, value: int = 1) -> None:
+        with self._lock:
+            self._counters[self._key(name)] += value
+
+    def gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            self._gauges[self._key(name)] = value
+
+    def histogram(self, name: str, value: float) -> None:
+        with self._lock:
+            self._histograms[self._key(name)].append(value)
+
+    def set(self, name: str, value: str) -> None:
+        with self._lock:
+            self._sets[self._key(name)] = value
+
+    def timing(self, name: str, value: float) -> None:
+        with self._lock:
+            self._timings[self._key(name)].append(value)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            out: dict = dict(self._counters)
+            out.update(self._gauges)
+            out.update(self._sets)
+            for name, vals in self._histograms.items():
+                if vals:
+                    s = sorted(vals)
+                    out[name] = {
+                        "count": len(s),
+                        "min": s[0],
+                        "max": s[-1],
+                        "p50": s[len(s) // 2],
+                        "p99": s[min(len(s) - 1, int(len(s) * 0.99))],
+                    }
+            for name, vals in self._timings.items():
+                if vals:
+                    out[name + ".avg_ms"] = sum(vals) / len(vals) * 1000
+            return out
+
+
+class StatsdStatsClient:
+    """UDP statsd sink with datadog-style |#tag lists (datadog/datadog.go)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 8125, prefix: str = "pilosa.", tags: tuple[str, ...] = ()):
+        self.addr = (host, port)
+        self.prefix = prefix
+        self._tags = tags
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+
+    def with_tags(self, *tags: str) -> "StatsdStatsClient":
+        c = StatsdStatsClient.__new__(StatsdStatsClient)
+        c.addr = self.addr
+        c.prefix = self.prefix
+        c._tags = tuple(sorted(set(self._tags) | set(tags)))
+        c._sock = self._sock
+        return c
+
+    def _send(self, payload: str) -> None:
+        if self._tags:
+            payload += "|#" + ",".join(self._tags)
+        try:
+            self._sock.sendto(payload.encode(), self.addr)
+        except OSError:
+            pass
+
+    def count(self, name: str, value: int = 1) -> None:
+        self._send(f"{self.prefix}{name}:{value}|c")
+
+    def gauge(self, name: str, value: float) -> None:
+        self._send(f"{self.prefix}{name}:{value}|g")
+
+    def histogram(self, name: str, value: float) -> None:
+        self._send(f"{self.prefix}{name}:{value}|h")
+
+    def set(self, name: str, value: str) -> None:
+        self._send(f"{self.prefix}{name}:{value}|s")
+
+    def timing(self, name: str, value: float) -> None:
+        self._send(f"{self.prefix}{name}:{value * 1000:.3f}|ms")
+
+
+class MultiStatsClient:
+    """Fan out to several clients (stats.go:133-185)."""
+
+    def __init__(self, clients: Iterable):
+        self.clients = list(clients)
+
+    def with_tags(self, *tags: str) -> "MultiStatsClient":
+        return MultiStatsClient([c.with_tags(*tags) for c in self.clients])
+
+    def count(self, name: str, value: int = 1) -> None:
+        for c in self.clients:
+            c.count(name, value)
+
+    def gauge(self, name: str, value: float) -> None:
+        for c in self.clients:
+            c.gauge(name, value)
+
+    def histogram(self, name: str, value: float) -> None:
+        for c in self.clients:
+            c.histogram(name, value)
+
+    def set(self, name: str, value: str) -> None:
+        for c in self.clients:
+            c.set(name, value)
+
+    def timing(self, name: str, value: float) -> None:
+        for c in self.clients:
+            c.timing(name, value)
+
+    def snapshot(self) -> dict:
+        for c in self.clients:
+            if hasattr(c, "snapshot"):
+                return c.snapshot()
+        return {}

@@ -106,8 +106,9 @@ class Writer:
     def packed(self, field: int, values: Iterable[int]) -> "Writer":
         values = list(values)
         if values:
-            raw = b"".join(encode_varint(int(v)) for v in values)
-            self.bytes_field(field, raw, force=True)
+            from pilosa_tpu import native
+
+            self.bytes_field(field, native.varint_encode(values), force=True)
         return self
 
     def finish(self) -> bytes:
@@ -147,12 +148,9 @@ def iter_fields(data: bytes):
 def decode_packed_uint64(raw) -> list[int]:
     if isinstance(raw, int):  # unpacked single value
         return [raw]
-    out = []
-    i = 0
-    while i < len(raw):
-        v, i = decode_varint(raw, i)
-        out.append(v)
-    return out
+    from pilosa_tpu import native
+
+    return [int(v) for v in native.varint_decode(bytes(raw))]
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,90 @@
+"""CLI tests (reference analog: cmd/*_test.go, ctl/*_test.go)."""
+
+import json
+import socket
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.cli.main import main
+from pilosa_tpu.config import Config
+from pilosa_tpu.server.client import Client
+from pilosa_tpu.server.server import Server
+from pilosa_tpu.pilosa import SLICE_WIDTH
+
+
+@pytest.fixture
+def srv(tmp_path):
+    s = Server(Config(data_dir=str(tmp_path / "data"), host="127.0.0.1:0", engine="numpy"))
+    s.open()
+    c = Client(s.host)
+    c.create_index("i")
+    c.create_frame("i", "f")
+    yield s
+    s.close()
+
+
+def test_config_command(capsys):
+    assert main(["config"]) == 0
+    out = capsys.readouterr().out
+    assert "data-dir" in out and "[cluster]" in out
+
+
+def test_config_env_precedence(capsys, monkeypatch):
+    monkeypatch.setenv("PILOSA_HOST", "envhost:123")
+    main(["config"])
+    assert 'host = "envhost:123"' in capsys.readouterr().out
+
+
+def test_server_command(tmp_path, capsys):
+    assert main(["server", "--data-dir", str(tmp_path / "d"), "--host", "127.0.0.1:0", "--test-exit"]) == 0
+    assert "serving on" in capsys.readouterr().out
+
+
+def test_import_export_sort(tmp_path, srv, capsys):
+    csv = tmp_path / "bits.csv"
+    csv.write_text(f"2,{SLICE_WIDTH+5}\n1,10\n1,3\n")
+    # sort pre-pass orders by slice then row
+    assert main(["sort", str(csv)]) == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    assert out == ["1,3", "1,10", f"2,{SLICE_WIDTH+5}"]
+
+    assert main(["import", "--host", srv.host, "--index", "i", "--frame", "f", str(csv)]) == 0
+    assert "imported 3 bits" in capsys.readouterr().out
+
+    assert main(["export", "--host", srv.host, "--index", "i", "--frame", "f"]) == 0
+    out = capsys.readouterr().out
+    assert "1,3" in out and f"2,{SLICE_WIDTH+5}" in out
+
+
+def test_backup_restore_roundtrip(tmp_path, srv, capsys):
+    c = Client(srv.host)
+    c.execute_query("i", 'SetBit(rowID=4, frame="f", columnID=9)')
+    tar = tmp_path / "f.tar"
+    assert main(["backup", "--host", srv.host, "--index", "i", "--frame", "f", "-o", str(tar)]) == 0
+    c.create_frame("i", "g")
+    assert main(["restore", "--host", srv.host, "--index", "i", "--frame", "g", "-i", str(tar)]) == 0
+    resp = c.execute_query("i", 'Bitmap(rowID=4, frame="g")')
+    assert resp["results"][0]["bitmap"]["bits"] == [9]
+
+
+def test_bench_command(srv, capsys):
+    assert main(["bench", "--host", srv.host, "--index", "i", "--frame", "f", "-n", "50"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["n"] == 50 and out["ops_per_sec"] > 0
+    assert main(["bench", "--host", srv.host, "--index", "i", "--frame", "f", "-o", "bogus"]) == 1
+
+
+def test_check_inspect(tmp_path, srv, capsys):
+    c = Client(srv.host)
+    c.execute_query("i", 'SetBit(rowID=1, frame="f", columnID=5)')
+    frag_path = srv.data_dir + "/i/f/views/standard/fragments/0"
+    assert main(["check", frag_path]) == 0
+    assert "ok" in capsys.readouterr().out
+    assert main(["inspect", "-v", frag_path]) == 0
+    out = capsys.readouterr().out
+    assert "containers" in out and "type=array" in out
+    # corrupted file fails check
+    bad = tmp_path / "bad"
+    bad.write_bytes(b"\x00" * 32)
+    assert main(["check", str(bad)]) == 1

@@ -1,0 +1,82 @@
+"""C++ native kernel tests: native results must equal the Python fallbacks
+(the asm-vs-Go equivalence idiom, roaring/assembly_test.go analog)."""
+
+import numpy as np
+import pytest
+
+from pilosa_tpu import native
+from pilosa_tpu.cluster import fnv1a64 as py_fnv64
+from pilosa_tpu.roaring import OP_ADD, OP_REMOVE, _popcount_words, encode_op
+from pilosa_tpu.wire import encode_varint
+
+pytestmark = pytest.mark.skipif(not native.available(), reason="native lib unavailable")
+
+
+def test_fnv1a64_matches_python():
+    for data in (b"", b"a", b"foobar", bytes(range(256))):
+        assert native.fnv1a64(data) == py_fnv64(data)
+
+
+def test_varint_roundtrip_matches_python(rng):
+    vals = np.concatenate(
+        [
+            rng.integers(0, 1 << 7, 100, dtype=np.uint64),
+            rng.integers(0, 1 << 32, 100, dtype=np.uint64),
+            rng.integers(0, 1 << 63, 100, dtype=np.uint64),
+            np.array([0, 1, (1 << 64) - 1], dtype=np.uint64),
+        ]
+    )
+    raw = native.varint_encode(vals)
+    want = b"".join(encode_varint(int(v)) for v in vals.tolist())
+    assert raw == want
+    back = native.varint_decode(raw)
+    np.testing.assert_array_equal(back, vals)
+
+
+def test_varint_decode_rejects_truncation():
+    raw = native.varint_encode(np.array([300], dtype=np.uint64))
+    with pytest.raises(ValueError):
+        native.varint_decode(raw[:-1])
+
+
+def test_oplog_roundtrip_and_corruption(rng):
+    types = rng.integers(0, 2, 50).astype(np.uint8)
+    vals = rng.integers(0, 1 << 40, 50, dtype=np.uint64)
+    raw = native.oplog_encode(types, vals)
+    want = b"".join(encode_op(int(t), int(v)) for t, v in zip(types.tolist(), vals.tolist()))
+    assert raw == want
+    t2, v2 = native.oplog_decode(raw)
+    np.testing.assert_array_equal(t2, types)
+    np.testing.assert_array_equal(v2, vals)
+    bad = bytearray(raw)
+    bad[13 * 7 + 2] ^= 0xFF
+    with pytest.raises(ValueError, match="op 7"):
+        native.oplog_decode(bytes(bad))
+
+
+def test_parse_csv():
+    data = b"1,100\n2,200,1500000000\n\n3,5\n"
+    rows, cols, ts = native.parse_csv(data)
+    assert rows.tolist() == [1, 2, 3]
+    assert cols.tolist() == [100, 200, 5]
+    assert ts.tolist() == [0, 1500000000, 0]
+    with pytest.raises(ValueError, match="line 2"):
+        native.parse_csv(b"1,2\nnope\n")
+    with pytest.raises(ValueError, match="line 1"):
+        native.parse_csv(b"5\n")
+
+
+def test_popcount_matches_lut(rng):
+    words = rng.integers(0, 1 << 32, 10000, dtype=np.uint32)
+    assert native.popcount_words(words) == _popcount_words(words)
+
+
+def test_wire_large_packed_uses_native(rng):
+    # encode via wire.Writer.packed (native path for >=64 values), decode both ways
+    from pilosa_tpu import wire
+
+    vals = rng.integers(0, 1 << 50, 1000, dtype=np.uint64).tolist()
+    raw = wire.Writer().packed(1, vals).finish()
+    fields = list(wire.iter_fields(raw))
+    decoded = wire.decode_packed_uint64(fields[0][2])
+    assert decoded == vals

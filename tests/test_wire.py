@@ -144,3 +144,16 @@ def test_block_data_roundtrip():
 def test_truncation_rejected():
     with pytest.raises(ValueError):
         list(wire.iter_fields(QREQ[:-3]))
+
+
+def test_import_request_negative_timestamps_large_batch():
+    # >= native threshold values incl. negative int64 timestamps must
+    # round-trip (regression: native uint64 conversion overflow).
+    n = 100
+    rows = list(range(n))
+    cols = list(range(n))
+    ts = [-5] * n
+    raw = wire.encode_import_request("i", "f", 0, rows, cols, ts)
+    back = wire.decode_import_request(raw)
+    assert back["timestamps"] == ts
+    assert back["rowIDs"] == rows
