@@ -74,8 +74,8 @@ int64_t pn_varint_encode(const uint64_t* vals, size_t n, uint8_t* out, size_t ca
     return (int64_t)o;
 }
 
-// Decode concatenated varints. Returns count decoded, or -1 on truncation
-// or overflow of the output buffer.
+// Decode concatenated varints. Returns count decoded, or -1 on truncation,
+// uint64 overflow (overlong varint), or output-buffer overflow.
 int64_t pn_varint_decode(const uint8_t* buf, size_t len, uint64_t* out, size_t cap) {
     size_t i = 0, n = 0;
     while (i < len) {
@@ -84,6 +84,9 @@ int64_t pn_varint_decode(const uint8_t* buf, size_t len, uint64_t* out, size_t c
         for (;;) {
             if (i >= len || shift > 63) return -1;
             uint8_t b = buf[i++];
+            // Byte 10 (shift 63) may only carry the final value bit; a set
+            // continuation or any higher value bit overflows uint64.
+            if (shift == 63 && (b & 0xFE)) return -1;
             v |= (uint64_t)(b & 0x7F) << shift;
             if (!(b & 0x80)) break;
             shift += 7;
@@ -148,26 +151,34 @@ int64_t pn_parse_csv(const char* buf, size_t len, uint64_t* rows, uint64_t* cols
         if (n >= cap) return -line;
         uint64_t vals[3] = {0, 0, 0};
         int field = 0;
-        bool any_digit = false;
+        // Per-field state so "5," / ",7" / "1 2" are rejected exactly like
+        // the Python fallback (int() allows surrounding, not interior,
+        // whitespace; empty row/col fields are malformed).
+        bool has_digit[3] = {false, false, false};
+        bool digits_done[3] = {false, false, false};  // saw space after digits
         for (; i < len && buf[i] != '\n'; i++) {
             char c = buf[i];
             if (c >= '0' && c <= '9') {
+                if (digits_done[field]) return -line;  // "1 2" in one field
                 vals[field] = vals[field] * 10 + (uint64_t)(c - '0');
-                any_digit = true;
+                has_digit[field] = true;
             } else if (c == ',') {
                 if (field >= 2) return -line;
                 field++;
             } else if (c == '\r' || c == ' ') {
-                // ignore
+                if (has_digit[field]) digits_done[field] = true;
             } else {
                 return -line;
             }
         }
         if (i < len) i++;  // consume newline
-        if (field < 1 || !any_digit) return -line;
+        // Row and column must each carry digits; an empty (or blank)
+        // timestamp field means 0 — the fallback strips the line and
+        // int() strips field-surrounding spaces, so blanks are legal there.
+        if (field < 1 || !has_digit[0] || !has_digit[1]) return -line;
         rows[n] = vals[0];
         cols[n] = vals[1];
-        ts[n] = (field >= 2) ? (int64_t)vals[2] : 0;
+        ts[n] = (field == 2) ? (int64_t)vals[2] : 0;
         n++;
         line++;
     }

@@ -8,13 +8,10 @@ host), gossip/ (memberlist).  This build ships:
 - ``StaticNodeSet`` — fixed host list, no messaging (cluster type
   "static"),
 - ``HTTPBroadcaster``/``HTTPBroadcastReceiver`` — sync fan-out over the
-  internal HTTP port (cluster type "http"),
-- ``GossipNodeSet`` — a lightweight UDP peer-exchange protocol standing in
-  for memberlist (cluster type "gossip"): periodic heartbeats carry the
-  member list and async messages; peers learned transitively, death by
-  timeout.  (The reference embeds hashicorp/memberlist; a full SWIM
-  implementation is out of scope for a storage engine — the interface and
-  failure-detection behavior are what matter here.)
+  internal HTTP port (cluster type "http").
+
+The SWIM gossip transport (cluster type "gossip") lives in
+``pilosa_tpu.gossip.GossipNodeSet``.
 """
 
 from __future__ import annotations

@@ -1,0 +1,7 @@
+"""`python -m pilosa_tpu.cli` entry point (same CLI as `python -m pilosa_tpu`)."""
+
+import sys
+
+from pilosa_tpu.cli.main import main
+
+sys.exit(main())

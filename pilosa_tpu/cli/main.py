@@ -76,13 +76,12 @@ def cmd_export(args) -> int:
     client = Client(args.host)
     max_slice = client.max_slices().get(args.index, 0)
     out = sys.stdout if args.output == "-" else open(args.output, "w")
-    for slice_i in range(max_slice + 1):
-        try:
+    try:
+        for slice_i in range(max_slice + 1):
             out.write(client.export_csv(args.index, args.frame, args.view, slice_i))
-        except Exception:
-            continue
-    if out is not sys.stdout:
-        out.close()
+    finally:
+        if out is not sys.stdout:
+            out.close()
     return 0
 
 
@@ -226,14 +225,13 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--test-exit", action="store_true", help=argparse.SUPPRESS)
     s.set_defaults(fn=cmd_server)
 
-    for name, fn in (("import", cmd_import),):
-        s = sub.add_parser(name, help="bulk-import CSV row,col[,timestamp] bits")
-        s.add_argument("--host", default="localhost:10101")
-        s.add_argument("--index", required=True, dest="index")
-        s.add_argument("--frame", required=True)
-        s.add_argument("--buffer-size", type=int, default=10_000_000)
-        s.add_argument("paths", nargs="+")
-        s.set_defaults(fn=fn)
+    s = sub.add_parser("import", help="bulk-import CSV row,col[,timestamp] bits")
+    s.add_argument("--host", default="localhost:10101")
+    s.add_argument("--index", required=True, dest="index")
+    s.add_argument("--frame", required=True)
+    s.add_argument("--buffer-size", type=int, default=10_000_000)
+    s.add_argument("paths", nargs="+")
+    s.set_defaults(fn=cmd_import)
 
     s = sub.add_parser("export", help="export a frame as CSV")
     s.add_argument("--host", default="localhost:10101")

@@ -127,17 +127,21 @@ def varint_decode(data: bytes) -> np.ndarray:
     lib = load() if len(data) >= _VARINT_NATIVE_THRESHOLD else None
     if lib is not None and data:
         buf = np.frombuffer(data, dtype=np.uint8)
-        out = np.empty(len(data), dtype=np.uint64)  # <= one value per byte
+        # Exact value count = bytes with the continuation bit clear.
+        count = int(np.count_nonzero(buf < 0x80))
+        out = np.empty(count, dtype=np.uint64)
         n = lib.pn_varint_decode(_u8(buf), len(buf), _u64(out), len(out))
         if n < 0:
-            raise ValueError("truncated varint stream")
-        return out[:n].copy()
+            raise ValueError("invalid varint stream (truncated or overflows uint64)")
+        return out if n == count else out[:n].copy()
     from pilosa_tpu.wire import decode_varint
 
     out_list = []
     i = 0
     while i < len(data):
         v, i = decode_varint(data, i)
+        if v > 0xFFFFFFFFFFFFFFFF:
+            raise ValueError("invalid varint stream (truncated or overflows uint64)")
         out_list.append(v)
     return np.array(out_list, dtype=np.uint64)
 
@@ -207,9 +211,12 @@ def parse_csv(data: bytes) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
         if len(parts) < 2:
             raise ValueError(f"malformed CSV at line {lineno}")
         try:
-            rows_l.append(int(parts[0]))
-            cols_l.append(int(parts[1]))
-            ts_l.append(int(parts[2]) if len(parts) > 2 and parts[2] else 0)
+            row, col = int(parts[0]), int(parts[1])
+            if row < 0 or col < 0:
+                raise ValueError("negative id")
+            rows_l.append(row)
+            cols_l.append(col)
+            ts_l.append(int(parts[2]) if len(parts) > 2 and parts[2].strip() else 0)
         except ValueError:
             raise ValueError(f"malformed CSV at line {lineno}")
     return (

@@ -83,12 +83,13 @@ class Server:
 
     def _build_broadcast(self):
         ctype = self.config.cluster.type
-        if ctype == CLUSTER_TYPE_STATIC or len(self.cluster.nodes) <= 1:
+        # Gossip membership is dynamic — a single configured host still
+        # gossips; the other types need a static peer list to matter.
+        if ctype == CLUSTER_TYPE_STATIC or (
+            ctype != CLUSTER_TYPE_GOSSIP and len(self.cluster.nodes) <= 1
+        ):
             return bc.NopBroadcaster(), None
-        if ctype in (CLUSTER_TYPE_HTTP, CLUSTER_TYPE_GOSSIP):
-            # Gossip rides the same internal HTTP port in this build; the
-            # membership semantics of memberlist are approximated by the
-            # static host list + per-request failure marking.
+        if ctype == CLUSTER_TYPE_HTTP:
             me = self.cluster.node_by_host(self.host)
             my_internal = me.internal_host if me else ""
             internal_hosts = [n.internal_host or n.host for n in self.cluster.nodes]
@@ -98,6 +99,20 @@ class Server:
                 port = int(my_internal.rsplit(":", 1)[1])
             receiver = bc.HTTPBroadcastReceiver(port)
             return broadcaster, receiver
+        if ctype == CLUSTER_TYPE_GOSSIP:
+            # SWIM gossip: UDP probe/piggyback + TCP push/pull, with this
+            # server as the StatusHandler (gossip/gossip.go, server.go:310-391).
+            from pilosa_tpu.gossip import GossipNodeSet
+
+            me = self.cluster.node_by_host(self.host)
+            bind = (me.internal_host if me and me.internal_host else "127.0.0.1:0")
+            nodeset = GossipNodeSet(
+                name=self.host,
+                bind=bind,
+                seed=self.config.cluster.gossip_seed,
+                status_handler=self,
+            )
+            return nodeset, nodeset
         raise ValueError(f"unknown cluster type: {ctype}")
 
     # -- lifecycle (server.go:92-158) --------------------------------------
@@ -106,8 +121,6 @@ class Server:
         os.makedirs(self.data_dir, exist_ok=True)
         self.holder.open()
         self.holder.on_new_fragment = self._on_new_fragment
-        if self.receiver is not None:
-            self.receiver.start(self.receive_message)
         host, port = self._split_host(self.host)
         self._httpd = serve(self.handler, host=host, port=port)
         actual_port = self._httpd.server_address[1]
@@ -118,6 +131,14 @@ class Server:
             self.syncer.host = self.host
             if self.cluster.nodes and self.cluster.nodes[0].host == self.config.host:
                 self.cluster.nodes[0].host = self.host
+        if self.receiver is not None:
+            if hasattr(self.receiver, "name"):
+                # Gossip members are named by the resolved API host — an
+                # ephemeral ":0" config port must not leak into the name.
+                self.receiver.name = self.host
+            self.receiver.start(self.receive_message)
+            if hasattr(self.receiver, "open"):
+                self.receiver.open()  # gossip: bind sockets + join seed
         self._start_loop(self._monitor_anti_entropy, self.config.anti_entropy_interval)
         self._start_loop(self._monitor_max_slices, self.config.cluster.polling_interval)
         self._start_loop(self._flush_caches, CACHE_FLUSH_INTERVAL)
@@ -200,6 +221,59 @@ class Server:
             )
         except Exception:
             pass
+
+    # -- StatusHandler (server.go:310-391, carried by gossip push/pull) -----
+
+    def local_status(self) -> bytes:
+        """Encode this node's schema + owned slices as internal.NodeStatus
+        (server.go:310-327)."""
+        from pilosa_tpu import wire
+
+        indexes = []
+        for name, idx in sorted(self.holder.indexes.items()):
+            max_slice = idx.max_slice()
+            indexes.append({
+                "name": name,
+                "meta": {"columnLabel": idx.column_label, "timeQuantum": idx.time_quantum},
+                "maxSlice": max_slice,
+                "frames": [
+                    {"name": fname, "meta": fr.schema_json()}
+                    for fname, fr in sorted(idx.frames.items())
+                ],
+                "slices": self.cluster.owns_slices(name, max_slice, self.host),
+            })
+        return wire.encode_node_status(self.host, "UP", indexes)
+
+    def handle_remote_status(self, buf: bytes) -> None:
+        """Merge a peer's NodeStatus: create missing indexes/frames, track
+        remote max slices (server.go:355-391)."""
+        from pilosa_tpu import wire
+
+        ns = wire.decode_node_status(buf)
+        node = self.cluster.node_by_host(ns.get("host", ""))
+        if node is not None and ns.get("state"):
+            node.state = ns["state"]
+        for idx_status in ns.get("indexes", []):
+            meta = idx_status.get("meta", {})
+            idx = self.holder.create_index_if_not_exists(
+                idx_status["name"],
+                IndexOptions(
+                    column_label=meta.get("columnLabel", ""),
+                    time_quantum=meta.get("timeQuantum", ""),
+                ),
+            )
+            for fr in idx_status.get("frames", []):
+                fmeta = fr.get("meta", {})
+                idx.create_frame_if_not_exists(
+                    fr["name"],
+                    FrameOptions(
+                        row_label=fmeta.get("rowLabel", ""),
+                        time_quantum=fmeta.get("timeQuantum", ""),
+                        cache_size=fmeta.get("cacheSize", 0),
+                    ),
+                )
+            if idx_status.get("maxSlice", 0) > idx.max_slice():
+                idx.set_remote_max_slice(idx_status["maxSlice"])
 
     def receive_message(self, data: bytes) -> None:
         """Apply a peer's schema mutation (server.go:259-304)."""

@@ -560,3 +560,68 @@ def decode_max_slices_response(data: bytes) -> dict[str, int]:
                     val = v2
             out[key] = val
     return out
+
+
+# -- node status (internal/private.proto:69-90 Frame/Index/NodeStatus) -------
+
+
+def encode_node_status(host: str, state: str, indexes: list[dict]) -> bytes:
+    """internal.NodeStatus: the gossip/status payload (private.proto:82-86).
+
+    ``indexes`` items: {"name", "meta": index-meta dict, "maxSlice",
+    "frames": [{"name", "meta": frame-meta dict}], "slices": [int]}.
+    """
+    w = Writer().string(1, host).string(2, state)
+    for idx in indexes:
+        iw = Writer().string(1, idx.get("name", ""))
+        meta = idx.get("meta") or {}
+        iw.message(2, encode_index_meta(meta.get("columnLabel", ""), meta.get("timeQuantum", "")))
+        iw.varint(3, idx.get("maxSlice", 0))
+        for fr in idx.get("frames", []):
+            fmeta = fr.get("meta") or {}
+            fw = Writer().string(1, fr.get("name", ""))
+            fw.message(
+                2,
+                encode_frame_meta(
+                    fmeta.get("rowLabel", ""),
+                    fmeta.get("inverseEnabled", False),
+                    fmeta.get("cacheType", ""),
+                    fmeta.get("cacheSize", 0),
+                    fmeta.get("timeQuantum", ""),
+                ),
+            )
+            iw.message(4, fw.finish())
+        for s in idx.get("slices", []):
+            iw.varint(5, s, force=True)  # repeated: zero-valued entries must survive
+        w.message(3, iw.finish())
+    return w.finish()
+
+
+def decode_node_status(data: bytes) -> dict:
+    out: dict = {"host": "", "state": "", "indexes": []}
+    for field, wire, v in iter_fields(data):
+        if field == 1:
+            out["host"] = v.decode()
+        elif field == 2:
+            out["state"] = v.decode()
+        elif field == 3:
+            idx: dict = {"name": "", "meta": {}, "maxSlice": 0, "frames": [], "slices": []}
+            for f2, w2, v2 in iter_fields(v):
+                if f2 == 1:
+                    idx["name"] = v2.decode()
+                elif f2 == 2:
+                    idx["meta"] = decode_index_meta(v2)
+                elif f2 == 3:
+                    idx["maxSlice"] = v2
+                elif f2 == 4:
+                    fr: dict = {"name": "", "meta": {}}
+                    for f3, w3, v3 in iter_fields(v2):
+                        if f3 == 1:
+                            fr["name"] = v3.decode()
+                        elif f3 == 2:
+                            fr["meta"] = decode_frame_meta(v3)
+                    idx["frames"].append(fr)
+                elif f2 == 5:
+                    idx["slices"].append(v2)
+            out["indexes"].append(idx)
+    return out

@@ -80,3 +80,67 @@ def test_wire_large_packed_uses_native(rng):
     fields = list(wire.iter_fields(raw))
     decoded = wire.decode_packed_uint64(fields[0][2])
     assert decoded == vals
+
+
+@pytest.fixture
+def force_fallback():
+    """Temporarily disable the native lib so the pure-Python path runs."""
+    lib, tried = native._lib, native._tried
+    native._lib, native._tried = None, True
+    yield
+    native._lib, native._tried = lib, tried
+
+
+@pytest.mark.parametrize(
+    "data",
+    [
+        b"5,",          # empty column field
+        b",7",          # empty row field
+        b"1 2,3",       # interior space concatenating digits
+        b"5,2,",        # empty timestamp -> 0
+        b"5,2, ",       # blank timestamp -> 0
+        b" 5 , 2 ",     # surrounding spaces ok
+        b"5,2,9\r\n",   # CRLF
+        b"5,2,x",       # junk timestamp
+        b"-1,2",        # negative id
+        b"3,4,  7 ",    # padded timestamp
+        b"1,100\n2,200,1500000000\n\n3,5\n",
+    ],
+)
+def test_parse_csv_native_matches_fallback(data, force_fallback):
+    """Native and fallback must agree on accept/reject AND values —
+    otherwise import behavior depends on whether the .so built."""
+    def run():
+        try:
+            r, c, t = native.parse_csv(data)
+            return ("ok", r.tolist(), c.tolist(), t.tolist())
+        except ValueError:
+            return ("err",)
+
+    fallback = run()
+    native._lib, native._tried = None, False  # re-enable native
+    if not native.available():
+        pytest.skip("native lib unavailable")
+    assert run() == fallback
+
+
+def test_varint_decode_rejects_overlong_both_paths(force_fallback):
+    """A 10-byte varint encoding >= 2^64 must raise ValueError on both
+    paths (not OverflowError, not silent truncation)."""
+    overlong = bytes([0x80] * 9 + [0x7F]) * 7  # > native threshold
+    with pytest.raises(ValueError):
+        native.varint_decode(overlong)  # fallback path
+    native._lib, native._tried = None, False
+    if not native.available():
+        pytest.skip("native lib unavailable")
+    with pytest.raises(ValueError):
+        native.varint_decode(overlong)  # native path
+
+
+def test_varint_decode_max_uint64_both_paths(force_fallback):
+    m = np.array([2**64 - 1] * 100, dtype=np.uint64)
+    np.testing.assert_array_equal(native.varint_decode(native.varint_encode(m)), m)
+    native._lib, native._tried = None, False
+    if not native.available():
+        pytest.skip("native lib unavailable")
+    np.testing.assert_array_equal(native.varint_decode(native.varint_encode(m)), m)
