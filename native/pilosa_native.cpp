@@ -156,15 +156,22 @@ int64_t pn_parse_csv(const char* buf, size_t len, uint64_t* rows, uint64_t* cols
         // whitespace; empty row/col fields are malformed).
         bool has_digit[3] = {false, false, false};
         bool digits_done[3] = {false, false, false};  // saw space after digits
+        bool line_content = false;                    // any digit or comma
         for (; i < len && buf[i] != '\n'; i++) {
             char c = buf[i];
             if (c >= '0' && c <= '9') {
                 if (digits_done[field]) return -line;  // "1 2" in one field
-                vals[field] = vals[field] * 10 + (uint64_t)(c - '0');
+                uint64_t d = (uint64_t)(c - '0');
+                // uint64 overflow check: the fallback rejects ids >= 2^64
+                // rather than wrapping them onto the wrong bit.
+                if (vals[field] > (0xFFFFFFFFFFFFFFFFULL - d) / 10) return -line;
+                vals[field] = vals[field] * 10 + d;
                 has_digit[field] = true;
+                line_content = true;
             } else if (c == ',') {
                 if (field >= 2) return -line;
                 field++;
+                line_content = true;
             } else if (c == '\r' || c == ' ') {
                 if (has_digit[field]) digits_done[field] = true;
             } else {
@@ -172,10 +179,15 @@ int64_t pn_parse_csv(const char* buf, size_t len, uint64_t* rows, uint64_t* cols
             }
         }
         if (i < len) i++;  // consume newline
+        if (!line_content) {  // whitespace-only line: skipped, like strip()
+            line++;
+            continue;
+        }
         // Row and column must each carry digits; an empty (or blank)
         // timestamp field means 0 — the fallback strips the line and
         // int() strips field-surrounding spaces, so blanks are legal there.
         if (field < 1 || !has_digit[0] || !has_digit[1]) return -line;
+        if (field == 2 && vals[2] > 0x7FFFFFFFFFFFFFFFULL) return -line;  // ts is int64
         rows[n] = vals[0];
         cols[n] = vals[1];
         ts[n] = (field == 2) ? (int64_t)vals[2] : 0;

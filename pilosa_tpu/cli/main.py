@@ -71,14 +71,20 @@ def cmd_import(args) -> int:
 
 
 def cmd_export(args) -> int:
-    from pilosa_tpu.server.client import Client
+    from pilosa_tpu.server.client import Client, ClientError
 
     client = Client(args.host)
     max_slice = client.max_slices().get(args.index, 0)
     out = sys.stdout if args.output == "-" else open(args.output, "w")
     try:
         for slice_i in range(max_slice + 1):
-            out.write(client.export_csv(args.index, args.frame, args.view, slice_i))
+            try:
+                out.write(client.export_csv(args.index, args.frame, args.view, slice_i))
+            except ClientError as e:
+                # Slices the local node doesn't hold 404 (sparse frames,
+                # cluster peers own them); anything else is a real failure.
+                if e.status != 404:
+                    raise
     finally:
         if out is not sys.stdout:
             out.close()

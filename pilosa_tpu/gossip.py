@@ -274,8 +274,22 @@ class GossipNodeSet:
             raise errs[0]
 
     def send_async(self, msg: bytes) -> None:
-        """Queue for piggybacked gossip delivery (gossip.go:152-164)."""
+        """Queue for piggybacked gossip delivery (gossip.go:152-164).
+
+        Messages too large for a UDP probe's piggyback budget would sit in
+        the queue forever; they take the TCP direct path instead (errors
+        ignored — async delivery is best-effort).
+        """
+        if 5 + len(msg) > _MAX_UDP - 200:
+            threading.Thread(target=self._quiet_sync, args=(msg,), daemon=True).start()
+            return
         self._queue_broadcast(_PB_USER, msg)
+
+    def _quiet_sync(self, msg: bytes) -> None:
+        try:
+            self.send_sync(msg)
+        except Exception:
+            pass
 
     # -- internals: queue + piggyback -------------------------------------
 
@@ -296,6 +310,11 @@ class GossipNodeSet:
         with self._lock:
             for lb in list(self._queue):
                 cost = 5 + len(lb.payload)
+                if cost > _MAX_UDP - 200:
+                    # Can never fit any packet's budget — drop instead of
+                    # rescanning a dead entry forever.
+                    self._queue.remove(lb)
+                    continue
                 if used + cost > limit:
                     continue
                 out.append((lb.kind, lb.payload))
@@ -361,10 +380,18 @@ class GossipNodeSet:
 
     def _udp_loop(self) -> None:
         while not self._closing.is_set():
-            try:
-                data, src = self._udp.recvfrom(65536)
-            except OSError:
+            sock = self._udp
+            if sock is None:
                 return
+            try:
+                data, src = sock.recvfrom(65536)
+            except OSError:
+                # Transient errors (e.g. ICMP port-unreachable surfacing as
+                # ConnectionResetError) must not kill failure detection;
+                # only exit once close() is underway.
+                if self._closing.is_set() or self._udp is None:
+                    return
+                continue
             try:
                 self._handle_udp(data, src)
             except Exception:

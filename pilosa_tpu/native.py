@@ -212,11 +212,14 @@ def parse_csv(data: bytes) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
             raise ValueError(f"malformed CSV at line {lineno}")
         try:
             row, col = int(parts[0]), int(parts[1])
-            if row < 0 or col < 0:
-                raise ValueError("negative id")
+            if not (0 <= row < 1 << 64) or not (0 <= col < 1 << 64):
+                raise ValueError("id out of uint64 range")
+            t = int(parts[2]) if len(parts) > 2 and parts[2].strip() else 0
+            if not (0 <= t < 1 << 63):
+                raise ValueError("timestamp out of int64 range")
             rows_l.append(row)
             cols_l.append(col)
-            ts_l.append(int(parts[2]) if len(parts) > 2 and parts[2].strip() else 0)
+            ts_l.append(t)
         except ValueError:
             raise ValueError(f"malformed CSV at line {lineno}")
     return (

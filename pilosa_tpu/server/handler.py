@@ -3,7 +3,8 @@
 Reference analog: handler.go (1429 LoC; route table handler.go:82-120).
 Routes:
 
-    GET    /                                        welcome / WebUI
+    GET    /                                        welcome (API) / WebUI (browser)
+    GET    /assets/{file}                           WebUI assets
     GET    /index                                   list indexes
     GET    /index/{index}                           index info
     POST   /index/{index}                           create index
@@ -41,6 +42,7 @@ from __future__ import annotations
 
 import io
 import json
+import os
 import re
 import threading
 import traceback
@@ -98,6 +100,7 @@ class Handler:
     def _build_routes(self):
         return [
             ("GET", re.compile(r"^/$"), self.get_root),
+            ("GET", re.compile(r"^/assets/(?P<file>[^/]+)$"), self.get_webui_asset),
             ("GET", re.compile(r"^/index$"), self.get_indexes),
             ("GET", re.compile(r"^/index/(?P<index>[^/]+)$"), self.get_index),
             ("POST", re.compile(r"^/index/(?P<index>[^/]+)$"), self.post_index),
@@ -188,12 +191,38 @@ class Handler:
 
     # -- root / misc -----------------------------------------------------
 
-    def get_root(self, **kw):
+    # WebUI embed (reference: webui/ served via statik, handler.go:132-145).
+    _WEBUI_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "webui")
+    _WEBUI_TYPES = {".html": "text/html", ".js": "application/javascript", ".css": "text/css",
+                    ".svg": "image/svg+xml", ".png": "image/png"}
+
+    def get_root(self, headers=None, **kw):
+        # Browsers get the console; API clients keep the plain-text banner.
+        if headers and "text/html" in (headers.get("accept") or ""):
+            try:
+                return self._webui_file("index.html")
+            except HTTPError:
+                pass  # bundle missing: the banner is a safer answer than 404
         return (
             200,
             "text/plain",
             b"Welcome. pilosa-tpu is running. POST PQL to /index/{index}/query.\n",
         )
+
+    def get_webui_asset(self, file=None, **kw):
+        if not file or "/" in file or file.startswith("."):
+            raise HTTPError(404, "not found")
+        return self._webui_file(os.path.join("assets", file))
+
+    def _webui_file(self, rel: str):
+        path = os.path.join(self._WEBUI_DIR, rel)
+        try:
+            with open(path, "rb") as f:
+                body = f.read()
+        except OSError:
+            raise HTTPError(404, "not found")
+        ctype = self._WEBUI_TYPES.get(os.path.splitext(rel)[1], "application/octet-stream")
+        return 200, ctype, body
 
     def get_version(self, **kw):
         return self._json({"version": self.version})

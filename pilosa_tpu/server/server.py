@@ -268,8 +268,10 @@ class Server:
                     fr["name"],
                     FrameOptions(
                         row_label=fmeta.get("rowLabel", ""),
-                        time_quantum=fmeta.get("timeQuantum", ""),
+                        inverse_enabled=fmeta.get("inverseEnabled", False),
+                        cache_type=fmeta.get("cacheType", ""),
                         cache_size=fmeta.get("cacheSize", 0),
+                        time_quantum=fmeta.get("timeQuantum", ""),
                     ),
                 )
             if idx_status.get("maxSlice", 0) > idx.max_slice():

@@ -1,0 +1,157 @@
+/* pilosa-tpu console (reference webui/assets/main.js analog, written for
+ * this framework's JSON API: /version /schema /status /hosts /index/{i}/query). */
+"use strict";
+
+const $ = (id) => document.getElementById(id);
+
+// -- tabs -------------------------------------------------------------------
+
+const TABS = ["console", "cluster", "schema"];
+TABS.forEach((name) => {
+  $("tab-" + name).addEventListener("click", () => {
+    TABS.forEach((t) => {
+      $("tab-" + t).classList.toggle("active", t === name);
+      $("pane-" + t).classList.toggle("active", t === name);
+    });
+    if (name === "cluster") loadCluster();
+    if (name === "schema") loadSchema();
+  });
+});
+
+// -- bootstrap --------------------------------------------------------------
+
+async function getJSON(path) {
+  const r = await fetch(path);
+  if (!r.ok) throw new Error(await r.text());
+  return r.json();
+}
+
+async function loadVersion() {
+  try {
+    const v = await getJSON("/version");
+    $("version").textContent = "v" + v.version;
+  } catch (e) {
+    $("version").textContent = "";
+  }
+}
+
+async function loadIndexes() {
+  const sel = $("index-select");
+  const prev = sel.value;
+  sel.innerHTML = '<option value="">Select index</option>';
+  try {
+    const schema = await getJSON("/schema");
+    for (const idx of schema.indexes || []) {
+      const opt = document.createElement("option");
+      opt.value = idx.name;
+      opt.textContent = idx.name;
+      sel.appendChild(opt);
+    }
+    sel.value = prev;
+  } catch (e) {
+    /* server unreachable; leave the placeholder */
+  }
+}
+
+// -- console ----------------------------------------------------------------
+
+function renderResult(query, body, ms, isError) {
+  const div = document.createElement("div");
+  div.className = "result" + (isError ? " error" : "");
+  const meta = document.createElement("div");
+  meta.className = "meta";
+  meta.textContent = `${new Date().toLocaleTimeString()}  ${ms.toFixed(1)} ms  ${query}`;
+  const pre = document.createElement("div");
+  pre.textContent = body;
+  div.appendChild(meta);
+  div.appendChild(pre);
+  $("output").prepend(div);
+  while ($("output").childElementCount > 50) $("output").lastChild.remove();
+}
+
+async function runQuery() {
+  const index = $("index-select").value;
+  const query = $("query").value.trim();
+  if (!index) return renderResult(query, "select an index first", 0, true);
+  if (!query) return;
+  const t0 = performance.now();
+  try {
+    const r = await fetch(`/index/${encodeURIComponent(index)}/query`, {
+      method: "POST",
+      body: query,
+    });
+    const text = await r.text();
+    const ms = performance.now() - t0;
+    $("timing").textContent = ms.toFixed(1) + " ms";
+    let pretty = text;
+    try {
+      pretty = JSON.stringify(JSON.parse(text), null, 2);
+    } catch (e) {
+      /* leave as-is */
+    }
+    renderResult(query, pretty, ms, !r.ok);
+    if (/^(SetBit|ClearBit|SetRowAttrs|SetColumnAttrs)/.test(query)) loadIndexes();
+  } catch (e) {
+    renderResult(query, String(e), performance.now() - t0, true);
+  }
+}
+
+$("run").addEventListener("click", runQuery);
+$("query").addEventListener("keydown", (ev) => {
+  if ((ev.ctrlKey || ev.metaKey) && ev.key === "Enter") runQuery();
+});
+
+// -- cluster ----------------------------------------------------------------
+
+async function loadCluster() {
+  const tbody = $("cluster-table").querySelector("tbody");
+  tbody.innerHTML = "";
+  try {
+    const status = await getJSON("/status");
+    for (const node of status.status?.cluster?.nodes || []) {
+      const tr = document.createElement("tr");
+      const state = node.state || "UP";
+      tr.innerHTML = `<td>${node.host}</td><td>${node.internalHost || ""}</td>` +
+        `<td class="state-${state}">${state}</td>`;
+      tbody.appendChild(tr);
+    }
+  } catch (e) {
+    tbody.innerHTML = `<tr><td colspan="3">${e}</td></tr>`;
+  }
+}
+
+// -- schema -----------------------------------------------------------------
+
+async function loadSchema() {
+  const tree = $("schema-tree");
+  tree.innerHTML = "";
+  try {
+    const schema = await getJSON("/schema");
+    for (const idx of schema.indexes || []) {
+      const div = document.createElement("div");
+      div.className = "tree-index";
+      const name = document.createElement("div");
+      name.className = "name";
+      name.textContent = idx.name;
+      div.appendChild(name);
+      for (const fr of idx.frames || []) {
+        const fdiv = document.createElement("div");
+        fdiv.className = "tree-frame";
+        const opts = [];
+        if (fr.rowLabel) opts.push("rowLabel=" + fr.rowLabel);
+        if (fr.cacheType) opts.push("cache=" + fr.cacheType + ":" + fr.cacheSize);
+        if (fr.timeQuantum) opts.push("time=" + fr.timeQuantum);
+        if (fr.inverseEnabled) opts.push("inverse");
+        fdiv.innerHTML = `${fr.name} <span class="opts">${opts.join("  ")}</span>`;
+        div.appendChild(fdiv);
+      }
+      tree.appendChild(div);
+    }
+    if (!tree.childElementCount) tree.textContent = "no indexes";
+  } catch (e) {
+    tree.textContent = String(e);
+  }
+}
+
+loadVersion();
+loadIndexes();

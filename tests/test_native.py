@@ -105,6 +105,11 @@ def force_fallback():
         b"-1,2",        # negative id
         b"3,4,  7 ",    # padded timestamp
         b"1,100\n2,200,1500000000\n\n3,5\n",
+        b"1,2\n   \n3,4",                     # whitespace-only line skipped
+        b"18446744073709551616,1",            # row overflows uint64
+        b"1,18446744073709551616",            # col overflows uint64
+        b"1,2,9223372036854775808",           # ts overflows int64
+        b"18446744073709551615,2",            # max uint64 row ok
     ],
 )
 def test_parse_csv_native_matches_fallback(data, force_fallback):

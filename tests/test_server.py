@@ -208,3 +208,34 @@ def test_two_node_cluster_distributed_query(tmp_path):
     finally:
         for s in servers:
             s.close()
+
+
+def test_webui_served_to_browsers(srv):
+    """`/` serves the console to Accept: text/html clients and the plain
+    banner to API clients; /assets/* serves the bundle (handler.go:132-145)."""
+    def get(path, accept=None):
+        req = urllib.request.Request(f"http://{srv.host}{path}")
+        if accept:
+            req.add_header("Accept", accept)
+        with urllib.request.urlopen(req, timeout=5) as r:
+            return r.status, r.headers.get("Content-Type", ""), r.read()
+
+    st, ct, body = get("/", accept="text/html,application/xhtml+xml")
+    assert st == 200 and ct.startswith("text/html")
+    assert b"pilosa-tpu console" in body
+
+    st, ct, body = get("/")
+    assert st == 200 and ct.startswith("text/plain")
+
+    st, ct, body = get("/assets/main.js")
+    assert st == 200 and ct == "application/javascript" and b"runQuery" in body
+    st, ct, body = get("/assets/style.css")
+    assert st == 200 and ct == "text/css"
+
+    with pytest.raises(urllib.error.HTTPError) as e:
+        get("/assets/nope.js")
+    assert e.value.code == 404
+    # path traversal is rejected, not served
+    with pytest.raises(urllib.error.HTTPError) as e:
+        get("/assets/..%2Findex.html")
+    assert e.value.code == 404
