@@ -6,23 +6,32 @@ Workload (BASELINE.md config 1/4 shape): a Star-Trace style index — a
 device-resident row matrix of ``n_slices`` slices × ``n_rows`` rows of
 packed SLICE_WIDTH-bit bitmaps — served a stream of
 ``Count(Intersect(Bitmap(r1), Bitmap(r2)))`` queries.  Queries run in
-batches through ONE fused jit computation (gather rows → AND → popcount →
-reduce over slices+words), which is the TPU-native form of the
-reference's per-slice goroutine fan-out + SIMD loop.
+batches through ONE fused computation per batch: on TPU a Pallas kernel
+that scalar-prefetches the row-id pairs and streams each operand row
+HBM→VMEM exactly once (gather → AND → popcount → reduce with no
+materialized intermediates — the TPU-native form of the reference's
+per-slice goroutine fan-out + SIMD loop, executor.go:1115-1244 +
+roaring/assembly_amd64.s:60-77).
+
+Timing methodology: all ``iters`` batches are chained inside one jitted
+``lax.scan`` and the timer stops only when the results have been fetched
+to host memory.  This is deliberate: the TPU here sits behind a remote
+tunnel with ~70 ms round-trip latency and unreliable
+``block_until_ready`` semantics, so per-batch host dispatch would
+measure the tunnel, not the device, and blocking on the last output
+alone under-measures.  One dispatch + explicit host fetch amortizes the
+round trip across the whole query stream and cannot finish early.
 
 vs_baseline: ratio against a single-threaded numpy popcount loop on the
 same data on this host's CPU — the stand-in for the reference's Go+SIMD
 single-node path (the reference publishes no numbers in-tree; see
-BASELINE.md).  The numpy baseline uses the same vectorized
-AND+LUT-popcount per query, which is competitive with the reference's
-per-container loops.
+BASELINE.md).
 """
 
 from __future__ import annotations
 
 import json
 import os
-import sys
 import time
 
 import numpy as np
@@ -32,7 +41,7 @@ def main() -> None:
     n_slices = int(os.environ.get("BENCH_SLICES", "16"))
     n_rows = int(os.environ.get("BENCH_ROWS", "64"))
     batch = int(os.environ.get("BENCH_BATCH", "256"))
-    iters = int(os.environ.get("BENCH_ITERS", "20"))
+    iters = int(os.environ.get("BENCH_ITERS", "40"))
     # Bit density ~2^-k via AND of k random words (throughput over packed
     # words is density-independent; this just keeps counts realistic).
     density_k = int(os.environ.get("BENCH_DENSITY_K", "4"))
@@ -45,29 +54,29 @@ def main() -> None:
     for _ in range(density_k - 1):
         row_matrix &= rng.integers(0, 1 << 32, size=(n_slices, n_rows, W), dtype=np.uint32)
 
-    pairs = rng.integers(0, n_rows, size=(iters, batch, 2), dtype=np.int32)
+    all_pairs = rng.integers(0, n_rows, size=(iters, batch, 2), dtype=np.int32)
 
     # ---- TPU path -------------------------------------------------------
     import jax
-    import jax.numpy as jnp
     from jax import lax
 
+    from pilosa_tpu.ops import dispatch
+
     @jax.jit
-    def query_batch(rm, prs):
-        a = jnp.take(rm, prs[:, 0], axis=1)
-        b = jnp.take(rm, prs[:, 1], axis=1)
-        return jnp.sum(lax.population_count(jnp.bitwise_and(a, b)).astype(jnp.int32), axis=(0, 2))
+    def run_stream(rm, pairs_stream):
+        def step(carry, prs):
+            return carry, dispatch.gather_count_and(rm, prs)
+
+        return lax.scan(step, 0, pairs_stream)[1]
 
     drm = jax.device_put(row_matrix)
-    dpairs = [jax.device_put(pairs[i]) for i in range(iters)]
-    # warmup/compile
-    query_batch(drm, dpairs[0]).block_until_ready()
+    dpairs = jax.device_put(all_pairs)
+    # Warmup compiles and runs the full stream once; fetching to host is
+    # the only reliable synchronization on this backend.
+    out = np.asarray(run_stream(drm, dpairs))
 
     t0 = time.perf_counter()
-    out = None
-    for i in range(iters):
-        out = query_batch(drm, dpairs[i])
-    out.block_until_ready()
+    out = np.asarray(run_stream(drm, dpairs))
     dt = time.perf_counter() - t0
     qps = iters * batch / dt
 
@@ -76,14 +85,16 @@ def main() -> None:
 
     base_iters = max(1, min(3, iters))
     t0 = time.perf_counter()
+    base_out = None
     for i in range(base_iters):
-        p = pairs[i]
+        p = all_pairs[i]
         a = row_matrix[:, p[:, 0], :]
         b = row_matrix[:, p[:, 1], :]
         inter = a & b
-        _ = _POPCNT8[inter.view(np.uint8)].reshape(n_slices, batch, -1).sum(axis=(0, 2))
+        base_out = _POPCNT8[inter.view(np.uint8)].reshape(n_slices, batch, -1).sum(axis=(0, 2))
     base_dt = time.perf_counter() - t0
     base_qps = base_iters * batch / base_dt
+    assert np.array_equal(out[base_iters - 1], base_out), "TPU/CPU result mismatch"
 
     result = {
         "metric": "intersect_count_qps",

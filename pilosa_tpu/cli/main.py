@@ -85,6 +85,11 @@ def cmd_export(args) -> int:
                 # cluster peers own them); anything else is a real failure.
                 if e.status != 404:
                     raise
+                print(
+                    f"warning: slice {slice_i} not on {args.host} (404); "
+                    "export may be partial — run against each cluster node",
+                    file=sys.stderr,
+                )
     finally:
         if out is not sys.stdout:
             out.close()

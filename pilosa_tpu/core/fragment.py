@@ -25,6 +25,7 @@ import hashlib
 import math
 import os
 import tempfile
+import threading
 from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Optional, Sequence
@@ -104,6 +105,9 @@ class Fragment:
         self.row_attr_store = row_attr_store
         self.stats = stats
 
+        # Guards storage + caches against concurrent readers/writers
+        # (fragment.go:69 mu analog).
+        self._mu = threading.RLock()
         self.storage: roaring.Bitmap = roaring.Bitmap()
         self.cache = cache_mod.new_cache(cache_type, cache_size)
         self._wal = None  # append handle to the data file
@@ -187,21 +191,24 @@ class Fragment:
     # -- bit ops (fragment.go:371-459) ----------------------------------
 
     def set_bit(self, row_id: int, column_id: int) -> bool:
-        changed = self.storage.add(self.pos(row_id, column_id))
-        if changed:
-            self._on_row_mutated(row_id)
-            self._increment_opn()
-        return changed
+        with self._mu:
+            changed = self.storage.add(self.pos(row_id, column_id))
+            if changed:
+                self._on_row_mutated(row_id)
+                self._increment_opn()
+            return changed
 
     def clear_bit(self, row_id: int, column_id: int) -> bool:
-        changed = self.storage.remove(self.pos(row_id, column_id))
-        if changed:
-            self._on_row_mutated(row_id)
-            self._increment_opn()
-        return changed
+        with self._mu:
+            changed = self.storage.remove(self.pos(row_id, column_id))
+            if changed:
+                self._on_row_mutated(row_id)
+                self._increment_opn()
+            return changed
 
     def contains(self, row_id: int, column_id: int) -> bool:
-        return self.storage.contains(self.pos(row_id, column_id))
+        with self._mu:
+            return self.storage.contains(self.pos(row_id, column_id))
 
     def _on_row_mutated(self, row_id: int) -> None:
         self._row_cache.pop(row_id, None)
@@ -216,6 +223,10 @@ class Fragment:
 
     def snapshot(self) -> None:
         """Rewrite the data file from storage; temp-file + rename."""
+        with self._mu:
+            self._snapshot()
+
+    def _snapshot(self) -> None:
         dirname = os.path.dirname(self.path) or "."
         fd, tmp = tempfile.mkstemp(prefix=os.path.basename(self.path), suffix=".snapshotting", dir=dirname)
         try:
@@ -233,30 +244,35 @@ class Fragment:
 
     def row_dense(self, row_id: int) -> np.ndarray:
         """One row of this slice as packed uint32 words (device layout)."""
-        cached = self._row_cache.get(row_id)
-        if cached is not None:
-            self._row_cache.move_to_end(row_id)
-            return cached
-        words = self.storage.to_dense_words(row_id * SLICE_WIDTH, SLICE_WIDTH)
-        self._row_cache[row_id] = words
-        while len(self._row_cache) > self._row_cache_max:
-            self._row_cache.popitem(last=False)
-        return words
+        with self._mu:
+            cached = self._row_cache.get(row_id)
+            if cached is not None:
+                self._row_cache.move_to_end(row_id)
+                return cached
+            words = self.storage.to_dense_words(row_id * SLICE_WIDTH, SLICE_WIDTH)
+            self._row_cache[row_id] = words
+            while len(self._row_cache) > self._row_cache_max:
+                self._row_cache.popitem(last=False)
+            return words
 
     def row(self, row_id: int) -> roaring.Bitmap:
         """Row as a roaring bitmap of global column positions for this slice."""
-        return self.storage.offset_range(
-            self.slice * SLICE_WIDTH, row_id * SLICE_WIDTH, (row_id + 1) * SLICE_WIDTH
-        )
+        with self._mu:
+            return self.storage.offset_range(
+                self.slice * SLICE_WIDTH, row_id * SLICE_WIDTH, (row_id + 1) * SLICE_WIDTH
+            )
 
     def row_count(self, row_id: int) -> int:
-        return self.storage.count_range(row_id * SLICE_WIDTH, (row_id + 1) * SLICE_WIDTH)
+        with self._mu:
+            return self.storage.count_range(row_id * SLICE_WIDTH, (row_id + 1) * SLICE_WIDTH)
 
     def max_row(self) -> int:
-        return self.storage.max() // SLICE_WIDTH
+        with self._mu:
+            return self.storage.max() // SLICE_WIDTH
 
     def count(self) -> int:
-        return self.storage.count()
+        with self._mu:
+            return self.storage.count()
 
     # -- TopN (fragment.go:493-659) -------------------------------------
 
@@ -356,6 +372,10 @@ class Fragment:
 
     def import_bits(self, row_ids: Sequence[int], column_ids: Sequence[int]) -> None:
         """Bulk load; WAL detached, one snapshot at the end."""
+        with self._mu:
+            self._import_bits(row_ids, column_ids)
+
+    def _import_bits(self, row_ids, column_ids) -> None:
         row_ids = np.asarray(row_ids, dtype=np.uint64)
         column_ids = np.asarray(column_ids, dtype=np.uint64)
         if len(row_ids) != len(column_ids):
@@ -384,6 +404,10 @@ class Fragment:
 
     def blocks(self) -> list[tuple[int, bytes]]:
         """(block id, sha1) for each non-empty block of HASH_BLOCK_SIZE rows."""
+        with self._mu:
+            return self._blocks()
+
+    def _blocks(self) -> list[tuple[int, bytes]]:
         positions = self.storage.to_array()
         if len(positions) == 0:
             return []
@@ -404,7 +428,8 @@ class Fragment:
         """(row_ids, column_ids) of all bits in a block (fragment.go:785-794)."""
         start = block_id * HASH_BLOCK_SIZE * SLICE_WIDTH
         end = (block_id + 1) * HASH_BLOCK_SIZE * SLICE_WIDTH
-        positions = self.storage.slice_values(start, end)
+        with self._mu:
+            positions = self.storage.slice_values(start, end)
         rows = positions // np.uint64(SLICE_WIDTH)
         cols = positions % np.uint64(SLICE_WIDTH)
         return rows, cols
@@ -454,10 +479,15 @@ class Fragment:
 
     def write_to(self, w) -> int:
         """Serialize current storage (snapshot format, no pending ops)."""
-        return self.storage.write_to(w)
+        with self._mu:
+            return self.storage.write_to(w)
 
     def read_from(self, data: bytes) -> None:
         """Replace contents from a snapshot byte string (restore path)."""
+        with self._mu:
+            self._read_from(data)
+
+    def _read_from(self, data: bytes) -> None:
         self.storage = roaring.Bitmap.from_bytes(data)
         self.storage.op_n = 0
         self._row_cache.clear()

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 from datetime import datetime
 from typing import Optional, Sequence
 
@@ -78,6 +79,8 @@ class Frame:
         self.cache_size = DEFAULT_CACHE_SIZE
         self.time_quantum = ""
 
+        # Guards view create against concurrent writers (frame.go mu analog).
+        self._mu = threading.RLock()
         self.views: dict[str, View] = {}
         self.row_attr_store = AttrStore(os.path.join(path, "row_attrs.db"))
 
@@ -186,10 +189,11 @@ class Frame:
         return self.views.get(name)
 
     def create_view_if_not_exists(self, name: str) -> View:
-        v = self.views.get(name)
-        if v is not None:
-            return v
-        return self._open_view(name)
+        with self._mu:
+            v = self.views.get(name)
+            if v is not None:
+                return v
+            return self._open_view(name)
 
     def max_slice(self) -> int:
         return max((v.max_slice() for v in self.views.values()), default=0)

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import os
 import shutil
+import threading
 from typing import Optional
 
 from pilosa_tpu.core.fragment import Fragment
@@ -29,6 +30,9 @@ class Holder:
     def __init__(self, path: str, stats=None):
         self.path = path
         self.stats = stats
+        # Guards index create/delete against concurrent schema merges
+        # (gossip push/pull runs from two threads; holder.go:35 mu analog).
+        self._mu = threading.RLock()
         self.indexes: dict[str, Index] = {}
         # Hook invoked as (index, frame, view, slice) when a fragment for a
         # new max slice is created locally — the server broadcasts a
@@ -66,15 +70,17 @@ class Holder:
         return self.indexes.get(name)
 
     def create_index(self, name: str, opt: Optional[IndexOptions] = None) -> Index:
-        if name in self.indexes:
-            raise ErrIndexExists(name)
-        return self._create_index(name, opt or IndexOptions())
+        with self._mu:
+            if name in self.indexes:
+                raise ErrIndexExists(name)
+            return self._create_index(name, opt or IndexOptions())
 
     def create_index_if_not_exists(self, name: str, opt: Optional[IndexOptions] = None) -> Index:
-        idx = self.indexes.get(name)
-        if idx is not None:
-            return idx
-        return self._create_index(name, opt or IndexOptions())
+        with self._mu:
+            idx = self.indexes.get(name)
+            if idx is not None:
+                return idx
+            return self._create_index(name, opt or IndexOptions())
 
     def _create_index(self, name: str, opt: IndexOptions) -> Index:
         validate_name(name)
@@ -90,11 +96,14 @@ class Holder:
         return idx
 
     def delete_index(self, name: str) -> None:
-        idx = self.indexes.pop(name, None)
-        if idx is None:
-            raise ErrIndexNotFound(name)
-        idx.close()
-        shutil.rmtree(idx.path, ignore_errors=True)
+        # close + rmtree stay under the lock so a concurrent create of the
+        # same name can't have its fresh directory deleted out from under it.
+        with self._mu:
+            idx = self.indexes.pop(name, None)
+            if idx is None:
+                raise ErrIndexNotFound(name)
+            idx.close()
+            shutil.rmtree(idx.path, ignore_errors=True)
 
     # -- accessors (holder.go:298-322) ------------------------------------
 

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 from typing import Optional
 
 from pilosa_tpu.core import timequantum as tq
@@ -47,6 +48,9 @@ class Index:
         self.remote_max_slice = 0
         self.remote_max_inverse_slice = 0
 
+        # Guards frame create/delete against concurrent schema merges
+        # (index.go mu analog).
+        self._mu = threading.RLock()
         self.frames: dict[str, Frame] = {}
         self.column_attr_store = AttrStore(os.path.join(path, "column_attrs.db"))
 
@@ -126,15 +130,17 @@ class Index:
         return self.frames.get(name)
 
     def create_frame(self, name: str, opt: FrameOptions) -> Frame:
-        if name in self.frames:
-            raise ErrFrameExists(name)
-        return self._create_frame(name, opt)
+        with self._mu:
+            if name in self.frames:
+                raise ErrFrameExists(name)
+            return self._create_frame(name, opt)
 
     def create_frame_if_not_exists(self, name: str, opt: Optional[FrameOptions] = None) -> Frame:
-        f = self.frames.get(name)
-        if f is not None:
-            return f
-        return self._create_frame(name, opt or FrameOptions())
+        with self._mu:
+            f = self.frames.get(name)
+            if f is not None:
+                return f
+            return self._create_frame(name, opt or FrameOptions())
 
     def _create_frame(self, name: str, opt: FrameOptions) -> Frame:
         validate_name(name)
@@ -158,13 +164,16 @@ class Index:
         return frame
 
     def delete_frame(self, name: str) -> None:
-        f = self.frames.pop(name, None)
-        if f is None:
-            raise ErrFrameNotFound(name)
-        f.close()
         import shutil
 
-        shutil.rmtree(f.path, ignore_errors=True)
+        # close + rmtree stay under the lock so a concurrent create of the
+        # same name can't have its fresh directory deleted out from under it.
+        with self._mu:
+            f = self.frames.pop(name, None)
+            if f is None:
+                raise ErrFrameNotFound(name)
+            f.close()
+            shutil.rmtree(f.path, ignore_errors=True)
 
     def schema_json(self) -> dict:
         return {

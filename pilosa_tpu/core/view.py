@@ -10,6 +10,7 @@ broadcast) when a fragment for a new max slice appears (view.go:219-254).
 from __future__ import annotations
 
 import os
+import threading
 from typing import Callable, Optional
 
 from pilosa_tpu.core import cache as cache_mod
@@ -46,6 +47,8 @@ class View:
         self.row_attr_store = row_attr_store
         self.on_new_fragment = on_new_fragment  # broadcast hook (CreateSliceMessage)
         self.stats = stats
+        # Guards fragment create against concurrent writers (view.go mu analog).
+        self._mu = threading.RLock()
         self.fragments: dict[int, Fragment] = {}
 
     # -- lifecycle ------------------------------------------------------
@@ -92,11 +95,12 @@ class View:
         return self.fragments.get(slice_i)
 
     def create_fragment_if_not_exists(self, slice_i: int) -> Fragment:
-        f = self.fragments.get(slice_i)
-        if f is not None:
-            return f
-        is_new_max = not self.fragments or slice_i > self.max_slice()
-        f = self._open_fragment(slice_i)
+        with self._mu:
+            f = self.fragments.get(slice_i)
+            if f is not None:
+                return f
+            is_new_max = not self.fragments or slice_i > self.max_slice()
+            f = self._open_fragment(slice_i)
         if is_new_max and self.on_new_fragment is not None:
             self.on_new_fragment(self.index, self.frame, self.name, slice_i)
         return f

@@ -208,13 +208,22 @@ def parse_csv(data: bytes) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
         if not line:
             continue
         parts = line.split(",")
-        if len(parts) < 2:
+        # Mirror the native parser exactly (pn_parse_csv): 2 or 3 fields,
+        # plain decimal digits only (no sign, no '_' grouping) — acceptance
+        # must not depend on whether the .so loaded.
+        if len(parts) < 2 or len(parts) > 3:
             raise ValueError(f"malformed CSV at line {lineno}")
         try:
+            if not parts[0].strip().isdigit() or not parts[1].strip().isdigit():
+                raise ValueError("non-digit id")
             row, col = int(parts[0]), int(parts[1])
             if not (0 <= row < 1 << 64) or not (0 <= col < 1 << 64):
                 raise ValueError("id out of uint64 range")
-            t = int(parts[2]) if len(parts) > 2 and parts[2].strip() else 0
+            t = 0
+            if len(parts) > 2 and parts[2].strip():
+                if not parts[2].strip().isdigit():
+                    raise ValueError("non-digit timestamp")
+                t = int(parts[2])
             if not (0 <= t < 1 << 63):
                 raise ValueError("timestamp out of int64 range")
             rows_l.append(row)

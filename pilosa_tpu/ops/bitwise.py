@@ -99,6 +99,22 @@ def batch_intersection_count(rows, src):
     return count(jnp.bitwise_and(rows, src[..., None, :] if src.ndim == rows.ndim - 1 else src))
 
 
+def gather_count_and(row_matrix, pairs):
+    """Batched Count(Intersect(Bitmap(p0), Bitmap(p1))) over all slices.
+
+    row_matrix: uint32[n_slices, n_rows, W]; pairs: int32[B, 2].
+    Returns int32[B]: per-query counts summed over slices and words.
+    XLA form of the fused gather kernel (gather → AND → popcount → reduce);
+    the Pallas version in pallas_kernels.fused_gather_count2 avoids
+    materializing the gathered stacks.
+    """
+    a = jnp.take(row_matrix, pairs[:, 0], axis=1)  # [n_slices, B, W]
+    b = jnp.take(row_matrix, pairs[:, 1], axis=1)
+    return jnp.sum(
+        lax.population_count(jnp.bitwise_and(a, b)).astype(jnp.int32), axis=(0, 2)
+    )
+
+
 # ---------------------------------------------------------------------------
 # Host-side numpy helpers (mask building, packing) — used to prepare
 # device inputs; never inside jit (they produce constants).

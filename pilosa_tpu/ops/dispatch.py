@@ -17,7 +17,12 @@ import os
 import jax
 
 from pilosa_tpu.ops import bitwise
-from pilosa_tpu.ops.pallas_kernels import _tileable, fused_count1, fused_count2
+from pilosa_tpu.ops.pallas_kernels import (
+    _tileable,
+    fused_count1,
+    fused_count2,
+    fused_gather_count2,
+)
 
 
 @functools.lru_cache(maxsize=None)
@@ -62,6 +67,14 @@ def count_andnot(a, b):
     if use_pallas() and _tileable(a.shape[-1]):
         return fused_count2("andnot", a, b)
     return bitwise.count_andnot(a, b)
+
+
+def gather_count_and(row_matrix, pairs):
+    """Batched Count(Intersect(...)) over a [n_slices, n_rows, W] row
+    matrix for int32[B, 2] row-id pairs — the headline query hot path."""
+    if use_pallas() and _tileable(row_matrix.shape[-1]):
+        return fused_gather_count2("and", row_matrix, pairs)
+    return bitwise.gather_count_and(row_matrix, pairs)
 
 
 def batch_intersection_count(rows, src):

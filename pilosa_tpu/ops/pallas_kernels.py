@@ -102,6 +102,59 @@ def fused_count2(op: str, a, b, interpret: bool = False):
     return out.sum(axis=(1, 2)).reshape(shape[:-1])
 
 
+def _gather_count_kernel(op, pairs_ref, a_ref, b_ref, out_ref):
+    s = pl.program_id(1)
+    part = _partial_tile(_op_apply(op, a_ref[0], b_ref[0]))
+
+    @pl.when(s == 0)
+    def _():
+        out_ref[0] = part
+
+    @pl.when(s != 0)
+    def _():
+        out_ref[0] = out_ref[0] + part
+
+
+@functools.partial(jax.jit, static_argnames=("op", "interpret"))
+def fused_gather_count2(op: str, row_matrix, pairs, interpret: bool = False):
+    """Per-query ``sum_s popcount(op(rm[s, p0], rm[s, p1]))`` without
+    materializing the gathered operands.
+
+    row_matrix: uint32[n_slices, n_rows, W] with W % 1024 == 0;
+    pairs: int32[B, 2] row ids.  Returns int32[B] counts summed over
+    slices and words.
+
+    The batched ``Count(Intersect(Bitmap(r1), Bitmap(r2)))`` hot path
+    (executor.go:576-605 + roaring/assembly_amd64.s:60-77 analog).  The
+    XLA form (`jnp.take` → AND → popcount) writes both gathered stacks to
+    HBM before reading them back; this kernel instead scalar-prefetches
+    the pair ids and DMAs each operand row HBM→VMEM exactly once per
+    (query, slice) grid step, halving HBM traffic.  The slice axis is the
+    minor grid dimension so the per-query accumulator tile stays resident
+    in VMEM across the reduction.
+    """
+    n_slices, n_rows, w = row_matrix.shape
+    sub = w // _LANES
+    rm4 = row_matrix.reshape(n_slices, n_rows, sub, _LANES)
+    b = pairs.shape[0]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b, n_slices),
+        in_specs=[
+            pl.BlockSpec((1, 1, sub, _LANES), lambda q, s, pr: (s, pr[q, 0], 0, 0)),
+            pl.BlockSpec((1, 1, sub, _LANES), lambda q, s, pr: (s, pr[q, 1], 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 8, _LANES), lambda q, s, pr: (q, 0, 0)),
+    )
+    out = pl.pallas_call(
+        functools.partial(_gather_count_kernel, op),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, 8, _LANES), jnp.int32),
+        interpret=interpret,
+    )(pairs, rm4, rm4)
+    return out.sum(axis=(1, 2))
+
+
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def fused_count1(a, interpret: bool = False):
     """sum(popcount(a)) over the last axis via a Pallas kernel."""

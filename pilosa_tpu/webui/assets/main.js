@@ -109,14 +109,25 @@ async function loadCluster() {
   try {
     const status = await getJSON("/status");
     for (const node of status.status?.cluster?.nodes || []) {
+      // Hosts arrive over the unauthenticated gossip channel — render as
+      // text, never markup.
       const tr = document.createElement("tr");
       const state = node.state || "UP";
-      tr.innerHTML = `<td>${node.host}</td><td>${node.internalHost || ""}</td>` +
-        `<td class="state-${state}">${state}</td>`;
+      for (const text of [node.host, node.internalHost || "", state]) {
+        const td = document.createElement("td");
+        td.textContent = text;
+        tr.appendChild(td);
+      }
+      tr.lastChild.className = `state-${state === "DOWN" ? "DOWN" : "UP"}`;
       tbody.appendChild(tr);
     }
   } catch (e) {
-    tbody.innerHTML = `<tr><td colspan="3">${e}</td></tr>`;
+    const tr = document.createElement("tr");
+    const td = document.createElement("td");
+    td.colSpan = 3;
+    td.textContent = String(e);
+    tr.appendChild(td);
+    tbody.appendChild(tr);
   }
 }
 

@@ -9,12 +9,29 @@ Must run before jax is imported anywhere.
 """
 
 import os
+import sys
 
-if not os.environ.get("PILOSA_TPU_TEST_REAL_TPU"):
-    os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+if not os.environ.get("PILOSA_TPU_TEST_REAL_TPU"):
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    # A sitecustomize hook (remote-TPU plugin) may have imported jax before
+    # this conftest ran, in which case jax has already latched
+    # JAX_PLATFORMS from the outer environment and the env var above is
+    # too late.  Force the config directly — backends are created lazily,
+    # so as long as no computation ran yet this reliably pins CPU (and
+    # keeps the suite off a possibly-unreachable remote TPU tunnel).
+    if "jax" in sys.modules:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        # XLA_FLAGS was latched at that import too — restore the 8-device
+        # virtual CPU mesh or the parallel/ suite silently skips.
+        try:
+            jax.config.update("jax_num_cpu_devices", 8)
+        except AttributeError:
+            pass
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

@@ -110,6 +110,10 @@ def force_fallback():
         b"1,18446744073709551616",            # col overflows uint64
         b"1,2,9223372036854775808",           # ts overflows int64
         b"18446744073709551615,2",            # max uint64 row ok
+        b"1,2,3,4",                           # too many fields
+        b"+1,2",                              # explicit sign rejected
+        b"1_0,2",                             # underscore grouping rejected
+        b"1,2,+3",                            # signed timestamp rejected
     ],
 )
 def test_parse_csv_native_matches_fallback(data, force_fallback):

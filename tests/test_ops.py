@@ -103,6 +103,22 @@ def test_pack_unpack_roundtrip(seed):
     assert bw.np_count(words) == len(pos)
 
 
+def test_gather_count_and_matches_numpy(rng):
+    # Batched Count(Intersect(r1, r2)) over a row matrix — the headline
+    # query path (executor.go:576-605 analog), jnp/XLA form.
+    n_slices, n_rows, batch = 3, 7, 11
+    rm = rand_words(rng, (n_slices, n_rows, W))
+    pairs = rng.integers(0, n_rows, size=(batch, 2)).astype(np.int32)
+    got = np.asarray(dispatch.gather_count_and(jnp.asarray(rm), jnp.asarray(pairs)))
+    want = np.array(
+        [
+            sum(bw.np_count_and(rm[s, p0], rm[s, p1]) for s in range(n_slices))
+            for p0, p1 in pairs
+        ]
+    )
+    np.testing.assert_array_equal(got, want)
+
+
 def test_pallas_partial_tile_math(rng):
     # The kernel body's reduction (`_partial_tile`) is pure jnp — verify it on
     # CPU against numpy.  (Pallas interpret mode hangs under the axon platform
@@ -135,6 +151,13 @@ def test_pallas_kernels_on_tpu(rng):
     np.testing.assert_array_equal(got2, np.array([bw.np_count_and(a[i], b[i]) for i in range(3)]))
     np.testing.assert_array_equal(got1, np.array([bw.np_count(a[i]) for i in range(3)]))
     np.testing.assert_array_equal(got_shared, np.array([bw.np_count_and(a[i], src) for i in range(3)]))
+    rm = rand_words(rng, (2, 5, W))
+    pairs = rng.integers(0, 5, size=(4, 2)).astype(np.int32)
+    got_g = np.asarray(pk.fused_gather_count2("and", jnp.asarray(rm), jnp.asarray(pairs)))
+    want_g = np.array(
+        [sum(bw.np_count_and(rm[s, p0], rm[s, p1]) for s in range(2)) for p0, p1 in pairs]
+    )
+    np.testing.assert_array_equal(got_g, want_g)
 
 
 def test_validate_names():
