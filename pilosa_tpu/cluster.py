@@ -107,22 +107,30 @@ class Cluster:
         return out
 
     def slices_by_node(
-        self, index: str, slices: list[int], exclude_down: bool = False
+        self,
+        index: str,
+        slices: list[int],
+        exclude_down: bool = False,
+        exclude_hosts: set | None = None,
     ) -> dict[Node, list[int]]:
         """Group slices by an owning node (executor.go:1095-1109).
 
-        Each slice goes to its first live owner; with replicas, a down
-        primary falls through to the next replica (the retry semantics of
-        executor.go:1147-1159 collapsed into placement time).
+        Each slice goes to its first eligible owner; with replicas, a down
+        (or ``exclude_hosts``-listed, i.e. failed mid-query) primary falls
+        through to the next replica — the placement half of the retry
+        semantics of executor.go:1147-1159.
         """
         out: dict[Node, list[int]] = {}
         for s in slices:
             owners = self.fragment_nodes(index, s)
             chosen = None
             for node in owners:
-                if not exclude_down or node.state == NODE_STATE_UP:
-                    chosen = node
-                    break
+                if exclude_down and node.state != NODE_STATE_UP:
+                    continue
+                if exclude_hosts and node.host in exclude_hosts:
+                    continue
+                chosen = node
+                break
             if chosen is None:
                 raise RuntimeError(f"slice {s} unavailable: all owners down")
             out.setdefault(chosen, []).append(s)

@@ -113,6 +113,11 @@ class Fragment:
         self._wal = None  # append handle to the data file
         self._row_cache: OrderedDict[int, np.ndarray] = OrderedDict()
         self._row_cache_max = 64
+        # Device-resident dense rows (HBM working set): engine arrays keyed
+        # by row id so repeat queries skip the host→device upload entirely.
+        # Invalidated alongside _row_cache on mutation.
+        self._row_dev_cache: OrderedDict[int, object] = OrderedDict()
+        self._row_dev_cache_max = 256
         self._checksums: dict[int, bytes] = {}
         self._open = False
 
@@ -212,6 +217,7 @@ class Fragment:
 
     def _on_row_mutated(self, row_id: int) -> None:
         self._row_cache.pop(row_id, None)
+        self._row_dev_cache.pop(row_id, None)
         self._checksums.pop(row_id // HASH_BLOCK_SIZE, None)
         self.cache.add(row_id, self.row_count(row_id))
 
@@ -254,6 +260,28 @@ class Fragment:
             while len(self._row_cache) > self._row_cache_max:
                 self._row_cache.popitem(last=False)
             return words
+
+    def row_device(self, row_id: int, engine):
+        """Dense row as an ENGINE array, cached device-side.
+
+        On the jax engine the packed words stay resident in HBM across
+        queries (the fragment's device working set); repeat reads of hot
+        rows cost zero host→device traffic.  Mutations invalidate the row
+        (see _on_row_mutated), so reads are always current.
+        """
+        # Compute-and-insert stays under one lock hold: inserting after a
+        # release could overwrite the invalidation of a concurrent mutation
+        # with a stale row.
+        with self._mu:
+            cached = self._row_dev_cache.get(row_id)
+            if cached is not None:
+                self._row_dev_cache.move_to_end(row_id)
+                return cached
+            arr = engine.asarray(self.row_dense(row_id))
+            self._row_dev_cache[row_id] = arr
+            while len(self._row_dev_cache) > self._row_dev_cache_max:
+                self._row_dev_cache.popitem(last=False)
+            return arr
 
     def row(self, row_id: int) -> roaring.Bitmap:
         """Row as a roaring bitmap of global column positions for this slice."""
@@ -387,6 +415,7 @@ class Fragment:
         finally:
             self.storage.op_writer = self._wal
         self._row_cache.clear()
+        self._row_dev_cache.clear()
         self._checksums.clear()
         for row_id in np.unique(row_ids):
             self.cache.bulk_add(int(row_id), self.row_count(int(row_id)))
@@ -491,6 +520,7 @@ class Fragment:
         self.storage = roaring.Bitmap.from_bytes(data)
         self.storage.op_n = 0
         self._row_cache.clear()
+        self._row_dev_cache.clear()
         self._checksums.clear()
         self.snapshot()
         self._rebuild_cache()

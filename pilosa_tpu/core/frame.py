@@ -99,12 +99,13 @@ class Frame:
 
     def close(self) -> None:
         self.row_attr_store.close()
-        for v in self.views.values():
+        for v in list(self.views.values()):
             v.close()
         self.views.clear()
 
     def flush_caches(self) -> None:
-        for v in self.views.values():
+        # list() snapshots: schema merges may insert concurrently
+        for v in list(self.views.values()):
             v.flush_caches()
 
     @property
@@ -196,7 +197,7 @@ class Frame:
             return self._open_view(name)
 
     def max_slice(self) -> int:
-        return max((v.max_slice() for v in self.views.values()), default=0)
+        return max((v.max_slice() for v in list(self.views.values())), default=0)
 
     def max_inverse_slice(self) -> int:
         v = self.views.get(VIEW_INVERSE)

@@ -52,7 +52,7 @@ class Holder:
             self.indexes[entry] = idx
 
     def close(self) -> None:
-        for idx in self.indexes.values():
+        for idx in list(self.indexes.values()):
             idx.close()
         self.indexes.clear()
 
@@ -61,7 +61,8 @@ class Holder:
             self.on_new_fragment(index, frame, view, slice_i)
 
     def flush_caches(self) -> None:
-        for idx in self.indexes.values():
+        # list() snapshots: schema merges may insert concurrently
+        for idx in list(self.indexes.values()):
             idx.flush_caches()
 
     # -- indexes ---------------------------------------------------------
@@ -122,10 +123,10 @@ class Holder:
     # -- schema (holder.go:154-171) ---------------------------------------
 
     def schema(self) -> list[dict]:
-        return [idx.schema_json() for _, idx in sorted(self.indexes.items())]
+        return [idx.schema_json() for _, idx in sorted(list(self.indexes.items()))]
 
     def max_slices(self) -> dict[str, int]:
-        return {name: idx.max_slice() for name, idx in self.indexes.items()}
+        return {name: idx.max_slice() for name, idx in list(self.indexes.items())}
 
     def max_inverse_slices(self) -> dict[str, int]:
-        return {name: idx.max_inverse_slice() for name, idx in self.indexes.items()}
+        return {name: idx.max_inverse_slice() for name, idx in list(self.indexes.items())}

@@ -70,12 +70,13 @@ class Index:
 
     def close(self) -> None:
         self.column_attr_store.close()
-        for f in self.frames.values():
+        for f in list(self.frames.values()):
             f.close()
         self.frames.clear()
 
     def flush_caches(self) -> None:
-        for f in self.frames.values():
+        # list() snapshots: schema merges may insert concurrently
+        for f in list(self.frames.values()):
             f.flush_caches()
 
     @property
@@ -111,11 +112,11 @@ class Index:
 
     def max_slice(self) -> int:
         """Max of local frames and the remotely-observed max (index.go:252)."""
-        local = max((f.max_slice() for f in self.frames.values()), default=0)
+        local = max((f.max_slice() for f in list(self.frames.values())), default=0)
         return max(local, self.remote_max_slice)
 
     def max_inverse_slice(self) -> int:
-        local = max((f.max_inverse_slice() for f in self.frames.values()), default=0)
+        local = max((f.max_inverse_slice() for f in list(self.frames.values())), default=0)
         return max(local, self.remote_max_inverse_slice)
 
     def set_remote_max_slice(self, v: int) -> None:
@@ -180,5 +181,5 @@ class Index:
             "name": self.name,
             "columnLabel": self.column_label,
             "timeQuantum": self.time_quantum,
-            "frames": [f.schema_json() for _, f in sorted(self.frames.items())],
+            "frames": [f.schema_json() for _, f in sorted(list(self.frames.items()))],
         }

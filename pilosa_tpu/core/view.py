@@ -62,12 +62,13 @@ class View:
             self._open_fragment(int(entry))
 
     def close(self) -> None:
-        for f in self.fragments.values():
+        for f in list(self.fragments.values()):
             f.close()
         self.fragments.clear()
 
     def flush_caches(self) -> None:
-        for f in self.fragments.values():
+        # list() snapshots: writers may insert fragments concurrently
+        for f in list(self.fragments.values()):
             f.flush_cache()
 
     def fragment_path(self, slice_i: int) -> str:
@@ -106,7 +107,7 @@ class View:
         return f
 
     def max_slice(self) -> int:
-        return max(self.fragments.keys(), default=0)
+        return max(list(self.fragments.keys()), default=0)
 
     # -- bit ops (view.go:266-283) ---------------------------------------
 

@@ -31,8 +31,19 @@ class NumpyEngine:
     def stack(self, rows: list[np.ndarray]) -> np.ndarray:
         return np.stack(rows) if rows else np.zeros((0, 0), dtype=np.uint32)
 
+    def stack_rows(self, rows: list) -> np.ndarray:
+        """Stack engine-resident rows (same as stack on numpy)."""
+        return self.stack(rows)
+
     def asarray(self, x: np.ndarray):
         return np.asarray(x)
+
+    def gather_count_and(self, row_matrix, pairs) -> np.ndarray:
+        """Batched Count(Intersect) over [n_slices, n_rows, W] for int32[B,2]
+        row-index pairs; returns int64[B]."""
+        a = row_matrix[:, pairs[:, 0], :]
+        b = row_matrix[:, pairs[:, 1], :]
+        return self.count(a & b).sum(axis=0)
 
     def bit_and(self, a, b):
         return a & b
@@ -77,8 +88,22 @@ class JaxEngine:
     def stack(self, rows: list[np.ndarray]):
         return self._jnp.asarray(np.stack(rows)) if rows else self._jnp.zeros((0, 0), dtype=self._jnp.uint32)
 
+    def stack_rows(self, rows: list):
+        """Stack device-resident rows WITHOUT a host round trip — rows from
+        the fragment device cache stay in HBM (device-side concat)."""
+        if not rows:
+            return self._jnp.zeros((0, 0), dtype=self._jnp.uint32)
+        return self._jnp.stack([self._jnp.asarray(r) for r in rows])
+
     def asarray(self, x):
         return self._jnp.asarray(x)
+
+    def gather_count_and(self, row_matrix, pairs) -> np.ndarray:
+        """Batched Count(Intersect) in ONE device dispatch (Pallas on TPU)."""
+        out = self._dispatch.gather_count_and(
+            self._jnp.asarray(row_matrix), self._jnp.asarray(pairs)
+        )
+        return np.asarray(out).astype(np.int64)
 
     def bit_and(self, a, b):
         return self._jnp.bitwise_and(a, b)

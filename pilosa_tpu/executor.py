@@ -147,8 +147,13 @@ class Executor:
             std_slices = list(range(idx.max_slice() + 1))
             inv_slices = list(range(idx.max_inverse_slice() + 1))
 
+        fused = self._fuse_count_intersect_batch(index, query.calls, std_slices, opt)
+
         results = []
-        for call in query.calls:
+        for i, call in enumerate(query.calls):
+            if fused is not None and i in fused:
+                results.append(fused[i])
+                continue
             call_slices = std_slices
             if call.supports_inverse() and std_slices is not None and inv_slices is not None:
                 frame_name = call.string_arg("frame") or DEFAULT_FRAME
@@ -159,6 +164,90 @@ class Executor:
                     call_slices = inv_slices
             results.append(self._execute_call(index, call, call_slices, opt))
         return results
+
+    # -- query-batch fusion ------------------------------------------------
+
+    def _fuse_count_intersect_batch(
+        self, index: str, calls, slices, opt: ExecOptions
+    ) -> Optional[dict[int, int]]:
+        """Run all Count(Intersect(Bitmap(a), Bitmap(b))) calls in a request
+        as ONE fused device dispatch.
+
+        The TPU-native replacement for issuing the hot query shape
+        (executor.go:576-605) one call at a time: row-id pairs are gathered
+        by the kernel straight from a device-resident row matrix
+        (ops.dispatch.gather_count_and), so a request carrying a batch of
+        count-intersect queries costs one kernel launch instead of
+        2×batch row uploads + batch reductions.  Only applies to
+        single-node/local execution; distributed requests go through the
+        per-call mapReduce with its node-failure retry.
+        """
+        if opt.remote or not slices:
+            return None
+        if self.cluster is not None and self.client_factory is not None and len(self.cluster.nodes) > 1:
+            return None
+
+        matched: dict[int, tuple[str, int, int]] = {}  # call idx -> (frame, r1, r2)
+        for i, c in enumerate(calls):
+            if c.name != "Count" or len(c.children) != 1:
+                continue
+            ch = c.children[0]
+            if ch.name != "Intersect" or len(ch.children) != 2:
+                continue
+            leaves = []
+            for leaf in ch.children:
+                if leaf.name != "Bitmap":
+                    break
+                try:
+                    frame, view, row_id = self._resolve_bitmap_leaf(index, leaf)
+                except PilosaError:
+                    return None  # surface the error through the normal path
+                if view != VIEW_STANDARD:
+                    break
+                leaves.append((frame, row_id))
+            if len(leaves) != 2 or leaves[0][0] != leaves[1][0]:
+                continue
+            matched[i] = (leaves[0][0], leaves[0][1], leaves[1][1])
+        # Fuse only when the WHOLE request is fusable reads: a write call
+        # anywhere in the request must be observed by later Counts
+        # (per-call ordering semantics), so mixed requests take the
+        # sequential path.
+        if len(matched) < 2 or len(matched) != len(calls):
+            return None
+
+        # One row matrix per frame: unique row ids -> device rows.
+        by_frame: dict[str, list[int]] = {}
+        for frame, r1, r2 in matched.values():
+            by_frame.setdefault(frame, []).extend((r1, r2))
+        frame_matrices: dict[str, tuple[dict[int, int], object]] = {}
+        for frame, ids in by_frame.items():
+            uniq = sorted(set(ids))
+            id_pos = {r: k for k, r in enumerate(uniq)}
+            per_slice = [
+                self.engine.stack_rows(
+                    [self._row_or_zeros(index, frame, s, r) for r in uniq]
+                )
+                for s in slices
+            ]
+            frame_matrices[frame] = (id_pos, self.engine.stack_rows(per_slice))
+
+        out: dict[int, int] = {}
+        for frame, (id_pos, matrix) in frame_matrices.items():
+            idxs = [i for i, (f, _, _) in matched.items() if f == frame]
+            pairs = np.array(
+                [[id_pos[matched[i][1]], id_pos[matched[i][2]]] for i in idxs],
+                dtype=np.int32,
+            )
+            counts = self.engine.gather_count_and(matrix, pairs)
+            for k, i in enumerate(idxs):
+                out[i] = int(counts[k])
+        return out
+
+    def _row_or_zeros(self, index: str, frame: str, slice_i: int, row_id: int):
+        frag = self.holder.fragment(index, frame, VIEW_STANDARD, slice_i)
+        if frag is None:
+            return self.engine.asarray(np.zeros(_WORDS, dtype=np.uint32))
+        return frag.row_device(row_id, self.engine)
 
     # -- call dispatch (executor.go:156-179) ------------------------------
 
@@ -281,11 +370,13 @@ class Executor:
             frag = self.holder.fragment(index, frame, view, s)
             if frag is None:
                 if zeros is None:
-                    zeros = np.zeros(_WORDS, dtype=np.uint32)
+                    zeros = self.engine.asarray(np.zeros(_WORDS, dtype=np.uint32))
                 rows.append(zeros)
             else:
-                rows.append(frag.row_dense(row_id))
-        return self.engine.stack(rows)
+                # Device-cached row: hot rows stay resident in HBM across
+                # queries instead of re-uploading every time.
+                rows.append(frag.row_device(row_id, self.engine))
+        return self.engine.stack_rows(rows)
 
     def _eval_bitmap_leaf(self, index: str, c: pql.Call, slices: list[int]):
         frame, view, id = self._resolve_bitmap_leaf(index, c)
@@ -509,9 +600,6 @@ class Executor:
         if self.cluster is None or opt.remote or self.client_factory is None:
             return reduce_fn(zero, local_map(slices))
 
-        by_node = self.cluster.slices_by_node(index, slices, exclude_down=True)
-        result = zero
-        errors: list[Exception] = []
         import concurrent.futures
 
         def run_node(node, node_slices):
@@ -520,16 +608,41 @@ class Executor:
             client = self.client_factory(node.host)
             return client.execute_remote_call(index, c, node_slices)
 
-        with concurrent.futures.ThreadPoolExecutor(max_workers=max(1, len(by_node))) as pool:
-            futs = {
-                pool.submit(run_node, node, node_slices): node
-                for node, node_slices in by_node.items()
-            }
-            for fut in concurrent.futures.as_completed(futs):
-                try:
-                    result = reduce_fn(result, fut.result())
-                except Exception as e:  # node failure → surface (retry in cluster layer)
-                    errors.append(e)
-        if errors:
-            raise errors[0]
+        # Mid-query node-failure retry (executor.go:1147-1159): when a
+        # remote node becomes UNREACHABLE (transport-level OSError — refused
+        # connection, reset, timeout), its slices are re-mapped onto the
+        # remaining replica owners and re-dispatched; the query only fails
+        # once some slice has no live owner left.  Application errors from a
+        # reachable node (and all local errors) are query errors and
+        # propagate immediately — retrying them on replicas would just
+        # repeat a deterministic failure and mask the real message.
+        result = zero
+        pending = slices
+        failed_hosts: set[str] = set()
+        last_failure: Optional[BaseException] = None
+        while pending:
+            try:
+                by_node = self.cluster.slices_by_node(
+                    index, pending, exclude_down=True, exclude_hosts=failed_hosts
+                )
+            except RuntimeError as e:
+                raise PilosaError(str(e)) from last_failure
+            pending = []
+            with concurrent.futures.ThreadPoolExecutor(max_workers=max(1, len(by_node))) as pool:
+                futs = {
+                    pool.submit(run_node, node, node_slices): node
+                    for node, node_slices in by_node.items()
+                }
+                for fut in concurrent.futures.as_completed(futs):
+                    node = futs[fut]
+                    try:
+                        node_result = fut.result()
+                    except OSError as e:
+                        if node.host == self.host:
+                            raise
+                        last_failure = e
+                        failed_hosts.add(node.host)
+                        pending.extend(by_node[node])
+                        continue
+                    result = reduce_fn(result, node_result)
         return result

@@ -214,14 +214,17 @@ def parse_csv(data: bytes) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
         if len(parts) < 2 or len(parts) > 3:
             raise ValueError(f"malformed CSV at line {lineno}")
         try:
-            if not parts[0].strip().isdigit() or not parts[1].strip().isdigit():
+            def _ascii_digits(s: str) -> bool:
+                return s.isascii() and s.isdigit()
+
+            if not _ascii_digits(parts[0].strip()) or not _ascii_digits(parts[1].strip()):
                 raise ValueError("non-digit id")
             row, col = int(parts[0]), int(parts[1])
             if not (0 <= row < 1 << 64) or not (0 <= col < 1 << 64):
                 raise ValueError("id out of uint64 range")
             t = 0
             if len(parts) > 2 and parts[2].strip():
-                if not parts[2].strip().isdigit():
+                if not _ascii_digits(parts[2].strip()):
                     raise ValueError("non-digit timestamp")
                 t = int(parts[2])
             if not (0 <= t < 1 << 63):

@@ -166,3 +166,105 @@ def test_jax_engine_matches_numpy(env, tmp_path):
     (bm_np,) = e.execute("i", 'Union(Bitmap(rowID=1, frame="f"), Bitmap(rowID=2, frame="f"))')
     (bm_j,) = ej.execute("i", 'Union(Bitmap(rowID=1, frame="f"), Bitmap(rowID=2, frame="f"))')
     assert bm_np.bits() == bm_j.bits()
+
+
+def test_mapreduce_node_failure_retry(tmp_path):
+    """A remote node erroring mid-query re-maps its slices onto the
+    remaining replica owners instead of failing the query
+    (executor.go:1147-1159)."""
+    from pilosa_tpu.cluster import Cluster, Node
+
+    h = Holder(str(tmp_path / "data"))
+    h.open()
+    idx = h.create_index("i")
+    idx.create_frame("f", FrameOptions())
+    # Bits in 4 slices, all stored locally (this host holds every replica's
+    # data so the fallback path can answer).
+    for s in range(4):
+        idx.frame("f").set_bit("standard", 1, s * SLICE_WIDTH + 3)
+
+    hosts = ["h0:1", "h1:1"]
+    cluster = Cluster([Node(host) for host in hosts], replica_n=2)
+
+    calls = []
+
+    class FailingClient:
+        def __init__(self, host):
+            self.host = host
+
+        def execute_remote_call(self, index, call, slices):
+            calls.append((self.host, list(slices)))
+            raise ConnectionError("node down")
+
+    e = Executor(
+        h, engine="numpy", cluster=cluster, client_factory=FailingClient, host="h0:1"
+    )
+    (n,) = e.execute("i", 'Count(Bitmap(rowID=1, frame="f"))')
+    assert n == 4  # all slices answered locally after h1 failed
+    assert any(host == "h1:1" for host, _ in calls)  # remote was tried
+    # With NO replicas (replica_n=1) the same failure surfaces an error.
+    cluster1 = Cluster([Node(host) for host in hosts], replica_n=1)
+    e1 = Executor(
+        h, engine="numpy", cluster=cluster1, client_factory=FailingClient, host="h0:1"
+    )
+    with pytest.raises(Exception):
+        e1.execute("i", 'Count(Bitmap(rowID=1, frame="f"))')
+    h.close()
+
+
+@pytest.mark.parametrize("engine", ["numpy", "jax"])
+def test_count_intersect_batch_fusion(tmp_path, engine):
+    """A request carrying several Count(Intersect(Bitmap,Bitmap)) calls runs
+    through the fused gather path and matches per-call execution."""
+    h = Holder(str(tmp_path / "data"))
+    h.open()
+    idx = h.create_index("i")
+    idx.create_frame("f", FrameOptions())
+    fr = idx.frame("f")
+    rng = np.random.default_rng(5)
+    for r in range(6):
+        for c in rng.choice(2 * SLICE_WIDTH, size=50, replace=False):
+            fr.set_bit("standard", r, int(c))
+    e = Executor(h, engine=engine)
+
+    batch_q = "\n".join(
+        f'Count(Intersect(Bitmap(rowID={a}, frame="f"), Bitmap(rowID={b}, frame="f")))'
+        for a, b in [(0, 1), (2, 3), (4, 5), (0, 5)]
+    )
+    fused = e.execute("i", batch_q)
+    singles = [
+        e.execute("i", f'Count(Intersect(Bitmap(rowID={a}, frame="f"), Bitmap(rowID={b}, frame="f")))')[0]
+        for a, b in [(0, 1), (2, 3), (4, 5), (0, 5)]
+    ]
+    assert fused == singles
+
+    # Mutation invalidates the device row cache: counts update.
+    before = e.execute("i", batch_q)[0]
+    col = 123456
+    fr.set_bit("standard", 0, col)
+    fr.set_bit("standard", 1, col)
+    after = e.execute("i", batch_q)[0]
+    assert after == before + 1
+    h.close()
+
+
+def test_fusion_respects_preceding_writes(tmp_path):
+    """A write earlier in the same request must be visible to later Counts —
+    mixed requests take the sequential path, not the fused one."""
+    h = Holder(str(tmp_path / "data"))
+    h.open()
+    idx = h.create_index("i")
+    idx.create_frame("f", FrameOptions())
+    fr = idx.frame("f")
+    fr.set_bit("standard", 0, 1)
+    fr.set_bit("standard", 1, 1)
+    e = Executor(h, engine="numpy")
+    q = (
+        'SetBit(rowID=0, frame="f", columnID=5) '
+        'SetBit(rowID=1, frame="f", columnID=5) '
+        'Count(Intersect(Bitmap(rowID=0, frame="f"), Bitmap(rowID=1, frame="f"))) '
+        'Count(Intersect(Bitmap(rowID=0, frame="f"), Bitmap(rowID=0, frame="f")))'
+    )
+    res = e.execute("i", q)
+    assert res == [True, True, 2, 2]  # counts observe the writes
+    h.close()

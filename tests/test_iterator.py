@@ -1,0 +1,65 @@
+"""Iterator tests (reference analog: iterator_test.go)."""
+
+import numpy as np
+
+from pilosa_tpu.iterator import (
+    BufIterator,
+    LimitIterator,
+    RoaringIterator,
+    SliceIterator,
+    merge_iterators,
+)
+from pilosa_tpu.pilosa import SLICE_WIDTH
+from pilosa_tpu.roaring import Bitmap
+
+
+def drain(it):
+    out = []
+    while (p := it.next()) is not None:
+        out.append(p)
+    return out
+
+
+def test_slice_iterator_orders_pairs():
+    it = SliceIterator([2, 1, 1], [5, 9, 3])
+    assert drain(it) == [(1, 3), (1, 9), (2, 5)]
+
+
+def test_slice_iterator_seek():
+    it = SliceIterator([0, 1, 2], [7, 7, 7])
+    it.seek(1, 0)
+    assert it.next() == (1, 7)
+    it.seek(1, 8)  # past (1,7) -> lands on (2,7)
+    assert it.next() == (2, 7)
+    it.seek(5, 0)
+    assert it.next() is None
+
+
+def test_roaring_iterator_maps_positions():
+    bm = Bitmap([3, SLICE_WIDTH + 4, 2 * SLICE_WIDTH])
+    it = RoaringIterator(bm)
+    assert drain(it) == [(0, 3), (1, 4), (2, 0)]
+    it.seek(1, 0)
+    assert it.next() == (1, 4)
+
+
+def test_buf_iterator_unread_peek():
+    it = BufIterator(SliceIterator([0, 0], [1, 2]))
+    assert it.peek() == (0, 1)
+    assert it.next() == (0, 1)
+    it.unread((9, 9))
+    assert it.next() == (9, 9)
+    assert it.next() == (0, 2)
+    assert it.next() is None
+
+
+def test_limit_iterator_stops_past_max_row():
+    it = LimitIterator(SliceIterator([0, 1, 2, 3], [0, 0, 0, 0]), max_row=1)
+    assert drain(it) == [(0, 0), (1, 0)]
+
+
+def test_merge_iterators_dedups():
+    a = SliceIterator([0, 1], [1, 2])
+    b = SliceIterator([0, 2], [1, 3])
+    merged = merge_iterators([a, b])
+    assert drain(merged) == [(0, 1), (1, 2), (2, 3)]
