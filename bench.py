@@ -37,7 +37,158 @@ import time
 import numpy as np
 
 
+def bench_setbit() -> dict:
+    """Config 2: SetBit op/sec through the fragment write path (the
+    `pilosa bench --operation set-bit` analog, ctl/bench.go:71-102)."""
+    n = int(os.environ.get("BENCH_OPS", "20000"))
+    import tempfile
+
+    from pilosa_tpu.core.fragment import Fragment
+
+    rng = np.random.default_rng(7)
+    rows = rng.integers(0, 1000, size=n)
+    cols = rng.integers(0, 1 << 20, size=n)
+    with tempfile.TemporaryDirectory() as d:
+        f = Fragment(os.path.join(d, "frag"), "i", "f", "standard", 0)
+        f.open()
+        t0 = time.perf_counter()
+        for r, c in zip(rows.tolist(), cols.tolist()):
+            f.set_bit(r, c)
+        dt = time.perf_counter() - t0
+        f.close()
+    return {
+        "metric": "setbit_ops_per_sec",
+        "value": round(n / dt, 1),
+        "unit": "SetBit/sec (single fragment, WAL on)",
+        "vs_baseline": 1.0,  # host-side path; no device analog
+    }
+
+
+def bench_topn() -> dict:
+    """Config 3: TopN over a ranked frame — candidate scoring via the
+    batched intersection-count kernel (fragment.go:493-625 analog)."""
+    n_rows = int(os.environ.get("BENCH_TOPN_ROWS", "2048"))
+    iters = int(os.environ.get("BENCH_ITERS", "10"))
+    import jax
+
+    from pilosa_tpu.ops import dispatch
+    from pilosa_tpu.ops.bitwise import WORDS_PER_SLICE
+
+    rng = np.random.default_rng(3)
+    rows = rng.integers(0, 1 << 32, size=(n_rows, WORDS_PER_SLICE), dtype=np.uint32)
+    src = rng.integers(0, 1 << 32, size=(WORDS_PER_SLICE,), dtype=np.uint32)
+    drows, dsrc = jax.device_put(rows), jax.device_put(src)
+    np.asarray(dispatch.batch_intersection_count(drows, dsrc))  # warm
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = np.asarray(dispatch.batch_intersection_count(drows, dsrc))
+    dt = (time.perf_counter() - t0) / iters
+    from pilosa_tpu.roaring import _POPCNT8
+
+    t0 = time.perf_counter()
+    base = _POPCNT8[(rows & src).view(np.uint8)].reshape(n_rows, -1).sum(axis=1)
+    base_dt = time.perf_counter() - t0
+    assert np.array_equal(out, base)
+    return {
+        "metric": "topn_candidate_scan_rows_per_sec",
+        "value": round(n_rows / dt, 1),
+        "unit": f"rows/sec scored vs src ({n_rows} rows x 2^20 cols, backend {jax.default_backend()})",
+        "vs_baseline": round(base_dt / dt, 2),
+    }
+
+
+def bench_union64() -> dict:
+    """Config 4: multi-slice Union+Count mapReduce over 64 slices."""
+    n_slices = int(os.environ.get("BENCH_SLICES", "64"))
+    iters = int(os.environ.get("BENCH_ITERS", "10"))
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from pilosa_tpu.ops.bitwise import WORDS_PER_SLICE
+
+    rng = np.random.default_rng(4)
+    a = rng.integers(0, 1 << 32, size=(n_slices, WORDS_PER_SLICE), dtype=np.uint32)
+    b = rng.integers(0, 1 << 32, size=(n_slices, WORDS_PER_SLICE), dtype=np.uint32)
+
+    @jax.jit
+    def union_count(x, y):
+        return jnp.sum(lax.population_count(jnp.bitwise_or(x, y)).astype(jnp.int64))
+
+    da, db = jax.device_put(a), jax.device_put(b)
+    int(union_count(da, db))  # warm
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        got = int(union_count(da, db))
+    dt = (time.perf_counter() - t0) / iters
+    from pilosa_tpu.roaring import _POPCNT8
+
+    t0 = time.perf_counter()
+    want = int(_POPCNT8[(a | b).view(np.uint8)].sum())
+    base_dt = time.perf_counter() - t0
+    assert got == want
+    cols_per_sec = n_slices * (1 << 20) / dt
+    return {
+        "metric": "union_count_cols_per_sec",
+        "value": round(cols_per_sec, 1),
+        "unit": f"columns/sec unioned+counted ({n_slices} slices, backend {jax.default_backend()})",
+        "vs_baseline": round(base_dt / dt, 2),
+    }
+
+
+def bench_timerange() -> dict:
+    """Config 5: time-quantum Range — OR-reduce the YMDH view cover of a
+    1-year range (time.go:95-167 analog; ~15 views) then popcount."""
+    iters = int(os.environ.get("BENCH_ITERS", "10"))
+    n_views = 15  # typical cover size for a 1-year [start, end) at YMDH
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from pilosa_tpu.ops.bitwise import WORDS_PER_SLICE
+
+    rng = np.random.default_rng(5)
+    views = rng.integers(0, 1 << 32, size=(n_views, WORDS_PER_SLICE), dtype=np.uint32)
+
+    @jax.jit
+    def range_union_count(v):
+        acc = lax.reduce(v, np.uint32(0), lax.bitwise_or, (0,))
+        return jnp.sum(lax.population_count(acc).astype(jnp.int64))
+
+    dv = jax.device_put(views)
+    int(range_union_count(dv))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        got = int(range_union_count(dv))
+    dt = (time.perf_counter() - t0) / iters
+    from pilosa_tpu.roaring import _POPCNT8
+
+    t0 = time.perf_counter()
+    acc = views[0].copy()
+    for i in range(1, n_views):
+        acc |= views[i]
+    want = int(_POPCNT8[acc.view(np.uint8)].sum())
+    base_dt = time.perf_counter() - t0
+    assert got == want
+    return {
+        "metric": "timerange_union_views_per_sec",
+        "value": round(n_views / dt, 1),
+        "unit": f"views/sec OR-reduced+counted ({n_views}-view YMDH cover, backend {jax.default_backend()})",
+        "vs_baseline": round(base_dt / dt, 2),
+    }
+
+
 def main() -> None:
+    cfg = os.environ.get("BENCH_CONFIG", "intersect_count")
+    if cfg != "intersect_count":
+        result = {
+            "setbit": bench_setbit,
+            "topn": bench_topn,
+            "union64": bench_union64,
+            "timerange": bench_timerange,
+        }[cfg]()
+        print(json.dumps(result))
+        return
     n_slices = int(os.environ.get("BENCH_SLICES", "16"))
     n_rows = int(os.environ.get("BENCH_ROWS", "64"))
     batch = int(os.environ.get("BENCH_BATCH", "256"))

@@ -132,7 +132,8 @@ class Cluster:
                 chosen = node
                 break
             if chosen is None:
-                raise RuntimeError(f"slice {s} unavailable: all owners down")
+                detail = "down or unreachable" if exclude_hosts else "down"
+                raise RuntimeError(f"slice {s} unavailable: all owners {detail}")
             out.setdefault(chosen, []).append(s)
         return out
 

@@ -217,7 +217,8 @@ class Fragment:
 
     def _on_row_mutated(self, row_id: int) -> None:
         self._row_cache.pop(row_id, None)
-        self._row_dev_cache.pop(row_id, None)
+        for k in [k for k in self._row_dev_cache if k[1] == row_id]:
+            self._row_dev_cache.pop(k, None)
         self._checksums.pop(row_id // HASH_BLOCK_SIZE, None)
         self.cache.add(row_id, self.row_count(row_id))
 
@@ -272,13 +273,14 @@ class Fragment:
         # Compute-and-insert stays under one lock hold: inserting after a
         # release could overwrite the invalidation of a concurrent mutation
         # with a stale row.
+        key = (getattr(engine, "name", "?"), row_id)
         with self._mu:
-            cached = self._row_dev_cache.get(row_id)
+            cached = self._row_dev_cache.get(key)
             if cached is not None:
-                self._row_dev_cache.move_to_end(row_id)
+                self._row_dev_cache.move_to_end(key)
                 return cached
             arr = engine.asarray(self.row_dense(row_id))
-            self._row_dev_cache[row_id] = arr
+            self._row_dev_cache[key] = arr
             while len(self._row_dev_cache) > self._row_dev_cache_max:
                 self._row_dev_cache.popitem(last=False)
             return arr

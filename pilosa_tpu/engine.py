@@ -35,6 +35,10 @@ class NumpyEngine:
         """Stack engine-resident rows (same as stack on numpy)."""
         return self.stack(rows)
 
+    def stack_slices(self, stacks: list) -> np.ndarray:
+        """Stack along the SLICE axis (mesh engines shard this one)."""
+        return self.stack(stacks)
+
     def asarray(self, x: np.ndarray):
         return np.asarray(x)
 
@@ -95,6 +99,10 @@ class JaxEngine:
             return self._jnp.zeros((0, 0), dtype=self._jnp.uint32)
         return self._jnp.stack([self._jnp.asarray(r) for r in rows])
 
+    def stack_slices(self, stacks: list):
+        """Stack along the SLICE axis (mesh engines shard this one)."""
+        return self.stack_rows(stacks)
+
     def asarray(self, x):
         return self._jnp.asarray(x)
 
@@ -132,6 +140,65 @@ class JaxEngine:
         return np.asarray(x)
 
 
+class MeshEngine(JaxEngine):
+    """JaxEngine whose slice stacks are sharded over a local device mesh.
+
+    The executor's local map phase becomes a single GSPMD computation: the
+    leading (slice) axis of every stack is partitioned over the
+    ``SliceMesh`` (parallel/sharded.py), elementwise set ops stay
+    shard-local, and reductions (Count, TopN candidate counts) get their
+    cross-device psum/all-gather inserted by XLA from the shardings — the
+    in-process analog of the reference's goroutine-per-slice fan-out
+    (executor.go:1209-1244), with ICI replacing channels.
+
+    Falls back to replication for stacks whose leading axis can't shard
+    (empty or single-slice).
+    """
+
+    name = "mesh"
+
+    def __init__(self, devices=None):
+        super().__init__()
+        from pilosa_tpu.parallel import SliceMesh
+        from pilosa_tpu.ops import bitwise as _bw
+
+        import jax
+
+        self._jax = jax
+        self.mesh = SliceMesh(devices)
+        # One jitted callable for the fused path — constructing jax.jit per
+        # call would re-trace and miss the dispatch cache every time.
+        self._gather_jit = jax.jit(_bw.gather_count_and)
+
+    def _shard_stack(self, x):
+        # Shard only cleanly-divisible leading axes (device_put requires
+        # even shards); ragged slice counts stay unsharded — correctness
+        # first, placement when the shapes allow it.  Only stack_slices
+        # routes here, so the leading axis is always the slice axis.
+        if x.ndim < 2 or x.shape[0] < 2 or x.shape[0] % self.mesh.n_devices:
+            return self._jnp.asarray(x)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        spec = P(self.mesh.AXIS, *([None] * (x.ndim - 1)))
+        return self._jax.device_put(x, NamedSharding(self.mesh.mesh, spec))
+
+    def stack(self, rows: list):
+        return self.stack_slices(rows)
+
+    def stack_slices(self, stacks: list):
+        return self._shard_stack(super().stack_rows(stacks))
+
+    def gather_count_and(self, row_matrix, pairs):
+        # Pallas can't lower under GSPMD partitioning; the jnp form is
+        # partitioned by XLA (local gather + AND + popcount per shard,
+        # psum over the slice axis).
+        out = self._gather_jit(
+            self._shard_stack(self._jnp.asarray(row_matrix)),
+            self._jnp.asarray(pairs),
+        )
+        return np.asarray(out).astype(np.int64)
+
+
 def new_engine(name: str = "auto"):
     """Engine factory. "auto" honors PILOSA_TPU_ENGINE, defaulting to jax
     with a numpy fallback when no jax backend can initialize."""
@@ -144,6 +211,8 @@ def new_engine(name: str = "auto"):
         name = env or "jax"
     if name == "numpy":
         return NumpyEngine()
+    if name == "mesh":
+        return MeshEngine()
     if name == "jax":
         if fallback_ok:
             try:

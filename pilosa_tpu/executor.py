@@ -229,7 +229,7 @@ class Executor:
                 )
                 for s in slices
             ]
-            frame_matrices[frame] = (id_pos, self.engine.stack_rows(per_slice))
+            frame_matrices[frame] = (id_pos, self.engine.stack_slices(per_slice))
 
         out: dict[int, int] = {}
         for frame, (id_pos, matrix) in frame_matrices.items():
@@ -376,7 +376,7 @@ class Executor:
                 # Device-cached row: hot rows stay resident in HBM across
                 # queries instead of re-uploading every time.
                 rows.append(frag.row_device(row_id, self.engine))
-        return self.engine.stack_rows(rows)
+        return self.engine.stack_slices(rows)
 
     def _eval_bitmap_leaf(self, index: str, c: pql.Call, slices: list[int]):
         frame, view, id = self._resolve_bitmap_leaf(index, c)

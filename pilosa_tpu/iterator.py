@@ -121,7 +121,14 @@ class LimitIterator:
         if self._eof:
             return None
         p = self._it.next()
-        if p is None or p[0] > self._max_row:
+        if p is None:
+            self._eof = True
+            return None
+        if p[0] > self._max_row:
+            # Push the boundary pair back (iterator.go:103-108) so a shared
+            # underlying iterator (k-way merge composition) doesn't lose it.
+            if hasattr(self._it, "unread"):
+                self._it.unread(p)
             self._eof = True
             return None
         return p

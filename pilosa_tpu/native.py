@@ -183,6 +183,11 @@ def oplog_decode(data: bytes) -> tuple[np.ndarray, np.ndarray]:
     return np.array(types_l, dtype=np.uint8), np.array(values_l, dtype=np.uint64)
 
 
+def _ascii_digits(s: str) -> bool:
+    """Plain ASCII decimal digits only — matches pn_parse_csv exactly."""
+    return s.isascii() and s.isdigit()
+
+
 def parse_csv(data: bytes) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
     """Parse 'row,col[,timestamp]' lines → (rows, cols, timestamps)."""
     lib = load()
@@ -214,9 +219,6 @@ def parse_csv(data: bytes) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
         if len(parts) < 2 or len(parts) > 3:
             raise ValueError(f"malformed CSV at line {lineno}")
         try:
-            def _ascii_digits(s: str) -> bool:
-                return s.isascii() and s.isdigit()
-
             if not _ascii_digits(parts[0].strip()) or not _ascii_digits(parts[1].strip()):
                 raise ValueError("non-digit id")
             row, col = int(parts[0]), int(parts[1])

@@ -91,6 +91,7 @@ class Handler:
         self.host = host
         self.broadcaster = broadcaster  # schema-mutation broadcast hook
         self.stats = stats
+        self._profiling = None  # active jax trace dir, if any
         self.client_factory = client_factory
         self.version = VERSION
         self._routes = self._build_routes()
@@ -116,6 +117,8 @@ class Handler:
             ("PATCH", re.compile(r"^/index/(?P<index>[^/]+)/time-quantum$"), self.patch_index_time_quantum),
             ("GET", re.compile(r"^/debug/vars$"), self.get_expvar),
             ("GET", re.compile(r"^/debug/pprof(?:/.*)?$"), self.get_pprof),
+            ("POST", re.compile(r"^/debug/profile/start$"), self.post_profile_start),
+            ("POST", re.compile(r"^/debug/profile/stop$"), self.post_profile_stop),
             ("GET", re.compile(r"^/export$"), self.get_export),
             ("GET", re.compile(r"^/fragment/block/data$"), self.get_fragment_block_data),
             ("POST", re.compile(r"^/fragment/block/diff$"), self.post_fragment_block_diff),
@@ -268,6 +271,35 @@ class Handler:
             out.write(f"--- thread {tid} ---\n")
             out.write("".join(traceback.format_stack(frame)))
         return 200, "text/plain", out.getvalue().encode()
+
+    def post_profile_start(self, params=None, **kw):
+        """Start a JAX/XLA device trace (the TPU-native analog of the
+        reference's CPU-profile flags, cmd/server.go:47-62).  Trace files
+        land in ``dir`` (default <data>/profiles) for TensorBoard."""
+        import jax
+
+        trace_dir = self._param(params or {}, "dir") or os.path.join(
+            self.holder.path, "profiles"
+        )
+        if self._profiling:
+            raise HTTPError(409, "profile already running")
+        try:
+            jax.profiler.start_trace(trace_dir)
+        except Exception as e:
+            raise HTTPError(500, f"profiler: {e}")
+        self._profiling = trace_dir
+        return self._json({"tracing": trace_dir})
+
+    def post_profile_stop(self, **kw):
+        import jax
+
+        if not self._profiling:
+            raise HTTPError(409, "no profile running")
+        try:
+            jax.profiler.stop_trace()
+        finally:
+            trace_dir, self._profiling = self._profiling, None
+        return self._json({"written": trace_dir})
 
     # -- index lifecycle --------------------------------------------------
 

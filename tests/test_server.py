@@ -239,3 +239,52 @@ def test_webui_served_to_browsers(srv):
     with pytest.raises(urllib.error.HTTPError) as e:
         get("/assets/..%2Findex.html")
     assert e.value.code == 404
+
+
+def test_profile_endpoints(client):
+    """JAX trace start/stop round trip (aux tracing subsystem)."""
+    status, body = client._request("POST", "/debug/profile/start")
+    if status == 500:
+        pytest.skip("jax profiler unavailable in this environment")
+    assert status == 200 and b"tracing" in body
+    # double start conflicts
+    status2, _ = client._request("POST", "/debug/profile/start")
+    assert status2 == 409
+    status3, body3 = client._request("POST", "/debug/profile/stop")
+    assert status3 == 200 and b"written" in body3
+    status4, _ = client._request("POST", "/debug/profile/stop")
+    assert status4 == 409
+
+
+def test_set_quick_property(tmp_path):
+    """Full-stack property test (server_test.go:42-121 TestMain_Set_Quick):
+    random SetBits over HTTP, Bitmap() must match a model dict, and state
+    must survive a restart."""
+    rng = np.random.default_rng(1234)
+    s = make_server(tmp_path)
+    try:
+        c = Client(s.host)
+        c.create_index("q")
+        c.create_frame("q", "f")
+        model: dict[int, set[int]] = {}
+        for _ in range(120):
+            row = int(rng.integers(0, 5))
+            col = int(rng.integers(0, 3 * SLICE_WIDTH))
+            resp = c.execute_query("q", f'SetBit(rowID={row}, frame="f", columnID={col})')
+            changed = resp["results"][0]["changed"]
+            assert changed == (col not in model.setdefault(row, set()))
+            model[row].add(col)
+        for row, cols in model.items():
+            resp = c.execute_query("q", f'Bitmap(rowID={row}, frame="f")')
+            assert resp["results"][0]["bitmap"]["bits"] == sorted(cols)
+    finally:
+        s.close()
+    # restart on the same data dir; all bits must come back
+    s2 = make_server(tmp_path)
+    try:
+        c2 = Client(s2.host)
+        for row, cols in model.items():
+            resp = c2.execute_query("q", f'Bitmap(rowID={row}, frame="f")')
+            assert resp["results"][0]["bitmap"]["bits"] == sorted(cols)
+    finally:
+        s2.close()
