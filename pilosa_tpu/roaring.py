@@ -296,15 +296,21 @@ class Bitmap:
     def count(self) -> int:
         return sum(c.n for c in self.containers.values())
 
+    def _keys_in_range(self, hk: int, he: int):
+        """Container keys present in [hk, he], UNSORTED.  Iterates whichever
+        side is smaller — the key range (a row spans ≤16 consecutive keys,
+        the SetBit hot path) or the container dict."""
+        if he - hk + 1 <= len(self.containers):
+            return [k for k in range(hk, he + 1) if k in self.containers]
+        return [k for k in self.containers if hk <= k <= he]
+
     def count_range(self, start: int, end: int) -> int:
         """Count values in [start, end)."""
         if end <= start:
             return 0
         total = 0
         hk, he = highbits(start), highbits(end - 1)
-        for key in self.sorted_keys():
-            if key < hk or key > he:
-                continue
+        for key in self._keys_in_range(hk, he):  # counting needs no order
             c = self.containers[key]
             lo = lowbits(start) if key == hk else 0
             hi = lowbits(end - 1) + 1 if key == he else CONTAINER_BITS
@@ -318,9 +324,7 @@ class Bitmap:
         """All values in [start, end) as sorted uint64 (OffsetRange core)."""
         out = []
         hk, he = highbits(start), highbits(max(end - 1, 0))
-        for key in self.sorted_keys():
-            if key < hk or key > he:
-                continue
+        for key in sorted(self._keys_in_range(hk, he)):
             vals = self.containers[key].values().astype(np.uint64) | np.uint64(key << 16)
             if key == hk or key == he:
                 vals = vals[(vals >= start) & (vals < end)]
@@ -355,7 +359,7 @@ class Bitmap:
         """Largest value present (0 when empty; roaring.go Max analog)."""
         if not self.containers:
             return 0
-        key = self.sorted_keys()[-1]
+        key = max(self.containers)
         vals = self.containers[key].values()
         return (key << 16) | int(vals[-1]) if len(vals) else 0
 
@@ -482,23 +486,27 @@ class Bitmap:
     # -- serialization -------------------------------------------------
 
     def write_to(self, w) -> int:
-        """Serialize in the reference's cookie-12346 format."""
+        """Serialize in the reference's cookie-12346 format.
+
+        Headers are built as vectorized numpy buffers — per-container
+        scalar packing dominated snapshot cost in the SetBit hot path
+        (snapshots fire every MaxOpN ops).
+        """
         keys = [k for k in self.sorted_keys() if self.containers[k].n > 0]
         n = len(keys)
-        header = io.BytesIO()
-        header.write(np.uint32(COOKIE).astype("<u4").tobytes())
-        header.write(np.uint32(n).astype("<u4").tobytes())
-        for k in keys:
-            header.write(np.uint64(k).astype("<u8").tobytes())
-            header.write(np.uint32(self.containers[k].n - 1).astype("<u4").tobytes())
-        offset = HEADER_SIZE + n * 12 + n * 4
-        for k in keys:
-            header.write(np.uint32(offset).astype("<u4").tobytes())
-            offset += self.containers[k].payload_size()
-        data = header.getvalue()
-        written = w.write(data)
-        for k in keys:
-            written += w.write(self.containers[k].payload())
+        written = w.write(np.array([COOKIE, n], dtype="<u4").tobytes())
+        if n:
+            conts = [self.containers[k] for k in keys]
+            ns = np.fromiter((c.n for c in conts), dtype=np.int64, count=n)
+            meta = np.zeros(n, dtype=[("key", "<u8"), ("n1", "<u4")])
+            meta["key"] = np.asarray(keys, dtype=np.uint64)
+            meta["n1"] = (ns - 1).astype(np.uint32)
+            written += w.write(meta.tobytes())
+            sizes = np.where(ns <= ARRAY_MAX_SIZE, ns * 4, BITMAP_N * 8)
+            offsets = HEADER_SIZE + n * 16 + np.concatenate(([0], np.cumsum(sizes[:-1])))
+            written += w.write(offsets.astype("<u4").tobytes())
+            for c in conts:
+                written += w.write(c.payload())
         return written
 
     def to_bytes(self) -> bytes:
