@@ -203,6 +203,31 @@ class Fragment:
                 self._increment_opn()
             return changed
 
+    def set_bits(self, row_ids, column_ids) -> np.ndarray:
+        """Durable batched SetBit: one vectorized storage pass + one WAL
+        append for the whole batch (the host-side write batching of
+        SURVEY §7 'hard parts (a)').
+
+        Returns a bool array: per input position, whether that bit was
+        newly set (duplicates within the batch count once, first wins —
+        identical to issuing the SetBits sequentially).
+        """
+        row_ids = np.asarray(row_ids, dtype=np.uint64)
+        column_ids = np.asarray(column_ids, dtype=np.uint64)
+        positions = row_ids * np.uint64(SLICE_WIDTH) + (column_ids % np.uint64(SLICE_WIDTH))
+        with self._mu:
+            added = self.storage.add_many_logged(positions)
+            if len(added):
+                for row_id in np.unique(added // np.uint64(SLICE_WIDTH)).tolist():
+                    self._on_row_mutated(int(row_id))
+                self._increment_opn()
+            # changed[i] = position newly added AND first occurrence in batch
+            is_new = np.isin(positions, added)
+            _, first_idx = np.unique(positions, return_index=True)
+            first_mask = np.zeros(len(positions), dtype=bool)
+            first_mask[first_idx] = True
+            return is_new & first_mask
+
     def clear_bit(self, row_id: int, column_id: int) -> bool:
         with self._mu:
             changed = self.storage.remove(self.pos(row_id, column_id))

@@ -115,6 +115,21 @@ class View:
         slice_i = column_id // SLICE_WIDTH
         return self.create_fragment_if_not_exists(slice_i).set_bit(row_id, column_id)
 
+    def set_bits(self, row_ids, column_ids):
+        """Batched SetBit routed per slice; returns per-input changed bools
+        (order preserved).  One fragment pass + WAL append per slice."""
+        import numpy as np
+
+        row_ids = np.asarray(row_ids, dtype=np.uint64)
+        column_ids = np.asarray(column_ids, dtype=np.uint64)
+        changed = np.zeros(len(row_ids), dtype=bool)
+        slices = (column_ids // np.uint64(SLICE_WIDTH)).astype(np.int64)
+        for s in np.unique(slices).tolist():
+            idx = np.nonzero(slices == s)[0]
+            frag = self.create_fragment_if_not_exists(int(s))
+            changed[idx] = frag.set_bits(row_ids[idx], column_ids[idx])
+        return changed
+
     def clear_bit(self, row_id: int, column_id: int) -> bool:
         slice_i = column_id // SLICE_WIDTH
         f = self.fragments.get(slice_i)

@@ -272,6 +272,46 @@ class Bitmap:
                 added += c.add_many(lows)
         return added
 
+    def add_many_logged(self, values: np.ndarray) -> np.ndarray:
+        """Vectorized add WITH WAL: applies the batch and appends one op
+        record per newly-set value (a durable bulk SetBit, unlike
+        ``add_many`` which callers must follow with a snapshot).
+
+        Returns the sorted uint64 array of values that were newly added.
+        """
+        values = np.unique(np.asarray(values, dtype=np.uint64))
+        if len(values) == 0:
+            return values
+        keys = (values >> np.uint64(16)).astype(np.int64)
+        uniq_keys, starts = np.unique(keys, return_index=True)
+        groups = np.split(values, starts[1:])
+        added_groups = []
+        for key, group in zip(uniq_keys.tolist(), groups):
+            lows = (group & np.uint64(0xFFFF)).astype(np.uint32)
+            c = self.containers.get(key)
+            if c is None:
+                c = Container.from_values(lows)
+                self.containers[key] = c
+                new_lows = c.values()
+            else:
+                have = c.values()
+                mask = ~np.isin(lows, have, assume_unique=True)
+                new_lows = lows[mask]
+                if len(new_lows):
+                    c.add_many(new_lows)
+            if len(new_lows):
+                added_groups.append(new_lows.astype(np.uint64) | np.uint64(key << 16))
+        if not added_groups:
+            return np.empty(0, dtype=np.uint64)
+        added = np.concatenate(added_groups)
+        if self.op_writer is not None:
+            from pilosa_tpu import native
+
+            types = np.zeros(len(added), dtype=np.uint8)  # OP_ADD
+            self.op_writer.write(native.oplog_encode(types, added))
+            self.op_n += len(added)
+        return added
+
     def _container_for(self, v: int) -> Container:
         key = highbits(v)
         c = self.containers.get(key)
