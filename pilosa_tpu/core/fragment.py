@@ -214,13 +214,25 @@ class Fragment:
         """
         row_ids = np.asarray(row_ids, dtype=np.uint64)
         column_ids = np.asarray(column_ids, dtype=np.uint64)
+        if len(row_ids) != len(column_ids):
+            raise ValueError("row/column id length mismatch")
         positions = row_ids * np.uint64(SLICE_WIDTH) + (column_ids % np.uint64(SLICE_WIDTH))
         with self._mu:
-            added = self.storage.add_many_logged(positions)
+            # Apply first, then choose durability by how much was actually
+            # new: a batch at/over the snapshot threshold goes straight to
+            # snapshot (import_bits shape, the op records would be
+            # superseded anyway); anything smaller appends its op records —
+            # so mostly-duplicate batches cost a few WAL records, not a
+            # fragment rewrite.
+            added = self.storage.add_many_unlogged(positions)
             if len(added):
                 for row_id in np.unique(added // np.uint64(SLICE_WIDTH)).tolist():
                     self._on_row_mutated(int(row_id))
-                self._increment_opn()
+                if len(added) >= self.max_opn:
+                    self._snapshot()
+                else:
+                    self.storage.log_add_ops(added)
+                    self._increment_opn()
             # changed[i] = position newly added AND first occurrence in batch
             is_new = np.isin(positions, added)
             _, first_idx = np.unique(positions, return_index=True)

@@ -220,6 +220,41 @@ class Frame:
                 changed = True
         return changed
 
+    def set_bits(
+        self,
+        name: str,
+        row_ids,
+        column_ids,
+        timestamps: Optional[Sequence[Optional[datetime]]] = None,
+    ) -> "np.ndarray":
+        """Durable batched SetBit: per-input changed bools, semantically
+        identical to issuing set_bit sequentially (first occurrence of a
+        duplicate wins).  One fragment pass + WAL append per touched
+        (view, slice) instead of per bit."""
+        if not is_valid_view(name):
+            raise ErrInvalidView(f"invalid view: {name}")
+        row_ids = np.asarray(row_ids, dtype=np.uint64)
+        column_ids = np.asarray(column_ids, dtype=np.uint64)
+        if len(row_ids) != len(column_ids):
+            raise ValueError("row/column id length mismatch")
+        if timestamps is not None and len(timestamps) != len(row_ids):
+            raise ValueError("timestamps length mismatch")
+        changed = self.create_view_if_not_exists(name).set_bits(row_ids, column_ids)
+        if self.time_quantum and timestamps is not None:
+            # Group indices by time sub-view so each sub-view gets one pass.
+            by_view: dict[str, list[int]] = {}
+            for i, t in enumerate(timestamps):
+                if t is None:
+                    continue
+                for subname in tq.views_by_time(name, t, self.time_quantum):
+                    by_view.setdefault(subname, []).append(i)
+            for subname, idxs in by_view.items():
+                sub_changed = self.create_view_if_not_exists(subname).set_bits(
+                    row_ids[idxs], column_ids[idxs]
+                )
+                changed[idxs] |= sub_changed
+        return changed
+
     def clear_bit(self, name: str, row_id: int, col_id: int) -> bool:
         if not is_valid_view(name):
             raise ErrInvalidView(f"invalid view: {name}")

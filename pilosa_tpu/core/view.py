@@ -122,6 +122,8 @@ class View:
 
         row_ids = np.asarray(row_ids, dtype=np.uint64)
         column_ids = np.asarray(column_ids, dtype=np.uint64)
+        if len(row_ids) != len(column_ids):
+            raise ValueError("row/column id length mismatch")
         changed = np.zeros(len(row_ids), dtype=bool)
         slices = (column_ids // np.uint64(SLICE_WIDTH)).astype(np.int64)
         for s in np.unique(slices).tolist():

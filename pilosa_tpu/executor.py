@@ -147,6 +147,10 @@ class Executor:
             std_slices = list(range(idx.max_slice() + 1))
             inv_slices = list(range(idx.max_inverse_slice() + 1))
 
+        batched_writes = self._fuse_set_bit_batch(index, query.calls, opt)
+        if batched_writes is not None:
+            return batched_writes
+
         fused = self._fuse_count_intersect_batch(index, query.calls, std_slices, opt)
 
         results = []
@@ -166,6 +170,73 @@ class Executor:
         return results
 
     # -- query-batch fusion ------------------------------------------------
+
+    def _fuse_set_bit_batch(
+        self, index: str, calls, opt: ExecOptions
+    ) -> Optional[list[bool]]:
+        """Batch an all-SetBit request into vectorized per-frame writes.
+
+        The write-path analog of the count-intersect fusion: a request
+        carrying N SetBit calls costs one fragment pass + one WAL append
+        per touched (view, slice) — and one forwarded request per remote
+        owner node — instead of N of each (executor.go:675-698 does N).
+        Only fires when the WHOLE request is SetBit calls, so per-call
+        ordering against reads is preserved; per-call changed bools are
+        identical to the sequential path (first duplicate wins).
+
+        Failure semantics differ from sequential on purpose: local writes
+        are all applied first, then remote forwards — so a node failure
+        leaves every locally-owned bit committed (sequential leaves a
+        call-order prefix).  SetBit is idempotent, so a client retry
+        converges to the same state on either path.
+        """
+        if len(calls) < 2 or any(c.name != "SetBit" for c in calls):
+            return None
+        try:
+            parsed = [self._set_bit_args(index, c) for c in calls]
+        except (PilosaError, ValueError):
+            # Surface the error through the sequential path, which also
+            # preserves its partial-commit semantics (calls before the bad
+            # one take effect, exactly as if executed one by one).
+            return None
+
+        changed = [False] * len(calls)
+
+        # Ownership split: local writes for slices this node owns, one
+        # batched forward per remote owner node.
+        by_node: dict[str, list[int]] = {}
+        if opt.remote or self.cluster is None or self.client_factory is None:
+            local_idx = list(range(len(calls)))
+        else:
+            local_idx = []
+            for i, (_, _, col_id, _) in enumerate(parsed):
+                for node in self.cluster.fragment_nodes(index, col_id // SLICE_WIDTH):
+                    if node.host == self.host:
+                        local_idx.append(i)
+                    else:
+                        by_node.setdefault(node.host, []).append(i)
+
+        by_frame: dict[Any, list[int]] = {}
+        for i in local_idx:
+            by_frame.setdefault(parsed[i][0], []).append(i)
+        for frame, idxs in by_frame.items():
+            rows = np.array([parsed[i][1] for i in idxs], dtype=np.uint64)
+            cols = np.array([parsed[i][2] for i in idxs], dtype=np.uint64)
+            stamps = [parsed[i][3] for i in idxs]
+            ch = frame.set_bits(VIEW_STANDARD, rows, cols, stamps)
+            if frame.inverse_enabled:
+                ch |= frame.set_bits(VIEW_INVERSE, cols, rows, stamps)
+            for k, i in enumerate(idxs):
+                if ch[k]:
+                    changed[i] = True
+
+        for host, idxs in by_node.items():
+            client = self.client_factory(host)
+            res = client.execute_remote(index, pql.Query(calls=[calls[i] for i in idxs]))
+            for k, i in enumerate(idxs):
+                if res and res[k]:
+                    changed[i] = True
+        return changed
 
     def _fuse_count_intersect_batch(
         self, index: str, calls, slices, opt: ExecOptions

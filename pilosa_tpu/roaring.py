@@ -116,6 +116,18 @@ class Container:
             return i < len(self.array) and self.array[i] == v
         return bool((int(self.bitmap[v >> 6]) >> (v & 63)) & 1)
 
+    def contains_many(self, lows: np.ndarray) -> np.ndarray:
+        """Vectorized membership mask for uint32 lowbits values."""
+        lows = np.asarray(lows, dtype=np.uint32)
+        if self.array is not None:
+            if len(self.array) == 0:
+                return np.zeros(len(lows), dtype=bool)
+            i = np.searchsorted(self.array, lows)
+            mask = i < len(self.array)
+            return mask & (self.array[np.minimum(i, len(self.array) - 1)] == lows)
+        words = self.bitmap[(lows >> np.uint32(6)).astype(np.int64)]
+        return ((words >> (lows & np.uint32(63)).astype(np.uint64)) & np.uint64(1)).astype(bool)
+
     def add(self, v: int) -> bool:
         """Insert lowbits value; True if it was newly added."""
         if self.array is not None:
@@ -158,7 +170,16 @@ class Container:
         if len(values) == 0:
             return 0
         before = self.n
-        merged = np.union1d(self.values(), values)
+        if self.bitmap is not None:
+            # Dense stays dense: OR the bits in directly, O(len + 1024)
+            # instead of a full unpack + union sort.
+            np.bitwise_or.at(
+                self.bitmap,
+                (values >> np.uint32(6)).astype(np.int64),
+                np.uint64(1) << (values & np.uint32(63)).astype(np.uint64),
+            )
+            return self.n - before
+        merged = np.union1d(self.array, values)
         if len(merged) > ARRAY_MAX_SIZE:
             self.bitmap = _values_to_bitmap(merged)
             self.array = None
@@ -250,27 +271,43 @@ class Bitmap:
             self._write_op(OP_REMOVE, v)
         return changed
 
-    def add_many(self, values: np.ndarray) -> int:
-        """Vectorized bulk add (no WAL; callers snapshot after, like Import)."""
-        values = np.asarray(values, dtype=np.uint64)
-        if len(values) == 0:
-            return 0
-        values = np.unique(values)
+    def _bulk_add(self, values: np.ndarray) -> np.ndarray:
+        """Shared bulk-add core: apply sorted-unique uint64 values and
+        return the (sorted) subset that was newly added.  No WAL."""
         keys = (values >> np.uint64(16)).astype(np.int64)
-        added = 0
         # values is sorted, so per-key groups are contiguous: one pass.
         uniq_keys, starts = np.unique(keys, return_index=True)
         groups = np.split(values, starts[1:])
+        added_groups = []
         for key, group in zip(uniq_keys.tolist(), groups):
             lows = (group & np.uint64(0xFFFF)).astype(np.uint32)
             c = self.containers.get(key)
             if c is None:
-                c = Container.from_values(lows)
-                self.containers[key] = c
-                added += c.n
+                self.containers[key] = Container.from_values(lows)
+                new_lows = lows
             else:
-                added += c.add_many(lows)
-        return added
+                new_lows = lows[~c.contains_many(lows)]
+                if len(new_lows):
+                    c.add_many(new_lows)
+            if len(new_lows):
+                added_groups.append(new_lows.astype(np.uint64) | np.uint64(key << 16))
+        if not added_groups:
+            return np.empty(0, dtype=np.uint64)
+        return np.concatenate(added_groups)
+
+    def add_many(self, values: np.ndarray) -> int:
+        """Vectorized bulk add (no WAL; callers snapshot after, like Import)."""
+        return len(self.add_many_unlogged(values))
+
+    def add_many_unlogged(self, values: np.ndarray) -> np.ndarray:
+        """Apply a batch WITHOUT touching the WAL; returns the sorted
+        uint64 array of newly-added values.  Callers own durability:
+        either snapshot afterwards (import path) or pass the result to
+        ``log_add_ops`` (small-batch path)."""
+        values = np.asarray(values, dtype=np.uint64)
+        if len(values) == 0:
+            return values
+        return self._bulk_add(np.unique(values))
 
     def add_many_logged(self, values: np.ndarray) -> np.ndarray:
         """Vectorized add WITH WAL: applies the batch and appends one op
@@ -279,38 +316,21 @@ class Bitmap:
 
         Returns the sorted uint64 array of values that were newly added.
         """
-        values = np.unique(np.asarray(values, dtype=np.uint64))
-        if len(values) == 0:
-            return values
-        keys = (values >> np.uint64(16)).astype(np.int64)
-        uniq_keys, starts = np.unique(keys, return_index=True)
-        groups = np.split(values, starts[1:])
-        added_groups = []
-        for key, group in zip(uniq_keys.tolist(), groups):
-            lows = (group & np.uint64(0xFFFF)).astype(np.uint32)
-            c = self.containers.get(key)
-            if c is None:
-                c = Container.from_values(lows)
-                self.containers[key] = c
-                new_lows = c.values()
-            else:
-                have = c.values()
-                mask = ~np.isin(lows, have, assume_unique=True)
-                new_lows = lows[mask]
-                if len(new_lows):
-                    c.add_many(new_lows)
-            if len(new_lows):
-                added_groups.append(new_lows.astype(np.uint64) | np.uint64(key << 16))
-        if not added_groups:
-            return np.empty(0, dtype=np.uint64)
-        added = np.concatenate(added_groups)
-        if self.op_writer is not None:
-            from pilosa_tpu import native
-
-            types = np.zeros(len(added), dtype=np.uint8)  # OP_ADD
-            self.op_writer.write(native.oplog_encode(types, added))
-            self.op_n += len(added)
+        added = self.add_many_unlogged(values)
+        self.log_add_ops(added)
         return added
+
+    def log_add_ops(self, added: np.ndarray) -> None:
+        """Append one OP_ADD record per value to the WAL (no-op when
+        detached).  For callers that apply a batch first and decide on
+        durability strategy after seeing what was actually new."""
+        if len(added) == 0 or self.op_writer is None:
+            return
+        from pilosa_tpu import native
+
+        types = np.zeros(len(added), dtype=np.uint8)  # OP_ADD
+        self.op_writer.write(native.oplog_encode(types, added))
+        self.op_n += len(added)
 
     def _container_for(self, v: int) -> Container:
         key = highbits(v)

@@ -276,3 +276,72 @@ def test_new_cache_types():
 
     with pytest.raises(ErrInvalidCacheType):
         cache_mod.new_cache("bogus", 10)
+
+
+def test_set_bits_matches_sequential(tmp_path):
+    """Batched set_bits == sequential set_bit: same changed mask, same data,
+    duplicates first-wins (fragment.go:371-413 semantics, batched)."""
+    rng = np.random.default_rng(3)
+    rows = rng.integers(0, 50, size=300, dtype=np.uint64)
+    cols = rng.integers(0, SLICE_WIDTH, size=300, dtype=np.uint64)
+    rows[10], cols[10] = rows[0], cols[0]  # in-batch duplicate
+
+    a = Fragment(str(tmp_path / "a"), "i", "f", "standard", 0)
+    a.open()
+    want = np.array([a.set_bit(int(r), int(c)) for r, c in zip(rows, cols)])
+    b = Fragment(str(tmp_path / "b"), "i", "f", "standard", 0)
+    b.open()
+    got = b.set_bits(rows, cols)
+    assert np.array_equal(got, want)
+    assert not got[10]  # duplicate of index 0
+    assert np.array_equal(b.storage.to_array(), a.storage.to_array())
+    # A second identical batch changes nothing.
+    assert not b.set_bits(rows, cols).any()
+    a.close()
+    b.close()
+
+
+def test_set_bits_wal_durable(tmp_path):
+    f = Fragment(str(tmp_path / "0"), "i", "f", "standard", 0)
+    f.open()
+    f.set_bits([1, 2, 3], [10, 20, 30])
+    f = reopen(f)  # WAL replay, no snapshot happened (batch < max_opn)
+    assert f.contains(1, 10) and f.contains(2, 20) and f.contains(3, 30)
+    f.close()
+
+
+def test_set_bits_length_mismatch(frag):
+    with pytest.raises(ValueError):
+        frag.set_bits([1, 2, 3], [10])
+
+
+def test_set_bits_bulk_batch_snapshots(tmp_path):
+    """A batch >= max_opn skips the WAL and snapshots once (import shape)."""
+    f = Fragment(str(tmp_path / "0"), "i", "f", "standard", 0, max_opn=10)
+    f.open()
+    rows = np.arange(20, dtype=np.uint64)
+    cols = np.arange(20, dtype=np.uint64) * 7
+    assert f.set_bits(rows, cols).all()
+    assert f.storage.op_n == 0  # snapshotted, WAL empty
+    f = reopen(f)
+    assert f.contains(5, 35)
+    assert f.row_count(5) == 1
+    f.close()
+
+
+def test_set_bits_mostly_duplicate_batch_uses_wal(tmp_path):
+    """A big batch whose NEW bits are few appends WAL records instead of
+    rewriting the fragment file (snapshot decision is on added count)."""
+    f = Fragment(str(tmp_path / "0"), "i", "f", "standard", 0, max_opn=100)
+    f.open()
+    rows = np.zeros(500, dtype=np.uint64)
+    cols = np.arange(500, dtype=np.uint64)
+    f.set_bits(rows, cols)  # >= max_opn -> snapshot, op_n == 0
+    assert f.storage.op_n == 0
+    cols2 = np.concatenate([cols, [1000, 1001, 1002]])
+    ch = f.set_bits(np.zeros(len(cols2), dtype=np.uint64), cols2)
+    assert ch.sum() == 3
+    assert f.storage.op_n == 3  # 3 WAL records, no snapshot
+    f = reopen(f)  # replayed from snapshot + WAL
+    assert f.contains(0, 1002) and f.row_count(0) == 503
+    f.close()

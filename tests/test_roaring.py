@@ -232,3 +232,56 @@ def test_dense_container_ops_stay_dense(rng):
     assert inter.count() == 30000
     # result containers holding >4096 values stay dense bitmaps
     assert any(c.bitmap is not None for c in inter.containers.values())
+
+
+def test_add_many_logged_matches_sequential_add(tmp_path):
+    """Bulk logged add == per-value add: same added set, same containers
+    (incl. dense containers past ARRAY_MAX_SIZE), same WAL replay."""
+    rng = np.random.default_rng(11)
+    # Dense cluster in one container (forces bitmap repr) + scattered keys.
+    vals = np.concatenate(
+        [
+            rng.integers(0, 6000, size=5000, dtype=np.uint64),  # key 0, dense
+            rng.integers(0, 1 << 30, size=2000, dtype=np.uint64),
+        ]
+    )
+    a = roaring.Bitmap()
+    want_added = sorted({int(v) for v in vals if a.add(int(v))})
+    path = str(tmp_path / "b")
+    b = roaring.Bitmap()
+    with open(path, "wb") as fh:
+        b.op_writer = fh
+        got = b.add_many_logged(vals)
+        # Second identical batch: nothing added, nothing logged.
+        assert len(b.add_many_logged(vals)) == 0
+    assert sorted(got.tolist()) == want_added
+    assert np.array_equal(b.to_array(), a.to_array())
+    assert b.op_n == len(want_added)
+
+
+def test_container_contains_many_and_dense_add():
+    rng = np.random.default_rng(5)
+    vals = np.unique(rng.integers(0, 65536, size=5000, dtype=np.uint32))
+    c = roaring.Container.from_values(vals)  # > 4096 -> bitmap repr
+    assert not c.is_array
+    probe = rng.integers(0, 65536, size=1000, dtype=np.uint32)
+    want = np.isin(probe, vals)
+    assert np.array_equal(c.contains_many(probe), want)
+    # Dense bulk add stays dense and counts correctly.
+    extra = np.unique(rng.integers(0, 65536, size=300, dtype=np.uint32))
+    new = extra[~np.isin(extra, vals)]
+    assert c.add_many(extra) == len(new)
+    assert c.n == len(vals) + len(new)
+    # Array-representation membership too.
+    small = roaring.Container.from_values(np.array([3, 9, 100], dtype=np.uint32))
+    assert small.is_array
+    assert np.array_equal(
+        small.contains_many(np.array([0, 3, 9, 99, 100], dtype=np.uint32)),
+        np.array([False, True, True, False, True]),
+    )
+    assert np.array_equal(
+        roaring.Container(array=np.empty(0, dtype=np.uint32)).contains_many(
+            np.array([1, 2], dtype=np.uint32)
+        ),
+        np.array([False, False]),
+    )
