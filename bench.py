@@ -68,27 +68,45 @@ def bench_topn() -> dict:
     """Config 3: TopN over a ranked frame — candidate scoring via the
     batched intersection-count kernel (fragment.go:493-625 analog)."""
     n_rows = int(os.environ.get("BENCH_TOPN_ROWS", "2048"))
-    iters = int(os.environ.get("BENCH_ITERS", "10"))
+    iters = int(os.environ.get("BENCH_ITERS", "400"))
     import jax
+    import jax.numpy as jnp
+    from jax import lax
 
-    from pilosa_tpu.ops import dispatch
     from pilosa_tpu.ops.bitwise import WORDS_PER_SLICE
 
     rng = np.random.default_rng(3)
     rows = rng.integers(0, 1 << 32, size=(n_rows, WORDS_PER_SLICE), dtype=np.uint32)
     src = rng.integers(0, 1 << 32, size=(WORDS_PER_SLICE,), dtype=np.uint32)
+    masks = rng.integers(0, 1 << 32, size=(iters,), dtype=np.uint32)
+
+    # Scan-chained stream (see bench_union64 docstring): each step scores
+    # every candidate row against a per-step src variant so the tunnel
+    # round trip amortizes across the whole stream.
+    @jax.jit
+    def run_stream(rws, s, ms):
+        def step(carry, m):
+            inter = jnp.bitwise_and(rws, jnp.bitwise_xor(s, m))
+            return carry, jnp.sum(
+                lax.population_count(inter).astype(jnp.int32), axis=1
+            )
+
+        return lax.scan(step, 0, ms)[1]
+
     drows, dsrc = jax.device_put(rows), jax.device_put(src)
-    np.asarray(dispatch.batch_intersection_count(drows, dsrc))  # warm
+    dmasks = jax.device_put(masks)
+    out = np.asarray(run_stream(drows, dsrc, dmasks))  # warm + compile
     t0 = time.perf_counter()
-    for _ in range(iters):
-        out = np.asarray(dispatch.batch_intersection_count(drows, dsrc))
+    out = np.asarray(run_stream(drows, dsrc, dmasks))
     dt = (time.perf_counter() - t0) / iters
     from pilosa_tpu.roaring import _POPCNT8
 
+    base_iters = max(1, min(2, iters))
     t0 = time.perf_counter()
-    base = _POPCNT8[(rows & src).view(np.uint8)].reshape(n_rows, -1).sum(axis=1)
-    base_dt = time.perf_counter() - t0
-    assert np.array_equal(out, base)
+    for i in range(base_iters):
+        base = _POPCNT8[(rows & (src ^ masks[i])).view(np.uint8)].reshape(n_rows, -1).sum(axis=1)
+    base_dt = (time.perf_counter() - t0) / base_iters
+    assert np.array_equal(out[base_iters - 1], base)
     return {
         "metric": "topn_candidate_scan_rows_per_sec",
         "value": round(n_rows / dt, 1),
@@ -98,9 +116,18 @@ def bench_topn() -> dict:
 
 
 def bench_union64() -> dict:
-    """Config 4: multi-slice Union+Count mapReduce over 64 slices."""
+    """Config 4: multi-slice Union+Count mapReduce over 64 slices.
+
+    Same timing methodology as the headline config: all iterations are
+    chained inside one jitted ``lax.scan`` and timing stops when the
+    results land on the host, so the remote-tunnel round trip is paid
+    once for the whole stream instead of once per query.  Each scan step
+    XORs one operand with a distinct 32-bit mask so every step's union
+    is a different computation XLA cannot hoist out of the loop (it
+    costs one extra elementwise op in a bandwidth-bound kernel).
+    """
     n_slices = int(os.environ.get("BENCH_SLICES", "64"))
-    iters = int(os.environ.get("BENCH_ITERS", "10"))
+    iters = int(os.environ.get("BENCH_ITERS", "16000"))
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -110,23 +137,30 @@ def bench_union64() -> dict:
     rng = np.random.default_rng(4)
     a = rng.integers(0, 1 << 32, size=(n_slices, WORDS_PER_SLICE), dtype=np.uint32)
     b = rng.integers(0, 1 << 32, size=(n_slices, WORDS_PER_SLICE), dtype=np.uint32)
+    masks = rng.integers(0, 1 << 32, size=(iters,), dtype=np.uint32)
 
     @jax.jit
-    def union_count(x, y):
-        return jnp.sum(lax.population_count(jnp.bitwise_or(x, y)).astype(jnp.int64))
+    def run_stream(x, y, ms):
+        def step(carry, m):
+            u = jnp.bitwise_or(jnp.bitwise_xor(x, m), y)
+            return carry, jnp.sum(lax.population_count(u).astype(jnp.int64))
+
+        return lax.scan(step, 0, ms)[1]
 
     da, db = jax.device_put(a), jax.device_put(b)
-    int(union_count(da, db))  # warm
+    dmasks = jax.device_put(masks)
+    got = np.asarray(run_stream(da, db, dmasks))  # warm + compile
     t0 = time.perf_counter()
-    for _ in range(iters):
-        got = int(union_count(da, db))
+    got = np.asarray(run_stream(da, db, dmasks))
     dt = (time.perf_counter() - t0) / iters
     from pilosa_tpu.roaring import _POPCNT8
 
+    base_iters = max(1, min(3, iters))
     t0 = time.perf_counter()
-    want = int(_POPCNT8[(a | b).view(np.uint8)].sum())
-    base_dt = time.perf_counter() - t0
-    assert got == want
+    for i in range(base_iters):
+        want = int(_POPCNT8[((a ^ masks[i]) | b).view(np.uint8)].sum())
+    base_dt = (time.perf_counter() - t0) / base_iters
+    assert got[base_iters - 1] == want
     cols_per_sec = n_slices * (1 << 20) / dt
     return {
         "metric": "union_count_cols_per_sec",
@@ -139,7 +173,7 @@ def bench_union64() -> dict:
 def bench_timerange() -> dict:
     """Config 5: time-quantum Range — OR-reduce the YMDH view cover of a
     1-year range (time.go:95-167 analog; ~15 views) then popcount."""
-    iters = int(os.environ.get("BENCH_ITERS", "10"))
+    iters = int(os.environ.get("BENCH_ITERS", "32768"))
     n_views = 15  # typical cover size for a 1-year [start, end) at YMDH
     import jax
     import jax.numpy as jnp
@@ -149,27 +183,46 @@ def bench_timerange() -> dict:
 
     rng = np.random.default_rng(5)
     views = rng.integers(0, 1 << 32, size=(n_views, WORDS_PER_SLICE), dtype=np.uint32)
+    masks = rng.integers(0, 1 << 32, size=(iters,), dtype=np.uint32)
+
+    # Scan-chained stream (see bench_union64 docstring for why): one
+    # dispatch + one host fetch for the whole stream; per-step masks keep
+    # every Range a distinct computation.  Each step evaluates a BATCH of
+    # range queries (vmapped over masks) — the executor's query-batch
+    # fusion shape — so the fixed per-step scan cost amortizes across a
+    # view cover that is otherwise only ~2 MB of HBM traffic.
+    step_batch = min(int(os.environ.get("BENCH_BATCH", "128")), iters)
+    iters -= iters % step_batch
+    masks = masks[:iters]
 
     @jax.jit
-    def range_union_count(v):
-        acc = lax.reduce(v, np.uint32(0), lax.bitwise_or, (0,))
-        return jnp.sum(lax.population_count(acc).astype(jnp.int64))
+    def run_stream(v, ms):
+        def one(m):
+            acc = lax.reduce(jnp.bitwise_xor(v, m), np.uint32(0), lax.bitwise_or, (0,))
+            return jnp.sum(lax.population_count(acc).astype(jnp.int64))
+
+        def step(carry, mrow):
+            return carry, jax.vmap(one)(mrow)
+
+        return lax.scan(step, 0, ms.reshape(-1, step_batch))[1].reshape(-1)
 
     dv = jax.device_put(views)
-    int(range_union_count(dv))
+    dmasks = jax.device_put(masks)
+    got = np.asarray(run_stream(dv, dmasks))  # warm + compile
     t0 = time.perf_counter()
-    for _ in range(iters):
-        got = int(range_union_count(dv))
+    got = np.asarray(run_stream(dv, dmasks))
     dt = (time.perf_counter() - t0) / iters
     from pilosa_tpu.roaring import _POPCNT8
 
+    base_iters = max(1, min(3, iters))
     t0 = time.perf_counter()
-    acc = views[0].copy()
-    for i in range(1, n_views):
-        acc |= views[i]
-    want = int(_POPCNT8[acc.view(np.uint8)].sum())
-    base_dt = time.perf_counter() - t0
-    assert got == want
+    for i in range(base_iters):
+        acc = views[0] ^ masks[i]
+        for j in range(1, n_views):
+            acc |= views[j] ^ masks[i]
+        want = int(_POPCNT8[acc.view(np.uint8)].sum())
+    base_dt = (time.perf_counter() - t0) / base_iters
+    assert got[base_iters - 1] == want
     return {
         "metric": "timerange_union_views_per_sec",
         "value": round(n_views / dt, 1),
@@ -192,7 +245,7 @@ def main() -> None:
     n_slices = int(os.environ.get("BENCH_SLICES", "16"))
     n_rows = int(os.environ.get("BENCH_ROWS", "64"))
     batch = int(os.environ.get("BENCH_BATCH", "256"))
-    iters = int(os.environ.get("BENCH_ITERS", "40"))
+    iters = int(os.environ.get("BENCH_ITERS", "160"))
     # Bit density ~2^-k via AND of k random words (throughput over packed
     # words is density-independent; this just keeps counts realistic).
     density_k = int(os.environ.get("BENCH_DENSITY_K", "4"))
