@@ -231,6 +231,92 @@ def bench_timerange() -> dict:
     }
 
 
+def bench_executor() -> dict:
+    """End-to-end product path: PQL text -> parser -> Executor ->
+    fused device dispatch (_fuse_count_intersect_batch) -> results.
+
+    Unlike the headline config (raw kernel throughput), this measures the
+    whole single-node product stack the way a client drives it: each
+    request is a batch of Count(Intersect(Bitmap, Bitmap)) calls in one
+    PQL string, against a Holder-backed frame whose rows live in the
+    fragment device cache after warmup.  vs_baseline compares the same
+    requests through the numpy engine (the reference-style CPU path).
+    """
+    n_slices = int(os.environ.get("BENCH_SLICES", "8"))
+    n_rows = int(os.environ.get("BENCH_ROWS", "32"))
+    batch = int(os.environ.get("BENCH_BATCH", "256"))
+    iters = int(os.environ.get("BENCH_ITERS", "20"))
+    bits_per_row = int(os.environ.get("BENCH_BITS_PER_ROW", "20000"))
+    import tempfile
+
+    from pilosa_tpu.core.frame import FrameOptions
+    from pilosa_tpu.core.holder import Holder
+    from pilosa_tpu.executor import Executor
+    from pilosa_tpu.pilosa import SLICE_WIDTH
+
+    rng = np.random.default_rng(11)
+
+    def build_query(pairs):
+        return " ".join(
+            f'Count(Intersect(Bitmap(rowID={a}, frame="f"), Bitmap(rowID={b}, frame="f")))'
+            for a, b in pairs
+        )
+
+    all_pairs = rng.integers(0, n_rows, size=(iters, batch, 2))
+    queries = [build_query(p.tolist()) for p in all_pairs]
+
+    with tempfile.TemporaryDirectory() as d:
+        h = Holder(d)
+        h.open()
+        idx = h.create_index("bench")
+        idx.create_frame("f", FrameOptions())
+        fr = idx.frame("f")
+        for s in range(n_slices):
+            rows = np.repeat(np.arange(n_rows, dtype=np.uint64), bits_per_row)
+            cols = rng.integers(0, SLICE_WIDTH, size=len(rows)).astype(
+                np.uint64
+            ) + np.uint64(s * SLICE_WIDTH)
+            fr.import_bits(rows, cols)
+
+        ex = Executor(h)
+        backend = ex.engine.name
+        ex.execute("bench", queries[0])  # warm: device cache + compile
+        # Drive like a loaded server: concurrent requests overlap parse
+        # (CPU) with device dispatch + result fetch, exactly as the
+        # threaded HTTP server does.  BENCH_THREADS=1 for pure latency.
+        n_threads = int(os.environ.get("BENCH_THREADS", "4"))
+        from concurrent.futures import ThreadPoolExecutor
+
+        t0 = time.perf_counter()
+        if n_threads > 1:
+            with ThreadPoolExecutor(n_threads) as pool:
+                outs = list(pool.map(lambda q: ex.execute("bench", q), queries))
+            out = outs[-1]
+        else:
+            for q in queries:
+                out = ex.execute("bench", q)
+        dt = time.perf_counter() - t0
+        qps = iters * batch / dt
+
+        ex_np = Executor(h, engine="numpy")
+        base_iters = max(1, min(3, iters))
+        t0 = time.perf_counter()
+        for q in queries[:base_iters]:
+            base_out = ex_np.execute("bench", q)
+        base_dt = time.perf_counter() - t0
+        base_qps = base_iters * batch / base_dt
+        # Correctness gate: the fused engine path must agree with the numpy
+        # product path on one of the timed queries.
+        assert ex.execute("bench", queries[base_iters - 1]) == base_out
+        h.close()
+    return {
+        "metric": "executor_intersect_count_qps",
+        "value": round(qps, 1),
+        "unit": f"PQL queries/sec end-to-end ({n_slices} slices, batch {batch}, engine {backend})",
+        "vs_baseline": round(qps / base_qps, 2),
+    }
+
+
 def main() -> None:
     cfg = os.environ.get("BENCH_CONFIG", "intersect_count")
     if cfg != "intersect_count":
@@ -239,6 +325,7 @@ def main() -> None:
             "topn": bench_topn,
             "union64": bench_union64,
             "timerange": bench_timerange,
+            "executor": bench_executor,
         }[cfg]()
         print(json.dumps(result))
         return

@@ -22,6 +22,7 @@ TPU-first departures from the reference:
 from __future__ import annotations
 
 import hashlib
+import itertools
 import math
 import os
 import tempfile
@@ -31,6 +32,9 @@ from dataclasses import dataclass, field
 from typing import Optional, Sequence
 
 import numpy as np
+
+# Process-global write-generation source (see Fragment.generation).
+_generation_counter = itertools.count(1)
 
 from pilosa_tpu import roaring
 from pilosa_tpu.core import cache as cache_mod
@@ -120,6 +124,13 @@ class Fragment:
         self._row_dev_cache_max = 256
         self._checksums: dict[int, bytes] = {}
         self._open = False
+        # Write generation: refreshed on every mutation from a
+        # process-global counter, so engine-side assembled row matrices
+        # (executor fused path) can validate their cache without hashing
+        # storage.  Global (not per-object) so a deleted+recreated
+        # fragment can never repeat an old fragment's generation and
+        # revive its cache entries.
+        self.generation = next(_generation_counter)
 
     # -- lifecycle (fragment.go:151-274) --------------------------------
 
@@ -253,6 +264,7 @@ class Fragment:
             return self.storage.contains(self.pos(row_id, column_id))
 
     def _on_row_mutated(self, row_id: int) -> None:
+        self.generation = next(_generation_counter)
         self._row_cache.pop(row_id, None)
         for k in [k for k in self._row_dev_cache if k[1] == row_id]:
             self._row_dev_cache.pop(k, None)
@@ -453,6 +465,7 @@ class Fragment:
             self.storage.add_many(positions)
         finally:
             self.storage.op_writer = self._wal
+        self.generation = next(_generation_counter)
         self._row_cache.clear()
         self._row_dev_cache.clear()
         self._checksums.clear()
@@ -558,6 +571,7 @@ class Fragment:
     def _read_from(self, data: bytes) -> None:
         self.storage = roaring.Bitmap.from_bytes(data)
         self.storage.op_n = 0
+        self.generation = next(_generation_counter)
         self._row_cache.clear()
         self._row_dev_cache.clear()
         self._checksums.clear()

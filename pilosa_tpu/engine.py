@@ -42,6 +42,11 @@ class NumpyEngine:
     def asarray(self, x: np.ndarray):
         return np.asarray(x)
 
+    def matrix(self, host_matrix: np.ndarray):
+        """Move a fully-assembled host row matrix [n_slices, n_rows, W]
+        into engine storage in ONE transfer (vs per-row uploads)."""
+        return host_matrix
+
     def gather_count_and(self, row_matrix, pairs) -> np.ndarray:
         """Batched Count(Intersect) over [n_slices, n_rows, W] for int32[B,2]
         row-index pairs; returns int64[B]."""
@@ -105,6 +110,10 @@ class JaxEngine:
 
     def asarray(self, x):
         return self._jnp.asarray(x)
+
+    def matrix(self, host_matrix: np.ndarray):
+        """One host→device transfer for an assembled row matrix."""
+        return self._jnp.asarray(host_matrix)
 
     def gather_count_and(self, row_matrix, pairs) -> np.ndarray:
         """Batched Count(Intersect) in ONE device dispatch (Pallas on TPU)."""
@@ -187,6 +196,10 @@ class MeshEngine(JaxEngine):
 
     def stack_slices(self, stacks: list):
         return self._shard_stack(super().stack_rows(stacks))
+
+    def matrix(self, host_matrix: np.ndarray):
+        """One sharded transfer: the slice axis lands partitioned."""
+        return self._shard_stack(host_matrix)
 
     def gather_count_and(self, row_matrix, pairs):
         # Pallas can't lower under GSPMD partitioning; the jnp form is

@@ -20,6 +20,9 @@ SetBit, ClearBit, SetRowAttrs, SetColumnAttrs.
 
 from __future__ import annotations
 
+import os
+import threading
+from collections import OrderedDict
 from dataclasses import dataclass, field
 from datetime import datetime
 from typing import Any, Optional, Sequence
@@ -118,6 +121,18 @@ class Executor:
         self.client_factory = client_factory  # host -> client with .query()
         self.host = host
         self.max_writes_per_request = max_writes_per_request
+        # Device-resident row matrices for the fused count-intersect path,
+        # keyed by (index, frame, slices) and validated by per-fragment
+        # write generations — steady-state fused requests cost zero
+        # host→device row traffic.
+        self._matrix_cache: "OrderedDict[tuple, tuple]" = OrderedDict()
+        self._matrix_mu = threading.Lock()
+        self._matrix_cache_entries = int(
+            os.environ.get("PILOSA_TPU_MATRIX_CACHE_ENTRIES", "4")
+        )
+        self._matrix_rows_max = int(
+            os.environ.get("PILOSA_TPU_MATRIX_ROWS_MAX", "1024")
+        )
 
     # -- top level (executor.go:65-153) ----------------------------------
 
@@ -292,15 +307,7 @@ class Executor:
             by_frame.setdefault(frame, []).extend((r1, r2))
         frame_matrices: dict[str, tuple[dict[int, int], object]] = {}
         for frame, ids in by_frame.items():
-            uniq = sorted(set(ids))
-            id_pos = {r: k for k, r in enumerate(uniq)}
-            per_slice = [
-                self.engine.stack_rows(
-                    [self._row_or_zeros(index, frame, s, r) for r in uniq]
-                )
-                for s in slices
-            ]
-            frame_matrices[frame] = (id_pos, self.engine.stack_slices(per_slice))
+            frame_matrices[frame] = self._frame_matrix(index, frame, slices, set(ids))
 
         out: dict[int, int] = {}
         for frame, (id_pos, matrix) in frame_matrices.items():
@@ -314,11 +321,47 @@ class Executor:
                 out[i] = int(counts[k])
         return out
 
-    def _row_or_zeros(self, index: str, frame: str, slice_i: int, row_id: int):
-        frag = self.holder.fragment(index, frame, VIEW_STANDARD, slice_i)
-        if frag is None:
-            return self.engine.asarray(np.zeros(_WORDS, dtype=np.uint32))
-        return frag.row_device(row_id, self.engine)
+    def _frame_matrix(
+        self, index: str, frame: str, slices, want: set[int]
+    ) -> tuple[dict[int, int], object]:
+        """Assembled engine row matrix [n_slices, n_rows, W] for a frame.
+
+        Cached across requests keyed by (index, frame, slices) and
+        validated against the fragments' write generations; a cache hit
+        whose row set covers ``want`` is returned as-is, so steady-state
+        fused queries re-use HBM-resident rows.  On miss the matrix is
+        assembled HOST-side and moved in one engine.matrix transfer
+        (per-row device stacking costs one dispatch per row).  Generations
+        are read BEFORE the rows: a concurrent mutation mid-assembly can
+        only make the recorded generations stale, forcing a rebuild next
+        request — never a stale hit.
+        """
+        key = (index, frame, tuple(slices))
+        frags = [self.holder.fragment(index, frame, VIEW_STANDARD, s) for s in slices]
+        gens = tuple(-1 if f is None else f.generation for f in frags)
+        with self._matrix_mu:
+            hit = self._matrix_cache.get(key)
+            fresh = hit is not None and hit[0] == gens
+            if fresh and want <= hit[1].keys():
+                self._matrix_cache.move_to_end(key)
+                return hit[1], hit[2]
+        rows = sorted(want | hit[1].keys()) if fresh else sorted(want)
+        if len(rows) > self._matrix_rows_max and len(want) <= self._matrix_rows_max:
+            rows = sorted(want)  # stop growing the union; keep serving the request
+        id_pos = {r: k for k, r in enumerate(rows)}
+        host = np.zeros((len(slices), len(rows), _WORDS), dtype=np.uint32)
+        for si, f in enumerate(frags):
+            if f is None:
+                continue
+            for k, r in enumerate(rows):
+                host[si, k] = f.row_dense(r)
+        matrix = self.engine.matrix(host)
+        with self._matrix_mu:
+            self._matrix_cache[key] = (gens, id_pos, matrix)
+            self._matrix_cache.move_to_end(key)
+            while len(self._matrix_cache) > self._matrix_cache_entries:
+                self._matrix_cache.popitem(last=False)
+        return id_pos, matrix
 
     # -- call dispatch (executor.go:156-179) ------------------------------
 

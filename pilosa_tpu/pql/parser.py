@@ -56,42 +56,50 @@ _TOKEN_RE = re.compile(
 )
 
 
-@dataclass(frozen=True)
+@dataclass(frozen=True, slots=True)
 class Token:
     kind: str
     lit: str
-    line: int
-    char: int
+    pos: int  # byte offset into the source; line/char derived on error
+
+
+_UNESCAPE_RE = re.compile(r"\\(.)")
+
+
+def _line_char(src: str, pos: int) -> tuple[int, int]:
+    """Derive (line, char) from a source offset.  Position bookkeeping is
+    deferred to error paths so the tokenize hot loop (thousands of tokens
+    per batched query request) does no per-token arithmetic."""
+    line = src.count("\n", 0, pos) + 1
+    char = pos - (src.rfind("\n", 0, pos) + 1)
+    return line, char
 
 
 def tokenize(src: str) -> list[Token]:
     tokens: list[Token] = []
-    line, char = 1, 0
+    append = tokens.append
     for m in _TOKEN_RE.finditer(src):
         kind = m.lastgroup
-        lit = m.group()
-        tline, tchar = line, char
-        nl = lit.count("\n")
-        if nl:
-            line += nl
-            char = len(lit) - lit.rfind("\n") - 1
-        else:
-            char += len(lit)
         if kind == "WS":
             continue
+        lit = m.group()
         if kind == "ILLEGAL":
-            raise ParseError(f"illegal character {lit!r}", tline, tchar)
+            raise ParseError(f"illegal character {lit!r}", *_line_char(src, m.start()))
         if kind == "STRING":
-            lit = re.sub(r"\\(.)", r"\1", lit[1:-1])
-        tokens.append(Token(kind, lit, tline, tchar))
-    tokens.append(Token("EOF", "", line, char))
+            lit = _UNESCAPE_RE.sub(r"\1", lit[1:-1])
+        append(Token(kind, lit, m.start()))
+    append(Token("EOF", "", len(src)))
     return tokens
 
 
 class _Parser:
-    def __init__(self, tokens: list[Token]):
+    def __init__(self, tokens: list[Token], src: str = ""):
         self.tokens = tokens
+        self.src = src
         self.i = 0
+
+    def fail(self, message: str, t: Token):
+        raise ParseError(message, *_line_char(self.src, t.pos))
 
     def peek(self) -> Token:
         return self.tokens[self.i]
@@ -105,7 +113,7 @@ class _Parser:
     def expect(self, kind: str) -> Token:
         t = self.next()
         if t.kind != kind:
-            raise ParseError(f"expected {kind}, found {t.lit!r}", t.line, t.char)
+            self.fail(f"expected {kind}, found {t.lit!r}", t)
         return t
 
     def parse_query(self) -> Query:
@@ -117,9 +125,7 @@ class _Parser:
     def parse_call(self) -> Call:
         name_tok = self.next()
         if name_tok.kind != "IDENT":
-            raise ParseError(
-                f"expected identifier, found: {name_tok.lit!r}", name_tok.line, name_tok.char
-            )
+            self.fail(f"expected identifier, found: {name_tok.lit!r}", name_tok)
         self.expect("LPAREN")
         children = self.parse_children()
         args: dict[str, Any] = {}
@@ -162,18 +168,16 @@ class _Parser:
             key_tok = self.expect("IDENT")
             eq = self.next()
             if eq.kind != "EQ":
-                raise ParseError(f"expected equals sign, found {eq.lit!r}", eq.line, eq.char)
+                self.fail(f"expected equals sign, found {eq.lit!r}", eq)
             value = self.parse_value()
             if key_tok.lit in args:
-                raise ParseError(
-                    f"argument key already used: {key_tok.lit}", key_tok.line, key_tok.char
-                )
+                self.fail(f"argument key already used: {key_tok.lit}", key_tok)
             args[key_tok.lit] = value
             t = self.peek()
             if t.kind == "RPAREN":
                 return args
             if t.kind != "COMMA":
-                raise ParseError(f"expected comma or right paren, found {t.lit!r}", t.line, t.char)
+                self.fail(f"expected comma or right paren, found {t.lit!r}", t)
             self.next()
 
     def parse_value(self, in_list: bool = False) -> Any:
@@ -200,9 +204,9 @@ class _Parser:
                 if sep.kind == "RBRACK":
                     return values
                 if sep.kind != "COMMA":
-                    raise ParseError(f"expected comma, found {sep.lit!r}", sep.line, sep.char)
-        raise ParseError(f"invalid argument value: {t.lit!r}", t.line, t.char)
+                    self.fail(f"expected comma, found {sep.lit!r}", sep)
+        self.fail(f"invalid argument value: {t.lit!r}", t)
 
 
 def parse(src: str) -> Query:
-    return _Parser(tokenize(src)).parse_query()
+    return _Parser(tokenize(src), src).parse_query()

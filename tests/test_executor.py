@@ -363,3 +363,43 @@ def test_set_bit_batch_bad_timestamp_partial_commit(env):
     with pytest.raises(ValueError):
         e.execute("i", q)
     assert e.execute("i", 'Count(Bitmap(rowID=1, frame="f"))') == [1]
+
+
+def test_fused_matrix_cache_survives_frame_recreate(env):
+    """The fused-path row-matrix cache must not serve a deleted frame's
+    data after the frame is recreated with a mutation history that lands
+    on a look-alike state (generations are process-global, so an object
+    swap can never repeat a cached generation tuple)."""
+    h, e = env
+    idx = h.index("i")
+    fr = idx.frame("general")
+    for c in range(10):
+        fr.set_bit("standard", 0, c)
+        fr.set_bit("standard", 1, c)
+    q = " ".join(
+        ['Count(Intersect(Bitmap(rowID=0, frame="general"), Bitmap(rowID=1, frame="general")))'] * 2
+    )
+    assert e.execute("i", q) == [10, 10]  # populates the matrix cache
+    idx.delete_frame("general")
+    idx.create_frame("general", FrameOptions())
+    fr2 = idx.frame("general")
+    for c in range(10):
+        fr2.set_bit("standard", 0, c)
+    fr2.set_bit("standard", 1, 0)
+    assert e.execute("i", q) == [1, 1]
+
+
+def test_fused_matrix_cache_sees_writes(env):
+    """Mutations between fused requests invalidate the cached matrix."""
+    h, e = env
+    fr = h.index("i").frame("general")
+    for c in range(5):
+        fr.set_bit("standard", 0, c)
+        fr.set_bit("standard", 1, c)
+    q = " ".join(
+        ['Count(Intersect(Bitmap(rowID=0, frame="general"), Bitmap(rowID=1, frame="general")))'] * 2
+    )
+    assert e.execute("i", q) == [5, 5]
+    e.execute("i", 'SetBit(rowID=0, frame="general", columnID=100) '
+                   'SetBit(rowID=1, frame="general", columnID=100)')
+    assert e.execute("i", q) == [6, 6]
