@@ -33,9 +33,6 @@ from typing import Optional, Sequence
 
 import numpy as np
 
-# Process-global write-generation source (see Fragment.generation).
-_generation_counter = itertools.count(1)
-
 from pilosa_tpu import roaring
 from pilosa_tpu.core import cache as cache_mod
 from pilosa_tpu.ops import bitwise as bw
@@ -50,6 +47,9 @@ DEFAULT_MAX_OPN = 2000
 DEFAULT_CACHE_SIZE = 50000
 
 _WORDS = SLICE_WIDTH // 32
+
+# Process-global write-generation source (see Fragment.generation).
+_generation_counter = itertools.count(1)
 
 # Magic header for the sidecar .cache file (row-id list persisted so ranked
 # caches can be rebuilt by recount on open; fragment.go:236-274, 1073-1093).

@@ -79,6 +79,17 @@ class NumpyEngine:
     def batch_intersection_count(self, rows, src) -> np.ndarray:
         return self.count(rows & src)
 
+    def update_slices(self, matrix, slice_idxs, planes):
+        """Functionally replace whole slice planes of a row matrix
+        (incremental refresh of a cached matrix after writes)."""
+        out = matrix.copy()
+        out[list(slice_idxs)] = planes
+        return out
+
+    def append_rows(self, matrix, block):
+        """Append new rows (axis 1) to a row matrix: [S, R, W] + [S, R', W]."""
+        return np.concatenate([matrix, block], axis=1)
+
     def to_numpy(self, x) -> np.ndarray:
         return np.asarray(x)
 
@@ -145,6 +156,16 @@ class JaxEngine:
     def batch_intersection_count(self, rows, src) -> np.ndarray:
         return np.asarray(self._dispatch.batch_intersection_count(rows, src)).astype(np.int64)
 
+    def update_slices(self, matrix, slice_idxs, planes):
+        """Replace stale slice planes on-device: uploads only the changed
+        planes and patches HBM→HBM instead of re-transferring the matrix."""
+        idx = self._jnp.asarray(np.asarray(slice_idxs, dtype=np.int32))
+        return matrix.at[idx].set(self._jnp.asarray(planes))
+
+    def append_rows(self, matrix, block):
+        """Device-side concat of new rows: only the new block crosses PCIe."""
+        return self._jnp.concatenate([matrix, self._jnp.asarray(block)], axis=1)
+
     def to_numpy(self, x) -> np.ndarray:
         return np.asarray(x)
 
@@ -200,6 +221,20 @@ class MeshEngine(JaxEngine):
     def matrix(self, host_matrix: np.ndarray):
         """One sharded transfer: the slice axis lands partitioned."""
         return self._shard_stack(host_matrix)
+
+    def _repin(self, out, like):
+        # Scatter/concat along or around the sharded slice axis may leave
+        # the result replicated; pin it back to the source's sharding.
+        sharding = getattr(like, "sharding", None)
+        if sharding is not None:
+            out = self._jax.device_put(out, sharding)
+        return out
+
+    def update_slices(self, matrix, slice_idxs, planes):
+        return self._repin(super().update_slices(matrix, slice_idxs, planes), matrix)
+
+    def append_rows(self, matrix, block):
+        return self._repin(super().append_rows(matrix, block), matrix)
 
     def gather_count_and(self, row_matrix, pairs):
         # Pallas can't lower under GSPMD partitioning; the jnp form is

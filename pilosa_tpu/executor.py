@@ -341,26 +341,65 @@ class Executor:
         gens = tuple(-1 if f is None else f.generation for f in frags)
         with self._matrix_mu:
             hit = self._matrix_cache.get(key)
-            fresh = hit is not None and hit[0] == gens
-            if fresh and want <= hit[1].keys():
-                self._matrix_cache.move_to_end(key)
-                return hit[1], hit[2]
-        rows = sorted(want | hit[1].keys()) if fresh else sorted(want)
-        if len(rows) > self._matrix_rows_max and len(want) <= self._matrix_rows_max:
-            rows = sorted(want)  # stop growing the union; keep serving the request
+            if hit is not None:
+                old_gens, old_id_pos, old_matrix = hit
+                stale = [si for si in range(len(slices)) if old_gens[si] != gens[si]]
+                covered = want <= old_id_pos.keys()
+                if not stale and covered:
+                    self._matrix_cache.move_to_end(key)
+                    return old_id_pos, old_matrix
+            else:
+                old_gens = old_id_pos = old_matrix = None
+
+        def densify(f, row_ids):
+            block = np.zeros((len(row_ids), _WORDS), dtype=np.uint32)
+            if f is not None:
+                for k, r in enumerate(row_ids):
+                    block[k] = f.row_dense(r)
+            return block
+
+        # Incremental refresh paths: a cached matrix is only patched, never
+        # rebuilt, when (a) writes touched a subset of slices (stale slice
+        # planes re-densified and scattered in place — one SetBit no longer
+        # costs a full matrix re-upload) and/or (b) the request references
+        # new rows (appended as a device-side concat).  Generations were
+        # read BEFORE any rows, so a concurrent mutation mid-refresh can
+        # only make the stored generations stale — never a stale hit.
+        if old_id_pos is not None:
+            ordered = sorted(old_id_pos, key=old_id_pos.get)
+            new_rows = sorted(want - old_id_pos.keys())
+            if len(ordered) + len(new_rows) <= self._matrix_rows_max:
+                matrix = old_matrix
+                if stale:
+                    planes = np.stack([densify(frags[si], ordered) for si in stale])
+                    matrix = self.engine.update_slices(matrix, stale, planes)
+                if new_rows:
+                    block = np.stack([densify(f, new_rows) for f in frags])
+                    matrix = self.engine.append_rows(matrix, block)
+                id_pos = dict(old_id_pos)
+                for r in new_rows:
+                    id_pos[r] = len(id_pos)
+                with self._matrix_mu:
+                    self._matrix_cache[key] = (gens, id_pos, matrix)
+                    self._matrix_cache.move_to_end(key)
+                    while len(self._matrix_cache) > self._matrix_cache_entries:
+                        self._matrix_cache.popitem(last=False)
+                return id_pos, matrix
+
+        # Full build.  Oversized row sets are served but never cached: one
+        # giant request must not pin rows_max-violating HBM in the LRU.
+        rows = sorted(want)
         id_pos = {r: k for k, r in enumerate(rows)}
-        host = np.zeros((len(slices), len(rows), _WORDS), dtype=np.uint32)
-        for si, f in enumerate(frags):
-            if f is None:
-                continue
-            for k, r in enumerate(rows):
-                host[si, k] = f.row_dense(r)
+        host = np.stack([densify(f, rows) for f in frags]) if rows else np.zeros(
+            (len(slices), 0, _WORDS), dtype=np.uint32
+        )
         matrix = self.engine.matrix(host)
-        with self._matrix_mu:
-            self._matrix_cache[key] = (gens, id_pos, matrix)
-            self._matrix_cache.move_to_end(key)
-            while len(self._matrix_cache) > self._matrix_cache_entries:
-                self._matrix_cache.popitem(last=False)
+        if len(rows) <= self._matrix_rows_max:
+            with self._matrix_mu:
+                self._matrix_cache[key] = (gens, id_pos, matrix)
+                self._matrix_cache.move_to_end(key)
+                while len(self._matrix_cache) > self._matrix_cache_entries:
+                    self._matrix_cache.popitem(last=False)
         return id_pos, matrix
 
     # -- call dispatch (executor.go:156-179) ------------------------------

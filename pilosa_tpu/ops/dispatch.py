@@ -18,10 +18,12 @@ import jax
 
 from pilosa_tpu.ops import bitwise
 from pilosa_tpu.ops.pallas_kernels import (
+    _resident_chunk_sub,
     _tileable,
     fused_count1,
     fused_count2,
     fused_gather_count2,
+    fused_resident_count2,
 )
 
 
@@ -73,6 +75,12 @@ def gather_count_and(row_matrix, pairs):
     """Batched Count(Intersect(...)) over a [n_slices, n_rows, W] row
     matrix for int32[B, 2] row-id pairs — the headline query hot path."""
     if use_pallas() and _tileable(row_matrix.shape[-1]):
+        n_slices, n_rows, w = row_matrix.shape
+        # Resident kernel wins whenever streaming ALL rows once beats
+        # gathering 2 rows per query (R < 2B) and an all-rows chunk fits
+        # the VMEM budget; otherwise fall back to the per-query gather.
+        if n_rows < 2 * pairs.shape[0] and _resident_chunk_sub(n_rows, w, pairs.shape[0]):
+            return fused_resident_count2("and", row_matrix, pairs)
         return fused_gather_count2("and", row_matrix, pairs)
     return bitwise.gather_count_and(row_matrix, pairs)
 

@@ -403,3 +403,63 @@ def test_fused_matrix_cache_sees_writes(env):
     e.execute("i", 'SetBit(rowID=0, frame="general", columnID=100) '
                    'SetBit(rowID=1, frame="general", columnID=100)')
     assert e.execute("i", q) == [6, 6]
+
+
+@pytest.mark.parametrize("engine", ["numpy", "jax", "mesh"])
+def test_fused_matrix_incremental_refresh(tmp_path, engine):
+    """The cached matrix is patched per-slice after writes and extended
+    per-row for new rowIDs, staying correct across both paths — on both
+    the numpy and jax (device scatter/concat) engines."""
+    h = Holder(str(tmp_path / "data"))
+    h.open()
+    idx = h.create_index("i")
+    idx.create_frame("general", FrameOptions())
+    e = Executor(h, engine=engine)
+    fr = h.index("i").frame("general")
+    # Two slices, rows 0/1 in both.
+    for base in (0, SLICE_WIDTH):
+        for c in range(5):
+            fr.set_bit("standard", 0, base + c)
+            fr.set_bit("standard", 1, base + c)
+    q01 = " ".join(
+        ['Count(Intersect(Bitmap(rowID=0, frame="general"), Bitmap(rowID=1, frame="general")))'] * 2
+    )
+    assert e.execute("i", q01) == [10, 10]  # seeds the cache
+    # Write to slice 1 only -> patch path (stale plane re-densified).
+    fr.set_bit("standard", 0, SLICE_WIDTH + 100)
+    fr.set_bit("standard", 1, SLICE_WIDTH + 100)
+    assert e.execute("i", q01) == [11, 11]
+    # New rows in the same frame -> append path.
+    fr.set_bit("standard", 7, 0)
+    fr.set_bit("standard", 8, 0)
+    q78 = " ".join(
+        ['Count(Intersect(Bitmap(rowID=7, frame="general"), Bitmap(rowID=8, frame="general")))'] * 2
+    )
+    assert e.execute("i", q78) == [1, 1]
+    # Patched + appended entry still serves the original rows correctly.
+    assert e.execute("i", q01) == [11, 11]
+    h.close()
+
+
+def test_fused_matrix_oversized_not_cached(env):
+    """A single request whose row set exceeds the cap is served but must
+    not pin an oversized matrix in the LRU cache."""
+    h, e = env
+    fr = h.index("i").frame("general")
+    e._matrix_rows_max = 4
+    for r in range(8):
+        fr.set_bit("standard", r, r)
+        fr.set_bit("standard", r, 100)
+    q = " ".join(
+        f'Count(Intersect(Bitmap(rowID={r}, frame="general"), Bitmap(rowID={(r + 1) % 8}, frame="general")))'
+        for r in range(8)
+    )
+    assert e.execute("i", q) == [1] * 8
+    assert len(e._matrix_cache) == 0  # oversized -> not cached
+    # A small request afterwards is cached as usual.
+    small = (
+        'Count(Intersect(Bitmap(rowID=0, frame="general"), Bitmap(rowID=1, frame="general"))) '
+        'Count(Intersect(Bitmap(rowID=2, frame="general"), Bitmap(rowID=3, frame="general")))'
+    )
+    assert e.execute("i", small) == [1, 1]
+    assert len(e._matrix_cache) == 1

@@ -158,6 +158,8 @@ def test_pallas_kernels_on_tpu(rng):
         [sum(bw.np_count_and(rm[s, p0], rm[s, p1]) for s in range(2)) for p0, p1 in pairs]
     )
     np.testing.assert_array_equal(got_g, want_g)
+    got_r = np.asarray(pk.fused_resident_count2("and", jnp.asarray(rm), jnp.asarray(pairs)))
+    np.testing.assert_array_equal(got_r, want_g)
 
 
 def test_validate_names():
