@@ -198,3 +198,233 @@ int64_t pn_parse_csv(const char* buf, size_t len, uint64_t* rows, uint64_t* cols
 }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------------
+// PQL fast-path parser (pql/parser.py hot loop for batched query bodies)
+//
+// Parses the common grammar subset straight into a flat PREORDER call
+// tree: per call (name span, n_children, n_args, arg offset); per arg
+// (key span, value type, int value or string span).  Anything outside
+// the subset (floats, [lists], escaped strings, >18-digit ints,
+// duplicate keys, or any syntax error) returns PN_PQL_FALLBACK and the
+// caller re-parses with the full Python parser, keeping semantics and
+// error messages identical to the slow path.
+// ---------------------------------------------------------------------------
+
+enum {
+    PN_PQL_FALLBACK = -1,
+    // arg value types
+    PN_V_INT = 0,
+    PN_V_STRING = 1,   // quoted, no escapes; span excludes quotes
+    PN_V_IDENT = 2,    // bare identifier -> string
+    PN_V_TRUE = 3,
+    PN_V_FALSE = 4,
+    PN_V_NULL = 5,
+};
+
+namespace {
+
+struct PqlOut {
+    int32_t* cname_s;
+    int32_t* cname_e;
+    int32_t* cnchild;
+    int32_t* cnargs;
+    int32_t* cargs_off;
+    int64_t call_cap;
+    int32_t* ak_s;
+    int32_t* ak_e;
+    int32_t* atype;
+    int64_t* aint;
+    int32_t* av_s;
+    int32_t* av_e;
+    int64_t arg_cap;
+};
+
+// C++-stack recursion bound for call(): deeper nesting falls back to the
+// Python parser (which raises a survivable RecursionError) instead of
+// overflowing the native stack.
+static const int PN_PQL_MAX_DEPTH = 100;
+
+struct PqlParser {
+    const char* s;
+    int64_t len;
+    int64_t i;
+    PqlOut* out;
+    int64_t n_calls;
+    int64_t n_args;
+    int depth;
+
+    bool ws() {
+        while (i < len) {
+            char c = s[i];
+            if (c == ' ' || c == '\t' || c == '\n' || c == '\r' || c == '\f' || c == '\v')
+                i++;
+            else
+                break;
+        }
+        return i < len;
+    }
+    static bool alpha(char c) { return (c >= 'A' && c <= 'Z') || (c >= 'a' && c <= 'z'); }
+    static bool identc(char c) {
+        return alpha(c) || (c >= '0' && c <= '9') || c == '_' || c == '.' || c == '-';
+    }
+    static bool digit(char c) { return c >= '0' && c <= '9'; }
+
+    // Returns false to trigger fallback.
+    bool ident(int32_t* s_out, int32_t* e_out) {
+        if (i >= len || !alpha(s[i])) return false;
+        int64_t b = i++;
+        while (i < len && identc(s[i])) i++;
+        *s_out = (int32_t)b;
+        *e_out = (int32_t)i;
+        return true;
+    }
+
+    bool call() {
+        if (n_calls >= out->call_cap || depth >= PN_PQL_MAX_DEPTH) return false;
+        depth++;
+        bool ok = call_inner();
+        depth--;
+        return ok;
+    }
+
+    bool call_inner() {
+        int64_t me = n_calls++;
+        if (!ident(&out->cname_s[me], &out->cname_e[me])) return false;
+        if (!ws() || s[i] != '(') return false;
+        i++;
+        // children: IDENT '(' lookahead
+        int32_t nchild = 0;
+        for (;;) {
+            if (!ws()) return false;
+            int64_t save = i;
+            int32_t ts_, te_;
+            if (ident(&ts_, &te_) && ws() && s[i] == '(') {
+                i = save;
+                if (!call()) return false;
+                nchild++;
+                if (!ws()) return false;
+                if (s[i] == ',') {
+                    i++;
+                    if (!ws()) return false;
+                    int64_t save3 = i;
+                    int32_t us_, ue_;
+                    if (ident(&us_, &ue_) && ws() && s[i] == '(') {
+                        i = save3;  // another child follows; comma consumed
+                        continue;
+                    }
+                    i = save3;  // cursor after comma: args begin here
+                }
+                break;
+            }
+            i = save;
+            break;
+        }
+        out->cnchild[me] = nchild;
+        // args
+        out->cargs_off[me] = (int32_t)n_args;
+        int32_t nargs = 0;
+        if (!ws()) return false;
+        while (s[i] != ')') {
+            if (n_args >= out->arg_cap) return false;
+            int64_t a = n_args;
+            if (!ident(&out->ak_s[a], &out->ak_e[a])) return false;
+            // duplicate key check (args per call are few; O(n^2) is fine)
+            for (int64_t p = out->cargs_off[me]; p < a; p++) {
+                int32_t la = out->ak_e[a] - out->ak_s[a];
+                int32_t lp = out->ak_e[p] - out->ak_s[p];
+                if (la == lp && memcmp(s + out->ak_s[a], s + out->ak_s[p], (size_t)la) == 0)
+                    return false;
+            }
+            if (!ws() || s[i] != '=') return false;
+            i++;
+            if (!value(a)) return false;
+            n_args++;
+            nargs++;
+            if (!ws()) return false;
+            if (s[i] == ',') {
+                i++;
+                if (!ws()) return false;
+                continue;
+            }
+            if (s[i] != ')') return false;
+        }
+        i++;  // consume ')'
+        out->cnargs[me] = nargs;
+        return true;
+    }
+
+    bool value(int64_t a) {
+        if (!ws()) return false;
+        char c = s[i];
+        if (c == '"' || c == '\'') {
+            int64_t b = ++i;
+            while (i < len && s[i] != c) {
+                if (s[i] == '\\') return false;  // escapes -> fallback
+                i++;
+            }
+            if (i >= len) return false;  // unterminated
+            out->atype[a] = PN_V_STRING;
+            out->av_s[a] = (int32_t)b;
+            out->av_e[a] = (int32_t)i;
+            i++;
+            return true;
+        }
+        if (c == '-' || digit(c)) {
+            int64_t b = i;
+            if (c == '-') i++;
+            int64_t dstart = i;
+            while (i < len && digit(s[i])) i++;
+            if (i == dstart) return false;            // bare '-'
+            if (i - dstart > 18) return false;        // huge int -> fallback
+            if (i < len && s[i] == '.') return false; // float -> fallback
+            int64_t v = 0;
+            for (int64_t p = dstart; p < i; p++) v = v * 10 + (s[p] - '0');
+            if (b != dstart) v = -v;
+            out->atype[a] = PN_V_INT;
+            out->aint[a] = v;
+            return true;
+        }
+        if (c == '[') return false;  // list -> fallback
+        int32_t vs, ve;
+        if (!ident(&vs, &ve)) return false;
+        int32_t l = ve - vs;
+        if (l == 4 && memcmp(s + vs, "true", 4) == 0)
+            out->atype[a] = PN_V_TRUE;
+        else if (l == 5 && memcmp(s + vs, "false", 5) == 0)
+            out->atype[a] = PN_V_FALSE;
+        else if (l == 4 && memcmp(s + vs, "null", 4) == 0)
+            out->atype[a] = PN_V_NULL;
+        else {
+            out->atype[a] = PN_V_IDENT;
+            out->av_s[a] = vs;
+            out->av_e[a] = ve;
+        }
+        return true;
+    }
+};
+
+}  // namespace
+
+extern "C" {
+
+// Returns the number of calls parsed (preorder), or PN_PQL_FALLBACK when
+// the source needs the full Python parser.  n_args_out gets the total
+// arg-slot count on success.
+int64_t pn_pql_parse(const char* src, int64_t len,
+                     int32_t* cname_s, int32_t* cname_e, int32_t* cnchild,
+                     int32_t* cnargs, int32_t* cargs_off, int64_t call_cap,
+                     int32_t* ak_s, int32_t* ak_e, int32_t* atype,
+                     int64_t* aint, int32_t* av_s, int32_t* av_e,
+                     int64_t arg_cap, int64_t* n_args_out) {
+    PqlOut out = {cname_s, cname_e, cnchild, cnargs, cargs_off, call_cap,
+                  ak_s, ak_e, atype, aint, av_s, av_e, arg_cap};
+    PqlParser p = {src, len, 0, &out, 0, 0, 0};
+    while (p.ws()) {
+        if (!p.call()) return PN_PQL_FALLBACK;
+    }
+    *n_args_out = p.n_args;
+    return p.n_calls;
+}
+
+}  // extern "C"

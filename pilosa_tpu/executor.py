@@ -144,7 +144,7 @@ class Executor:
         opt: Optional[ExecOptions] = None,
     ) -> list[Any]:
         if isinstance(query, str):
-            query = pql.parse(query)
+            query = pql.parse_cached(query)
         if not query.calls:
             raise ErrQueryRequired("query required")
         if self.max_writes_per_request and query.write_call_n() > self.max_writes_per_request:

@@ -75,6 +75,14 @@ def load() -> Optional[ctypes.CDLL]:
         lib.pn_oplog_decode.argtypes = [u8p, ctypes.c_size_t, u8p, u64p]
         lib.pn_parse_csv.restype = ctypes.c_int64
         lib.pn_parse_csv.argtypes = [ctypes.c_char_p, ctypes.c_size_t, u64p, u64p, i64p, ctypes.c_size_t]
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        lib.pn_pql_parse.restype = ctypes.c_int64
+        lib.pn_pql_parse.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64,
+            i32p, i32p, i32p, i32p, i32p, ctypes.c_int64,
+            i32p, i32p, i32p, i64p, i32p, i32p,
+            ctypes.c_int64, i64p,
+        ]
         _lib = lib
         return _lib
 
@@ -240,6 +248,53 @@ def parse_csv(data: bytes) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
         np.array(rows_l, dtype=np.uint64),
         np.array(cols_l, dtype=np.uint64),
         np.array(ts_l, dtype=np.int64),
+    )
+
+
+def pql_parse_flat(src: bytes):
+    """Native PQL fast path: parse a query body into flat preorder arrays.
+
+    Returns None when the library is unavailable or the source needs the
+    full Python parser (floats, lists, escapes, any syntax error — the
+    caller falls back, keeping error messages identical).  On success
+    returns (n_calls, cname_s, cname_e, cnchild, cnargs, cargs_off,
+    n_args, ak_s, ak_e, atype, aint, av_s, av_e) — all spans are byte
+    offsets into ``src``.
+    """
+    lib = load()
+    if lib is None or not src:
+        return None
+    call_cap = len(src) // 3 + 2
+    arg_cap = len(src) // 3 + 2
+    i32 = ctypes.POINTER(ctypes.c_int32)
+    cname_s = np.empty(call_cap, dtype=np.int32)
+    cname_e = np.empty(call_cap, dtype=np.int32)
+    cnchild = np.empty(call_cap, dtype=np.int32)
+    cnargs = np.empty(call_cap, dtype=np.int32)
+    cargs_off = np.empty(call_cap, dtype=np.int32)
+    ak_s = np.empty(arg_cap, dtype=np.int32)
+    ak_e = np.empty(arg_cap, dtype=np.int32)
+    atype = np.empty(arg_cap, dtype=np.int32)
+    aint = np.empty(arg_cap, dtype=np.int64)
+    av_s = np.empty(arg_cap, dtype=np.int32)
+    av_e = np.empty(arg_cap, dtype=np.int32)
+    n_args_out = ctypes.c_int64(0)
+
+    def p(a):
+        return a.ctypes.data_as(i32)
+
+    n = lib.pn_pql_parse(
+        src, len(src),
+        p(cname_s), p(cname_e), p(cnchild), p(cnargs), p(cargs_off), call_cap,
+        p(ak_s), p(ak_e), p(atype),
+        aint.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), p(av_s), p(av_e),
+        arg_cap, ctypes.byref(n_args_out),
+    )
+    if n < 0:
+        return None
+    return (
+        int(n), cname_s, cname_e, cnchild, cnargs, cargs_off,
+        int(n_args_out.value), ak_s, ak_e, atype, aint, av_s, av_e,
     )
 
 

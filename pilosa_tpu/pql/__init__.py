@@ -11,4 +11,4 @@ are whitespace-separated call trees like::
 """
 
 from pilosa_tpu.pql.ast import Call, Query, TIME_FORMAT  # noqa: F401
-from pilosa_tpu.pql.parser import ParseError, parse  # noqa: F401
+from pilosa_tpu.pql.parser import ParseError, parse, parse_cached  # noqa: F401

@@ -23,8 +23,9 @@ Python-native shape) rather than a rune scanner with unread stacks.
 from __future__ import annotations
 
 import re
-from dataclasses import dataclass
-from typing import Any, Optional
+import threading
+from collections import OrderedDict
+from typing import Any, NamedTuple
 
 from pilosa_tpu.pql.ast import Call, Query
 
@@ -56,8 +57,7 @@ _TOKEN_RE = re.compile(
 )
 
 
-@dataclass(frozen=True, slots=True)
-class Token:
+class Token(NamedTuple):
     kind: str
     lit: str
     pos: int  # byte offset into the source; line/char derived on error
@@ -208,5 +208,94 @@ class _Parser:
         self.fail(f"invalid argument value: {t.lit!r}", t)
 
 
+_NATIVE_VALUES = {3: True, 4: False, 5: None}  # PN_V_TRUE/FALSE/NULL
+
+
+def _parse_native(src: str):
+    """Native C++ fast path (native/pilosa_native.cpp pn_pql_parse): the
+    flat preorder call tree is rebuilt into Call objects here.  Returns
+    None whenever the source needs the slow path — unsupported constructs
+    OR any syntax error, so error messages always come from the Python
+    parser and are byte-identical with or without the .so."""
+    from pilosa_tpu import native
+
+    try:
+        raw = src.encode("utf-8")
+    except UnicodeEncodeError:
+        return None
+    flat = native.pql_parse_flat(raw)
+    if flat is None:
+        return None
+    (n, cname_s, cname_e, cnchild, cnargs, cargs_off,
+     n_args, ak_s, ak_e, atype, aint, av_s, av_e) = flat
+    # Slice to the used prefixes before tolist: the arrays are allocated at
+    # source-length capacity, far larger than the parsed counts.
+    cname_s = cname_s[:n].tolist()
+    cname_e = cname_e[:n].tolist()
+    cnchild = cnchild[:n].tolist()
+    cnargs = cnargs[:n].tolist()
+    cargs_off = cargs_off[:n].tolist()
+    ak_s, ak_e = ak_s[:n_args].tolist(), ak_e[:n_args].tolist()
+    atype, aint = atype[:n_args].tolist(), aint[:n_args].tolist()
+    av_s, av_e = av_s[:n_args].tolist(), av_e[:n_args].tolist()
+
+    def build(i: int) -> tuple[Call, int]:
+        children = []
+        j = i + 1
+        for _ in range(cnchild[i]):
+            child, j = build(j)
+            children.append(child)
+        args: dict[str, Any] = {}
+        off = cargs_off[i]
+        for a in range(off, off + cnargs[i]):
+            t = atype[a]
+            if t == 0:
+                v: Any = aint[a]
+            elif t in (1, 2):
+                v = raw[av_s[a]:av_e[a]].decode("utf-8")
+            else:
+                v = _NATIVE_VALUES[t]
+            args[raw[ak_s[a]:ak_e[a]].decode("utf-8")] = v
+        return Call(name=raw[cname_s[i]:cname_e[i]].decode("utf-8"), args=args, children=children), j
+
+    calls = []
+    i = 0
+    while i < n:
+        call, i = build(i)
+        calls.append(call)
+    return Query(calls=calls)
+
+
 def parse(src: str) -> Query:
+    q = _parse_native(src)
+    if q is not None:
+        return q
     return _Parser(tokenize(src), src).parse_query()
+
+
+# Prepared-query cache: dashboards and importers re-send identical PQL
+# request bodies; parsing is the dominant host cost of a large batched
+# request, so identical sources hit a process-wide LRU.  Safe to share
+# because the executor never mutates a parsed AST in place (TopN phase 2
+# goes through Call.clone, executor analog of ast.go Clone).
+_PARSE_CACHE: "OrderedDict[str, Query]" = OrderedDict()
+_PARSE_MU = threading.Lock()
+_PARSE_CACHE_ENTRIES = 512
+_PARSE_CACHE_MAX_LEN = 1 << 16  # don't pin megabyte import bodies
+
+
+def parse_cached(src: str) -> Query:
+    if len(src) > _PARSE_CACHE_MAX_LEN:
+        return parse(src)
+    with _PARSE_MU:
+        q = _PARSE_CACHE.get(src)
+        if q is not None:
+            _PARSE_CACHE.move_to_end(src)
+            return q
+    q = parse(src)
+    with _PARSE_MU:
+        _PARSE_CACHE[src] = q
+        _PARSE_CACHE.move_to_end(src)
+        while len(_PARSE_CACHE) > _PARSE_CACHE_ENTRIES:
+            _PARSE_CACHE.popitem(last=False)
+    return q

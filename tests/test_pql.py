@@ -104,3 +104,87 @@ def test_clone_and_str_roundtrip():
     assert q2.calls[0].name == "TopN"
     assert q2.calls[0].args["n"] == 2
     assert q2.calls[0].children[0].name == "Bitmap"
+
+
+def _ast_eq(a, b):
+    if isinstance(a, Query):
+        return isinstance(b, Query) and len(a.calls) == len(b.calls) and all(
+            _ast_eq(x, y) for x, y in zip(a.calls, b.calls)
+        )
+    return (
+        a.name == b.name
+        and a.args == b.args
+        and all(type(a.args[k]) is type(b.args[k]) for k in a.args)
+        and len(a.children) == len(b.children)
+        and all(_ast_eq(x, y) for x, y in zip(a.children, b.children))
+    )
+
+
+@pytest.mark.parametrize(
+    "src",
+    [
+        "Bitmap(rowID=10, frame='stargazer')",
+        'Count(Intersect(Bitmap(rowID=1, frame="f"), Bitmap(rowID=2, frame="f")))',
+        "SetBit(rowID=1, frame=f, columnID=5, timestamp='2017-01-02T03:04')",
+        "TopN(Bitmap(rowID=1, frame=o), frame=\"f\", n=2)",
+        "Union(Bitmap(rowID=1, frame=f), Bitmap(rowID=2, frame=f), Bitmap(rowID=3, frame=f))",
+        "F(a=true, b=false, c=null, d=some-ident.x, e=-42)",
+        "A() B(x=1) C(D(), E(y='z'))",
+        "Range(rowID=1, frame=f, start='2010-01-01T00:00', end='2011-01-01T00:00')",
+        "  \n\t Bitmap( rowID = 7 , frame = f )  \n",
+        "Xor(Bitmap(rowID=1, frame=f), Bitmap(rowID=2, frame=f))",
+    ],
+)
+def test_native_parser_matches_python(src):
+    """The C++ fast path (pn_pql_parse) must produce the exact AST of the
+    pure-Python parser — values, types, nesting, and call order."""
+    from pilosa_tpu.pql import parser as pmod
+
+    py = pmod._Parser(pmod.tokenize(src), src).parse_query()
+    fast = pmod.parse(src)
+    assert _ast_eq(py, fast)
+
+
+@pytest.mark.parametrize(
+    "src",
+    [
+        "TopN(frame=f, ids=[1,2,3])",          # list -> fallback
+        "F(x=1.5)",                             # float -> fallback
+        "F(s='a\\'b')",                         # escape -> fallback
+        "F(n=123456789012345678901234567890)",  # >int64 -> fallback
+    ],
+)
+def test_native_parser_falls_back(src):
+    """Unsupported constructs still parse correctly via the Python path."""
+    from pilosa_tpu.pql import parser as pmod
+
+    py = pmod._Parser(pmod.tokenize(src), src).parse_query()
+    assert _ast_eq(py, pmod.parse(src))
+
+
+@pytest.mark.parametrize(
+    "src",
+    ["F(", "F)x", "F(x=1,,)", "F(x=1 y=2)", "F(x=)", "F(x=1)G", "9(x=1)", "F(x=1, x=2)"],
+)
+def test_native_parser_error_parity(src):
+    """Malformed sources raise ParseError with the .so loaded (the native
+    path must reject them and defer to the Python parser for the error)."""
+    with pytest.raises(ParseError):
+        parse(src)
+
+
+def test_deeply_nested_query_does_not_crash():
+    """A crafted deeply-nested body must never kill the process: the
+    native parser caps its recursion depth and defers to the Python
+    parser, which raises a survivable error."""
+    src = "A(" * 100000 + ")" * 100000
+    with pytest.raises((RecursionError, ParseError)):
+        parse(src)
+    # Deep-but-reasonable nesting still parses (through either path).
+    src2 = "A(" * 90 + "B(x=1)" + ")" * 90
+    c = parse(src2).calls[0]
+    depth = 0
+    while c.children:
+        c = c.children[0]
+        depth += 1
+    assert depth == 90 and c.name == "B" and c.args == {"x": 1}
