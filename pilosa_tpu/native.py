@@ -264,8 +264,11 @@ def pql_parse_flat(src: bytes):
     lib = load()
     if lib is None or not src:
         return None
-    call_cap = len(src) // 3 + 2
-    arg_cap = len(src) // 3 + 2
+    # Exact upper bounds from two cheap scans: every call carries a '('
+    # and every arg an '=' — far tighter than source-length sizing for
+    # large request bodies (a 10MB import body stays ~KBs of arrays).
+    call_cap = src.count(b"(") + 1
+    arg_cap = src.count(b"=") + 1
     i32 = ctypes.POINTER(ctypes.c_int32)
     cname_s = np.empty(call_cap, dtype=np.int32)
     cname_e = np.empty(call_cap, dtype=np.int32)

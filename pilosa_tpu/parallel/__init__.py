@@ -5,10 +5,16 @@ executor.go:1115-1244) and cluster.go.  Inside one host/pod, the
 goroutine-per-slice fan-out becomes GSPMD: bitmap stacks are sharded along
 a ``slice`` mesh axis and XLA inserts the ICI collectives (psum for Count,
 all_gather for bitmap materialization, top-k merge for TopN).  Across
-hosts, placement stays hash-ring based (pilosa_tpu.cluster) with
-HTTP-forwarded remote execution, mirroring the reference's data plane.
+hosts there are two planes: a GLOBAL jax.distributed mesh whose
+collectives ride ICI/DCN (multihost.py — homogeneous TPU jobs), and the
+hash-ring + HTTP-forwarded remote execution mirroring the reference's
+data plane (pilosa_tpu.cluster — heterogeneous clusters).
 """
 
+from pilosa_tpu.parallel.multihost import (  # noqa: F401
+    MultiHostSliceMesh,
+    init_multihost,
+)
 from pilosa_tpu.parallel.sharded import (  # noqa: F401
     SliceMesh,
     sharded_count_and,

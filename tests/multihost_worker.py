@@ -1,0 +1,60 @@
+"""Worker process for tests/test_multihost.py: joins a 2-process gloo
+mesh, builds a global slice stack from process-local shards, runs the
+sharded kernels, and prints verifiable results.
+
+Run: python tests/multihost_worker.py <coordinator> <num_procs> <pid>
+"""
+
+import json
+import sys
+
+
+def main() -> int:
+    coordinator, num_procs, pid = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+
+    import numpy as np
+
+    from pilosa_tpu.parallel.multihost import MultiHostSliceMesh, init_multihost
+
+    init_multihost(coordinator, num_procs, pid, local_device_count=2)
+
+    import jax
+
+    from pilosa_tpu.ops import bitwise as bw
+    from pilosa_tpu.parallel import sharded_count_and, sharded_union_reduce
+
+    mesh = MultiHostSliceMesh()
+    n_slices, W = 8, 256
+    rng = np.random.default_rng(42)  # same seed everywhere: shared ground truth
+    a_full = rng.integers(0, 1 << 32, size=(n_slices, W), dtype=np.uint32)
+    b_full = rng.integers(0, 1 << 32, size=(n_slices, W), dtype=np.uint32)
+
+    owned = mesh.owned_slices(n_slices)
+    a = mesh.shard_stack_local({s: a_full[s] for s in owned}, n_slices, (W,))
+    b = mesh.shard_stack_local({s: b_full[s] for s in owned}, n_slices, (W,))
+
+    got_count = int(sharded_count_and(mesh, a, b))
+    want_count = sum(bw.np_count_and(a_full[i], b_full[i]) for i in range(n_slices))
+
+    union = mesh.fetch_global(sharded_union_reduce(mesh, [a, b]))
+    union_ok = bool(np.array_equal(union, a_full | b_full))
+
+    print(
+        json.dumps(
+            {
+                "pid": pid,
+                "global_devices": jax.device_count(),
+                "local_devices": jax.local_device_count(),
+                "owned": owned,
+                "count": got_count,
+                "count_ok": got_count == want_count,
+                "union_ok": union_ok,
+            }
+        ),
+        flush=True,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
