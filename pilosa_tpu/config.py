@@ -45,6 +45,9 @@ class Config:
     max_writes_per_request: int = DEFAULT_MAX_WRITES_PER_REQUEST
     log_path: str = ""
     engine: str = "auto"
+    # "expvar" (default; served at /debug/vars), "statsd[:host[:port]]"
+    # (datadog-compatible UDP), "nop" to disable (stats.go:33-54 analog).
+    stats: str = "expvar"
 
     @classmethod
     def from_toml(cls, path: str) -> "Config":
@@ -65,6 +68,7 @@ class Config:
         )
         cfg.log_path = raw.get("log-path", cfg.log_path)
         cfg.engine = raw.get("engine", cfg.engine)
+        cfg.stats = raw.get("stats", cfg.stats)
         cl = raw.get("cluster", {})
         cfg.cluster.replica_n = cl.get("replicas", cfg.cluster.replica_n)
         cfg.cluster.type = cl.get("type", cfg.cluster.type)
@@ -90,12 +94,15 @@ class Config:
             self.cluster.type = env["PILOSA_CLUSTER_TYPE"]
         if "PILOSA_ENGINE" in env:
             self.engine = env["PILOSA_ENGINE"]
+        if "PILOSA_STATS" in env:
+            self.stats = env["PILOSA_STATS"]
         return self
 
     def to_toml(self) -> str:
         lines = [
             f'data-dir = "{self.data_dir}"',
             f'host = "{self.host}"',
+            f'stats = "{self.stats}"',
             "",
             "[cluster]",
             f'  type = "{self.cluster.type}"',

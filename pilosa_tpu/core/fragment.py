@@ -106,8 +106,10 @@ class Fragment:
         self.cache_type = cache_type
         self.cache_size = cache_size
         self.max_opn = max_opn
+        from pilosa_tpu.stats import NOP_STATS
+
         self.row_attr_store = row_attr_store
-        self.stats = stats
+        self.stats = stats if stats is not None else NOP_STATS
 
         # Guards storage + caches against concurrent readers/writers
         # (fragment.go:69 mu analog).
@@ -212,6 +214,7 @@ class Fragment:
             if changed:
                 self._on_row_mutated(row_id)
                 self._increment_opn()
+                self.stats.count("setN", 1)  # fragment.go:410
             return changed
 
     def set_bits(self, row_ids, column_ids) -> np.ndarray:
@@ -237,6 +240,7 @@ class Fragment:
             # fragment rewrite.
             added = self.storage.add_many_unlogged(positions)
             if len(added):
+                self.stats.count("setN", len(added))
                 for row_id in np.unique(added // np.uint64(SLICE_WIDTH)).tolist():
                     self._on_row_mutated(int(row_id))
                 if len(added) >= self.max_opn:
@@ -257,6 +261,7 @@ class Fragment:
             if changed:
                 self._on_row_mutated(row_id)
                 self._increment_opn()
+                self.stats.count("clearN", 1)  # fragment.go:456
             return changed
 
     def contains(self, row_id: int, column_id: int) -> bool:
@@ -283,6 +288,9 @@ class Fragment:
             self._snapshot()
 
     def _snapshot(self) -> None:
+        import time as _time
+
+        t0 = _time.perf_counter()
         dirname = os.path.dirname(self.path) or "."
         fd, tmp = tempfile.mkstemp(prefix=os.path.basename(self.path), suffix=".snapshotting", dir=dirname)
         try:
@@ -295,6 +303,9 @@ class Fragment:
             raise
         self.storage.op_n = 0
         self._attach_wal()
+        # duration logging analog (fragment.go:1012-1020); timing() takes
+        # seconds (sinks convert to ms themselves).
+        self.stats.timing("snapshot", _time.perf_counter() - t0)
 
     # -- row reads (fragment.go:332-367) --------------------------------
 

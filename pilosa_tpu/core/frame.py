@@ -66,11 +66,13 @@ class FrameOptions:
 
 class Frame:
     def __init__(self, path: str, index: str, name: str, stats=None, on_new_fragment=None):
+        from pilosa_tpu.stats import NOP_STATS
+
         validate_name(name)
         self.path = path
         self.index = index
         self.name = name
-        self.stats = stats
+        self.stats = stats if stats is not None else NOP_STATS
         self.on_new_fragment = on_new_fragment
 
         self.row_label = DEFAULT_ROW_LABEL
@@ -180,7 +182,7 @@ class Frame:
             cache_size=self.cache_size,
             row_attr_store=self.row_attr_store,
             on_new_fragment=self.on_new_fragment,
-            stats=self.stats,
+            stats=self.stats.with_tags(f"view:{name}"),
         )
         v.open()
         self.views[name] = v

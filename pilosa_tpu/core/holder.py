@@ -28,8 +28,10 @@ CACHE_FLUSH_INTERVAL = 60.0  # seconds (holder.go:30-31)
 
 class Holder:
     def __init__(self, path: str, stats=None):
+        from pilosa_tpu.stats import NopStatsClient
+
         self.path = path
-        self.stats = stats
+        self.stats = stats if stats is not None else NopStatsClient()
         # Guards index create/delete against concurrent schema merges
         # (gossip push/pull runs from two threads; holder.go:35 mu analog).
         self._mu = threading.RLock()
@@ -47,9 +49,15 @@ class Holder:
             full = os.path.join(self.path, entry)
             if not os.path.isdir(full) or entry.startswith("."):
                 continue
-            idx = Index(full, entry, stats=self.stats, on_new_fragment=self._fragment_hook)
+            idx = Index(
+                full,
+                entry,
+                stats=self.stats.with_tags(f"index:{entry}"),
+                on_new_fragment=self._fragment_hook,
+            )
             idx.open()
             self.indexes[entry] = idx
+            self.stats.count("indexN", 1)  # holder.go:113
 
     def close(self) -> None:
         for idx in list(self.indexes.values()):
@@ -88,12 +96,13 @@ class Holder:
         idx = Index(
             os.path.join(self.path, name),
             name,
-            stats=self.stats,
+            stats=self.stats.with_tags(f"index:{name}"),
             on_new_fragment=self._fragment_hook,
         )
         idx.open()
         idx.apply_options(opt)
         self.indexes[name] = idx
+        self.stats.count("indexN", 1)  # holder.go:252
         return idx
 
     def delete_index(self, name: str) -> None:
@@ -105,6 +114,7 @@ class Holder:
                 raise ErrIndexNotFound(name)
             idx.close()
             shutil.rmtree(idx.path, ignore_errors=True)
+            self.stats.count("indexN", -1)  # holder.go:292
 
     # -- accessors (holder.go:298-322) ------------------------------------
 

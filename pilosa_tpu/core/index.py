@@ -37,10 +37,12 @@ class IndexOptions:
 
 class Index:
     def __init__(self, path: str, name: str, stats=None, on_new_fragment=None):
+        from pilosa_tpu.stats import NopStatsClient
+
         validate_name(name)
         self.path = path
         self.name = name
-        self.stats = stats
+        self.stats = stats if stats is not None else NopStatsClient()
         self.on_new_fragment = on_new_fragment
 
         self.column_label = DEFAULT_COLUMN_LABEL
@@ -64,9 +66,16 @@ class Index:
             full = os.path.join(self.path, entry)
             if not os.path.isdir(full) or entry.startswith("."):
                 continue
-            frame = Frame(full, self.name, entry, stats=self.stats, on_new_fragment=self.on_new_fragment)
+            frame = Frame(
+                full,
+                self.name,
+                entry,
+                stats=self.stats.with_tags(f"frame:{entry}"),
+                on_new_fragment=self.on_new_fragment,
+            )
             frame.open()
             self.frames[entry] = frame
+            self.stats.count("frameN", 1)  # index.go:183
 
     def close(self) -> None:
         self.column_attr_store.close()
@@ -154,7 +163,7 @@ class Index:
             os.path.join(self.path, name),
             self.name,
             name,
-            stats=self.stats,
+            stats=self.stats.with_tags(f"frame:{name}"),
             on_new_fragment=self.on_new_fragment,
         )
         frame.open()
@@ -162,6 +171,7 @@ class Index:
             opt.time_quantum = self.time_quantum  # inherit index default
         frame.apply_options(opt)
         self.frames[name] = frame
+        self.stats.count("frameN", 1)  # index.go:434
         return frame
 
     def delete_frame(self, name: str) -> None:
@@ -173,6 +183,7 @@ class Index:
             f = self.frames.pop(name, None)
             if f is None:
                 raise ErrFrameNotFound(name)
+            self.stats.count("frameN", -1)  # index.go:474
             f.close()
             shutil.rmtree(f.path, ignore_errors=True)
 

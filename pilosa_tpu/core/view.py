@@ -45,8 +45,10 @@ class View:
         self.cache_type = cache_type
         self.cache_size = cache_size
         self.row_attr_store = row_attr_store
+        from pilosa_tpu.stats import NOP_STATS
+
         self.on_new_fragment = on_new_fragment  # broadcast hook (CreateSliceMessage)
-        self.stats = stats
+        self.stats = stats if stats is not None else NOP_STATS
         # Guards fragment create against concurrent writers (view.go mu analog).
         self._mu = threading.RLock()
         self.fragments: dict[int, Fragment] = {}
@@ -84,7 +86,7 @@ class View:
             cache_type=self.cache_type,
             cache_size=self.cache_size,
             row_attr_store=self.row_attr_store,
-            stats=self.stats,
+            stats=self.stats.with_tags(f"slice:{slice_i}"),
         )
         f.open()
         self.fragments[slice_i] = f
@@ -102,8 +104,10 @@ class View:
                 return f
             is_new_max = not self.fragments or slice_i > self.max_slice()
             f = self._open_fragment(slice_i)
-        if is_new_max and self.on_new_fragment is not None:
-            self.on_new_fragment(self.index, self.frame, self.name, slice_i)
+        if is_new_max:
+            self.stats.count("maxSlice", 1)  # view.go:251
+            if self.on_new_fragment is not None:
+                self.on_new_fragment(self.index, self.frame, self.name, slice_i)
         return f
 
     def max_slice(self) -> int:

@@ -106,7 +106,13 @@ class MultiHostSliceMesh(SliceMesh):
         """Global slice indices whose shards live on THIS process."""
         return [s for _, r in self._local_device_ranges(n_slices) for s in r]
 
-    def shard_stack_local(self, local_data: dict[int, np.ndarray], n_slices: int, row_shape: tuple):
+    def shard_stack_local(
+        self,
+        local_data: dict[int, np.ndarray],
+        n_slices: int,
+        row_shape: tuple,
+        dtype=np.uint32,
+    ):
         """Build a global [n_slices, *row_shape] array from THIS process's
         slices only (missing owned slices are zero).
 
@@ -120,7 +126,12 @@ class MultiHostSliceMesh(SliceMesh):
 
         spec = P(self.AXIS, *([None] * len(row_shape)))
         sharding = NamedSharding(self.mesh, spec)
-        dtype = next((v.dtype for v in local_data.values()), np.uint32)
+        # dtype is an explicit parameter (not inferred from local_data): a
+        # host owning only empty slices must still agree with its peers on
+        # the global aval, or cross-process collectives fail.
+        for v in local_data.values():
+            if v.dtype != dtype:
+                raise TypeError(f"local slice dtype {v.dtype} != declared {np.dtype(dtype)}")
         shards = []
         for d, owned in self._local_device_ranges(n_slices):
             block = np.zeros((len(owned), *row_shape), dtype=dtype)

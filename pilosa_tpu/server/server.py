@@ -38,7 +38,11 @@ from pilosa_tpu.syncer import HolderSyncer
 
 class Server:
     def __init__(self, config: Optional[Config] = None, stats=None):
+        from pilosa_tpu.stats import new_stats_client
+
         self.config = config or Config()
+        if stats is None:
+            stats = new_stats_client(self.config.stats)
         self.stats = stats
         self.host = self.config.host
         self.data_dir = os.path.expanduser(self.config.data_dir)

@@ -36,6 +36,11 @@ class NopStatsClient:
         pass
 
 
+# Shared null-object instance: data-model objects coerce stats=None to
+# this so emission sites need no truthiness guards.
+NOP_STATS = NopStatsClient()
+
+
 class ExpvarStatsClient:
     """In-process stats exposed at /debug/vars (stats.go:70-130)."""
 
@@ -183,3 +188,19 @@ class MultiStatsClient:
             if hasattr(c, "snapshot"):
                 return c.snapshot()
         return {}
+
+
+def new_stats_client(spec: str):
+    """Build a stats client from a config string: "expvar" (default),
+    "statsd[:host[:port]]", or "nop" (cmd/server.go stats wiring analog)."""
+    spec = (spec or "expvar").strip()
+    if spec in ("nop", "none", ""):
+        return NopStatsClient()
+    if spec == "expvar":
+        return ExpvarStatsClient()
+    if spec == "statsd" or spec.startswith("statsd:"):
+        parts = spec.split(":")
+        host = parts[1] if len(parts) > 1 and parts[1] else "127.0.0.1"
+        port = int(parts[2]) if len(parts) > 2 else 8125
+        return MultiStatsClient([ExpvarStatsClient(), StatsdStatsClient(host=host, port=port)])
+    raise ValueError(f"unknown stats backend: {spec!r}")

@@ -288,3 +288,24 @@ def test_set_quick_property(tmp_path):
             assert resp["results"][0]["bitmap"]["bits"] == sorted(cols)
     finally:
         s2.close()
+
+
+def test_stats_wired_through_data_path(tmp_path):
+    """Counters flow holder->index->frame->view->fragment with tags and
+    surface at /debug/vars (stats.go + holder.go:113/252, fragment.go:410)."""
+    s = make_server(tmp_path, name="stats0")
+    try:
+        c = Client(s.host)
+        c.create_index("st")
+        c.create_frame("st", "f")
+        c.execute_query("st", 'SetBit(rowID=1, frame="f", columnID=5) '
+                              'SetBit(rowID=1, frame="f", columnID=6)')
+        c.execute_query("st", 'ClearBit(rowID=1, frame="f", columnID=6)')
+        with urllib.request.urlopen(f"http://{s.host}/debug/vars") as resp:
+            vars_ = json.loads(resp.read())
+        flat = json.dumps(vars_)
+        assert "indexN" in flat
+        assert "setN" in flat and "clearN" in flat
+        assert "index:st" in flat and "frame:f" in flat  # tag propagation
+    finally:
+        s.close()

@@ -70,3 +70,60 @@ def test_attr_sync(two_replicated_nodes):
     s0.syncer.sync_holder()
     assert s0.holder.frame("i", "f").row_attr_store.attrs(3) == {"name": "bob"}
     assert s0.holder.index("i").column_attr_store.attrs(8) == {"tag": "z"}
+
+
+@pytest.fixture
+def three_replicated_nodes(tmp_path):
+    hosts = [f"127.0.0.1:{free_port()}" for _ in range(3)]
+    servers = []
+    for i, h in enumerate(hosts):
+        cfg = Config(
+            data_dir=str(tmp_path / f"m{i}"),
+            host=h,
+            engine="numpy",
+            cluster=ClusterConfig(type="static", hosts=list(hosts), replica_n=3),
+        )
+        s = Server(cfg)
+        s.open()
+        servers.append(s)
+    yield servers
+    for s in servers:
+        s.close()
+
+
+def test_three_node_majority_vote(three_replicated_nodes):
+    """With 3 replicas the merge threshold is 2 (fragment.go:802-920
+    setN >= (len+1)/2): bits on >=2 nodes survive, bits on exactly one
+    node are CLEARED everywhere — not unioned."""
+    servers = three_replicated_nodes
+    clients = [Client(s.host) for s in servers]
+    for c in clients:
+        c.create_index("i")
+        c.create_frame("i", "f")
+    # col=1 on all three; col=2 on two nodes; col=3 on one node only.
+    for c in clients:
+        c.execute_query("i", 'SetBit(rowID=1, frame="f", columnID=1)', remote=True)
+    for c in clients[:2]:
+        c.execute_query("i", 'SetBit(rowID=1, frame="f", columnID=2)', remote=True)
+    clients[2].execute_query("i", 'SetBit(rowID=1, frame="f", columnID=3)', remote=True)
+
+    servers[0].syncer.sync_holder()
+
+    for c in clients:
+        r = c.execute_query("i", 'Bitmap(rowID=1, frame="f")', remote=True)
+        assert r["results"][0]["bitmap"]["bits"] == [1, 2]
+
+
+def test_sync_survives_down_peer(two_replicated_nodes):
+    """A dead replica must not break anti-entropy for the live pair
+    (executor.go:1147-1159-style degradation: skip, don't crash)."""
+    s0, s1 = two_replicated_nodes
+    c0 = Client(s0.host)
+    for c in (c0, Client(s1.host)):
+        c.create_index("i")
+        c.create_frame("i", "f")
+    c0.execute_query("i", 'SetBit(rowID=5, frame="f", columnID=77)', remote=True)
+    s1.close()  # peer goes dark
+    s0.syncer.sync_holder()  # must not raise
+    r = c0.execute_query("i", 'Bitmap(rowID=5, frame="f")', remote=True)
+    assert r["results"][0]["bitmap"]["bits"] == [77]
