@@ -333,7 +333,9 @@ def main() -> None:
     n_slices = int(os.environ.get("BENCH_SLICES", "16"))
     n_rows = int(os.environ.get("BENCH_ROWS", "64"))
     batch = int(os.environ.get("BENCH_BATCH", "256"))
-    iters = int(os.environ.get("BENCH_ITERS", "160"))
+    # Long enough that the one-dispatch stream's tunnel round trip (~70ms)
+    # is <15% of the timed window — shorter streams measure the tunnel.
+    iters = int(os.environ.get("BENCH_ITERS", "640"))
     # Bit density ~2^-k via AND of k random words (throughput over packed
     # words is density-independent; this just keeps counts realistic).
     density_k = int(os.environ.get("BENCH_DENSITY_K", "4"))
