@@ -233,7 +233,7 @@ def bench_timerange() -> dict:
 
 def bench_executor() -> dict:
     """End-to-end product path: PQL text -> parser -> Executor ->
-    fused device dispatch (_fuse_count_intersect_batch) -> results.
+    fused device dispatch (_fuse_count_pair_batch) -> results.
 
     Unlike the headline config (raw kernel throughput), this measures the
     whole single-node product stack the way a client drives it: each

@@ -24,6 +24,14 @@ import numpy as np
 
 from pilosa_tpu.roaring import _POPCNT8
 
+# Pair-op table shared by the numpy fused path (computed lazily, one op).
+_NP_OPS = {
+    "and": lambda a, b: a & b,
+    "or": lambda a, b: a | b,
+    "xor": lambda a, b: a ^ b,
+    "andnot": lambda a, b: a & ~b,
+}
+
 
 class NumpyEngine:
     name = "numpy"
@@ -50,9 +58,14 @@ class NumpyEngine:
     def gather_count_and(self, row_matrix, pairs) -> np.ndarray:
         """Batched Count(Intersect) over [n_slices, n_rows, W] for int32[B,2]
         row-index pairs; returns int64[B]."""
+        return self.gather_count("and", row_matrix, pairs)
+
+    def gather_count(self, op: str, row_matrix, pairs) -> np.ndarray:
+        """Batched Count(<op>(...)) — and/or/xor/andnot pair counts."""
         a = row_matrix[:, pairs[:, 0], :]
         b = row_matrix[:, pairs[:, 1], :]
-        return self.count(a & b).sum(axis=0)
+        r = _NP_OPS[op](a, b)
+        return self.count(r).sum(axis=0)
 
     def bit_and(self, a, b):
         return a & b
@@ -128,8 +141,11 @@ class JaxEngine:
 
     def gather_count_and(self, row_matrix, pairs) -> np.ndarray:
         """Batched Count(Intersect) in ONE device dispatch (Pallas on TPU)."""
-        out = self._dispatch.gather_count_and(
-            self._jnp.asarray(row_matrix), self._jnp.asarray(pairs)
+        return self.gather_count("and", row_matrix, pairs)
+
+    def gather_count(self, op: str, row_matrix, pairs) -> np.ndarray:
+        out = self._dispatch.gather_count(
+            op, self._jnp.asarray(row_matrix), self._jnp.asarray(pairs)
         )
         return np.asarray(out).astype(np.int64)
 
@@ -198,7 +214,7 @@ class MeshEngine(JaxEngine):
         self.mesh = SliceMesh(devices)
         # One jitted callable for the fused path — constructing jax.jit per
         # call would re-trace and miss the dispatch cache every time.
-        self._gather_jit = jax.jit(_bw.gather_count_and)
+        self._gather_jit = jax.jit(_bw.gather_count, static_argnums=0)
 
     def _shard_stack(self, x):
         # Shard only cleanly-divisible leading axes (device_put requires
@@ -237,10 +253,14 @@ class MeshEngine(JaxEngine):
         return self._repin(super().append_rows(matrix, block), matrix)
 
     def gather_count_and(self, row_matrix, pairs):
+        return self.gather_count("and", row_matrix, pairs)
+
+    def gather_count(self, op, row_matrix, pairs):
         # Pallas can't lower under GSPMD partitioning; the jnp form is
-        # partitioned by XLA (local gather + AND + popcount per shard,
-        # psum over the slice axis).
+        # partitioned by XLA (local gather + bitwise op + popcount per
+        # shard, psum over the slice axis).
         out = self._gather_jit(
+            op,
             self._shard_stack(self._jnp.asarray(row_matrix)),
             self._jnp.asarray(pairs),
         )

@@ -166,7 +166,7 @@ class Executor:
         if batched_writes is not None:
             return batched_writes
 
-        fused = self._fuse_count_intersect_batch(index, query.calls, std_slices, opt)
+        fused = self._fuse_count_pair_batch(index, query.calls, std_slices, opt)
 
         results = []
         for i, call in enumerate(query.calls):
@@ -253,19 +253,28 @@ class Executor:
                     changed[i] = True
         return changed
 
-    def _fuse_count_intersect_batch(
+    # PQL pair-op -> kernel op for the fused batch path.
+    _FUSABLE_OPS = {
+        "Intersect": "and",
+        "Union": "or",
+        "Difference": "andnot",
+        "Xor": "xor",
+    }
+
+    def _fuse_count_pair_batch(
         self, index: str, calls, slices, opt: ExecOptions
     ) -> Optional[dict[int, int]]:
-        """Run all Count(Intersect(Bitmap(a), Bitmap(b))) calls in a request
-        as ONE fused device dispatch.
+        """Run all Count(<op>(Bitmap(a), Bitmap(b))) calls in a request as
+        fused device dispatches (one per distinct op).
 
-        The TPU-native replacement for issuing the hot query shape
+        The TPU-native replacement for issuing the hot query shapes
         (executor.go:576-605) one call at a time: row-id pairs are gathered
         by the kernel straight from a device-resident row matrix
-        (ops.dispatch.gather_count_and), so a request carrying a batch of
-        count-intersect queries costs one kernel launch instead of
-        2×batch row uploads + batch reductions.  Only applies to
-        single-node/local execution; distributed requests go through the
+        (ops.dispatch.gather_count), so a request carrying a batch of
+        pair-count queries costs one kernel launch per op instead of
+        2×batch row uploads + batch reductions.  Covers Intersect, Union,
+        Difference, and Xor with exactly two Bitmap children.  Only applies
+        to single-node/local execution; distributed requests go through the
         per-call mapReduce with its node-failure retry.
         """
         if opt.remote or not slices:
@@ -273,12 +282,14 @@ class Executor:
         if self.cluster is not None and self.client_factory is not None and len(self.cluster.nodes) > 1:
             return None
 
-        matched: dict[int, tuple[str, int, int]] = {}  # call idx -> (frame, r1, r2)
+        # call idx -> (frame, kernel_op, r1, r2)
+        matched: dict[int, tuple[str, str, int, int]] = {}
         for i, c in enumerate(calls):
             if c.name != "Count" or len(c.children) != 1:
                 continue
             ch = c.children[0]
-            if ch.name != "Intersect" or len(ch.children) != 2:
+            op = self._FUSABLE_OPS.get(ch.name)
+            if op is None or len(ch.children) != 2:
                 continue
             leaves = []
             for leaf in ch.children:
@@ -293,7 +304,7 @@ class Executor:
                 leaves.append((frame, row_id))
             if len(leaves) != 2 or leaves[0][0] != leaves[1][0]:
                 continue
-            matched[i] = (leaves[0][0], leaves[0][1], leaves[1][1])
+            matched[i] = (leaves[0][0], op, leaves[0][1], leaves[1][1])
         # Fuse only when the WHOLE request is fusable reads: a write call
         # anywhere in the request must be observed by later Counts
         # (per-call ordering semantics), so mixed requests take the
@@ -303,7 +314,7 @@ class Executor:
 
         # One row matrix per frame: unique row ids -> device rows.
         by_frame: dict[str, list[int]] = {}
-        for frame, r1, r2 in matched.values():
+        for frame, _, r1, r2 in matched.values():
             by_frame.setdefault(frame, []).extend((r1, r2))
         frame_matrices: dict[str, tuple[dict[int, int], object]] = {}
         for frame, ids in by_frame.items():
@@ -311,14 +322,16 @@ class Executor:
 
         out: dict[int, int] = {}
         for frame, (id_pos, matrix) in frame_matrices.items():
-            idxs = [i for i, (f, _, _) in matched.items() if f == frame]
-            pairs = np.array(
-                [[id_pos[matched[i][1]], id_pos[matched[i][2]]] for i in idxs],
-                dtype=np.int32,
-            )
-            counts = self.engine.gather_count_and(matrix, pairs)
-            for k, i in enumerate(idxs):
-                out[i] = int(counts[k])
+            ops_here = sorted({op for f, op, _, _ in matched.values() if f == frame})
+            for op in ops_here:
+                idxs = [i for i, (f, o, _, _) in matched.items() if f == frame and o == op]
+                pairs = np.array(
+                    [[id_pos[matched[i][2]], id_pos[matched[i][3]]] for i in idxs],
+                    dtype=np.int32,
+                )
+                counts = self.engine.gather_count(op, matrix, pairs)
+                for k, i in enumerate(idxs):
+                    out[i] = int(counts[k])
         return out
 
     def _frame_matrix(

@@ -108,11 +108,18 @@ def gather_count_and(row_matrix, pairs):
     the Pallas version in pallas_kernels.fused_gather_count2 avoids
     materializing the gathered stacks.
     """
+    return gather_count("and", row_matrix, pairs)
+
+
+def gather_count(op: str, row_matrix, pairs):
+    """Batched Count(<op>(Bitmap(p0), Bitmap(p1))) over all slices — the
+    generalization of :func:`gather_count_and` to Union ("or"),
+    Difference ("andnot"), and Xor ("xor")."""
+    from pilosa_tpu.ops.pallas_kernels import _op_apply
+
     a = jnp.take(row_matrix, pairs[:, 0], axis=1)  # [n_slices, B, W]
     b = jnp.take(row_matrix, pairs[:, 1], axis=1)
-    return jnp.sum(
-        lax.population_count(jnp.bitwise_and(a, b)).astype(jnp.int32), axis=(0, 2)
-    )
+    return jnp.sum(lax.population_count(_op_apply(op, a, b)).astype(jnp.int32), axis=(0, 2))
 
 
 # ---------------------------------------------------------------------------

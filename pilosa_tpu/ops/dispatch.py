@@ -74,15 +74,21 @@ def count_andnot(a, b):
 def gather_count_and(row_matrix, pairs):
     """Batched Count(Intersect(...)) over a [n_slices, n_rows, W] row
     matrix for int32[B, 2] row-id pairs — the headline query hot path."""
+    return gather_count("and", row_matrix, pairs)
+
+
+def gather_count(op, row_matrix, pairs):
+    """Batched Count(<op>(Bitmap, Bitmap)) — and/or/xor/andnot (the
+    fused forms of Intersect/Union/Xor/Difference count batches)."""
     if use_pallas() and _tileable(row_matrix.shape[-1]):
         n_slices, n_rows, w = row_matrix.shape
         # Resident kernel wins whenever streaming ALL rows once beats
         # gathering 2 rows per query (R < 2B) and an all-rows chunk fits
         # the VMEM budget; otherwise fall back to the per-query gather.
         if n_rows < 2 * pairs.shape[0] and _resident_chunk_sub(n_rows, w, pairs.shape[0]):
-            return fused_resident_count2("and", row_matrix, pairs)
-        return fused_gather_count2("and", row_matrix, pairs)
-    return bitwise.gather_count_and(row_matrix, pairs)
+            return fused_resident_count2(op, row_matrix, pairs)
+        return fused_gather_count2(op, row_matrix, pairs)
+    return bitwise.gather_count(op, row_matrix, pairs)
 
 
 def batch_intersection_count(rows, src):

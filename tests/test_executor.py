@@ -245,6 +245,25 @@ def test_count_intersect_batch_fusion(tmp_path, engine):
     fr.set_bit("standard", 1, col)
     after = e.execute("i", batch_q)[0]
     assert after == before + 1
+
+    # The fused path generalizes across pair ops — a mixed batch of
+    # Count(Intersect/Union/Difference/Xor) matches per-call execution.
+    mixed = " ".join(
+        f'Count({op}(Bitmap(rowID={a}, frame="f"), Bitmap(rowID={b}, frame="f")))'
+        for op, a, b in [
+            ("Intersect", 0, 1), ("Union", 0, 1), ("Difference", 0, 1),
+            ("Xor", 0, 1), ("Union", 2, 3), ("Difference", 4, 5),
+        ]
+    )
+    fused_mixed = e.execute("i", mixed)
+    singles_mixed = [
+        e.execute("i", f'Count({op}(Bitmap(rowID={a}, frame="f"), Bitmap(rowID={b}, frame="f")))')[0]
+        for op, a, b in [
+            ("Intersect", 0, 1), ("Union", 0, 1), ("Difference", 0, 1),
+            ("Xor", 0, 1), ("Union", 2, 3), ("Difference", 4, 5),
+        ]
+    ]
+    assert fused_mixed == singles_mixed
     h.close()
 
 
