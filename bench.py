@@ -284,7 +284,7 @@ def bench_executor() -> dict:
         # Drive like a loaded server: concurrent requests overlap parse
         # (CPU) with device dispatch + result fetch, exactly as the
         # threaded HTTP server does.  BENCH_THREADS=1 for pure latency.
-        n_threads = int(os.environ.get("BENCH_THREADS", "4"))
+        n_threads = int(os.environ.get("BENCH_THREADS", "8"))
         from concurrent.futures import ThreadPoolExecutor
 
         t0 = time.perf_counter()

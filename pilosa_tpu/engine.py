@@ -24,7 +24,9 @@ import numpy as np
 
 from pilosa_tpu.roaring import _POPCNT8
 
-# Pair-op table shared by the numpy fused path (computed lazily, one op).
+# Pair-op table for the numpy engine.  Deliberately NOT shared with
+# ops.bitwise.apply_pair_op: importing ops.bitwise pulls in jax at module
+# top, and the numpy engine must work on hosts where jax is absent/broken.
 _NP_OPS = {
     "and": lambda a, b: a & b,
     "or": lambda a, b: a | b,
@@ -251,9 +253,6 @@ class MeshEngine(JaxEngine):
 
     def append_rows(self, matrix, block):
         return self._repin(super().append_rows(matrix, block), matrix)
-
-    def gather_count_and(self, row_matrix, pairs):
-        return self.gather_count("and", row_matrix, pairs)
 
     def gather_count(self, op, row_matrix, pairs):
         # Pallas can't lower under GSPMD partitioning; the jnp form is

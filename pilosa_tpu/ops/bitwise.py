@@ -58,6 +58,25 @@ def bit_andnot(a, b):
     return jnp.bitwise_and(a, jnp.bitwise_not(b))
 
 
+# Operator-based pair-op table: works on jnp arrays AND inside Pallas
+# kernel bodies (tracers lower &,|,^,~ to the bitwise ops).  Owned here so
+# the jnp fallback never depends on the Pallas modules.
+_PAIR_OPS = {
+    "and": lambda a, b: a & b,
+    "or": lambda a, b: a | b,
+    "xor": lambda a, b: a ^ b,
+    "andnot": lambda a, b: a & ~b,
+}
+
+
+def apply_pair_op(op: str, a, b):
+    try:
+        f = _PAIR_OPS[op]
+    except KeyError:
+        raise ValueError(f"unknown op {op!r}") from None
+    return f(a, b)
+
+
 def popcount_words(x):
     """Per-word popcount (the POPCNTQ analog, vectorized over all words)."""
     return lax.population_count(x)
@@ -115,11 +134,9 @@ def gather_count(op: str, row_matrix, pairs):
     """Batched Count(<op>(Bitmap(p0), Bitmap(p1))) over all slices — the
     generalization of :func:`gather_count_and` to Union ("or"),
     Difference ("andnot"), and Xor ("xor")."""
-    from pilosa_tpu.ops.pallas_kernels import _op_apply
-
     a = jnp.take(row_matrix, pairs[:, 0], axis=1)  # [n_slices, B, W]
     b = jnp.take(row_matrix, pairs[:, 1], axis=1)
-    return jnp.sum(lax.population_count(_op_apply(op, a, b)).astype(jnp.int32), axis=(0, 2))
+    return jnp.sum(lax.population_count(apply_pair_op(op, a, b)).astype(jnp.int32), axis=(0, 2))
 
 
 # ---------------------------------------------------------------------------

@@ -30,16 +30,8 @@ _LANES = 128
 _SUBLANES = 8  # int32/uint32 min sublane count
 
 
-def _op_apply(op: str, a, b):
-    if op == "and":
-        return jnp.bitwise_and(a, b)
-    if op == "or":
-        return jnp.bitwise_or(a, b)
-    if op == "xor":
-        return jnp.bitwise_xor(a, b)
-    if op == "andnot":
-        return jnp.bitwise_and(a, jnp.bitwise_not(b))
-    raise ValueError(f"unknown op {op!r}")
+# Shared pair-op table (operators lower identically in kernel bodies).
+from pilosa_tpu.ops.bitwise import apply_pair_op as _op_apply  # noqa: E402
 
 
 def _partial_tile(words):
