@@ -277,9 +277,7 @@ class Executor:
         to single-node/local execution; distributed requests go through the
         per-call mapReduce with its node-failure retry.
         """
-        if opt.remote or not slices:
-            return None
-        if self.cluster is not None and self.client_factory is not None and len(self.cluster.nodes) > 1:
+        if not slices:
             return None
 
         # call idx -> (frame, kernel_op, r1, r2)
@@ -312,27 +310,73 @@ class Executor:
         if len(matched) < 2 or len(matched) != len(calls):
             return None
 
+        idxs = sorted(matched)
+        distributed = (
+            not opt.remote
+            and self.cluster is not None
+            and self.client_factory is not None
+            and len(self.cluster.nodes) > 1
+        )
+        if not distributed:
+            counts = self._fused_local_counts(index, matched, idxs, slices)
+            return dict(zip(idxs, counts))
+
+        # Distributed fusion: ONE forwarded batch request per remote node
+        # (N fused calls x M nodes = M requests, not N*M per-call
+        # forwards), local slices through the fused kernels, and the same
+        # mid-query replica failover as per-call mapReduce.  The remote
+        # peer re-enters this function with opt.remote=True and fuses its
+        # own slice batch.
+        batch_query = pql.Query(calls=[calls[i] for i in idxs])
+
+        def local_map(node_slices):
+            return self._fused_local_counts(index, matched, idxs, node_slices)
+
+        def remote_map(client, node_slices):
+            res = client.execute_remote(index, batch_query, node_slices)
+            if len(res) != len(idxs):
+                raise PilosaError(
+                    f"fused batch: peer returned {len(res)} results for {len(idxs)} calls"
+                )
+            return [int(r) for r in res]
+
+        totals = self._map_reduce(
+            index,
+            None,
+            slices,
+            opt,
+            local_map,
+            lambda a, b: [x + y for x, y in zip(a, b)],
+            [0] * len(idxs),
+            remote_map=remote_map,
+        )
+        return dict(zip(idxs, totals))
+
+    def _fused_local_counts(
+        self, index: str, matched: dict, idxs: list[int], slices
+    ) -> list[int]:
+        """Fused pair counts for the given slice batch, aligned with idxs."""
+        slices = list(slices or [])
+        out: dict[int, int] = {}
+        if not slices:
+            return [0] * len(idxs)
         # One row matrix per frame: unique row ids -> device rows.
         by_frame: dict[str, list[int]] = {}
         for frame, _, r1, r2 in matched.values():
             by_frame.setdefault(frame, []).extend((r1, r2))
-        frame_matrices: dict[str, tuple[dict[int, int], object]] = {}
         for frame, ids in by_frame.items():
-            frame_matrices[frame] = self._frame_matrix(index, frame, slices, set(ids))
-
-        out: dict[int, int] = {}
-        for frame, (id_pos, matrix) in frame_matrices.items():
+            id_pos, matrix = self._frame_matrix(index, frame, slices, set(ids))
             ops_here = sorted({op for f, op, _, _ in matched.values() if f == frame})
             for op in ops_here:
-                idxs = [i for i, (f, o, _, _) in matched.items() if f == frame and o == op]
+                op_idxs = [i for i, (f, o, _, _) in matched.items() if f == frame and o == op]
                 pairs = np.array(
-                    [[id_pos[matched[i][2]], id_pos[matched[i][3]]] for i in idxs],
+                    [[id_pos[matched[i][2]], id_pos[matched[i][3]]] for i in op_idxs],
                     dtype=np.int32,
                 )
                 counts = self.engine.gather_count(op, matrix, pairs)
-                for k, i in enumerate(idxs):
+                for k, i in enumerate(op_idxs):
                     out[i] = int(counts[k])
-        return out
+        return [out[i] for i in idxs]
 
     def _frame_matrix(
         self, index: str, frame: str, slices, want: set[int]
@@ -755,12 +799,18 @@ class Executor:
 
     # -- mapReduce (executor.go:1115-1244) ----------------------------------
 
-    def _map_reduce(self, index: str, c: pql.Call, slices, opt: ExecOptions, local_map, reduce_fn, zero):
+    def _map_reduce(
+        self, index: str, c, slices, opt: ExecOptions, local_map, reduce_fn, zero,
+        remote_map=None,
+    ):
         """Fan the call out over slice owners and reduce.
 
         Local slices evaluate as ONE batched computation (local_map gets the
         whole list); remote nodes get the call forwarded once each with
         their slice list, mirroring the reference's per-node batching.
+        ``remote_map(client, node_slices)`` overrides how a remote node is
+        driven (the fused batch path forwards a whole Query instead of one
+        call).
         """
         slices = list(slices or [])
         if self.cluster is None or opt.remote or self.client_factory is None:
@@ -772,6 +822,8 @@ class Executor:
             if node.host == self.host:
                 return local_map(node_slices)
             client = self.client_factory(node.host)
+            if remote_map is not None:
+                return remote_map(client, node_slices)
             return client.execute_remote_call(index, c, node_slices)
 
         # Mid-query node-failure retry (executor.go:1147-1159): when a

@@ -6,7 +6,6 @@ same object graph must never corrupt state or raise).
 import threading
 
 import numpy as np
-import pytest
 
 from pilosa_tpu.core.frame import FrameOptions
 from pilosa_tpu.core.holder import Holder

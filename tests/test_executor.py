@@ -482,3 +482,61 @@ def test_fused_matrix_oversized_not_cached(env):
     )
     assert e.execute("i", small) == [1, 1]
     assert len(e._matrix_cache) == 1
+
+
+def test_fused_batch_distributed_one_request_per_node(tmp_path):
+    """In a cluster, a fused batch forwards ONE Query per remote node
+    (not one request per call), sums per-call counts across nodes, and
+    fails over to replicas when the remote dies."""
+    from pilosa_tpu.cluster import Cluster, Node
+
+    h = Holder(str(tmp_path / "data"))
+    h.open()
+    idx = h.create_index("i")
+    idx.create_frame("f", FrameOptions())
+    fr = idx.frame("f")
+    # All data locally resident (this host holds every replica's data).
+    for s in range(4):
+        for c in range(10):
+            fr.set_bit("standard", 0, s * SLICE_WIDTH + c)
+            fr.set_bit("standard", 1, s * SLICE_WIDTH + c + 5)
+
+    hosts = ["h0:1", "h1:1"]
+    cluster = Cluster([Node(host) for host in hosts], replica_n=2)
+    remote_batches = []
+
+    class SpyClient:
+        def __init__(self, host):
+            self.host = host
+
+        def execute_remote(self, index, query, slices=None):
+            remote_batches.append((self.host, len(query.calls), list(slices)))
+            # Answer from the same holder (stand-in for the peer's data).
+            peer = Executor(h, engine="numpy")
+            return peer.execute(
+                index, query, slices=slices, opt=ExecOptions(remote=True)
+            )
+
+    e = Executor(h, engine="numpy", cluster=cluster, client_factory=SpyClient, host="h0:1")
+    q = " ".join(
+        ['Count(Intersect(Bitmap(rowID=0, frame="f"), Bitmap(rowID=1, frame="f")))'] * 3
+    )
+    got = e.execute("i", q)
+    assert got == [20, 20, 20]  # 5 per slice x 4 slices... verified below
+    single = Executor(h, engine="numpy").execute(
+        "i", 'Count(Intersect(Bitmap(rowID=0, frame="f"), Bitmap(rowID=1, frame="f")))'
+    )
+    assert got == single * 3
+    # Exactly one remote batch request carrying all 3 calls.
+    assert len(remote_batches) == 1
+    host_seen, n_calls, slices_seen = remote_batches[0]
+    assert host_seen == "h1:1" and n_calls == 3 and slices_seen
+
+    # Failover: a dying remote re-maps its slices locally; counts intact.
+    class DyingClient(SpyClient):
+        def execute_remote(self, index, query, slices=None):
+            raise ConnectionError("node down")
+
+    e2 = Executor(h, engine="numpy", cluster=cluster, client_factory=DyingClient, host="h0:1")
+    assert e2.execute("i", q) == got
+    h.close()

@@ -309,3 +309,62 @@ def test_stats_wired_through_data_path(tmp_path):
         assert "index:st" in flat and "frame:f" in flat  # tag propagation
     finally:
         s.close()
+
+
+def test_two_node_fused_batch_query(tmp_path):
+    """A batch of Count(pair-op) calls against a 2-node cluster runs
+    through the distributed fused path (one forwarded batch per node) and
+    matches per-call execution."""
+    import socket
+
+    def free_port():
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    hosts = [f"127.0.0.1:{free_port()}" for _ in range(2)]
+    servers = []
+    for i, h in enumerate(hosts):
+        cfg = Config(
+            data_dir=str(tmp_path / f"n{i}"),
+            host=h,
+            engine="numpy",
+            cluster=ClusterConfig(type="static", hosts=list(hosts)),
+        )
+        s = Server(cfg)
+        s.open()
+        servers.append(s)
+    try:
+        c0 = Client(hosts[0])
+        for c in (c0, Client(hosts[1])):
+            c.create_index("i")
+            c.create_frame("i", "f")
+        cluster = servers[0].cluster
+        rng = np.random.default_rng(9)
+        bits = []
+        for r in range(4):
+            for s_i in range(4):
+                for c_i in rng.choice(1000, size=40, replace=False):
+                    bits.append((r, s_i * SLICE_WIDTH + int(c_i)))
+        c0.import_bits("i", "f", bits, fragment_nodes=cluster.fragment_nodes)
+        servers[0]._monitor_max_slices()
+        servers[1]._monitor_max_slices()
+
+        combos = [("Intersect", 0, 1), ("Union", 1, 2), ("Difference", 2, 3), ("Xor", 0, 3)]
+        batch = " ".join(
+            f'Count({op}(Bitmap(rowID={a}, frame="f"), Bitmap(rowID={b}, frame="f")))'
+            for op, a, b in combos
+        )
+        fused = c0.execute_query("i", batch)["results"]
+        singles = [
+            c0.execute_query(
+                "i", f'Count({op}(Bitmap(rowID={a}, frame="f"), Bitmap(rowID={b}, frame="f")))'
+            )["results"][0]
+            for op, a, b in combos
+        ]
+        assert fused == singles
+        # Both nodes agree (the batch coordinated from node 1 too).
+        assert Client(hosts[1]).execute_query("i", batch)["results"] == fused
+    finally:
+        for s in servers:
+            s.close()
