@@ -105,6 +105,11 @@ class NumpyEngine:
         """Append new rows (axis 1) to a row matrix: [S, R, W] + [S, R', W]."""
         return np.concatenate([matrix, block], axis=1)
 
+    def pair_gram(self, matrix):
+        """All-pairs AND-count Gram, or None when unsupported (host
+        all-pairs popcount would dwarf the direct path)."""
+        return None
+
     def to_numpy(self, x) -> np.ndarray:
         return np.asarray(x)
 
@@ -146,8 +151,11 @@ class JaxEngine:
         return self.gather_count("and", row_matrix, pairs)
 
     def gather_count(self, op: str, row_matrix, pairs) -> np.ndarray:
+        # allow_gram=False: eager per-request dispatch can't amortize the
+        # all-pairs matmul; the executor's generation-cached Gram
+        # (pair_gram) is the product-path version of that strategy.
         out = self._dispatch.gather_count(
-            op, self._jnp.asarray(row_matrix), self._jnp.asarray(pairs)
+            op, self._jnp.asarray(row_matrix), self._jnp.asarray(pairs), allow_gram=False
         )
         return np.asarray(out).astype(np.int64)
 
@@ -183,6 +191,16 @@ class JaxEngine:
     def append_rows(self, matrix, block):
         """Device-side concat of new rows: only the new block crosses PCIe."""
         return self._jnp.concatenate([matrix, self._jnp.asarray(block)], axis=1)
+
+    def pair_gram(self, matrix):
+        """All-pairs AND-count Gram via one MXU int8 matmul (exact)."""
+        if not hasattr(self, "_gram_jit"):
+            import jax
+
+            from pilosa_tpu.ops.bitwise import pair_gram
+
+            self._gram_jit = jax.jit(pair_gram)
+        return np.asarray(self._gram_jit(self._jnp.asarray(matrix))).astype(np.int64)
 
     def to_numpy(self, x) -> np.ndarray:
         return np.asarray(x)

@@ -49,6 +49,23 @@ from pilosa_tpu.pilosa import (
 # Frame used when a call doesn't specify one (executor.go:33-35).
 DEFAULT_FRAME = "general"
 
+
+def _gram_pair_counts_np(op: str, gram: np.ndarray, pairs: np.ndarray) -> np.ndarray:
+    """Host-side mirror of ops.bitwise.gram_pair_counts (kept separate so
+    the numpy-engine path never imports jax)."""
+    g_and = gram[pairs[:, 0], pairs[:, 1]]
+    if op == "and":
+        return g_and
+    d0 = gram[pairs[:, 0], pairs[:, 0]]
+    d1 = gram[pairs[:, 1], pairs[:, 1]]
+    if op == "or":
+        return d0 + d1 - g_and
+    if op == "xor":
+        return d0 + d1 - 2 * g_and
+    if op == "andnot":
+        return d0 - g_and
+    raise ValueError(f"unknown op {op!r}")
+
 _WORDS = SLICE_WIDTH // 32
 
 
@@ -365,7 +382,8 @@ class Executor:
         for frame, _, r1, r2 in matched.values():
             by_frame.setdefault(frame, []).extend((r1, r2))
         for frame, ids in by_frame.items():
-            id_pos, matrix = self._frame_matrix(index, frame, slices, set(ids))
+            id_pos, matrix, box = self._frame_matrix(index, frame, slices, set(ids))
+            gram = self._frame_gram(matrix, box)
             ops_here = sorted({op for f, op, _, _ in matched.values() if f == frame})
             for op in ops_here:
                 op_idxs = [i for i, (f, o, _, _) in matched.items() if f == frame and o == op]
@@ -373,14 +391,63 @@ class Executor:
                     [[id_pos[matched[i][2]], id_pos[matched[i][3]]] for i in op_idxs],
                     dtype=np.int32,
                 )
-                counts = self.engine.gather_count(op, matrix, pairs)
+                if gram is not None:
+                    # Lazy import is safe here: a non-None Gram implies the
+                    # jax engine built it, so jax is already loaded.
+                    from pilosa_tpu.ops.bitwise import gram_pair_counts
+
+                    counts = gram_pair_counts(op, gram, pairs)
+                else:
+                    counts = self.engine.gather_count(op, matrix, pairs)
                 for k, i in enumerate(op_idxs):
                     out[i] = int(counts[k])
         return [out[i] for i in idxs]
 
+    # Transient-HBM budget for the unpacked int8 bit matrix a Gram build
+    # streams through the MXU (ops/dispatch.py uses the same bound).
+    _GRAM_BYTES_BUDGET = 1536 * 1024 * 1024
+
+    def _frame_gram(self, matrix, box: Optional[dict]):
+        """Cached all-pairs AND-count Gram for a fused-path row matrix.
+
+        Computed lazily on the SECOND request against an unchanged cached
+        matrix (cold single requests keep the cheaper direct kernels;
+        steady-state dashboards upgrade to host-side count lookups, which
+        answer every pair op via gram_pair_counts identities).  The box
+        lives and dies with the cache entry, so any patch/append/rebuild
+        invalidates the Gram with it.
+        """
+        if box is None or box.get("hits", 0) < 2:
+            return None
+        if os.environ.get("PILOSA_TPU_NO_GRAM", "").lower() in ("1", "true", "yes"):
+            return None
+        gram = box.get("gram")
+        if gram is not None:
+            return gram
+        shape = getattr(matrix, "shape", None)
+        # Unpacked int8 bits are 32 bytes per uint32 word.
+        if not shape or shape[0] * shape[1] * shape[2] * 32 > self._GRAM_BYTES_BUDGET:
+            return None
+        mu = box.get("mu")
+        if mu is None or not mu.acquire(blocking=False):
+            # Another request is already building this Gram; serve this one
+            # through the direct kernels instead of piling up builders.
+            return None
+        try:
+            gram = box.get("gram")
+            if gram is None:
+                gram = self.engine.pair_gram(matrix)
+                if gram is None:
+                    box["hits"] = -(1 << 30)  # engine can't: stop re-checking
+                    return None
+                box["gram"] = gram
+            return gram
+        finally:
+            mu.release()
+
     def _frame_matrix(
         self, index: str, frame: str, slices, want: set[int]
-    ) -> tuple[dict[int, int], object]:
+    ) -> tuple[dict[int, int], object, Optional[dict]]:
         """Assembled engine row matrix [n_slices, n_rows, W] for a frame.
 
         Cached across requests keyed by (index, frame, slices) and
@@ -399,12 +466,13 @@ class Executor:
         with self._matrix_mu:
             hit = self._matrix_cache.get(key)
             if hit is not None:
-                old_gens, old_id_pos, old_matrix = hit
+                old_gens, old_id_pos, old_matrix, old_box = hit
                 stale = [si for si in range(len(slices)) if old_gens[si] != gens[si]]
                 covered = want <= old_id_pos.keys()
                 if not stale and covered:
                     self._matrix_cache.move_to_end(key)
-                    return old_id_pos, old_matrix
+                    old_box["hits"] = old_box.get("hits", 0) + 1
+                    return old_id_pos, old_matrix, old_box
             else:
                 old_gens = old_id_pos = old_matrix = None
 
@@ -436,12 +504,16 @@ class Executor:
                 id_pos = dict(old_id_pos)
                 for r in new_rows:
                     id_pos[r] = len(id_pos)
+                # Fresh box: a patched/extended matrix invalidates any Gram
+                # (this path always changed something — an unchanged covered
+                # hit returned above).
+                box = {"hits": 1, "mu": threading.Lock()}
                 with self._matrix_mu:
-                    self._matrix_cache[key] = (gens, id_pos, matrix)
+                    self._matrix_cache[key] = (gens, id_pos, matrix, box)
                     self._matrix_cache.move_to_end(key)
                     while len(self._matrix_cache) > self._matrix_cache_entries:
                         self._matrix_cache.popitem(last=False)
-                return id_pos, matrix
+                return id_pos, matrix, box
 
         # Full build.  Oversized row sets are served but never cached: one
         # giant request must not pin rows_max-violating HBM in the LRU.
@@ -452,12 +524,14 @@ class Executor:
         )
         matrix = self.engine.matrix(host)
         if len(rows) <= self._matrix_rows_max:
+            box = {"hits": 1, "mu": threading.Lock()}
             with self._matrix_mu:
-                self._matrix_cache[key] = (gens, id_pos, matrix)
+                self._matrix_cache[key] = (gens, id_pos, matrix, box)
                 self._matrix_cache.move_to_end(key)
                 while len(self._matrix_cache) > self._matrix_cache_entries:
                     self._matrix_cache.popitem(last=False)
-        return id_pos, matrix
+            return id_pos, matrix, box
+        return id_pos, matrix, None
 
     # -- call dispatch (executor.go:156-179) ------------------------------
 

@@ -139,6 +139,47 @@ def gather_count(op: str, row_matrix, pairs):
     return jnp.sum(lax.population_count(apply_pair_op(op, a, b)).astype(jnp.int32), axis=(0, 2))
 
 
+def pair_gram(row_matrix):
+    """All-pairs intersection-count Gram matrix G[i,j] = |row_i & row_j|
+    summed over slices, via ONE int8 matmul on the MXU.
+
+    The MXU strategy for tiny row sets: slices are disjoint bit ranges of
+    the same rows, so the Gram over the concatenated unpacked bit vectors
+    equals the per-slice sum.  int8×int8→int32 accumulation is exact
+    (products are 0/1; counts ≤ 2^31).  G answers every pair op through
+    count identities (see gram_pair_counts), and — being a pure function
+    of the row matrix — XLA hoists it out of query-stream loops, so a
+    stream of fused batches pays for it once.
+    """
+    s, r, w = row_matrix.shape
+    shifts = jnp.arange(32, dtype=jnp.uint32)
+    flat = row_matrix.transpose(1, 0, 2).reshape(r, s * w)
+    bits = ((flat[..., None] >> shifts) & jnp.uint32(1)).astype(jnp.int8).reshape(r, -1)
+    return lax.dot_general(
+        bits, bits, (((1,), (1,)), ((), ())), preferred_element_type=jnp.int32
+    )
+
+
+def gram_pair_counts(op: str, gram, pairs):
+    """Per-pair counts for any pair op from the AND-Gram matrix.
+
+    |a|b| = |a|+|b|-|a&b|;  |a^b| = |a|+|b|-2|a&b|;  |a&~b| = |a|-|a&b|.
+    Works on numpy or jnp arrays (gram: int32[R,R]; pairs: int[B,2]).
+    """
+    g_and = gram[pairs[:, 0], pairs[:, 1]]
+    if op == "and":
+        return g_and
+    d0 = gram[pairs[:, 0], pairs[:, 0]]
+    d1 = gram[pairs[:, 1], pairs[:, 1]]
+    if op == "or":
+        return d0 + d1 - g_and
+    if op == "xor":
+        return d0 + d1 - 2 * g_and
+    if op == "andnot":
+        return d0 - g_and
+    raise ValueError(f"unknown op {op!r}")
+
+
 # ---------------------------------------------------------------------------
 # Host-side numpy helpers (mask building, packing) — used to prepare
 # device inputs; never inside jit (they produce constants).

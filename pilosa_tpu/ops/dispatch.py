@@ -77,11 +77,36 @@ def gather_count_and(row_matrix, pairs):
     return gather_count("and", row_matrix, pairs)
 
 
-def gather_count(op, row_matrix, pairs):
+# Gram strategy gate: all-pairs count work may exceed the requested batch
+# by this factor before the MXU path stops paying off; the unpacked int8
+# bit matrix must also fit a transient-HBM budget.
+_GRAM_FACTOR = 16
+_GRAM_BYTES_BUDGET = 1536 * 1024 * 1024
+
+
+def _use_gram(n_slices: int, n_rows: int, w: int, batch: int) -> bool:
+    if os.environ.get("PILOSA_TPU_NO_GRAM", "").lower() in ("1", "true", "yes"):
+        return False
+    bits_bytes = n_rows * n_slices * w * 32
+    return n_rows * n_rows <= _GRAM_FACTOR * batch and bits_bytes <= _GRAM_BYTES_BUDGET
+
+
+def gather_count(op, row_matrix, pairs, allow_gram: bool = True):
     """Batched Count(<op>(Bitmap, Bitmap)) — and/or/xor/andnot (the
-    fused forms of Intersect/Union/Xor/Difference count batches)."""
+    fused forms of Intersect/Union/Xor/Difference count batches).
+
+    ``allow_gram=False`` skips the all-pairs MXU strategy — callers that
+    manage their own Gram cache (the executor) or dispatch eagerly
+    per-call want the cheaper direct kernels; the Gram branch pays off
+    inside jitted query streams where XLA hoists it out of the loop."""
+    n_slices, n_rows, w = row_matrix.shape
+    # Matmul Gram strategy for tiny row sets: one int8 matmul computes ALL
+    # pair counts; per-query answers are lookups.  Pure HLO on the row
+    # matrix only (no Pallas dependency — any jax backend), so XLA hoists
+    # it out of jitted query streams.
+    if allow_gram and _use_gram(n_slices, n_rows, w, pairs.shape[0]):
+        return bitwise.gram_pair_counts(op, bitwise.pair_gram(row_matrix), pairs)
     if use_pallas() and _tileable(row_matrix.shape[-1]):
-        n_slices, n_rows, w = row_matrix.shape
         # Resident kernel wins whenever streaming ALL rows once beats
         # gathering 2 rows per query (R < 2B) and an all-rows chunk fits
         # the VMEM budget; otherwise fall back to the per-query gather.

@@ -540,3 +540,36 @@ def test_fused_batch_distributed_one_request_per_node(tmp_path):
     e2 = Executor(h, engine="numpy", cluster=cluster, client_factory=DyingClient, host="h0:1")
     assert e2.execute("i", q) == got
     h.close()
+
+
+def test_fused_gram_upgrade_and_invalidation(tmp_path):
+    """Repeated fused requests against an unchanged matrix upgrade to the
+    cached Gram (host lookups); any write invalidates it with the entry."""
+    h = Holder(str(tmp_path / "data"))
+    h.open()
+    idx = h.create_index("i")
+    idx.create_frame("f", FrameOptions())
+    fr = idx.frame("f")
+    for r in range(4):
+        for c in range(10 + r):
+            fr.set_bit("standard", r, c)
+    e = Executor(h, engine="jax")
+    q = (
+        'Count(Intersect(Bitmap(rowID=0, frame="f"), Bitmap(rowID=1, frame="f"))) '
+        'Count(Union(Bitmap(rowID=2, frame="f"), Bitmap(rowID=3, frame="f")))'
+    )
+    first = e.execute("i", q)
+    boxes = [entry[3] for entry in e._matrix_cache.values()]
+    assert boxes and all("gram" not in b for b in boxes)  # cold: direct kernels
+    second = e.execute("i", q)
+    assert second == first
+    boxes = [entry[3] for entry in e._matrix_cache.values()]
+    assert any("gram" in b for b in boxes)  # upgraded on 2nd hit
+    third = e.execute("i", q)  # served from Gram lookups
+    assert third == first
+    # A write invalidates the entry (and its Gram); counts update.
+    fr.set_bit("standard", 0, 500)
+    fr.set_bit("standard", 1, 500)
+    after = e.execute("i", q)
+    assert after[0] == first[0] + 1 and after[1] == first[1]
+    h.close()

@@ -173,3 +173,19 @@ def test_validate_names():
     validate_label("ColumnID")
     with pytest.raises(ErrLabel):
         validate_label("col\n")
+
+
+@pytest.mark.parametrize("op", ["and", "or", "xor", "andnot"])
+def test_pair_gram_identities(rng, op):
+    """The AND-Gram + count identities reproduce every pair op's counts
+    (the MXU all-pairs strategy; exact int8->int32 accumulation)."""
+    rm = rand_words(rng, (3, 6, W))
+    pairs = rng.integers(0, 6, size=(9, 2)).astype(np.int32)
+    G = np.asarray(bw.pair_gram(jnp.asarray(rm)))
+    got = np.asarray(bw.gram_pair_counts(op, G, pairs))
+    f = {"and": lambda a, b: a & b, "or": lambda a, b: a | b,
+         "xor": lambda a, b: a ^ b, "andnot": lambda a, b: a & ~b}[op]
+    want = np.array(
+        [sum(bw.np_count(f(rm[s, p0], rm[s, p1])) for s in range(3)) for p0, p1 in pairs]
+    )
+    np.testing.assert_array_equal(got, want)
