@@ -50,22 +50,6 @@ from pilosa_tpu.pilosa import (
 DEFAULT_FRAME = "general"
 
 
-def _gram_pair_counts_np(op: str, gram: np.ndarray, pairs: np.ndarray) -> np.ndarray:
-    """Host-side mirror of ops.bitwise.gram_pair_counts (kept separate so
-    the numpy-engine path never imports jax)."""
-    g_and = gram[pairs[:, 0], pairs[:, 1]]
-    if op == "and":
-        return g_and
-    d0 = gram[pairs[:, 0], pairs[:, 0]]
-    d1 = gram[pairs[:, 1], pairs[:, 1]]
-    if op == "or":
-        return d0 + d1 - g_and
-    if op == "xor":
-        return d0 + d1 - 2 * g_and
-    if op == "andnot":
-        return d0 - g_and
-    raise ValueError(f"unknown op {op!r}")
-
 _WORDS = SLICE_WIDTH // 32
 
 
@@ -161,6 +145,9 @@ class Executor:
         opt: Optional[ExecOptions] = None,
     ) -> list[Any]:
         if isinstance(query, str):
+            fast = self._flat_fast_path(index, query, slices, opt)
+            if fast is not None:
+                return fast
             query = pql.parse_cached(query)
         if not query.calls:
             raise ErrQueryRequired("query required")
@@ -277,6 +264,106 @@ class Executor:
         "Difference": "andnot",
         "Xor": "xor",
     }
+    _FUSABLE_BYTES = {
+        b"Intersect": "and",
+        b"Union": "or",
+        b"Difference": "andnot",
+        b"Xor": "xor",
+    }
+
+    def _flat_fast_path(self, index: str, src: str, slices, opt) -> Optional[list]:
+        """Compiled-query lane: serve an all-``Count(<op>(Bitmap,Bitmap))``
+        request straight from the native parser's flat arrays — no Token
+        stream, no Call objects (the dominant host cost of a large batched
+        request).  Returns None for ANYTHING outside the exact shape —
+        other calls, inverse views, unusual args, parse errors — so the
+        normal parse path keeps every behavior and error message.
+        """
+        if os.environ.get("PILOSA_TPU_NO_FASTLANE", "").lower() in ("1", "true", "yes"):
+            return None
+        from pilosa_tpu import native
+
+        try:
+            raw = src.encode("utf-8")
+        except UnicodeEncodeError:
+            return None
+        flat = native.pql_parse_flat(raw)
+        if flat is None:
+            return None
+        (n, cs, ce, cchild, cnargs, coff, n_args, aks, ake, atype, aint, avs, ave) = flat
+        # The pattern is exactly 4 preorder records per call; need >= 2 calls.
+        if n < 8 or n % 4:
+            return None
+        # Cheap bail before the bulk tolist: a non-Count first call (e.g. a
+        # big SetBit import body) must not pay a discarded array pass.
+        if raw[int(cs[0]):int(ce[0])] != b"Count":
+            return None
+        cs, ce = cs[:n].tolist(), ce[:n].tolist()
+        cchild, cnargs, coff = cchild[:n].tolist(), cnargs[:n].tolist(), coff[:n].tolist()
+        aks, ake = aks[:n_args].tolist(), ake[:n_args].tolist()
+        atype, aint = atype[:n_args].tolist(), aint[:n_args].tolist()
+        avs, ave = avs[:n_args].tolist(), ave[:n_args].tolist()
+
+        frames: dict[str, object] = {}
+        matched: dict[int, tuple[str, str, int, int]] = {}
+        call_i = 0
+        for i in range(0, n, 4):
+            if raw[cs[i]:ce[i]] != b"Count" or cchild[i] != 1 or cnargs[i] != 0:
+                return None
+            op = self._FUSABLE_BYTES.get(raw[cs[i + 1]:ce[i + 1]])
+            if op is None or cchild[i + 1] != 2 or cnargs[i + 1] != 0:
+                return None
+            leaves = []
+            for j in (i + 2, i + 3):
+                if raw[cs[j]:ce[j]] != b"Bitmap" or cchild[j] != 0 or cnargs[j] not in (1, 2):
+                    return None
+                frame_name = DEFAULT_FRAME
+                row_id = None
+                row_key = None
+                for a in range(coff[j], coff[j] + cnargs[j]):
+                    k = raw[aks[a]:ake[a]]
+                    if k == b"frame":
+                        if atype[a] not in (1, 2):  # string/ident
+                            return None
+                        frame_name = raw[avs[a]:ave[a]].decode("utf-8")
+                    else:
+                        if row_key is not None:  # two non-frame args (e.g.
+                            return None          # rowID+columnID): slow path
+                        if atype[a] != 0 or aint[a] < 0:  # non-negative int
+                            return None
+                        row_key, row_id = k, aint[a]
+                if row_id is None:
+                    return None
+                label_bytes = frames.get(frame_name)
+                if label_bytes is None:
+                    fr = self.holder.frame(index, frame_name)
+                    if fr is None:
+                        return None  # normal path raises the proper error
+                    label_bytes = fr.row_label.encode("utf-8")
+                    frames[frame_name] = label_bytes
+                if row_key != label_bytes:
+                    return None  # inverse view or unknown label: slow path
+                leaves.append((frame_name, row_id))
+            if leaves[0][0] != leaves[1][0]:
+                return None
+            matched[call_i] = (leaves[0][0], op, leaves[0][1], leaves[1][1])
+            call_i += 1
+
+        # Index resolution AFTER shape matching keeps error precedence
+        # identical to the normal path (shape mismatches never raise here).
+        idx_obj = self.holder.index(index)
+        if idx_obj is None:
+            return None  # normal path raises ErrIndexNotFound in order
+        std_slices = list(slices) if slices else list(range(idx_obj.max_slice() + 1))
+        if not std_slices:
+            return None
+        opt = opt or ExecOptions()
+        idxs = list(range(call_i))
+        # The forwarded Query (cluster hop only) comes from the cached
+        # parser — every call matched, so it is the whole request verbatim.
+        return self._fused_dispatch(
+            index, matched, idxs, std_slices, opt, lambda: pql.parse_cached(src)
+        )
 
     def _fuse_count_pair_batch(
         self, index: str, calls, slices, opt: ExecOptions
@@ -328,6 +415,27 @@ class Executor:
             return None
 
         idxs = sorted(matched)
+        totals = self._fused_dispatch(
+            index, matched, idxs, slices, opt,
+            lambda: pql.Query(calls=[calls[i] for i in idxs]),
+        )
+        return dict(zip(idxs, totals))
+
+    def _fused_dispatch(
+        self, index: str, matched: dict, idxs: list[int], slices, opt: ExecOptions,
+        batch_query_fn,
+    ) -> list[int]:
+        """Run matched pair-count calls locally or cluster-wide.
+
+        Distributed fusion: ONE forwarded batch request per remote node
+        (N fused calls x M nodes = M requests, not N*M per-call forwards),
+        local slices through the fused kernels, and the same mid-query
+        replica failover as per-call mapReduce.  ``batch_query_fn`` builds
+        the Query to forward — called only when a remote hop exists, so
+        AST-free callers (the flat fast lane) stay AST-free single-node.
+        The remote peer re-enters the fused path with opt.remote=True and
+        fuses its own slice batch.
+        """
         distributed = (
             not opt.remote
             and self.cluster is not None
@@ -335,16 +443,9 @@ class Executor:
             and len(self.cluster.nodes) > 1
         )
         if not distributed:
-            counts = self._fused_local_counts(index, matched, idxs, slices)
-            return dict(zip(idxs, counts))
+            return self._fused_local_counts(index, matched, idxs, slices)
 
-        # Distributed fusion: ONE forwarded batch request per remote node
-        # (N fused calls x M nodes = M requests, not N*M per-call
-        # forwards), local slices through the fused kernels, and the same
-        # mid-query replica failover as per-call mapReduce.  The remote
-        # peer re-enters this function with opt.remote=True and fuses its
-        # own slice batch.
-        batch_query = pql.Query(calls=[calls[i] for i in idxs])
+        batch_query = batch_query_fn()
 
         def local_map(node_slices):
             return self._fused_local_counts(index, matched, idxs, node_slices)
@@ -357,7 +458,7 @@ class Executor:
                 )
             return [int(r) for r in res]
 
-        totals = self._map_reduce(
+        return self._map_reduce(
             index,
             None,
             slices,
@@ -367,7 +468,6 @@ class Executor:
             [0] * len(idxs),
             remote_map=remote_map,
         )
-        return dict(zip(idxs, totals))
 
     def _fused_local_counts(
         self, index: str, matched: dict, idxs: list[int], slices

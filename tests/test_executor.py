@@ -573,3 +573,60 @@ def test_fused_gram_upgrade_and_invalidation(tmp_path):
     after = e.execute("i", q)
     assert after[0] == first[0] + 1 and after[1] == first[1]
     h.close()
+
+
+def test_flat_fast_lane_matches_slow_path(tmp_path):
+    """The AST-free compiled-query lane must agree with the parse path on
+    results, fall back for out-of-shape requests, and preserve errors."""
+    import os
+
+    h = Holder(str(tmp_path / "data"))
+    h.open()
+    idx = h.create_index("i")
+    idx.create_frame("f", FrameOptions())
+    fr = idx.frame("f")
+    rng = np.random.default_rng(2)
+    for r in range(5):
+        for c in rng.choice(2 * SLICE_WIDTH, size=60, replace=False):
+            fr.set_bit("standard", r, int(c))
+    e = Executor(h, engine="numpy")
+    batch = " ".join(
+        f'Count({op}(Bitmap(rowID={a}, frame="f"), Bitmap(rowID={b}, frame="f")))'
+        for op, a, b in [("Intersect", 0, 1), ("Union", 1, 2), ("Difference", 3, 4), ("Xor", 2, 4)]
+    )
+    fast = e.execute("i", batch)
+    os.environ["PILOSA_TPU_NO_FASTLANE"] = "1"
+    try:
+        slow = e.execute("i", batch)
+    finally:
+        del os.environ["PILOSA_TPU_NO_FASTLANE"]
+    assert fast == slow
+
+    # Out-of-shape requests fall back and still work.
+    mixed = 'Count(Intersect(Bitmap(rowID=0, frame="f"), Bitmap(rowID=1, frame="f"))) Bitmap(rowID=2, frame="f")'
+    res = e.execute("i", mixed)
+    assert res[0] == slow[0] and res[1].bits()
+    # Unknown frame: identical error through the fallback.
+    with pytest.raises(PilosaError):
+        e.execute("i", 'Count(Intersect(Bitmap(rowID=0, frame="nope"), Bitmap(rowID=1, frame="nope"))) '
+                       'Count(Intersect(Bitmap(rowID=0, frame="nope"), Bitmap(rowID=1, frame="nope")))')
+    # Parse errors surface identically (fast lane defers to slow path).
+    with pytest.raises(Exception):
+        e.execute("i", "Count(Intersect(Bitmap(rowID=0")
+    h.close()
+
+
+def test_flat_fast_lane_rejects_conflicting_args(env):
+    """Bitmap(columnID=.., rowID=..) must raise through the slow path, not
+    be silently answered by the fast lane (arg-conflict parity)."""
+    h, e = env
+    fr = h.index("i").frame("general")
+    for c in range(5):
+        fr.set_bit("standard", 0, c)
+        fr.set_bit("standard", 1, c)
+    bad = (
+        'Count(Intersect(Bitmap(columnID=2, rowID=0), Bitmap(rowID=1))) '
+        'Count(Intersect(Bitmap(rowID=0), Bitmap(rowID=1)))'
+    )
+    with pytest.raises(PilosaError):
+        e.execute("i", bad)
