@@ -125,6 +125,13 @@ class Fragment:
         self._row_dev_cache: OrderedDict[int, object] = OrderedDict()
         self._row_dev_cache_max = 256
         self._checksums: dict[int, bytes] = {}
+        # Incrementally-maintained per-row bit counts (LRU-bounded like the
+        # other per-row caches): every guarded mutation knows its delta, so
+        # the rank-cache update on the SetBit hot path avoids a count_range
+        # scan per op (fragment.go keeps the same invariant through its
+        # stored container counts).
+        self._row_counts: OrderedDict[int, int] = OrderedDict()
+        self._row_counts_max = 4096
         self._open = False
         # Write generation: refreshed on every mutation from a
         # process-global counter, so engine-side assembled row matrices
@@ -212,7 +219,7 @@ class Fragment:
         with self._mu:
             changed = self.storage.add(self.pos(row_id, column_id))
             if changed:
-                self._on_row_mutated(row_id)
+                self._on_row_mutated(row_id, delta=1)
                 self._increment_opn()
                 self.stats.count("setN", 1)  # fragment.go:410
             return changed
@@ -241,8 +248,11 @@ class Fragment:
             added = self.storage.add_many_unlogged(positions)
             if len(added):
                 self.stats.count("setN", len(added))
-                for row_id in np.unique(added // np.uint64(SLICE_WIDTH)).tolist():
-                    self._on_row_mutated(int(row_id))
+                rows_added, per_row = np.unique(
+                    added // np.uint64(SLICE_WIDTH), return_counts=True
+                )
+                for row_id, cnt in zip(rows_added.tolist(), per_row.tolist()):
+                    self._on_row_mutated(int(row_id), delta=int(cnt))
                 if len(added) >= self.max_opn:
                     self._snapshot()
                 else:
@@ -259,7 +269,7 @@ class Fragment:
         with self._mu:
             changed = self.storage.remove(self.pos(row_id, column_id))
             if changed:
-                self._on_row_mutated(row_id)
+                self._on_row_mutated(row_id, delta=-1)
                 self._increment_opn()
                 self.stats.count("clearN", 1)  # fragment.go:456
             return changed
@@ -268,13 +278,22 @@ class Fragment:
         with self._mu:
             return self.storage.contains(self.pos(row_id, column_id))
 
-    def _on_row_mutated(self, row_id: int) -> None:
+    def _on_row_mutated(self, row_id: int, delta: Optional[int] = None) -> None:
         self.generation = next(_generation_counter)
         self._row_cache.pop(row_id, None)
         for k in [k for k in self._row_dev_cache if k[1] == row_id]:
             self._row_dev_cache.pop(k, None)
         self._checksums.pop(row_id // HASH_BLOCK_SIZE, None)
-        self.cache.add(row_id, self.row_count(row_id))
+        rc = None
+        if delta is not None:
+            cached = self._row_counts.get(row_id)
+            if cached is not None:
+                rc = cached + delta
+                self._row_counts[row_id] = rc
+                self._row_counts.move_to_end(row_id)
+        if rc is None:
+            rc = self._row_count_locked(row_id)
+        self.cache.add(row_id, rc)
 
     def _increment_opn(self) -> None:
         if self.storage.op_n >= self.max_opn:
@@ -354,7 +373,21 @@ class Fragment:
 
     def row_count(self, row_id: int) -> int:
         with self._mu:
-            return self.storage.count_range(row_id * SLICE_WIDTH, (row_id + 1) * SLICE_WIDTH)
+            return self._row_count_locked(row_id)
+
+    def _row_count_locked(self, row_id: int) -> int:
+        """Cached row cardinality; sole owner of the count+store logic."""
+        rc = self._row_counts.get(row_id)
+        if rc is None:
+            rc = self.storage.count_range(
+                row_id * SLICE_WIDTH, (row_id + 1) * SLICE_WIDTH
+            )
+            self._row_counts[row_id] = rc
+            while len(self._row_counts) > self._row_counts_max:
+                self._row_counts.popitem(last=False)
+        else:
+            self._row_counts.move_to_end(row_id)
+        return rc
 
     def max_row(self) -> int:
         with self._mu:
@@ -480,6 +513,7 @@ class Fragment:
         self._row_cache.clear()
         self._row_dev_cache.clear()
         self._checksums.clear()
+        self._row_counts.clear()
         for row_id in np.unique(row_ids):
             self.cache.bulk_add(int(row_id), self.row_count(int(row_id)))
         self.cache.recalculate()
@@ -586,6 +620,7 @@ class Fragment:
         self._row_cache.clear()
         self._row_dev_cache.clear()
         self._checksums.clear()
+        self._row_counts.clear()
         self.snapshot()
         self._rebuild_cache()
 
