@@ -123,7 +123,7 @@ class Executor:
         self.host = host
         self.max_writes_per_request = max_writes_per_request
         # Device-resident row matrices for the fused count-intersect path,
-        # keyed by (index, frame, slices) and validated by per-fragment
+        # keyed by (index, frame, view, slices) and validated by per-fragment
         # write generations — steady-state fused requests cost zero
         # host→device row traffic.
         self._matrix_cache: "OrderedDict[tuple, tuple]" = OrderedDict()
@@ -170,7 +170,7 @@ class Executor:
         if batched_writes is not None:
             return batched_writes
 
-        fused = self._fuse_count_pair_batch(index, query.calls, std_slices, opt)
+        fused = self._fuse_count_pair_batch(index, query.calls, std_slices, inv_slices, opt)
 
         results = []
         for i, call in enumerate(query.calls):
@@ -305,7 +305,8 @@ class Executor:
         avs, ave = avs[:n_args].tolist(), ave[:n_args].tolist()
 
         frames: dict[str, object] = {}
-        matched: dict[int, tuple[str, str, int, int]] = {}
+        # call idx -> (frame, view, kernel_op, r1, r2)
+        matched: dict[int, tuple[str, str, str, int, int]] = {}
         call_i = 0
         for i in range(0, n, 4):
             if raw[cs[i]:ce[i]] != b"Count" or cchild[i] != 1 or cnargs[i] != 0:
@@ -346,7 +347,7 @@ class Executor:
                 leaves.append((frame_name, row_id))
             if leaves[0][0] != leaves[1][0]:
                 return None
-            matched[call_i] = (leaves[0][0], op, leaves[0][1], leaves[1][1])
+            matched[call_i] = (leaves[0][0], VIEW_STANDARD, op, leaves[0][1], leaves[1][1])
             call_i += 1
 
         # Index resolution AFTER shape matching keeps error precedence
@@ -366,7 +367,7 @@ class Executor:
         )
 
     def _fuse_count_pair_batch(
-        self, index: str, calls, slices, opt: ExecOptions
+        self, index: str, calls, slices, inv_slices, opt: ExecOptions
     ) -> Optional[dict[int, int]]:
         """Run all Count(<op>(Bitmap(a), Bitmap(b))) calls in a request as
         fused device dispatches (one per distinct op).
@@ -384,8 +385,9 @@ class Executor:
         if not slices:
             return None
 
-        # call idx -> (frame, kernel_op, r1, r2)
-        matched: dict[int, tuple[str, str, int, int]] = {}
+        # call idx -> (frame, view, kernel_op, r1, r2)
+        matched: dict[int, tuple[str, str, str, int, int]] = {}
+        batch_view: Optional[str] = None
         for i, c in enumerate(calls):
             if c.name != "Count" or len(c.children) != 1:
                 continue
@@ -401,17 +403,27 @@ class Executor:
                     frame, view, row_id = self._resolve_bitmap_leaf(index, leaf)
                 except PilosaError:
                     return None  # surface the error through the normal path
-                if view != VIEW_STANDARD:
-                    break
-                leaves.append((frame, row_id))
-            if len(leaves) != 2 or leaves[0][0] != leaves[1][0]:
+                leaves.append((frame, view, row_id))
+            if len(leaves) != 2 or leaves[0][:2] != leaves[1][:2]:
                 continue
-            matched[i] = (leaves[0][0], op, leaves[0][1], leaves[1][1])
+            # Uniform view across the batch: the slice domain (standard vs
+            # inverse axis) is per-mapReduce, so mixed-view requests take
+            # the sequential path.
+            if batch_view is None:
+                batch_view = leaves[0][1]
+            elif leaves[0][1] != batch_view:
+                return None
+            matched[i] = (leaves[0][0], leaves[0][1], op, leaves[0][2], leaves[1][2])
         # Fuse only when the WHOLE request is fusable reads: a write call
         # anywhere in the request must be observed by later Counts
         # (per-call ordering semantics), so mixed requests take the
         # sequential path.
         if len(matched) < 2 or len(matched) != len(calls):
+            return None
+
+        if batch_view != VIEW_STANDARD and inv_slices is not None:
+            slices = inv_slices  # inverse axis has its own max slice
+        if not slices:
             return None
 
         idxs = sorted(matched)
@@ -477,18 +489,21 @@ class Executor:
         out: dict[int, int] = {}
         if not slices:
             return [0] * len(idxs)
-        # One row matrix per frame: unique row ids -> device rows.
-        by_frame: dict[str, list[int]] = {}
-        for frame, _, r1, r2 in matched.values():
-            by_frame.setdefault(frame, []).extend((r1, r2))
-        for frame, ids in by_frame.items():
-            id_pos, matrix, box = self._frame_matrix(index, frame, slices, set(ids))
+        # One row matrix per (frame, view): unique row ids -> device rows.
+        by_fv: dict[tuple[str, str], list[int]] = {}
+        for frame, view, _, r1, r2 in matched.values():
+            by_fv.setdefault((frame, view), []).extend((r1, r2))
+        for (frame, view), ids in by_fv.items():
+            id_pos, matrix, box = self._frame_matrix(index, frame, slices, set(ids), view)
             gram = self._frame_gram(matrix, box)
-            ops_here = sorted({op for f, op, _, _ in matched.values() if f == frame})
+            ops_here = sorted({op for f, v, op, _, _ in matched.values() if (f, v) == (frame, view)})
             for op in ops_here:
-                op_idxs = [i for i, (f, o, _, _) in matched.items() if f == frame and o == op]
+                op_idxs = [
+                    i for i, (f, v, o, _, _) in matched.items()
+                    if (f, v, o) == (frame, view, op)
+                ]
                 pairs = np.array(
-                    [[id_pos[matched[i][2]], id_pos[matched[i][3]]] for i in op_idxs],
+                    [[id_pos[matched[i][3]], id_pos[matched[i][4]]] for i in op_idxs],
                     dtype=np.int32,
                 )
                 if gram is not None:
@@ -546,11 +561,11 @@ class Executor:
             mu.release()
 
     def _frame_matrix(
-        self, index: str, frame: str, slices, want: set[int]
+        self, index: str, frame: str, slices, want: set[int], view: str = VIEW_STANDARD
     ) -> tuple[dict[int, int], object, Optional[dict]]:
-        """Assembled engine row matrix [n_slices, n_rows, W] for a frame.
+        """Assembled engine row matrix [n_slices, n_rows, W] for a frame view.
 
-        Cached across requests keyed by (index, frame, slices) and
+        Cached across requests keyed by (index, frame, view, slices) and
         validated against the fragments' write generations; a cache hit
         whose row set covers ``want`` is returned as-is, so steady-state
         fused queries re-use HBM-resident rows.  On miss the matrix is
@@ -560,8 +575,8 @@ class Executor:
         only make the recorded generations stale, forcing a rebuild next
         request — never a stale hit.
         """
-        key = (index, frame, tuple(slices))
-        frags = [self.holder.fragment(index, frame, VIEW_STANDARD, s) for s in slices]
+        key = (index, frame, view, tuple(slices))
+        frags = [self.holder.fragment(index, frame, view, s) for s in slices]
         gens = tuple(-1 if f is None else f.generation for f in frags)
         with self._matrix_mu:
             hit = self._matrix_cache.get(key)

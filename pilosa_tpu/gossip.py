@@ -216,10 +216,20 @@ class GossipNodeSet:
 
         if self.seed and self.seed != self.addr:
             # Join: full state exchange with the seed (gossip.go:70-76).
-            try:
-                self._push_pull(self.seed)
-            except OSError as e:
-                raise ConnectionError(f"gossip join to seed {self.seed}: {e}") from e
+            # Briefly retried — a seed that is itself just starting may
+            # refuse the first connection (memberlist retries joins too).
+            last: Optional[OSError] = None
+            for attempt in range(3):
+                try:
+                    self._push_pull(self.seed)
+                    last = None
+                    break
+                except OSError as e:
+                    last = e
+                    if attempt < 2:
+                        time.sleep(0.2)
+            if last is not None:
+                raise ConnectionError(f"gossip join to seed {self.seed}: {last}") from last
 
     def close(self) -> None:
         self._closing.set()

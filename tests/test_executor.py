@@ -630,3 +630,41 @@ def test_flat_fast_lane_rejects_conflicting_args(env):
     )
     with pytest.raises(PilosaError):
         e.execute("i", bad)
+
+
+@pytest.mark.parametrize("engine", ["numpy", "jax"])
+def test_inverse_view_fused_batch(tmp_path, engine):
+    """A batch of Count(op(Bitmap(columnID=..), ...)) calls (inverse view)
+    fuses like the standard view and matches per-call execution; a batch
+    mixing views falls back and stays correct."""
+    h = Holder(str(tmp_path / "data"))
+    h.open()
+    idx = h.create_index("i")
+    idx.create_frame("f", FrameOptions(inverse_enabled=True))
+    e = Executor(h, engine=engine)
+    rng = np.random.default_rng(6)
+    for r in range(4):
+        for c in rng.choice(300, size=40, replace=False):
+            e.execute("i", f'SetBit(rowID={r}, frame="f", columnID={int(c)})')
+    inv_batch = " ".join(
+        f'Count({op}(Bitmap(columnID={a}, frame="f"), Bitmap(columnID={b}, frame="f")))'
+        for op, a, b in [("Intersect", 5, 6), ("Union", 7, 8), ("Xor", 5, 8)]
+    )
+    fused = e.execute("i", inv_batch)
+    singles = [
+        e.execute("i", f'Count({op}(Bitmap(columnID={a}, frame="f"), Bitmap(columnID={b}, frame="f")))')[0]
+        for op, a, b in [("Intersect", 5, 6), ("Union", 7, 8), ("Xor", 5, 8)]
+    ]
+    assert fused == singles
+    # Mixed views in one request: sequential path, still correct.
+    mixed = (
+        'Count(Intersect(Bitmap(rowID=0, frame="f"), Bitmap(rowID=1, frame="f"))) '
+        'Count(Intersect(Bitmap(columnID=5, frame="f"), Bitmap(columnID=6, frame="f")))'
+    )
+    got = e.execute("i", mixed)
+    want = [
+        e.execute("i", 'Count(Intersect(Bitmap(rowID=0, frame="f"), Bitmap(rowID=1, frame="f")))')[0],
+        e.execute("i", 'Count(Intersect(Bitmap(columnID=5, frame="f"), Bitmap(columnID=6, frame="f")))')[0],
+    ]
+    assert got == want
+    h.close()
