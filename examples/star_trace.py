@@ -1,0 +1,82 @@
+"""Star Trace walkthrough — the reference's getting-started demo, end to end.
+
+Models GitHub stargazers: an index over repositories (columns) with a
+``stargazer`` frame (rows = users) and a ``language`` frame (rows =
+language ids).  Mirrors the PQL sequence from the reference docs: SetBit
+writes, Bitmap/Intersect/Union/Count reads, TopN ranking, and row
+attributes — driven through a real HTTP server + client.
+
+Run: python examples/star_trace.py          (uses an ephemeral port)
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from pilosa_tpu.config import Config
+from pilosa_tpu.server.client import Client
+from pilosa_tpu.server.server import Server
+
+
+def main() -> None:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        host = f"127.0.0.1:{s.getsockname()[1]}"
+    with tempfile.TemporaryDirectory() as data_dir:
+        server = Server(Config(data_dir=data_dir, host=host))
+        server.open()
+        try:
+            c = Client(host)
+
+            # Schema: repository index, stargazer + language frames.
+            c.create_index("repository", {"columnLabel": "repo_id"})
+            c.create_frame("repository", "stargazer", {"rowLabel": "user_id", "cacheType": "ranked"})
+            c.create_frame("repository", "language", {"rowLabel": "language_id"})
+
+            # Load: who starred what, and what language each repo is.
+            rng = np.random.default_rng(7)
+            stars = [(u, r) for u in range(1, 9) for r in rng.choice(100, size=12, replace=False)]
+            c.import_bits("repository", "stargazer", stars)
+            langs = [(int(r % 5), int(r)) for r in range(100)]
+            c.import_bits("repository", "language", langs)
+
+            # Which repos did user 1 star?
+            r = c.execute_query("repository", "Bitmap(user_id=1, frame=stargazer)")
+            print("user 1 starred:", r["results"][0]["bitmap"]["bits"][:10], "...")
+
+            # Repos starred by BOTH user 1 and user 2 (the headline shape).
+            r = c.execute_query(
+                "repository",
+                "Count(Intersect(Bitmap(user_id=1, frame=stargazer), Bitmap(user_id=2, frame=stargazer)))",
+            )
+            print("starred by 1 AND 2:", r["results"][0]["n"])
+
+            # Starred by 1 or 2, written in language 0.
+            r = c.execute_query(
+                "repository",
+                "Count(Intersect(Union(Bitmap(user_id=1, frame=stargazer),"
+                " Bitmap(user_id=2, frame=stargazer)), Bitmap(language_id=0, frame=language)))",
+            )
+            print("(1 OR 2) AND language 0:", r["results"][0]["n"])
+
+            # Top stargazers (ranked cache + two-phase exact counts).
+            r = c.execute_query("repository", "TopN(frame=stargazer, n=3)")
+            print("top stargazers:", [(p["id"], p["count"]) for p in r["results"][0]["pairs"]])
+
+            # Row attributes ride along with Bitmap results.
+            c.execute_query("repository", 'SetRowAttrs(user_id=1, frame=stargazer, name="alice")')
+            r = c.execute_query("repository", "Bitmap(user_id=1, frame=stargazer)")
+            print("user 1 attrs:", r["results"][0]["bitmap"]["attrs"])
+        finally:
+            server.close()
+
+
+if __name__ == "__main__":
+    main()
