@@ -406,6 +406,179 @@ struct PqlParser {
 
 }  // namespace
 
+// ---------------------------------------------------------------------------
+// Fused pair-count batch matcher: recognizes a request that is ENTIRELY
+// Count(<op>(Bitmap(...), Bitmap(...))) calls and emits pair arrays
+// directly — the executor's compiled-query lane skips tokens, ASTs, and
+// per-arg Python work.  Frame names and row-key labels are interned by
+// content into small tables so Python decodes each distinct string once.
+// Returns the call count, or PN_PQL_FALLBACK for ANYTHING else (other
+// calls, floats, escapes, duplicate/conflicting args, syntax errors) so
+// the slower paths keep every behavior and error message.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+struct PairMatcher {
+    const char* s;
+    int64_t len;
+    int64_t i;
+
+    bool ws() {
+        while (i < len) {
+            char c = s[i];
+            if (c == ' ' || c == '\t' || c == '\n' || c == '\r' || c == '\f' || c == '\v')
+                i++;
+            else
+                break;
+        }
+        return i < len;
+    }
+    bool lit(const char* word, int n) {
+        if (i + n > len || memcmp(s + i, word, (size_t)n) != 0) return false;
+        // Must not extend into a longer identifier.
+        if (i + n < len && PqlParser::identc(s[i + n])) return false;
+        i += n;
+        return true;
+    }
+    bool ch(char c) {
+        if (i >= len || s[i] != c) return false;
+        i++;
+        return true;
+    }
+    bool ident(int32_t* b, int32_t* e) {
+        if (i >= len || !PqlParser::alpha(s[i])) return false;
+        int64_t st = i++;
+        while (i < len && PqlParser::identc(s[i])) i++;
+        *b = (int32_t)st;
+        *e = (int32_t)i;
+        return true;
+    }
+    bool integer(int64_t* out) {
+        if (i >= len || s[i] < '0' || s[i] > '9') return false;
+        int64_t st = i;
+        int64_t v = 0;
+        while (i < len && s[i] >= '0' && s[i] <= '9') {
+            if (i - st >= 18) return false;  // bound BEFORE accumulating: no overflow UB
+            v = v * 10 + (s[i++] - '0');
+        }
+        if (i < len && (s[i] == '.' || PqlParser::identc(s[i]))) return false;
+        *out = v;
+        return true;
+    }
+};
+
+// Intern a span by content into (tab_s, tab_e, n_tab); returns index.
+static int32_t intern_span(const char* s, int32_t b, int32_t e, int32_t* tab_s,
+                           int32_t* tab_e, int32_t* n_tab, int32_t cap) {
+    for (int32_t t = 0; t < *n_tab; t++) {
+        int32_t l = tab_e[t] - tab_s[t];
+        if (l == e - b && memcmp(s + tab_s[t], s + b, (size_t)l) == 0) return t;
+    }
+    if (*n_tab >= cap) return -2;
+    tab_s[*n_tab] = b;
+    tab_e[*n_tab] = e;
+    return (*n_tab)++;
+}
+
+}  // namespace
+
+extern "C" {
+
+// op ids: 0=and(Intersect) 1=or(Union) 2=xor(Xor) 3=andnot(Difference)
+// frame_id -1 = default frame.  Returns matched call count, or
+// PN_PQL_FALLBACK.  Tables: unique frame spans and row-key spans.
+int64_t pn_pql_match_pairs(const char* src, int64_t len,
+                           uint8_t* op_ids, int32_t* frame_ids, int32_t* key_ids,
+                           int64_t* r1, int64_t* r2, int64_t call_cap,
+                           int32_t* uf_s, int32_t* uf_e, int32_t* n_frames,
+                           int32_t* uk_s, int32_t* uk_e, int32_t* n_keys,
+                           int32_t tab_cap) {
+    PairMatcher p = {src, len, 0};
+    int64_t n = 0;
+    *n_frames = 0;
+    *n_keys = 0;
+    while (p.ws()) {
+        if (n >= call_cap) return PN_PQL_FALLBACK;
+        if (!p.lit("Count", 5)) return PN_PQL_FALLBACK;
+        if (!p.ws() || !p.ch('(')) return PN_PQL_FALLBACK;
+        if (!p.ws()) return PN_PQL_FALLBACK;
+        uint8_t op;
+        if (p.lit("Intersect", 9)) op = 0;
+        else if (p.lit("Union", 5)) op = 1;
+        else if (p.lit("Xor", 3)) op = 2;
+        else if (p.lit("Difference", 10)) op = 3;
+        else return PN_PQL_FALLBACK;
+        if (!p.ws() || !p.ch('(')) return PN_PQL_FALLBACK;
+        int32_t fid[2], kid[2];
+        int64_t row[2];
+        for (int leaf = 0; leaf < 2; leaf++) {
+            if (!p.ws() || !p.lit("Bitmap", 6)) return PN_PQL_FALLBACK;
+            if (!p.ws() || !p.ch('(')) return PN_PQL_FALLBACK;
+            int32_t f_s = -1, f_e = -1, k_s = -1, k_e = -1;
+            int64_t rv = -1;
+            for (int a = 0; a < 2; a++) {
+                if (!p.ws()) return PN_PQL_FALLBACK;
+                int32_t ks, ke;
+                if (!p.ident(&ks, &ke)) return PN_PQL_FALLBACK;
+                if (!p.ws() || !p.ch('=')) return PN_PQL_FALLBACK;
+                if (!p.ws()) return PN_PQL_FALLBACK;
+                if (ke - ks == 5 && memcmp(src + ks, "frame", 5) == 0) {
+                    if (f_s >= 0) return PN_PQL_FALLBACK;  // duplicate frame=
+                    char q = src[p.i];
+                    if (q == '"' || q == '\'') {
+                        p.i++;
+                        f_s = (int32_t)p.i;
+                        while (p.i < len && src[p.i] != q) {
+                            if (src[p.i] == '\\') return PN_PQL_FALLBACK;
+                            p.i++;
+                        }
+                        if (p.i >= len) return PN_PQL_FALLBACK;
+                        f_e = (int32_t)p.i;
+                        p.i++;
+                    } else if (!p.ident(&f_s, &f_e)) {
+                        return PN_PQL_FALLBACK;
+                    }
+                } else {
+                    if (rv >= 0) return PN_PQL_FALLBACK;  // two int keys
+                    if (!p.integer(&rv)) return PN_PQL_FALLBACK;
+                    k_s = ks;
+                    k_e = ke;
+                }
+                if (!p.ws()) return PN_PQL_FALLBACK;
+                if (src[p.i] == ',') {
+                    p.i++;
+                    continue;
+                }
+                break;
+            }
+            if (!p.ws() || !p.ch(')')) return PN_PQL_FALLBACK;
+            if (rv < 0 || k_s < 0) return PN_PQL_FALLBACK;
+            fid[leaf] = (f_s < 0)
+                            ? -1
+                            : intern_span(src, f_s, f_e, uf_s, uf_e, n_frames, tab_cap);
+            kid[leaf] = intern_span(src, k_s, k_e, uk_s, uk_e, n_keys, tab_cap);
+            if (fid[leaf] == -2 || kid[leaf] == -2) return PN_PQL_FALLBACK;
+            row[leaf] = rv;
+            if (leaf == 0) {
+                if (!p.ws() || !p.ch(',')) return PN_PQL_FALLBACK;
+            }
+        }
+        if (!p.ws() || !p.ch(')')) return PN_PQL_FALLBACK;  // close op
+        if (!p.ws() || !p.ch(')')) return PN_PQL_FALLBACK;  // close Count
+        if (fid[0] != fid[1] || kid[0] != kid[1]) return PN_PQL_FALLBACK;
+        op_ids[n] = op;
+        frame_ids[n] = fid[0];
+        key_ids[n] = kid[0];
+        r1[n] = row[0];
+        r2[n] = row[1];
+        n++;
+    }
+    return n >= 2 ? n : PN_PQL_FALLBACK;
+}
+
+}  // extern "C"
+
 extern "C" {
 
 // Returns the number of calls parsed (preorder), or PN_PQL_FALLBACK when

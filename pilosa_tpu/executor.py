@@ -264,20 +264,14 @@ class Executor:
         "Difference": "andnot",
         "Xor": "xor",
     }
-    _FUSABLE_BYTES = {
-        b"Intersect": "and",
-        b"Union": "or",
-        b"Difference": "andnot",
-        b"Xor": "xor",
-    }
-
     def _flat_fast_path(self, index: str, src: str, slices, opt) -> Optional[list]:
         """Compiled-query lane: serve an all-``Count(<op>(Bitmap,Bitmap))``
-        request straight from the native parser's flat arrays — no Token
-        stream, no Call objects (the dominant host cost of a large batched
-        request).  Returns None for ANYTHING outside the exact shape —
-        other calls, inverse views, unusual args, parse errors — so the
-        normal parse path keeps every behavior and error message.
+        request straight from the native matcher's pair arrays — no Token
+        stream, no Call objects, no per-call Python work (the dominant
+        host costs of a large batched request).  Returns None for
+        ANYTHING outside the exact shape — other calls, inverse views,
+        unusual args, parse errors — so the normal parse path keeps every
+        behavior and error message.
         """
         if os.environ.get("PILOSA_TPU_NO_FASTLANE", "").lower() in ("1", "true", "yes"):
             return None
@@ -287,69 +281,21 @@ class Executor:
             raw = src.encode("utf-8")
         except UnicodeEncodeError:
             return None
-        flat = native.pql_parse_flat(raw)
-        if flat is None:
+        m = native.pql_match_pairs(raw)
+        if m is None:
             return None
-        (n, cs, ce, cchild, cnargs, coff, n_args, aks, ake, atype, aint, avs, ave) = flat
-        # The pattern is exactly 4 preorder records per call; need >= 2 calls.
-        if n < 8 or n % 4:
-            return None
-        # Cheap bail before the bulk tolist: a non-Count first call (e.g. a
-        # big SetBit import body) must not pay a discarded array pass.
-        if raw[int(cs[0]):int(ce[0])] != b"Count":
-            return None
-        cs, ce = cs[:n].tolist(), ce[:n].tolist()
-        cchild, cnargs, coff = cchild[:n].tolist(), cnargs[:n].tolist(), coff[:n].tolist()
-        aks, ake = aks[:n_args].tolist(), ake[:n_args].tolist()
-        atype, aint = atype[:n_args].tolist(), aint[:n_args].tolist()
-        avs, ave = avs[:n_args].tolist(), ave[:n_args].tolist()
+        op_ids, frame_ids, key_ids, r1, r2, frames_b, keys_b = m
 
-        frames: dict[str, object] = {}
-        # call idx -> (frame, view, kernel_op, r1, r2)
-        matched: dict[int, tuple[str, str, str, int, int]] = {}
-        call_i = 0
-        for i in range(0, n, 4):
-            if raw[cs[i]:ce[i]] != b"Count" or cchild[i] != 1 or cnargs[i] != 0:
+        # Validate each distinct (frame, row-key) combo once: the key must
+        # be the frame's row label (standard view; inverse and unknown
+        # labels take the slow path, missing frames raise there too).
+        frame_names = [b.decode("utf-8") for b in frames_b]
+        key_names = [b.decode("utf-8") for b in keys_b]
+        for f_id, k_id in set(zip(frame_ids.tolist(), key_ids.tolist())):
+            fname = frame_names[f_id] if f_id >= 0 else DEFAULT_FRAME
+            fr = self.holder.frame(index, fname)
+            if fr is None or key_names[k_id] != fr.row_label:
                 return None
-            op = self._FUSABLE_BYTES.get(raw[cs[i + 1]:ce[i + 1]])
-            if op is None or cchild[i + 1] != 2 or cnargs[i + 1] != 0:
-                return None
-            leaves = []
-            for j in (i + 2, i + 3):
-                if raw[cs[j]:ce[j]] != b"Bitmap" or cchild[j] != 0 or cnargs[j] not in (1, 2):
-                    return None
-                frame_name = DEFAULT_FRAME
-                row_id = None
-                row_key = None
-                for a in range(coff[j], coff[j] + cnargs[j]):
-                    k = raw[aks[a]:ake[a]]
-                    if k == b"frame":
-                        if atype[a] not in (1, 2):  # string/ident
-                            return None
-                        frame_name = raw[avs[a]:ave[a]].decode("utf-8")
-                    else:
-                        if row_key is not None:  # two non-frame args (e.g.
-                            return None          # rowID+columnID): slow path
-                        if atype[a] != 0 or aint[a] < 0:  # non-negative int
-                            return None
-                        row_key, row_id = k, aint[a]
-                if row_id is None:
-                    return None
-                label_bytes = frames.get(frame_name)
-                if label_bytes is None:
-                    fr = self.holder.frame(index, frame_name)
-                    if fr is None:
-                        return None  # normal path raises the proper error
-                    label_bytes = fr.row_label.encode("utf-8")
-                    frames[frame_name] = label_bytes
-                if row_key != label_bytes:
-                    return None  # inverse view or unknown label: slow path
-                leaves.append((frame_name, row_id))
-            if leaves[0][0] != leaves[1][0]:
-                return None
-            matched[call_i] = (leaves[0][0], VIEW_STANDARD, op, leaves[0][1], leaves[1][1])
-            call_i += 1
-
         # Index resolution AFTER shape matching keeps error precedence
         # identical to the normal path (shape mismatches never raise here).
         idx_obj = self.holder.index(index)
@@ -359,12 +305,67 @@ class Executor:
         if not std_slices:
             return None
         opt = opt or ExecOptions()
-        idxs = list(range(call_i))
-        # The forwarded Query (cluster hop only) comes from the cached
-        # parser — every call matched, so it is the whole request verbatim.
-        return self._fused_dispatch(
-            index, matched, idxs, std_slices, opt, lambda: pql.parse_cached(src)
+
+        if self._is_distributed(opt):
+            # Cluster hop: build the matched dict + forwarded Query (from
+            # the parse cache) and reuse the failover machinery.
+            matched = {
+                i: (
+                    frame_names[frame_ids[i]] if frame_ids[i] >= 0 else DEFAULT_FRAME,
+                    VIEW_STANDARD,
+                    native.PQL_PAIR_OPS[op_ids[i]],
+                    int(r1[i]),
+                    int(r2[i]),
+                )
+                for i in range(len(op_ids))
+            }
+            return self._fused_dispatch(
+                index, matched, list(range(len(op_ids))), std_slices, opt,
+                lambda: pql.parse_cached(src),
+            )
+        return self._fused_local_counts_arrays(
+            index, frame_names, op_ids, frame_ids, r1, r2, std_slices
         )
+
+    def _fused_local_counts_arrays(
+        self, index: str, frame_names, op_ids, frame_ids, r1, r2, slices
+    ) -> list[int]:
+        """Vectorized local evaluator for the compiled-query lane: group by
+        (frame, op) with numpy masks, map row ids to matrix positions via
+        searchsorted, and answer each group with one Gram lookup batch or
+        kernel dispatch — no per-call Python loop."""
+        from pilosa_tpu.native import PQL_PAIR_OPS
+
+        out = np.zeros(len(op_ids), dtype=np.int64)
+        for f_id in np.unique(frame_ids):
+            fmask = frame_ids == f_id
+            fname = frame_names[f_id] if f_id >= 0 else DEFAULT_FRAME
+            fr1, fr2 = r1[fmask], r2[fmask]
+            rows = np.unique(np.concatenate([fr1, fr2]))
+            id_pos, matrix, box = self._frame_matrix(
+                index, fname, slices, set(rows.tolist())
+            )
+            lut = np.fromiter(
+                (id_pos[int(rv)] for rv in rows), dtype=np.int32, count=len(rows)
+            )
+            p1 = lut[np.searchsorted(rows, fr1)]
+            p2 = lut[np.searchsorted(rows, fr2)]
+            gram = self._frame_gram(matrix, box)
+            fops = op_ids[fmask]
+            fout = np.zeros(len(fr1), dtype=np.int64)
+            for op_id in np.unique(fops):
+                om = fops == op_id
+                pairs = np.stack([p1[om], p2[om]], axis=1).astype(np.int32)
+                op = PQL_PAIR_OPS[int(op_id)]
+                if gram is not None:
+                    from pilosa_tpu.ops.bitwise import gram_pair_counts
+
+                    counts = gram_pair_counts(op, gram, pairs)
+                else:
+                    counts = self.engine.gather_count(op, matrix, pairs)
+                fout[om] = counts
+            out[fmask] = fout
+        return [int(v) for v in out]
 
     def _fuse_count_pair_batch(
         self, index: str, calls, slices, inv_slices, opt: ExecOptions
@@ -433,6 +434,16 @@ class Executor:
         )
         return dict(zip(idxs, totals))
 
+    def _is_distributed(self, opt: ExecOptions) -> bool:
+        """Whether this executor coordinates a multi-node fan-out (shared
+        by the AST fused path and the compiled-query lane)."""
+        return (
+            not opt.remote
+            and self.cluster is not None
+            and self.client_factory is not None
+            and len(self.cluster.nodes) > 1
+        )
+
     def _fused_dispatch(
         self, index: str, matched: dict, idxs: list[int], slices, opt: ExecOptions,
         batch_query_fn,
@@ -448,13 +459,7 @@ class Executor:
         The remote peer re-enters the fused path with opt.remote=True and
         fuses its own slice batch.
         """
-        distributed = (
-            not opt.remote
-            and self.cluster is not None
-            and self.client_factory is not None
-            and len(self.cluster.nodes) > 1
-        )
-        if not distributed:
+        if not self._is_distributed(opt):
             return self._fused_local_counts(index, matched, idxs, slices)
 
         batch_query = batch_query_fn()

@@ -83,6 +83,13 @@ def load() -> Optional[ctypes.CDLL]:
             i32p, i32p, i32p, i64p, i32p, i32p,
             ctypes.c_int64, i64p,
         ]
+        lib.pn_pql_match_pairs.restype = ctypes.c_int64
+        lib.pn_pql_match_pairs.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64,
+            u8p, i32p, i32p, i64p, i64p, ctypes.c_int64,
+            i32p, i32p, i32p, i32p, i32p, i32p,
+            ctypes.c_int32,
+        ]
         _lib = lib
         return _lib
 
@@ -298,6 +305,58 @@ def pql_parse_flat(src: bytes):
     return (
         int(n), cname_s, cname_e, cnchild, cnargs, cargs_off,
         int(n_args_out.value), ak_s, ak_e, atype, aint, av_s, av_e,
+    )
+
+
+# Kernel op names by pn_pql_match_pairs op id.
+PQL_PAIR_OPS = ("and", "or", "xor", "andnot")
+
+_PAIR_TAB_CAP = 64  # distinct frame names / row labels per request
+
+
+def pql_match_pairs(src: bytes):
+    """Native matcher for an all-Count(<op>(Bitmap,Bitmap)) request body.
+
+    Returns None (fall back to the slower paths) or
+    (op_ids u8[N], frame_ids i32[N] (-1 = default frame), key_ids i32[N],
+    r1 i64[N], r2 i64[N], frames list[bytes], keys list[bytes]) where
+    frames/keys are the interned distinct spans referenced by the ids.
+    """
+    lib = load()
+    if lib is None or not src:
+        return None
+    # Cheap bail before any scan/allocation: a request not starting with
+    # "Count" (e.g. a megabyte SetBit import body) pays nothing here.
+    if not src.lstrip()[:5] == b"Count":
+        return None
+    call_cap = src.count(b"Count") + 1
+    op_ids = np.empty(call_cap, dtype=np.uint8)
+    frame_ids = np.empty(call_cap, dtype=np.int32)
+    key_ids = np.empty(call_cap, dtype=np.int32)
+    r1 = np.empty(call_cap, dtype=np.int64)
+    r2 = np.empty(call_cap, dtype=np.int64)
+    uf_s = np.empty(_PAIR_TAB_CAP, dtype=np.int32)
+    uf_e = np.empty(_PAIR_TAB_CAP, dtype=np.int32)
+    uk_s = np.empty(_PAIR_TAB_CAP, dtype=np.int32)
+    uk_e = np.empty(_PAIR_TAB_CAP, dtype=np.int32)
+    n_frames = ctypes.c_int32(0)
+    n_keys = ctypes.c_int32(0)
+    i32 = ctypes.POINTER(ctypes.c_int32)
+    i64 = ctypes.POINTER(ctypes.c_int64)
+    n = lib.pn_pql_match_pairs(
+        src, len(src),
+        _u8(op_ids), frame_ids.ctypes.data_as(i32), key_ids.ctypes.data_as(i32),
+        r1.ctypes.data_as(i64), r2.ctypes.data_as(i64), call_cap,
+        uf_s.ctypes.data_as(i32), uf_e.ctypes.data_as(i32), ctypes.byref(n_frames),
+        uk_s.ctypes.data_as(i32), uk_e.ctypes.data_as(i32), ctypes.byref(n_keys),
+        _PAIR_TAB_CAP,
+    )
+    if n < 0:
+        return None
+    frames = [src[uf_s[t]:uf_e[t]] for t in range(n_frames.value)]
+    keys = [src[uk_s[t]:uk_e[t]] for t in range(n_keys.value)]
+    return (
+        op_ids[:n], frame_ids[:n], key_ids[:n], r1[:n], r2[:n], frames, keys,
     )
 
 
