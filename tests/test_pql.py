@@ -188,3 +188,49 @@ def test_deeply_nested_query_does_not_crash():
         c = c.children[0]
         depth += 1
     assert depth == 90 and c.name == "B" and c.args == {"x": 1}
+
+
+def test_parser_fuzz_native_python_parity():
+    """Bounded structured fuzz: random sources must either produce the
+    SAME AST from the native fast path and the pure-Python parser, or
+    raise through the same error path (never crash, never diverge)."""
+    import random
+
+    from pilosa_tpu.pql import parser as pmod
+
+    rng = random.Random(1234)
+    names = ["Count", "Intersect", "Bitmap", "Union", "TopN", "F", "my-f.x"]
+    keys = ["rowID", "frame", "n", "columnID", "x_y"]
+    vals = ["1", "-5", "0", '"str"', "'s'", "true", "false", "null", "ident-v",
+            "1.5", "[1,2]", "99999999999999999999"]
+
+    def gen_call(depth):
+        name = rng.choice(names)
+        parts = []
+        for _ in range(rng.randint(0, 2)):
+            if depth < 2 and rng.random() < 0.4:
+                parts.append(gen_call(depth + 1))
+        args = ", ".join(
+            f"{rng.choice(keys)}={rng.choice(vals)}" for _ in range(rng.randint(0, 3))
+        )
+        inner = ", ".join(p for p in parts if p)
+        if inner and args:
+            return f"{name}({inner}, {args})"
+        return f"{name}({inner or args})"
+
+    for _ in range(300):
+        src = " ".join(gen_call(0) for _ in range(rng.randint(1, 4)))
+        try:
+            slow = pmod._Parser(pmod.tokenize(src), src).parse_query()
+            slow_err = None
+        except Exception as e:
+            slow, slow_err = None, type(e)
+        try:
+            fast = pmod.parse(src)
+            fast_err = None
+        except Exception as e:
+            fast, fast_err = None, type(e)
+        if slow_err is not None:
+            assert fast_err is slow_err, (src, slow_err, fast_err)
+        else:
+            assert fast_err is None and _ast_eq(slow, fast), src
