@@ -54,6 +54,16 @@ class FrameOptions:
         self.cache_size = cache_size
         self.time_quantum = time_quantum
 
+    def validate(self) -> None:
+        """Raise for any invalid option — callers check BEFORE creating
+        frame state on disk, so a rejected create leaves no ghost frame."""
+        if self.row_label:
+            validate_label(self.row_label)
+        if self.cache_type:
+            cache_mod.new_cache(self.cache_type, 1)
+        if self.time_quantum:
+            tq.parse_time_quantum(self.time_quantum)
+
     def to_json(self) -> dict:
         return {
             "rowLabel": self.row_label,

@@ -159,6 +159,9 @@ class Index:
         row_label = opt.row_label or "rowID"
         if row_label == self.column_label:
             raise ErrColumnRowLabelEqual(f"row label equals column label: {row_label}")
+        # Validate ALL options BEFORE any directory exists: a rejected
+        # create must not leave a ghost frame that reappears on restart.
+        opt.validate()
         frame = Frame(
             os.path.join(self.path, name),
             self.name,

@@ -234,3 +234,54 @@ def test_attr_blocks_and_diff(store, tmp_path):
     assert blocks_diff(store.blocks(), store.blocks()) == []
     assert other.block_data(1) == {ATTR_BLOCK_SIZE + 1: {"b": 999}}
     other.close()
+
+
+def test_create_frame_rejects_bad_cache_type(tmp_path):
+    """Invalid cacheType fails at creation (handler 400), leaving no ghost
+    frame directory behind (handler_internal_test.go analog)."""
+    import os
+    import pytest
+    from pilosa_tpu.core.frame import FrameOptions
+    from pilosa_tpu.core.holder import Holder
+    from pilosa_tpu.pilosa import ErrInvalidCacheType
+
+    h = Holder(str(tmp_path / "d"))
+    h.open()
+    idx = h.create_index("i")
+    with pytest.raises(ErrInvalidCacheType):
+        idx.create_frame("bad", FrameOptions(cache_type="bogus"))
+    assert idx.frame("bad") is None
+    assert not os.path.exists(os.path.join(idx.path, "bad"))
+    h.close()
+    # Restart: no ghost frame rediscovered.
+    h2 = Holder(str(tmp_path / "d"))
+    h2.open()
+    assert h2.index("i").frame("bad") is None
+    h2.close()
+
+
+def test_create_frame_rejects_bad_options_without_ghosts(tmp_path):
+    """Every invalid FrameOption fails BEFORE any on-disk state exists."""
+    import os
+    import pytest
+    from pilosa_tpu.core.frame import FrameOptions
+    from pilosa_tpu.core.holder import Holder
+    from pilosa_tpu.pilosa import PilosaError
+
+    h = Holder(str(tmp_path / "d"))
+    h.open()
+    idx = h.create_index("i")
+    for bad in (
+        FrameOptions(time_quantum="bogus"),
+        FrameOptions(row_label="BAD LABEL"),
+        FrameOptions(cache_type="bogus"),
+    ):
+        with pytest.raises(PilosaError):
+            idx.create_frame("bad", bad)
+        assert idx.frame("bad") is None
+        assert not os.path.exists(os.path.join(idx.path, "bad"))
+    h.close()
+    h2 = Holder(str(tmp_path / "d"))
+    h2.open()
+    assert h2.index("i").frame("bad") is None
+    h2.close()
