@@ -151,8 +151,8 @@ class Frame:
             )
 
     def apply_options(self, opt: FrameOptions) -> None:
+        opt.validate()  # single source of truth for option validity
         if opt.row_label:
-            validate_label(opt.row_label)
             self.row_label = opt.row_label
         self.inverse_enabled = bool(opt.inverse_enabled)
         if opt.cache_type:

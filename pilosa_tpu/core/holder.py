@@ -93,6 +93,8 @@ class Holder:
 
     def _create_index(self, name: str, opt: IndexOptions) -> Index:
         validate_name(name)
+        # Validate options BEFORE any directory exists (no ghost indexes).
+        opt.validate()
         idx = Index(
             os.path.join(self.path, name),
             name,

@@ -34,6 +34,14 @@ class IndexOptions:
         self.column_label = column_label
         self.time_quantum = time_quantum
 
+    def validate(self) -> None:
+        """Raise for any invalid option — checked BEFORE creating index
+        state on disk, so a rejected create leaves no ghost index."""
+        if self.column_label:
+            validate_label(self.column_label)
+        if self.time_quantum:
+            tq.parse_time_quantum(self.time_quantum)
+
 
 class Index:
     def __init__(self, path: str, name: str, stats=None, on_new_fragment=None):
@@ -106,8 +114,8 @@ class Index:
             json.dump({"columnLabel": self.column_label, "timeQuantum": self.time_quantum}, f)
 
     def apply_options(self, opt: IndexOptions) -> None:
+        opt.validate()  # single source of truth for option validity
         if opt.column_label:
-            validate_label(opt.column_label)
             self.column_label = opt.column_label
         if opt.time_quantum:
             self.time_quantum = tq.parse_time_quantum(opt.time_quantum)

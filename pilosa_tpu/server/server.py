@@ -31,6 +31,7 @@ from pilosa_tpu.core.frame import FrameOptions
 from pilosa_tpu.core.holder import CACHE_FLUSH_INTERVAL, Holder
 from pilosa_tpu.core.index import IndexOptions
 from pilosa_tpu.executor import Executor
+from pilosa_tpu.pilosa import PilosaError
 from pilosa_tpu.server.client import Client
 from pilosa_tpu.server.handler import Handler, serve
 from pilosa_tpu.syncer import HolderSyncer
@@ -168,6 +169,11 @@ class Server:
     def port(self) -> int:
         return self._httpd.server_address[1] if self._httpd else 0
 
+    def _log(self, msg: str) -> None:
+        import logging
+
+        logging.getLogger("pilosa_tpu").warning(msg)
+
     # -- background loops ---------------------------------------------------
 
     def _start_loop(self, fn, interval: float) -> None:
@@ -258,26 +264,39 @@ class Server:
         if node is not None and ns.get("state"):
             node.state = ns["state"]
         for idx_status in ns.get("indexes", []):
+            # Per-item isolation: one peer-advertised index/frame with
+            # invalid options (e.g. persisted by an older node) must not
+            # abort the REST of the merge — later entries and remote
+            # max-slice tracking still apply.
             meta = idx_status.get("meta", {})
-            idx = self.holder.create_index_if_not_exists(
-                idx_status["name"],
-                IndexOptions(
-                    column_label=meta.get("columnLabel", ""),
-                    time_quantum=meta.get("timeQuantum", ""),
-                ),
-            )
-            for fr in idx_status.get("frames", []):
-                fmeta = fr.get("meta", {})
-                idx.create_frame_if_not_exists(
-                    fr["name"],
-                    FrameOptions(
-                        row_label=fmeta.get("rowLabel", ""),
-                        inverse_enabled=fmeta.get("inverseEnabled", False),
-                        cache_type=fmeta.get("cacheType", ""),
-                        cache_size=fmeta.get("cacheSize", 0),
-                        time_quantum=fmeta.get("timeQuantum", ""),
+            try:
+                idx = self.holder.create_index_if_not_exists(
+                    idx_status["name"],
+                    IndexOptions(
+                        column_label=meta.get("columnLabel", ""),
+                        time_quantum=meta.get("timeQuantum", ""),
                     ),
                 )
+            except PilosaError as e:
+                self._log(f"status merge: skipping index {idx_status['name']!r}: {e}")
+                continue
+            for fr in idx_status.get("frames", []):
+                fmeta = fr.get("meta", {})
+                try:
+                    idx.create_frame_if_not_exists(
+                        fr["name"],
+                        FrameOptions(
+                            row_label=fmeta.get("rowLabel", ""),
+                            inverse_enabled=fmeta.get("inverseEnabled", False),
+                            cache_type=fmeta.get("cacheType", ""),
+                            cache_size=fmeta.get("cacheSize", 0),
+                            time_quantum=fmeta.get("timeQuantum", ""),
+                        ),
+                    )
+                except PilosaError as e:
+                    self._log(
+                        f"status merge: skipping frame {idx_status['name']}/{fr['name']!r}: {e}"
+                    )
             if idx_status.get("maxSlice", 0) > idx.max_slice():
                 idx.set_remote_max_slice(idx_status["maxSlice"])
 

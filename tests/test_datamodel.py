@@ -285,3 +285,25 @@ def test_create_frame_rejects_bad_options_without_ghosts(tmp_path):
     h2.open()
     assert h2.index("i").frame("bad") is None
     h2.close()
+
+
+def test_create_index_rejects_bad_options_without_ghosts(tmp_path):
+    """Invalid IndexOptions fail BEFORE any on-disk state exists."""
+    import os
+    import pytest
+    from pilosa_tpu.core.holder import Holder
+    from pilosa_tpu.core.index import IndexOptions
+    from pilosa_tpu.pilosa import PilosaError
+
+    h = Holder(str(tmp_path / "d"))
+    h.open()
+    for bad in (IndexOptions(column_label="BAD LABEL"), IndexOptions(time_quantum="bogus")):
+        with pytest.raises(PilosaError):
+            h.create_index("ghost", bad)
+        assert h.index("ghost") is None
+        assert not os.path.exists(os.path.join(h.path, "ghost"))
+    h.close()
+    h2 = Holder(str(tmp_path / "d"))
+    h2.open()
+    assert h2.index("ghost") is None
+    h2.close()

@@ -368,3 +368,27 @@ def test_two_node_fused_batch_query(tmp_path):
     finally:
         for s in servers:
             s.close()
+
+
+def test_status_merge_skips_bad_items(tmp_path):
+    """A peer-advertised frame with invalid options (e.g. persisted by an
+    older node) must not abort the rest of the status merge."""
+    s = make_server(tmp_path, name="m0")
+    try:
+        indexes = [
+            {"name": "a", "meta": {}, "maxSlice": 3,
+             "frames": [{"name": "bad", "meta": {"cacheType": "bogus"}},
+                        {"name": "good", "meta": {}}]},
+            {"name": "b", "meta": {}, "maxSlice": 1, "frames": []},
+        ]
+        from pilosa_tpu import wire
+
+        s.handle_remote_status(wire.encode_node_status(s.host, "UP", indexes))
+        # bad frame skipped; everything after it still merged.
+        assert s.holder.index("a") is not None
+        assert s.holder.index("a").frame("bad") is None
+        assert s.holder.index("a").frame("good") is not None
+        assert s.holder.index("a").max_slice() == 3
+        assert s.holder.index("b") is not None
+    finally:
+        s.close()
