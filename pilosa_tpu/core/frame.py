@@ -151,7 +151,8 @@ class Frame:
             )
 
     def apply_options(self, opt: FrameOptions) -> None:
-        opt.validate()  # single source of truth for option validity
+        # Callers validate first (Index._create_frame runs opt.validate()
+        # BEFORE any on-disk state exists); this only applies.
         if opt.row_label:
             self.row_label = opt.row_label
         self.inverse_enabled = bool(opt.inverse_enabled)

@@ -114,7 +114,8 @@ class Index:
             json.dump({"columnLabel": self.column_label, "timeQuantum": self.time_quantum}, f)
 
     def apply_options(self, opt: IndexOptions) -> None:
-        opt.validate()  # single source of truth for option validity
+        # Callers validate first (Holder._create_index runs opt.validate()
+        # BEFORE any on-disk state exists); this only applies.
         if opt.column_label:
             self.column_label = opt.column_label
         if opt.time_quantum:

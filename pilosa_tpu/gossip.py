@@ -178,10 +178,7 @@ class GossipNodeSet:
             raise RuntimeError(
                 "opening GossipNodeSet: call start(handler) before open()"
             )  # gossip.go:64-66
-        host, port = _split_addr(self.bind)
-        self._udp = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
-        self._udp.bind((host, port))
-        port = self._udp.getsockname()[1]
+        host, cfg_port = _split_addr(self.bind)
 
         nodeset = self
 
@@ -199,8 +196,26 @@ class GossipNodeSet:
                 except Exception:
                     pass
 
+        # Gossip needs the SAME port on UDP and TCP (memberlist does too).
+        # With an ephemeral bind (":0") the kernel-chosen UDP port may be
+        # held by another process on TCP — rebind the pair until both work.
         socketserver.ThreadingTCPServer.allow_reuse_address = True
-        self._tcp = socketserver.ThreadingTCPServer((host, port), _TCPHandler)
+        last_err: Optional[OSError] = None
+        for _ in range(16):
+            self._udp = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            self._udp.bind((host, cfg_port))
+            port = self._udp.getsockname()[1]
+            try:
+                self._tcp = socketserver.ThreadingTCPServer((host, port), _TCPHandler)
+                last_err = None
+                break
+            except OSError as e:
+                last_err = e
+                self._udp.close()
+                if cfg_port != 0:
+                    break  # explicit port: caller asked for exactly this one
+        if last_err is not None:
+            raise last_err
         self.addr = f"{host}:{port}"
         self.bind = self.addr
 

@@ -15,6 +15,7 @@ import functools
 import os
 
 import jax
+import jax.numpy as jnp
 
 from pilosa_tpu.ops import bitwise
 from pilosa_tpu.ops.pallas_kernels import (
@@ -91,6 +92,11 @@ def _use_gram(n_slices: int, n_rows: int, w: int, batch: int) -> bool:
     return n_rows * n_rows <= _GRAM_FACTOR * batch and bits_bytes <= _GRAM_BYTES_BUDGET
 
 
+# The Pallas kernels scalar-prefetch the pair ids into SMEM (~1 MiB);
+# large batches are evaluated in chunks of this many queries.
+_GATHER_BATCH_MAX = 1024
+
+
 def gather_count(op, row_matrix, pairs, allow_gram: bool = True):
     """Batched Count(<op>(Bitmap, Bitmap)) — and/or/xor/andnot (the
     fused forms of Intersect/Union/Xor/Difference count batches).
@@ -107,10 +113,22 @@ def gather_count(op, row_matrix, pairs, allow_gram: bool = True):
     if allow_gram and _use_gram(n_slices, n_rows, w, pairs.shape[0]):
         return bitwise.gram_pair_counts(op, bitwise.pair_gram(row_matrix), pairs)
     if use_pallas() and _tileable(row_matrix.shape[-1]):
+        b = pairs.shape[0]
+        if b > _GATHER_BATCH_MAX:
+            # Chunk oversized batches: the prefetched pair ids must fit
+            # SMEM (observed hard failure at B=4096 on v5e).
+            return jnp.concatenate(
+                [
+                    gather_count(
+                        op, row_matrix, pairs[i : i + _GATHER_BATCH_MAX], allow_gram=False
+                    )
+                    for i in range(0, b, _GATHER_BATCH_MAX)
+                ]
+            )
         # Resident kernel wins whenever streaming ALL rows once beats
         # gathering 2 rows per query (R < 2B) and an all-rows chunk fits
         # the VMEM budget; otherwise fall back to the per-query gather.
-        if n_rows < 2 * pairs.shape[0] and _resident_chunk_sub(n_rows, w, pairs.shape[0]):
+        if n_rows < 2 * b and _resident_chunk_sub(n_rows, w, b):
             return fused_resident_count2(op, row_matrix, pairs)
         return fused_gather_count2(op, row_matrix, pairs)
     return bitwise.gather_count(op, row_matrix, pairs)

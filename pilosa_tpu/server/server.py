@@ -31,10 +31,14 @@ from pilosa_tpu.core.frame import FrameOptions
 from pilosa_tpu.core.holder import CACHE_FLUSH_INTERVAL, Holder
 from pilosa_tpu.core.index import IndexOptions
 from pilosa_tpu.executor import Executor
+import logging
+
 from pilosa_tpu.pilosa import PilosaError
 from pilosa_tpu.server.client import Client
 from pilosa_tpu.server.handler import Handler, serve
 from pilosa_tpu.syncer import HolderSyncer
+
+_logger = logging.getLogger("pilosa_tpu")
 
 
 class Server:
@@ -74,6 +78,9 @@ class Server:
         self._httpd = None
         self._closing = threading.Event()
         self._threads: list[threading.Thread] = []
+        # Distinct (kind, name) items already warned about during status
+        # merges — a steady-state bad peer item logs once, not per sync.
+        self._merge_warned: set[tuple] = set()
 
     # -- wiring ----------------------------------------------------------
 
@@ -169,10 +176,16 @@ class Server:
     def port(self) -> int:
         return self._httpd.server_address[1] if self._httpd else 0
 
-    def _log(self, msg: str) -> None:
-        import logging
-
-        logging.getLogger("pilosa_tpu").warning(msg)
+    def _log_merge_skip(self, key: tuple, msg: str) -> None:
+        """Warn once per distinct (item, error) — steady-state bad peers
+        don't spam every sync, but a NEW failure mode for the same item
+        still surfaces."""
+        if key in self._merge_warned:
+            return
+        if len(self._merge_warned) > 1024:
+            self._merge_warned.clear()
+        self._merge_warned.add(key)
+        _logger.warning(msg)
 
     # -- background loops ---------------------------------------------------
 
@@ -268,21 +281,27 @@ class Server:
             # invalid options (e.g. persisted by an older node) must not
             # abort the REST of the merge — later entries and remote
             # max-slice tracking still apply.
-            meta = idx_status.get("meta", {})
             try:
+                name = idx_status["name"]
+                meta = idx_status.get("meta", {}) or {}
                 idx = self.holder.create_index_if_not_exists(
-                    idx_status["name"],
+                    name,
                     IndexOptions(
                         column_label=meta.get("columnLabel", ""),
                         time_quantum=meta.get("timeQuantum", ""),
                     ),
                 )
-            except PilosaError as e:
-                self._log(f"status merge: skipping index {idx_status['name']!r}: {e}")
+            except (PilosaError, KeyError, TypeError, AttributeError) as e:
+                # Invalid options OR a structurally-malformed item from a
+                # different-version peer: skip it, keep merging the rest.
+                self._log_merge_skip(
+                    ("index", str(idx_status.get("name")), str(e)),
+                    f"status merge: skipping index {idx_status.get('name')!r}: {e}",
+                )
                 continue
             for fr in idx_status.get("frames", []):
-                fmeta = fr.get("meta", {})
                 try:
+                    fmeta = fr.get("meta", {}) or {}
                     idx.create_frame_if_not_exists(
                         fr["name"],
                         FrameOptions(
@@ -293,9 +312,10 @@ class Server:
                             time_quantum=fmeta.get("timeQuantum", ""),
                         ),
                     )
-                except PilosaError as e:
-                    self._log(
-                        f"status merge: skipping frame {idx_status['name']}/{fr['name']!r}: {e}"
+                except (PilosaError, KeyError, TypeError, AttributeError) as e:
+                    self._log_merge_skip(
+                        ("frame", name, str(fr.get("name") if hasattr(fr, "get") else fr), str(e)),
+                        f"status merge: skipping frame {name}/{fr!r}: {e}",
                     )
             if idx_status.get("maxSlice", 0) > idx.max_slice():
                 idx.set_remote_max_slice(idx_status["maxSlice"])

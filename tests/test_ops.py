@@ -189,3 +189,35 @@ def test_pair_gram_identities(rng, op):
         [sum(bw.np_count(f(rm[s, p0], rm[s, p1])) for s in range(3)) for p0, p1 in pairs]
     )
     np.testing.assert_array_equal(got, want)
+
+
+def test_gather_count_chunks_large_batches(rng, monkeypatch):
+    """Batches beyond the SMEM prefetch budget are evaluated in chunks
+    with identical results (observed hard failure at B=4096 on v5e).
+    The Pallas gate is forced on and the kernels stubbed with the jnp
+    forms so CI actually executes the chunk/concatenate logic."""
+    from pilosa_tpu.ops.dispatch import _GATHER_BATCH_MAX
+
+    chunk_sizes = []
+
+    def fake_kernel(op, rm_, prs, interpret=False):
+        chunk_sizes.append(int(prs.shape[0]))
+        return bw.gather_count(op, rm_, prs)
+
+    monkeypatch.setattr(dispatch, "use_pallas", lambda: True)
+    monkeypatch.setattr(dispatch, "fused_gather_count2", fake_kernel)
+    monkeypatch.setattr(dispatch, "fused_resident_count2", fake_kernel)
+
+    n_slices, n_rows = 2, 5
+    rm = rand_words(rng, (n_slices, n_rows, W))
+    b = _GATHER_BATCH_MAX + 37
+    pairs = rng.integers(0, n_rows, size=(b, 2)).astype(np.int32)
+    got = np.asarray(
+        dispatch.gather_count("and", jnp.asarray(rm), jnp.asarray(pairs), allow_gram=False)
+    )
+    assert got.shape == (b,)
+    assert chunk_sizes == [_GATHER_BATCH_MAX, 37]  # chunking really ran
+    for k in (0, _GATHER_BATCH_MAX - 1, _GATHER_BATCH_MAX, b - 1):
+        p0, p1 = pairs[k]
+        want = sum(bw.np_count_and(rm[s, p0], rm[s, p1]) for s in range(n_slices))
+        assert got[k] == want

@@ -392,3 +392,25 @@ def test_status_merge_skips_bad_items(tmp_path):
         assert s.holder.index("b") is not None
     finally:
         s.close()
+
+
+def test_status_merge_survives_malformed_items(tmp_path):
+    """Structurally-malformed peer items (missing keys, wrong types — a
+    different-version peer) are skipped per item, not merge-aborting."""
+    s = make_server(tmp_path, name="mm0")
+    try:
+        indexes = [
+            {"name": "a", "meta": {}, "maxSlice": 0,
+             "frames": [{"meta": {}},                      # no "name"
+                        {"name": "ok", "meta": {}}]},
+            {"name": "b", "meta": {}, "maxSlice": 2, "frames": []},
+        ]
+        from pilosa_tpu import wire
+
+        s.handle_remote_status(wire.encode_node_status(s.host, "UP", indexes))
+        assert s.holder.index("a") is not None
+        assert s.holder.index("a").frame("ok") is not None
+        assert s.holder.index("b") is not None
+        assert s.holder.index("b").max_slice() == 2
+    finally:
+        s.close()
