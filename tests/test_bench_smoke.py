@@ -1,0 +1,57 @@
+"""Smoke tests for the benchmark harness and examples.
+
+The driver runs ``python bench.py`` at round end — a broken bench records
+nothing, so every config must at least produce its JSON line on tiny
+shapes (CPU backend).  Same for the getting-started example.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(env_extra, script="bench.py", timeout=240):
+    env = dict(os.environ)
+    env.pop("PYTHONPATH", None)  # keep TPU plugin site dirs out
+    env["JAX_PLATFORMS"] = "cpu"
+    env.update(env_extra)
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, script)],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+        env=env,
+        timeout=timeout,
+    )
+    assert out.returncode == 0, f"stderr:\n{out.stderr[-2000:]}"
+    return out.stdout
+
+
+@pytest.mark.parametrize(
+    "cfg,extra",
+    [
+        ("intersect_count", {"BENCH_ITERS": "2", "BENCH_SLICES": "2", "BENCH_ROWS": "4", "BENCH_BATCH": "4"}),
+        ("setbit", {"BENCH_OPS": "300"}),
+        ("topn", {"BENCH_ITERS": "2", "BENCH_TOPN_ROWS": "8"}),
+        ("union64", {"BENCH_ITERS": "3", "BENCH_SLICES": "2"}),
+        ("timerange", {"BENCH_ITERS": "4", "BENCH_BATCH": "2"}),
+        ("executor", {"BENCH_ITERS": "3", "BENCH_SLICES": "2", "BENCH_ROWS": "4",
+                      "BENCH_BATCH": "4", "BENCH_BITS_PER_ROW": "50", "BENCH_THREADS": "2"}),
+    ],
+)
+def test_bench_config_emits_json(cfg, extra):
+    stdout = _run({"BENCH_CONFIG": cfg, **extra})
+    line = stdout.strip().splitlines()[-1]
+    result = json.loads(line)
+    assert set(result) == {"metric", "value", "unit", "vs_baseline"}
+    assert result["value"] > 0
+
+
+def test_star_trace_example_runs():
+    stdout = _run({}, script=os.path.join("examples", "star_trace.py"))
+    assert "top stargazers:" in stdout and "user 1 attrs:" in stdout
