@@ -15,7 +15,7 @@ hash-routed.
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 DEFAULT_PARTITION_N = 256
 DEFAULT_REPLICA_N = 1

@@ -203,6 +203,9 @@ class Frame:
         return self.views.get(name)
 
     def create_view_if_not_exists(self, name: str) -> View:
+        # Don't create inverse views when disabled (frame.go:413-415).
+        if name == VIEW_INVERSE and not self.inverse_enabled:
+            raise ErrFrameInverseDisabled(f"inverse storage disabled for frame {self.name!r}")
         with self._mu:
             v = self.views.get(name)
             if v is not None:

@@ -17,7 +17,6 @@ from typing import Optional
 from pilosa_tpu.core import timequantum as tq
 from pilosa_tpu.core.attr import AttrStore
 from pilosa_tpu.core.frame import Frame, FrameOptions
-from pilosa_tpu.core.view import VIEW_STANDARD
 from pilosa_tpu.pilosa import (
     ErrColumnRowLabelEqual,
     ErrFrameExists,

@@ -33,11 +33,10 @@ from pilosa_tpu import pql
 from pilosa_tpu.core import cache as cache_mod
 from pilosa_tpu.core.fragment import TopOptions
 from pilosa_tpu.core import timequantum as tq
-from pilosa_tpu.core.frame import DEFAULT_ROW_LABEL
-from pilosa_tpu.core.index import DEFAULT_COLUMN_LABEL
 from pilosa_tpu.core.view import VIEW_INVERSE, VIEW_STANDARD
 from pilosa_tpu.engine import new_engine
 from pilosa_tpu.pilosa import (
+    ErrFrameInverseDisabled,
     ErrFrameNotFound,
     ErrIndexNotFound,
     ErrQueryRequired,
@@ -763,7 +762,9 @@ class Executor:
             )
         if col_ok:
             if not frame.inverse_enabled:
-                raise PilosaError("Bitmap() cannot retrieve columns unless inverse storage enabled")
+                raise ErrFrameInverseDisabled(
+                    "Bitmap() cannot retrieve columns unless inverse storage enabled"
+                )
             return frame_name, VIEW_INVERSE, col_id
         return frame_name, VIEW_STANDARD, row_id
 

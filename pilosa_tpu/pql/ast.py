@@ -9,7 +9,7 @@ WriteCallN mutation counting (ast.go:31-41), SupportsInverse/IsInverse
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Any, Optional
+from typing import Any
 
 # Timestamp layout for SetBit/Range args (pql/parser.go:25).
 TIME_FORMAT = "%Y-%m-%dT%H:%M"

@@ -11,7 +11,6 @@ from __future__ import annotations
 import json
 import urllib.error
 import urllib.request
-from datetime import datetime
 from typing import Any, Optional, Sequence
 
 import numpy as np

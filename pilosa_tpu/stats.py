@@ -11,9 +11,8 @@ from __future__ import annotations
 
 import socket
 import threading
-import time
 from collections import defaultdict
-from typing import Iterable, Optional
+from typing import Iterable
 
 
 class NopStatsClient:

@@ -10,9 +10,6 @@ to each peer as SetBit/ClearBit PQL.
 
 from __future__ import annotations
 
-import numpy as np
-
-from pilosa_tpu.core.view import VIEW_STANDARD
 
 
 class HolderSyncer:

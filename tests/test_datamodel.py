@@ -307,3 +307,19 @@ def test_create_index_rejects_bad_options_without_ghosts(tmp_path):
     h2.open()
     assert h2.index("ghost") is None
     h2.close()
+
+
+def test_inverse_disabled_raises_specific_error(holder):
+    """Reads against a non-inverse frame raise ErrFrameInverseDisabled,
+    and inverse views cannot be created on it (frame.go:413-415)."""
+    from pilosa_tpu.executor import Executor
+    from pilosa_tpu.pilosa import ErrFrameInverseDisabled
+
+    idx = holder.create_index("inv")
+    idx.create_frame("f", FrameOptions())  # inverse disabled
+    e = Executor(holder, engine="numpy")
+    e.execute("inv", 'SetBit(rowID=1, frame="f", columnID=2)')
+    with pytest.raises(ErrFrameInverseDisabled):
+        e.execute("inv", 'Bitmap(columnID=2, frame="f")')
+    with pytest.raises(ErrFrameInverseDisabled):
+        idx.frame("f").create_view_if_not_exists(VIEW_INVERSE)
