@@ -26,7 +26,7 @@ from pilosa_tpu.core import cache as cache_mod
 from pilosa_tpu.core import timequantum as tq
 from pilosa_tpu.core.attr import AttrStore
 from pilosa_tpu.core.fragment import DEFAULT_CACHE_SIZE
-from pilosa_tpu.core.view import VIEW_INVERSE, VIEW_STANDARD, View, is_valid_view
+from pilosa_tpu.core.view import VIEW_INVERSE, VIEW_STANDARD, View, is_inverse_view, is_valid_view
 from pilosa_tpu.pilosa import (
     ErrFrameInverseDisabled,
     ErrInvalidView,
@@ -203,8 +203,9 @@ class Frame:
         return self.views.get(name)
 
     def create_view_if_not_exists(self, name: str) -> View:
-        # Don't create inverse views when disabled (frame.go:413-415).
-        if name == VIEW_INVERSE and not self.inverse_enabled:
+        # Don't create inverse views (incl. time-quantum inverse
+        # sub-views) when disabled (frame.go:413-415 IsInverseView).
+        if is_inverse_view(name) and not self.inverse_enabled:
             raise ErrFrameInverseDisabled(f"inverse storage disabled for frame {self.name!r}")
         with self._mu:
             v = self.views.get(name)

@@ -25,6 +25,12 @@ def is_valid_view(name: str) -> bool:
     return name in (VIEW_STANDARD, VIEW_INVERSE)
 
 
+def is_inverse_view(name: str) -> bool:
+    """The base inverse view or any time-quantum inverse sub-view
+    (view.go IsInverseView prefix semantics)."""
+    return name == VIEW_INVERSE or name.startswith(VIEW_INVERSE + "_")
+
+
 class View:
     def __init__(
         self,

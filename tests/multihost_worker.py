@@ -39,6 +39,40 @@ def main() -> int:
     union = mesh.fetch_global(sharded_union_reduce(mesh, [a, b]))
     union_ok = bool(np.array_equal(union, a_full | b_full))
 
+    # Full product stack in SPMD lockstep: every process holds the same
+    # Holder data and runs the SAME PQL through a MeshEngine whose slice
+    # axis spans the GLOBAL device list — host work is replicated, device
+    # work is sharded, counts psum across processes.  The multi-host
+    # analog of the reference's coordinator+peers, with ICI/DCN
+    # collectives instead of protobuf-over-TCP reduces.
+    import tempfile
+
+    from pilosa_tpu.core.frame import FrameOptions
+    from pilosa_tpu.core.holder import Holder
+    from pilosa_tpu.engine import MeshEngine
+    from pilosa_tpu.executor import Executor
+    from pilosa_tpu.pilosa import SLICE_WIDTH
+
+    with tempfile.TemporaryDirectory() as d:
+        h = Holder(d)
+        h.open()
+        idx = h.create_index("g")
+        idx.create_frame("f", FrameOptions())
+        fr = idx.frame("f")
+        for r in range(3):
+            for s in range(4):
+                fr.set_bit("standard", r, s * SLICE_WIDTH + 7 + r)
+                fr.set_bit("standard", r, s * SLICE_WIDTH + 99)
+        e_np = Executor(h, engine="numpy")
+        e_mesh = Executor(h, engine=MeshEngine(devices=jax.devices()))
+        q = (
+            'Count(Intersect(Bitmap(rowID=0, frame="f"), Bitmap(rowID=1, frame="f"))) '
+            'Count(Union(Bitmap(rowID=1, frame="f"), Bitmap(rowID=2, frame="f")))'
+        )
+        mesh_res = e_mesh.execute("g", q)
+        exec_ok = mesh_res == e_np.execute("g", q)
+        h.close()
+
     print(
         json.dumps(
             {
@@ -49,6 +83,8 @@ def main() -> int:
                 "count": got_count,
                 "count_ok": got_count == want_count,
                 "union_ok": union_ok,
+                "exec_results": [int(v) for v in mesh_res],
+                "exec_ok": bool(exec_ok),
             }
         ),
         flush=True,

@@ -323,3 +323,5 @@ def test_inverse_disabled_raises_specific_error(holder):
         e.execute("inv", 'Bitmap(columnID=2, frame="f")')
     with pytest.raises(ErrFrameInverseDisabled):
         idx.frame("f").create_view_if_not_exists(VIEW_INVERSE)
+    with pytest.raises(ErrFrameInverseDisabled):  # time sub-views too
+        idx.frame("f").create_view_if_not_exists(VIEW_INVERSE + "_2017")

@@ -67,7 +67,11 @@ def test_two_process_global_mesh():
         assert o["local_devices"] == 2
         assert o["count_ok"], o
         assert o["union_ok"], o
+        # Full PQL executor in SPMD lockstep over the global mesh agrees
+        # with the numpy engine on every process.
+        assert o["exec_ok"], o
     # Both processes computed the SAME global count from disjoint shards.
     assert by_pid[0]["count"] == by_pid[1]["count"]
+    assert by_pid[0]["exec_results"] == by_pid[1]["exec_results"]
     # Slice ownership is disjoint and covers the stack.
     assert sorted(by_pid[0]["owned"] + by_pid[1]["owned"]) == list(range(8))
