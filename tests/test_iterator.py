@@ -63,3 +63,17 @@ def test_merge_iterators_dedups():
     b = SliceIterator([0, 2], [1, 3])
     merged = merge_iterators([a, b])
     assert drain(merged) == [(0, 1), (1, 2), (2, 3)]
+
+
+def test_buf_iterator_double_unread_raises():
+    """Double unread without an intervening read is a programming error
+    (iterator_test.go TestBufIterator_DoubleFillPanic analog)."""
+    import pytest
+
+    from pilosa_tpu.iterator import BufIterator, SliceIterator
+
+    it = BufIterator(SliceIterator([1], [2]))
+    p = it.next()
+    it.unread(p)
+    with pytest.raises(RuntimeError):
+        it.unread(p)
