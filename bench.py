@@ -44,6 +44,20 @@ import time
 import numpy as np
 
 
+def _best_of_runs(fn, default_runs=3):
+    """Min wall time over N runs (tunnel jitter; see headline config)."""
+    import time as _t
+
+    runs = int(os.environ.get("BENCH_TIMED_RUNS", str(default_runs)))
+    dt = float("inf")
+    out = None
+    for _ in range(runs):
+        t0 = _t.perf_counter()
+        out = fn()
+        dt = min(dt, _t.perf_counter() - t0)
+    return dt, out
+
+
 def bench_setbit() -> dict:
     """Config 2: SetBit op/sec through the fragment write path (the
     `pilosa bench --operation set-bit` analog, ctl/bench.go:71-102)."""
@@ -103,9 +117,8 @@ def bench_topn() -> dict:
     drows, dsrc = jax.device_put(rows), jax.device_put(src)
     dmasks = jax.device_put(masks)
     out = np.asarray(run_stream(drows, dsrc, dmasks))  # warm + compile
-    t0 = time.perf_counter()
-    out = np.asarray(run_stream(drows, dsrc, dmasks))
-    dt = (time.perf_counter() - t0) / iters
+    dt, out = _best_of_runs(lambda: np.asarray(run_stream(drows, dsrc, dmasks)))
+    dt /= iters
     from pilosa_tpu.roaring import _POPCNT8
 
     base_iters = max(1, min(2, iters))
@@ -157,9 +170,8 @@ def bench_union64() -> dict:
     da, db = jax.device_put(a), jax.device_put(b)
     dmasks = jax.device_put(masks)
     got = np.asarray(run_stream(da, db, dmasks))  # warm + compile
-    t0 = time.perf_counter()
-    got = np.asarray(run_stream(da, db, dmasks))
-    dt = (time.perf_counter() - t0) / iters
+    dt, got = _best_of_runs(lambda: np.asarray(run_stream(da, db, dmasks)))
+    dt /= iters
     from pilosa_tpu.roaring import _POPCNT8
 
     base_iters = max(1, min(3, iters))
@@ -216,9 +228,8 @@ def bench_timerange() -> dict:
     dv = jax.device_put(views)
     dmasks = jax.device_put(masks)
     got = np.asarray(run_stream(dv, dmasks))  # warm + compile
-    t0 = time.perf_counter()
-    got = np.asarray(run_stream(dv, dmasks))
-    dt = (time.perf_counter() - t0) / iters
+    dt, got = _best_of_runs(lambda: np.asarray(run_stream(dv, dmasks)))
+    dt /= iters
     from pilosa_tpu.roaring import _POPCNT8
 
     base_iters = max(1, min(3, iters))
