@@ -46,15 +46,13 @@ import numpy as np
 
 def _best_of_runs(fn, default_runs=3):
     """Min wall time over N runs (tunnel jitter; see headline config)."""
-    import time as _t
-
-    runs = int(os.environ.get("BENCH_TIMED_RUNS", str(default_runs)))
+    runs = max(1, int(os.environ.get("BENCH_TIMED_RUNS", str(default_runs))))
     dt = float("inf")
     out = None
     for _ in range(runs):
-        t0 = _t.perf_counter()
+        t0 = time.perf_counter()
         out = fn()
-        dt = min(dt, _t.perf_counter() - t0)
+        dt = min(dt, time.perf_counter() - t0)
     return dt, out
 
 
@@ -392,12 +390,7 @@ def main() -> None:
     # Best of N timed runs (min wall time): the remote tunnel adds tens of
     # ms of jitter per dispatch, so a single draw under-reports the
     # sustained rate.  Standard min-of-N benchmark methodology.
-    timed_runs = int(os.environ.get("BENCH_TIMED_RUNS", "3"))
-    dt = float("inf")
-    for _ in range(timed_runs):
-        t0 = time.perf_counter()
-        out = np.asarray(run_stream(drm, dpairs))
-        dt = min(dt, time.perf_counter() - t0)
+    dt, out = _best_of_runs(lambda: np.asarray(run_stream(drm, dpairs)))
     qps = iters * batch / dt
 
     # ---- CPU numpy baseline (single-threaded popcount loop) -------------
