@@ -83,3 +83,35 @@ def test_random_pql_numpy_vs_jax(tmp_path, seed):
             )
             assert e_np.execute("d", wq) is not None
     h.close()
+
+
+@pytest.mark.parametrize("seed", [7, 8])
+def test_random_range_queries_numpy_vs_jax(tmp_path, seed):
+    """Time-quantum Range covers through both engines must agree."""
+    rng = random.Random(seed)
+    nprng = np.random.default_rng(seed)
+    h = Holder(str(tmp_path / "data"))
+    h.open()
+    idx = h.create_index("d")
+    idx.create_frame("t", FrameOptions(time_quantum="YMDH"))
+    fr = idx.frame("t")
+    e_np = Executor(h, engine="numpy")
+    months = [f"2017-{m:02d}-{d:02d}T{hh:02d}:00" for m in (1, 2, 3) for d in (1, 15) for hh in (0, 12)]
+    for _ in range(120):
+        r = int(nprng.integers(0, 4))
+        c = int(nprng.integers(0, 2 * SLICE_WIDTH))
+        ts = rng.choice(months)
+        e_np.execute("d", f'SetBit(rowID={r}, frame="t", columnID={c}, timestamp="{ts}")')
+    e_jx = Executor(h, engine="jax")
+    spans = [("2017-01-01T00:00", "2017-02-01T00:00"), ("2017-01-10T00:00", "2017-03-20T12:00"),
+             ("2016-12-01T00:00", "2018-01-01T00:00"), ("2017-02-15T06:00", "2017-02-15T18:00")]
+    for _ in range(12):
+        r = rng.randrange(4)
+        start, end = rng.choice(spans)
+        q = f'Range(rowID={r}, frame="t", start="{start}", end="{end}")'
+        got_np = _norm(e_np.execute("d", q))
+        got_jx = _norm(e_jx.execute("d", q))
+        assert got_np == got_jx, f"divergence on: {q}"
+        q2 = f"Count({q})"
+        assert e_np.execute("d", q2) == e_jx.execute("d", q2)
+    h.close()

@@ -157,3 +157,38 @@ def test_import_request_negative_timestamps_large_batch():
     back = wire.decode_import_request(raw)
     assert back["timestamps"] == ts
     assert back["rowIDs"] == rows
+
+
+def test_wire_decode_fuzz_never_crashes():
+    """Random/truncated bytes into every decoder must raise cleanly
+    (ValueError/IndexError-family), never hang or hard-crash."""
+    import random
+
+    from pilosa_tpu import wire
+
+    decoders = [
+        wire.decode_query_request,
+        wire.decode_query_response,
+        wire.decode_import_request,
+        wire.decode_node_status,
+    ]
+    rng = random.Random(99)
+    # structured-ish prefixes: valid messages truncated/corrupted
+    seeds = [
+        wire.encode_query_request("Count(Bitmap(rowID=1))", slices=[0, 1], remote=True),
+        wire.encode_node_status("h:1", "UP", [{"name": "i", "meta": {}, "maxSlice": 1, "frames": []}]),
+    ]
+    cases = [bytes(rng.randrange(256) for _ in range(rng.randrange(0, 60))) for _ in range(300)]
+    for s in seeds:
+        for _ in range(100):
+            cut = rng.randrange(0, len(s) + 1)
+            mutated = bytearray(s[:cut])
+            if mutated and rng.random() < 0.5:
+                mutated[rng.randrange(len(mutated))] ^= 1 << rng.randrange(8)
+            cases.append(bytes(mutated))
+    for data in cases:
+        for dec in decoders:
+            try:
+                dec(data)
+            except Exception as e:
+                assert not isinstance(e, (SystemExit, MemoryError)), (dec, data[:20])
