@@ -414,3 +414,43 @@ def test_status_merge_survives_malformed_items(tmp_path):
         assert s.holder.index("b").max_slice() == 2
     finally:
         s.close()
+
+
+def test_http_surface_survives_garbage(srv, client):
+    """Random paths/methods/bodies must yield clean HTTP errors, never
+    kill the server or leak tracebacks as responses."""
+    import random
+    import urllib.error
+
+    rng = random.Random(5)
+    client.create_index("z")
+    client.create_frame("z", "f")
+    paths = [
+        "/", "/index", "/index/", "/index/%ff", "/index/z/query", "/index/z/frame/f",
+        "/schema", "/status", "/fragment/data?index=z&frame=f&view=standard&slice=0",
+        "/fragment/data?index=z&frame=f&view=standard&slice=notanumber",
+        "/fragment/nodes?index=z", "/fragment/nodes", "/export", "/nope/deep/path",
+        "/index/z/query?slices=a,b", "/debug/vars", "/index/z/time-quantum",
+    ]
+    bodies = [b"", b"\x00\x01\x02" * 40, b"{", b'{"options": 5}', b"Count(", b"A" * 5000,
+              bytes(rng.randrange(256) for _ in range(64))]
+    for _ in range(120):
+        path = rng.choice(paths)
+        method = rng.choice(["GET", "POST", "DELETE", "PATCH", "PUT"])
+        body = rng.choice(bodies) if method in ("POST", "PATCH", "PUT") else None
+        req = urllib.request.Request(f"http://{srv.host}{path}", data=body, method=method)
+        if rng.random() < 0.3:
+            req.add_header("Content-Type", "application/x-protobuf")
+            req.add_header("Accept", "application/x-protobuf")
+        try:
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                resp.read()
+        except urllib.error.HTTPError as e:
+            assert 400 <= e.code < 600
+            e.read()
+        except urllib.error.URLError as e:  # pragma: no cover
+            raise AssertionError(f"server died on {method} {path}: {e}")
+    # Server is still fully functional afterwards.
+    assert client.status()["state"] == "UP"
+    resp = client.execute_query("z", 'SetBit(rowID=1, frame="f", columnID=1)')
+    assert resp["results"][0]["changed"] is True
