@@ -44,7 +44,7 @@ import time
 import numpy as np
 
 
-def _best_of_runs(fn, default_runs=3):
+def _best_of_runs(fn, default_runs=5):
     """Min wall time over N runs (tunnel jitter; see headline config)."""
     runs = max(1, int(os.environ.get("BENCH_TIMED_RUNS", str(default_runs))))
     dt = float("inf")
@@ -351,9 +351,10 @@ def main() -> None:
     n_slices = int(os.environ.get("BENCH_SLICES", "16"))
     n_rows = int(os.environ.get("BENCH_ROWS", "64"))
     batch = int(os.environ.get("BENCH_BATCH", "256"))
-    # Long enough that the one-dispatch stream's tunnel round trip (~70ms)
-    # is <15% of the timed window — shorter streams measure the tunnel.
-    iters = int(os.environ.get("BENCH_ITERS", "640"))
+    # Long enough that the one-dispatch stream's fixed costs (tunnel round
+    # trip ~70ms + the hoisted Gram build) amortize — shorter streams
+    # measure the tunnel, not the sustained device rate.
+    iters = int(os.environ.get("BENCH_ITERS", "1280"))
     # Bit density ~2^-k via AND of k random words (throughput over packed
     # words is density-independent; this just keeps counts realistic).
     density_k = int(os.environ.get("BENCH_DENSITY_K", "4"))
