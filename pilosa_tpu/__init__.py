@@ -43,3 +43,40 @@ from pilosa_tpu.pilosa import (  # noqa: F401
     validate_name,
     validate_label,
 )
+
+# Lazy top-level API (PEP 562): `pilosa_tpu.Holder` etc. without paying the
+# jax import at package-import time (the numpy engine must work on hosts
+# where jax is absent entirely).
+_LAZY = {
+    "Holder": ("pilosa_tpu.core.holder", "Holder"),
+    "Index": ("pilosa_tpu.core.index", "Index"),
+    "Frame": ("pilosa_tpu.core.frame", "Frame"),
+    "FrameOptions": ("pilosa_tpu.core.frame", "FrameOptions"),
+    "IndexOptions": ("pilosa_tpu.core.index", "IndexOptions"),
+    "Executor": ("pilosa_tpu.executor", "Executor"),
+    "Server": ("pilosa_tpu.server.server", "Server"),
+    "Client": ("pilosa_tpu.server.client", "Client"),
+    "Config": ("pilosa_tpu.config", "Config"),
+}
+
+
+__all__ = [
+    "PilosaError", "ErrIndexExists", "ErrIndexNotFound", "ErrFrameExists",
+    "ErrFrameNotFound", "ErrFragmentNotFound", "ErrQueryRequired",
+    "validate_name", "validate_label", *sorted(_LAZY),
+]
+
+
+def __getattr__(name):
+    target = _LAZY.get(name)
+    if target is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    obj = getattr(importlib.import_module(target[0]), target[1])
+    globals()[name] = obj  # cache: later accesses are plain dict hits
+    return obj
+
+
+def __dir__():
+    return sorted(set(list(globals()) + list(_LAZY)))
