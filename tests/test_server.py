@@ -454,3 +454,47 @@ def test_http_surface_survives_garbage(srv, client):
     assert client.status()["state"] == "UP"
     resp = client.execute_query("z", 'SetBit(rowID=1, frame="f", columnID=1)')
     assert resp["results"][0]["changed"] is True
+
+
+def test_json_and_protobuf_codecs_agree(srv, client):
+    """The same query answered over JSON and protobuf negotiation must
+    carry identical data (handler.go content-negotiation parity)."""
+    client.create_index("cp")
+    client.create_frame("cp", "f", {"cacheType": "ranked"})
+    bits = [(r, c) for r in range(3) for c in range(r, 40 + r)]
+    client.import_bits("cp", "f", bits)
+    client.execute_query("cp", 'SetRowAttrs(rowID=1, frame="f", name="x", n=3)')
+    queries = [
+        'Count(Intersect(Bitmap(rowID=0, frame="f"), Bitmap(rowID=1, frame="f")))',
+        'Bitmap(rowID=1, frame="f")',
+        'TopN(frame="f", n=2)',
+        'Union(Bitmap(rowID=0, frame="f"), Bitmap(rowID=2, frame="f"))',
+    ]
+    for q in queries:
+        pb = client.execute_query("cp", q)  # protobuf path
+        req = urllib.request.Request(
+            f"http://{srv.host}/index/cp/query", data=q.encode(), method="POST"
+        )
+        js = json.loads(urllib.request.urlopen(req).read())  # JSON path
+
+        def norm(results):
+            out = []
+            for r in results:
+                if isinstance(r, dict) and "bitmap" in r:
+                    out.append(("bm", tuple(r["bitmap"]["bits"]),
+                                tuple(sorted(r["bitmap"].get("attrs", {}).items()))))
+                elif isinstance(r, dict) and "pairs" in r:
+                    out.append(("pairs", tuple((p["id"], p["count"]) for p in r["pairs"])))
+                elif isinstance(r, dict) and "n" in r:
+                    out.append(("n", r["n"]))
+                elif isinstance(r, dict) and "attrs" in r and "bits" in r:
+                    out.append(("bm", tuple(r["bits"]), tuple(sorted(r["attrs"].items()))))
+                elif isinstance(r, list):
+                    out.append(("pairs", tuple((p["id"], p["count"]) for p in r)))
+                elif isinstance(r, int):
+                    out.append(("n", r))  # JSON carries counts as numbers
+                else:
+                    out.append(("v", r))
+            return out
+
+        assert norm(pb["results"]) == norm(js["results"]), q
