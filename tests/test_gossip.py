@@ -233,3 +233,35 @@ def test_server_schema_propagates_over_gossip(tmp_path):
         a.close()
         if b is not None:
             b.close()
+
+
+def test_four_node_convergence_and_death():
+    """Membership converges through a single seed at 4 nodes; a killed
+    node is marked dead everywhere and survivors keep broadcasting."""
+    a = _mknode("n0:1")
+    b = _mknode("n1:1", seed=a.addr)
+    c = _mknode("n2:1", seed=a.addr)
+    d = _mknode("n3:1", seed=a.addr)
+    nodes = [a, b, c, d]
+    rec = _Recorder()
+    d.handler = rec
+    try:
+        want = ["n0:1", "n1:1", "n2:1", "n3:1"]
+        for n in nodes:
+            assert _wait_for(lambda n=n: sorted(n.nodes()) == want, timeout=12), (
+                n.name, n.nodes())
+        # Kill one non-seed node; everyone else marks it dead.
+        c.close()
+        alive = ["n0:1", "n1:1", "n3:1"]
+        for n in (a, b, d):
+            assert _wait_for(lambda n=n: sorted(n.nodes()) == alive, timeout=12), (
+                n.name, n.nodes())
+        # Survivors still deliver broadcasts end to end.
+        a.send_async(b"after-death")
+        assert _wait_for(lambda: b"after-death" in rec.messages, timeout=8)
+    finally:
+        for n in nodes:
+            try:
+                n.close()
+            except Exception:
+                pass
