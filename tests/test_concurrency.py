@@ -3,9 +3,11 @@ relies on go test -race + mutex-per-object; here threaded stress over the
 same object graph must never corrupt state or raise).
 """
 
+import os
 import threading
 
 import numpy as np
+import pytest
 
 from pilosa_tpu.core.frame import FrameOptions
 from pilosa_tpu.core.holder import Holder
@@ -133,3 +135,93 @@ def test_concurrent_schema_and_writes(tmp_path):
     assert not errors, errors
     assert fr.view("standard").fragment(0).row_count(0) == 500
     h.close()
+
+
+@pytest.mark.skipif(
+    not os.environ.get("PILOSA_TPU_SOAK"),
+    reason="heavy soak; run with PILOSA_TPU_SOAK=1",
+)
+def test_soak_two_engines_with_snapshots(tmp_path):
+    """8 writers (16k mixed direct/PQL/time-quantum writes), numpy AND
+    jax readers, a snapshot+flush loop — then exact per-row counts and
+    durability across reopen."""
+    from pilosa_tpu.executor import Executor
+
+    h = Holder(str(tmp_path / "soak"))
+    h.open()
+    idx = h.create_index("i")
+    idx.create_frame(
+        "f", FrameOptions(inverse_enabled=True, time_quantum="YM", cache_type="ranked")
+    )
+    fr = idx.frame("f")
+    e = Executor(h, engine="numpy")
+    e2 = Executor(h, engine="jax")
+    errors: list = []
+    stop = threading.Event()
+    written: list[set] = [set() for _ in range(8)]
+
+    def writer(k):
+        try:
+            rng = np.random.default_rng(k)
+            for j in range(2000):
+                r = int(rng.integers(0, 16))
+                c = int(rng.integers(0, 3 * SLICE_WIDTH))
+                if j % 37 == 0:
+                    e.execute(
+                        "i",
+                        f'SetBit(rowID={r}, frame="f", columnID={c}, '
+                        f'timestamp="2017-0{1 + (j % 9)}-01T00:00")',
+                    )
+                else:
+                    fr.set_bit("standard", r, c)
+                written[k].add((r, c))
+        except BaseException as x:  # pragma: no cover
+            errors.append(("w", k, x))
+
+    def reader(eng):
+        try:
+            while not stop.is_set():
+                eng.execute(
+                    "i",
+                    'Count(Intersect(Bitmap(rowID=0, frame="f"), Bitmap(rowID=1, frame="f")))'
+                    ' Count(Union(Bitmap(rowID=2, frame="f"), Bitmap(rowID=3, frame="f")))',
+                )
+                eng.execute("i", 'TopN(frame="f", n=3)')
+                eng.execute("i", 'Bitmap(columnID=5, frame="f")')
+        except BaseException as x:  # pragma: no cover
+            errors.append(("r", x))
+
+    def flusher():
+        try:
+            while not stop.is_set():
+                h.flush_caches()
+                for frag in list(fr.view("standard").fragments.values()):
+                    frag.snapshot()
+        except BaseException as x:  # pragma: no cover
+            errors.append(("s", x))
+
+    ws = [threading.Thread(target=writer, args=(k,)) for k in range(8)]
+    aux = [threading.Thread(target=reader, args=(eng,)) for eng in (e, e2)] + [
+        threading.Thread(target=flusher)
+    ]
+    for t in ws + aux:
+        t.start()
+    for t in ws:
+        t.join(timeout=300)
+    stop.set()
+    for t in aux:
+        t.join(timeout=60)
+    assert not errors, errors[:3]
+    model: dict[int, set] = {}
+    for s in written:
+        for r, c in s:
+            model.setdefault(r, set()).add(c)
+    for r, cols in model.items():
+        assert e.execute("i", f'Count(Bitmap(rowID={r}, frame="f"))') == [len(cols)]
+    h.close()
+    h2 = Holder(str(tmp_path / "soak"))
+    h2.open()
+    e3 = Executor(h2, engine="numpy")
+    for r, cols in model.items():
+        assert e3.execute("i", f'Count(Bitmap(rowID={r}, frame="f"))') == [len(cols)]
+    h2.close()
