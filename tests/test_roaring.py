@@ -285,3 +285,44 @@ def test_container_contains_many_and_dense_add():
         ),
         np.array([False, False]),
     )
+
+
+def test_serialization_independent_decoder():
+    """Decode a written file with an INDEPENDENT reader built only from
+    the documented reference layout (roaring.go:475-533) — cookie, 12-byte
+    container headers, u32 offset table, array u32le / bitmap u64le
+    payloads — no reuse of roaring.py's decoder."""
+    rng = np.random.default_rng(9)
+    vals = np.unique(
+        np.concatenate(
+            [
+                rng.integers(0, 3000, size=500, dtype=np.uint64),  # array container
+                np.uint64(1 << 16) + rng.integers(0, 60000, size=20000, dtype=np.uint64),  # bitmap
+                np.uint64(5 << 16) + np.arange(10, dtype=np.uint64),  # sparse high key
+            ]
+        )
+    )
+    bm = Bitmap()
+    bm.add_many(vals)
+    data = bm.to_bytes()
+
+    import struct
+
+    cookie, n = struct.unpack_from("<II", data, 0)
+    assert cookie == 12346
+    decoded = []
+    offsets_at = 8 + n * 12
+    for i in range(n):
+        key, n1 = struct.unpack_from("<QI", data, 8 + i * 12)
+        count = n1 + 1
+        (off,) = struct.unpack_from("<I", data, offsets_at + i * 4)
+        if count <= 4096:
+            lows = np.frombuffer(data, dtype="<u4", count=count, offset=off)
+        else:
+            words = np.frombuffer(data, dtype="<u8", count=1024, offset=off)
+            bits = np.unpackbits(words.view(np.uint8), bitorder="little")
+            lows = np.nonzero(bits)[0]
+            assert len(lows) == count
+        decoded.append(np.asarray(lows, dtype=np.uint64) + np.uint64(key << 16))
+    got = np.concatenate(decoded)
+    np.testing.assert_array_equal(np.sort(got), vals)
