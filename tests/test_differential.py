@@ -40,6 +40,7 @@ def test_random_pql_numpy_vs_jax(tmp_path, seed):
     idx = h.create_index("d")
     idx.create_frame("f", FrameOptions(inverse_enabled=True, cache_type="ranked"))
     idx.create_frame("g", FrameOptions())
+    idx.create_frame("empty", FrameOptions())  # never written: zero paths
     for frame in ("f", "g"):
         fr = idx.frame(frame)
         rows = nprng.integers(0, 8, size=400)
@@ -51,6 +52,9 @@ def test_random_pql_numpy_vs_jax(tmp_path, seed):
     def bitmap(frame):
         if frame == "f" and rng.random() < 0.3:
             return f'Bitmap(columnID={rng.randrange(200)}, frame="f")'
+        if rng.random() < 0.1:  # missing rows / empty frame: zero paths
+            frame = rng.choice([frame, "empty"])
+            return f'Bitmap(rowID={rng.randrange(50, 60)}, frame="{frame}")'
         return f'Bitmap(rowID={rng.randrange(8)}, frame="{frame}")'
 
     def tree(depth, frame):
