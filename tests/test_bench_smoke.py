@@ -55,3 +55,44 @@ def test_bench_config_emits_json(cfg, extra):
 def test_star_trace_example_runs():
     stdout = _run({}, script=os.path.join("examples", "star_trace.py"))
     assert "top stargazers:" in stdout and "user 1 attrs:" in stdout
+
+
+def test_graft_entry_dryrun_smoke():
+    """The driver's multichip dryrun must keep working (4 virtual devices
+    keeps it quick; the driver runs 8)."""
+    import subprocess
+
+    env = dict(os.environ)
+    env.pop("PYTHONPATH", None)
+    env.pop("JAX_PLATFORMS", None)  # the script pins its own CPU mesh
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "__graft_entry__.py"), "4"],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+        env=env,
+        timeout=280,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "dryrun_multichip OK" in out.stdout
+
+
+def test_graft_entry_compiles_single_chip():
+    """entry() must stay jittable (driver compile-check analog)."""
+    import subprocess
+
+    env = dict(os.environ)
+    env.pop("PYTHONPATH", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    code = (
+        "import __graft_entry__ as g, jax;"
+        "fn, args = g.entry();"
+        "out = jax.jit(fn)(*args);"
+        "print('entry OK', [getattr(o, 'shape', None) for o in out])"
+    )
+    out = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True, cwd=REPO, env=env,
+        timeout=240,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "entry OK" in out.stdout
