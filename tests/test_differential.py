@@ -73,7 +73,7 @@ def test_random_pql_numpy_vs_jax(tmp_path, seed):
             return tree(rng.choice([1, 2]), frame)
         return f'TopN(frame="{frame}", n={rng.randrange(1, 6)})'
 
-    for _ in range(25):
+    for _ in range(35):
         q = " ".join(call() for _ in range(rng.randrange(1, 6)))
         got_np = _norm(e_np.execute("d", q))
         got_jx = _norm(e_jx.execute("d", q))
