@@ -113,6 +113,14 @@ void pn_oplog_encode(const uint8_t* types, const uint64_t* vals, size_t n, uint8
     }
 }
 
+// Single-record encode for the SetBit hot path: one ctypes call into a
+// caller-owned 13-byte buffer beats per-op Python FNV + struct packing.
+void pn_oplog_encode(const uint8_t* types, const uint64_t* vals, size_t n, uint8_t* out);
+
+void pn_op_encode1(uint8_t typ, uint64_t value, uint8_t* out) {
+    pn_oplog_encode(&typ, &value, 1, out);
+}
+
 // Returns ops decoded, or -(index+1) of the first corrupt record.
 int64_t pn_oplog_decode(const uint8_t* buf, size_t len, uint8_t* types, uint64_t* vals) {
     size_t n = len / 13;

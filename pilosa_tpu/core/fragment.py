@@ -119,11 +119,16 @@ class Fragment:
         self._wal = None  # append handle to the data file
         self._row_cache: OrderedDict[int, np.ndarray] = OrderedDict()
         self._row_cache_max = 64
-        # Device-resident dense rows (HBM working set): engine arrays keyed
-        # by row id so repeat queries skip the host→device upload entirely.
-        # Invalidated alongside _row_cache on mutation.
-        self._row_dev_cache: OrderedDict[int, object] = OrderedDict()
+        # Device-resident dense rows (HBM working set): per row id, a dict
+        # of engine-name -> engine array, so repeat queries skip the
+        # host→device upload entirely and mutation invalidates a row in
+        # O(1) (one dict pop, not a scan over the cache).  The bound counts
+        # ARRAYS (rows x engines), keeping the same memory cap as the old
+        # flat (engine, row) keying even when several engines read one
+        # fragment.
+        self._row_dev_cache: OrderedDict[int, dict] = OrderedDict()
         self._row_dev_cache_max = 256
+        self._row_dev_cache_arrays = 0
         self._checksums: dict[int, bytes] = {}
         # Incrementally-maintained per-row bit counts (LRU-bounded like the
         # other per-row caches): every guarded mutation knows its delta, so
@@ -281,8 +286,9 @@ class Fragment:
     def _on_row_mutated(self, row_id: int, delta: Optional[int] = None) -> None:
         self.generation = next(_generation_counter)
         self._row_cache.pop(row_id, None)
-        for k in [k for k in self._row_dev_cache if k[1] == row_id]:
-            self._row_dev_cache.pop(k, None)
+        dropped = self._row_dev_cache.pop(row_id, None)
+        if dropped is not None:
+            self._row_dev_cache_arrays -= len(dropped)
         self._checksums.pop(row_id // HASH_BLOCK_SIZE, None)
         rc = None
         if delta is not None:
@@ -352,16 +358,23 @@ class Fragment:
         # Compute-and-insert stays under one lock hold: inserting after a
         # release could overwrite the invalidation of a concurrent mutation
         # with a stale row.
-        key = (getattr(engine, "name", "?"), row_id)
+        ename = getattr(engine, "name", "?")
         with self._mu:
-            cached = self._row_dev_cache.get(key)
-            if cached is not None:
-                self._row_dev_cache.move_to_end(key)
-                return cached
+            per_row = self._row_dev_cache.get(row_id)
+            if per_row is not None:
+                cached = per_row.get(ename)
+                if cached is not None:
+                    self._row_dev_cache.move_to_end(row_id)
+                    return cached
             arr = engine.asarray(self.row_dense(row_id))
-            self._row_dev_cache[key] = arr
-            while len(self._row_dev_cache) > self._row_dev_cache_max:
-                self._row_dev_cache.popitem(last=False)
+            if per_row is None:
+                per_row = self._row_dev_cache[row_id] = {}
+            per_row[ename] = arr
+            self._row_dev_cache_arrays += 1
+            self._row_dev_cache.move_to_end(row_id)
+            while self._row_dev_cache_arrays > self._row_dev_cache_max:
+                _, evicted = self._row_dev_cache.popitem(last=False)
+                self._row_dev_cache_arrays -= len(evicted)
             return arr
 
     def row(self, row_id: int) -> roaring.Bitmap:
@@ -512,6 +525,7 @@ class Fragment:
         self.generation = next(_generation_counter)
         self._row_cache.clear()
         self._row_dev_cache.clear()
+        self._row_dev_cache_arrays = 0
         self._checksums.clear()
         self._row_counts.clear()
         for row_id in np.unique(row_ids):
@@ -619,6 +633,7 @@ class Fragment:
         self.generation = next(_generation_counter)
         self._row_cache.clear()
         self._row_dev_cache.clear()
+        self._row_dev_cache_arrays = 0
         self._checksums.clear()
         self._row_counts.clear()
         self.snapshot()

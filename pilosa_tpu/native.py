@@ -41,6 +41,11 @@ def _build() -> bool:
 
 def load() -> Optional[ctypes.CDLL]:
     global _lib, _tried
+    # Lock-free fast path: both fields are only ever set under _lock and
+    # transition once (None -> value), so a stale read at worst takes the
+    # locked slow path.  Per-op WAL encodes call this on the hot path.
+    if _lib is not None or _tried:
+        return _lib
     with _lock:
         if _lib is not None or _tried:
             return _lib
@@ -71,6 +76,8 @@ def load() -> Optional[ctypes.CDLL]:
         lib.pn_varint_decode.argtypes = [u8p, ctypes.c_size_t, u64p, ctypes.c_size_t]
         lib.pn_oplog_encode.restype = None
         lib.pn_oplog_encode.argtypes = [u8p, u64p, ctypes.c_size_t, u8p]
+        lib.pn_op_encode1.restype = None
+        lib.pn_op_encode1.argtypes = [ctypes.c_uint8, ctypes.c_uint64, u8p]
         lib.pn_oplog_decode.restype = ctypes.c_int64
         lib.pn_oplog_decode.argtypes = [u8p, ctypes.c_size_t, u8p, u64p]
         lib.pn_parse_csv.restype = ctypes.c_int64
@@ -159,6 +166,23 @@ def varint_decode(data: bytes) -> np.ndarray:
             raise ValueError("invalid varint stream (truncated or overflows uint64)")
         out_list.append(v)
     return np.array(out_list, dtype=np.uint64)
+
+
+_op1_local = threading.local()
+
+
+def op_encode1(typ: int, value: int) -> bytes:
+    """One 13-byte WAL op record (the single-SetBit hot path)."""
+    lib = load()
+    if lib is None:
+        from pilosa_tpu.roaring import encode_op
+
+        return encode_op(typ, value)
+    buf = getattr(_op1_local, "buf", None)
+    if buf is None:
+        buf = _op1_local.buf = (ctypes.c_uint8 * 13)()
+    lib.pn_op_encode1(typ, value, buf)
+    return bytes(buf)
 
 
 def oplog_encode(types: np.ndarray, values: np.ndarray) -> bytes:

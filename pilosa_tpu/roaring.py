@@ -32,6 +32,8 @@ from typing import Iterable, Iterator, Optional
 
 import numpy as np
 
+from pilosa_tpu import native
+
 COOKIE = 12346
 HEADER_SIZE = 8
 ARRAY_MAX_SIZE = 4096
@@ -79,7 +81,7 @@ class Container:
     most ARRAY_MAX_SIZE=4096 values (roaring.go:833, 951-953).
     """
 
-    __slots__ = ("array", "bitmap", "_n")
+    __slots__ = ("array", "bitmap", "_n", "_ser")
 
     def __init__(self, array: Optional[np.ndarray] = None, bitmap: Optional[np.ndarray] = None):
         if array is None and bitmap is None:
@@ -90,6 +92,10 @@ class Container:
         # field, roaring.go:42); add/remove adjust it so snapshots and
         # counts skip a popcount per container.  None = unknown.
         self._n: Optional[int] = None
+        # Cached (n, payload bytes) for serialization: snapshots only
+        # re-encode containers that changed since the last one (the
+        # per-container-dirty incremental snapshot; cleared on mutation).
+        self._ser: Optional[tuple[int, bytes]] = None
 
     # -- constructors -------------------------------------------------
 
@@ -141,19 +147,22 @@ class Container:
 
     def add(self, v: int) -> bool:
         """Insert lowbits value; True if it was newly added."""
-        if self.array is not None:
-            i = int(np.searchsorted(self.array, v))
-            if i < len(self.array) and self.array[i] == v:
+        arr = self.array
+        if arr is not None:
+            # Direct ndarray method: the np.searchsorted module wrapper pays
+            # ~3µs of dispatch machinery per call on this hot path.
+            i = int(arr.searchsorted(v))
+            if i < len(arr) and arr[i] == v:
                 return False
-            if len(self.array) >= ARRAY_MAX_SIZE:
-                self.bitmap = _values_to_bitmap(self.array)
-                self._n = len(self.array) + 1
+            self._ser = None
+            if len(arr) >= ARRAY_MAX_SIZE:
+                self.bitmap = _values_to_bitmap(arr)
+                self._n = len(arr) + 1
                 self.array = None
                 self.bitmap[v >> 6] |= np.uint64(1 << (v & 63))
                 return True
             # np.insert pays axis-normalization machinery per call; a plain
             # split copy is ~3x faster on the SetBit hot path.
-            arr = self.array
             new = np.empty(len(arr) + 1, dtype=np.uint32)
             new[:i] = arr[:i]
             new[i] = v
@@ -163,6 +172,7 @@ class Container:
         w, b = v >> 6, v & 63
         if (int(self.bitmap[w]) >> b) & 1:
             return False
+        self._ser = None
         self.bitmap[w] |= np.uint64(1 << b)
         if self._n is not None:
             self._n += 1
@@ -170,14 +180,16 @@ class Container:
 
     def remove(self, v: int) -> bool:
         if self.array is not None:
-            i = int(np.searchsorted(self.array, v))
+            i = int(self.array.searchsorted(v))
             if i >= len(self.array) or self.array[i] != v:
                 return False
+            self._ser = None
             self.array = np.delete(self.array, i)
             return True
         w, b = v >> 6, v & 63
         if not (int(self.bitmap[w]) >> b) & 1:
             return False
+        self._ser = None
         self.bitmap[w] &= np.uint64(~(1 << b) & 0xFFFFFFFFFFFFFFFF)
         if self._n is not None:
             self._n -= 1
@@ -193,6 +205,7 @@ class Container:
         values = np.asarray(values, dtype=np.uint32)
         if len(values) == 0:
             return 0
+        self._ser = None
         before = self.n
         if self.bitmap is not None:
             # Dense stays dense: OR the bits in directly, O(len + 1024)
@@ -238,6 +251,22 @@ class Container:
         if self.array is not None:
             return 4 * len(self.array)
         return 8 * BITMAP_N
+
+    def ser(self) -> tuple[int, bytes]:
+        """(n, payload bytes), cached until the next mutation — snapshots
+        re-encode only the containers that changed (incremental snapshot;
+        fragment.go rewrites every container each time)."""
+        s = self._ser
+        if s is None:
+            s = (self.n, self.payload())
+            if self.array is not None and len(self.array) <= 512:
+                # Only small array containers cache their payload: the win
+                # is the per-container Python overhead on snapshot (small
+                # containers dominate sparse fragments), while pinning
+                # multi-KB copies (dense 8 KB, near-full arrays 16 KB)
+                # would meaningfully grow host memory on large fragments.
+                self._ser = s
+        return s
 
     def check(self) -> None:
         if self.array is not None:
@@ -356,8 +385,6 @@ class Bitmap:
         durability strategy after seeing what was actually new."""
         if len(added) == 0 or self.op_writer is None:
             return
-        from pilosa_tpu import native
-
         types = np.zeros(len(added), dtype=np.uint8)  # OP_ADD
         self.op_writer.write(native.oplog_encode(types, added))
         self.op_n += len(added)
@@ -373,7 +400,7 @@ class Bitmap:
     def _write_op(self, typ: int, value: int) -> None:
         if self.op_writer is None:
             return
-        self.op_writer.write(encode_op(typ, value))
+        self.op_writer.write(native.op_encode1(typ, value))
         self.op_n += 1
 
     # -- queries ------------------------------------------------------
@@ -582,21 +609,35 @@ class Bitmap:
         scalar packing dominated snapshot cost in the SetBit hot path
         (snapshots fire every MaxOpN ops).
         """
-        keys = [k for k in self.sorted_keys() if self.containers[k].n > 0]
-        n = len(keys)
+        sers = [
+            (k, s)
+            for k in self.sorted_keys()
+            if (s := self.containers[k].ser())[0] > 0
+        ]
+        n = len(sers)
         written = w.write(np.array([COOKIE, n], dtype="<u4").tobytes())
         if n:
-            conts = [self.containers[k] for k in keys]
-            ns = np.fromiter((c.n for c in conts), dtype=np.int64, count=n)
+            ns = np.fromiter((s[0] for _, s in sers), dtype=np.int64, count=n)
             meta = np.zeros(n, dtype=[("key", "<u8"), ("n1", "<u4")])
-            meta["key"] = np.asarray(keys, dtype=np.uint64)
+            meta["key"] = np.fromiter((k for k, _ in sers), dtype=np.uint64, count=n)
             meta["n1"] = (ns - 1).astype(np.uint32)
             written += w.write(meta.tobytes())
             sizes = np.where(ns <= ARRAY_MAX_SIZE, ns * 4, BITMAP_N * 8)
             offsets = HEADER_SIZE + n * 16 + np.concatenate(([0], np.cumsum(sizes[:-1])))
             written += w.write(offsets.astype("<u4").tobytes())
-            for c in conts:
-                written += w.write(c.payload())
+            # Join payloads in bounded chunks: one write per ~8 MB keeps the
+            # syscall count low without transiently doubling a large
+            # snapshot's memory in a single join.
+            chunk: list[bytes] = []
+            chunk_bytes = 0
+            for _, s in sers:
+                chunk.append(s[1])
+                chunk_bytes += len(s[1])
+                if chunk_bytes >= (8 << 20):
+                    written += w.write(b"".join(chunk))
+                    chunk, chunk_bytes = [], 0
+            if chunk:
+                written += w.write(b"".join(chunk))
         return written
 
     def to_bytes(self) -> bytes:
@@ -637,8 +678,6 @@ class Bitmap:
         # native pass when the C++ kernels are available.
         buf = data[ops_offset:]
         if buf:
-            from pilosa_tpu import native
-
             types, values = native.oplog_decode(bytes(buf))
             for typ, value in zip(types.tolist(), values.tolist()):
                 value = int(value)
