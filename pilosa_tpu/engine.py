@@ -69,6 +69,14 @@ class NumpyEngine:
         r = _NP_OPS[op](a, b)
         return self.count(r).sum(axis=0)
 
+    def gather_count_or_multi(self, row_matrix, idx) -> np.ndarray:
+        """Batched Count(Union of a V-row view cover) — the fused Range
+        count.  idx: int32[B, V], short covers padded by repeating a valid
+        index (OR is idempotent).  Returns int64[B]."""
+        g = row_matrix[:, idx, :]  # [S, B, V, W]
+        acc = np.bitwise_or.reduce(g, axis=2)
+        return self.count(acc).sum(axis=0)
+
     def bit_and(self, a, b):
         return a & b
 
@@ -159,6 +167,12 @@ class JaxEngine:
         )
         return np.asarray(out).astype(np.int64)
 
+    def gather_count_or_multi(self, row_matrix, idx) -> np.ndarray:
+        out = self._dispatch.gather_count_or_multi(
+            self._jnp.asarray(row_matrix), self._jnp.asarray(idx)
+        )
+        return np.asarray(out).astype(np.int64)
+
     def bit_and(self, a, b):
         return self._jnp.bitwise_and(a, b)
 
@@ -232,9 +246,10 @@ class MeshEngine(JaxEngine):
 
         self._jax = jax
         self.mesh = SliceMesh(devices)
-        # One jitted callable for the fused path — constructing jax.jit per
+        # One jitted callable per fused path — constructing jax.jit per
         # call would re-trace and miss the dispatch cache every time.
         self._gather_jit = jax.jit(_bw.gather_count, static_argnums=0)
+        self._gather_or_jit = jax.jit(_bw.gather_count_or_multi)
 
     def _shard_stack(self, x):
         # Shard only cleanly-divisible leading axes (device_put requires
@@ -280,6 +295,13 @@ class MeshEngine(JaxEngine):
             op,
             self._shard_stack(self._jnp.asarray(row_matrix)),
             self._jnp.asarray(pairs),
+        )
+        return np.asarray(out).astype(np.int64)
+
+    def gather_count_or_multi(self, row_matrix, idx):
+        out = self._gather_or_jit(
+            self._shard_stack(self._jnp.asarray(row_matrix)),
+            self._jnp.asarray(idx),
         )
         return np.asarray(out).astype(np.int64)
 

@@ -126,6 +126,9 @@ class Executor:
         # write generations — steady-state fused requests cost zero
         # host→device row traffic.
         self._matrix_cache: "OrderedDict[tuple, tuple]" = OrderedDict()
+        # Multi-view matrices for the fused Range path, keyed by
+        # (index, frame, views, slices); validated the same way.
+        self._multi_matrix_cache: "OrderedDict[tuple, tuple]" = OrderedDict()
         self._matrix_mu = threading.Lock()
         self._matrix_cache_entries = int(
             os.environ.get("PILOSA_TPU_MATRIX_CACHE_ENTRIES", "4")
@@ -170,6 +173,8 @@ class Executor:
             return batched_writes
 
         fused = self._fuse_count_pair_batch(index, query.calls, std_slices, inv_slices, opt)
+        if fused is None:
+            fused = self._fuse_count_range_batch(index, query.calls, std_slices, opt)
 
         results = []
         for i, call in enumerate(query.calls):
@@ -318,9 +323,11 @@ class Executor:
                 )
                 for i in range(len(op_ids))
             }
+            idxs = list(range(len(op_ids)))
             return self._fused_dispatch(
-                index, matched, list(range(len(op_ids))), std_slices, opt,
+                index, idxs, std_slices, opt,
                 lambda: pql.parse_cached(src),
+                lambda node_slices: self._fused_local_counts(index, matched, idxs, node_slices),
             )
         return self._fused_local_counts_arrays(
             index, frame_names, op_ids, frame_ids, r1, r2, std_slices
@@ -428,10 +435,135 @@ class Executor:
 
         idxs = sorted(matched)
         totals = self._fused_dispatch(
-            index, matched, idxs, slices, opt,
+            index, idxs, slices, opt,
             lambda: pql.Query(calls=[calls[i] for i in idxs]),
+            lambda node_slices: self._fused_local_counts(index, matched, idxs, node_slices),
         )
         return dict(zip(idxs, totals))
+
+    def _fuse_count_range_batch(
+        self, index: str, calls, slices, opt: ExecOptions
+    ) -> Optional[dict[int, int]]:
+        """Run an all-``Count(Range(...))`` request as fused device
+        dispatches: the per-call view covers (time.go:95-167) become rows
+        of ONE multi-view matrix and every query's union+popcount happens
+        in one kernel batch (dispatch.gather_count_or_multi) instead of
+        per-call view gathers and OR chains.  Same fusion contract as the
+        pair path: only fires when the WHOLE request matches, everything
+        else falls back to the sequential path with identical errors.
+        """
+        if not slices or len(calls) < 2:
+            return None
+        matched: dict[int, tuple[str, int, list[str]]] = {}
+        for i, c in enumerate(calls):
+            if c.name != "Count" or len(c.children) != 1:
+                return None
+            ch = c.children[0]
+            if ch.name != "Range" or ch.children:
+                return None
+            try:
+                frame_name, frame, row_id, start, end = self._parse_range_args(index, ch)
+            except PilosaError:
+                return None  # surface the error through the normal path
+            views = (
+                tq.views_by_time_range(VIEW_STANDARD, start, end, frame.time_quantum)
+                if frame.time_quantum
+                else []
+            )
+            matched[i] = (frame_name, row_id, views)
+
+        idxs = sorted(matched)
+        totals = self._fused_dispatch(
+            index, idxs, slices, opt,
+            lambda: pql.Query(calls=[calls[i] for i in idxs]),
+            lambda node_slices: self._fused_local_range_counts(index, matched, idxs, node_slices),
+        )
+        return dict(zip(idxs, totals))
+
+    def _fused_local_range_counts(
+        self, index: str, matched: dict, idxs: list[int], slices
+    ) -> list[int]:
+        """Fused Range counts for a slice batch, aligned with idxs.
+
+        Builds one matrix per frame whose rows are the distinct
+        (view, row_id) combos referenced by the batch, pads each call's
+        cover to the batch max by repeating its first row (OR-idempotent),
+        and answers the whole frame group in one engine dispatch."""
+        slices = list(slices or [])
+        out: dict[int, int] = {}
+        if not slices:
+            return [0] * len(idxs)
+        by_frame: dict[str, list[int]] = {}
+        for i in idxs:
+            by_frame.setdefault(matched[i][0], []).append(i)
+        for frame_name, f_idxs in by_frame.items():
+            live = [i for i in f_idxs if matched[i][2]]
+            for i in f_idxs:
+                if not matched[i][2]:
+                    out[i] = 0  # no quantum / empty cover (zeros segment)
+            if not live:
+                continue
+            combos = sorted(
+                {(v, matched[i][1]) for i in live for v in matched[i][2]}
+            )
+            id_pos, matrix = self._multi_view_matrix(index, frame_name, slices, combos)
+            vmax = max(len(matched[i][2]) for i in live)
+            idx_arr = np.zeros((len(live), vmax), dtype=np.int32)
+            for k, i in enumerate(live):
+                _, row_id, views = matched[i]
+                cover = [id_pos[(v, row_id)] for v in views]
+                idx_arr[k, : len(cover)] = cover
+                idx_arr[k, len(cover):] = cover[0]  # pad: OR-idempotent
+            counts = self.engine.gather_count_or_multi(matrix, idx_arr)
+            for k, i in enumerate(live):
+                out[i] = int(counts[k])
+        return [out[i] for i in idxs]
+
+    def _multi_view_matrix(
+        self, index: str, frame: str, slices, combos: list[tuple[str, int]]
+    ) -> tuple[dict[tuple[str, int], int], object]:
+        """Engine matrix [n_slices, len(combos), W] whose row planes are
+        (view, row_id) combos — the fused Range path's working set.
+
+        Cached like the single-view matrix (LRU, validated by the write
+        generations of every (view, slice) fragment involved); rebuilt
+        whole on any change (Range covers touch many small time views, so
+        per-plane patching buys little).
+        """
+        views = sorted({v for v, _ in combos})
+        frags = {
+            v: [self.holder.fragment(index, frame, v, s) for s in slices]
+            for v in views
+        }
+        gens = tuple(
+            tuple(-1 if f is None else f.generation for f in frags[v]) for v in views
+        )
+        key = (index, frame, tuple(views), tuple(slices))
+        with self._matrix_mu:
+            hit = self._multi_matrix_cache.get(key)
+            if hit is not None:
+                old_gens, old_id_pos, old_matrix = hit
+                if old_gens == gens and set(combos) <= old_id_pos.keys():
+                    self._multi_matrix_cache.move_to_end(key)
+                    return old_id_pos, old_matrix
+
+        id_pos = {c: k for k, c in enumerate(combos)}
+        planes = []
+        for si in range(len(slices)):
+            block = np.zeros((len(combos), _WORDS), dtype=np.uint32)
+            for k, (v, r) in enumerate(combos):
+                f = frags[v][si]
+                if f is not None:
+                    block[k] = f.row_dense(r)
+            planes.append(block)
+        matrix = self.engine.matrix(np.stack(planes))
+        if len(combos) <= self._matrix_rows_max:
+            with self._matrix_mu:
+                self._multi_matrix_cache[key] = (gens, id_pos, matrix)
+                self._multi_matrix_cache.move_to_end(key)
+                while len(self._multi_matrix_cache) > self._matrix_cache_entries:
+                    self._multi_matrix_cache.popitem(last=False)
+        return id_pos, matrix
 
     def _is_distributed(self, opt: ExecOptions) -> bool:
         """Whether this executor coordinates a multi-node fan-out (shared
@@ -444,27 +576,28 @@ class Executor:
         )
 
     def _fused_dispatch(
-        self, index: str, matched: dict, idxs: list[int], slices, opt: ExecOptions,
-        batch_query_fn,
+        self, index: str, idxs: list[int], slices, opt: ExecOptions,
+        batch_query_fn, local_fn,
     ) -> list[int]:
-        """Run matched pair-count calls locally or cluster-wide.
+        """Run a matched fused count batch locally or cluster-wide.
 
         Distributed fusion: ONE forwarded batch request per remote node
         (N fused calls x M nodes = M requests, not N*M per-call forwards),
-        local slices through the fused kernels, and the same mid-query
-        replica failover as per-call mapReduce.  ``batch_query_fn`` builds
-        the Query to forward — called only when a remote hop exists, so
+        local slices through the fused kernels via ``local_fn(slices)``
+        (pair counts or Range covers), and the same mid-query replica
+        failover as per-call mapReduce.  ``batch_query_fn`` builds the
+        Query to forward — called only when a remote hop exists, so
         AST-free callers (the flat fast lane) stay AST-free single-node.
         The remote peer re-enters the fused path with opt.remote=True and
         fuses its own slice batch.
         """
         if not self._is_distributed(opt):
-            return self._fused_local_counts(index, matched, idxs, slices)
+            return local_fn(slices)
 
         batch_query = batch_query_fn()
 
         def local_map(node_slices):
-            return self._fused_local_counts(index, matched, idxs, node_slices)
+            return local_fn(node_slices)
 
         def remote_map(client, node_slices):
             res = client.execute_remote(index, batch_query, node_slices)
@@ -787,9 +920,9 @@ class Executor:
         frame, view, id = self._resolve_bitmap_leaf(index, c)
         return self._gather_rows(index, frame, view, id, slices)
 
-    def _eval_range(self, index: str, c: pql.Call, slices: list[int]):
-        """Range(): union of time-view rows covering [start, end)
-        (executor.go:498-554)."""
+    def _parse_range_args(self, index: str, c: pql.Call):
+        """(frame_name, frame, row_id, start, end) for a Range() call,
+        with the sequential path's exact errors (executor.go:498-531)."""
         frame_name = c.string_arg("frame") or DEFAULT_FRAME
         frame = self.holder.frame(index, frame_name)
         if frame is None:
@@ -808,6 +941,12 @@ class Executor:
             end = datetime.strptime(end_s, pql.TIME_FORMAT)
         except ValueError:
             raise PilosaError("cannot parse Range() time")
+        return frame_name, frame, row_id, start, end
+
+    def _eval_range(self, index: str, c: pql.Call, slices: list[int]):
+        """Range(): union of time-view rows covering [start, end)
+        (executor.go:498-554)."""
+        frame_name, frame, row_id, start, end = self._parse_range_args(index, c)
         out = self.engine.asarray(np.zeros((len(slices), _WORDS), dtype=np.uint32))
         if not frame.time_quantum:
             return out

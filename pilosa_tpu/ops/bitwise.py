@@ -139,6 +139,29 @@ def gather_count(op: str, row_matrix, pairs):
     return jnp.sum(lax.population_count(apply_pair_op(op, a, b)).astype(jnp.int32), axis=(0, 2))
 
 
+def gather_count_or_multi(row_matrix, idx):
+    """Batched Count(Union(Bitmap_1 … Bitmap_V)) per query — the fused
+    time-quantum Range count (time.go:95-167 + executor.go:498-554: a
+    Range unions the minimal view cover, then Count popcounts it).
+
+    row_matrix: uint32[n_slices, n_rows, W]; idx: int32[B, V] row indices,
+    where short covers pad by REPEATING a valid index (OR is idempotent,
+    so padding needs no mask).  Returns int32[B] summed over slices.
+    XLA form (gather → OR-reduce → popcount); the Pallas version streams
+    one row per grid step without materializing the gather.
+    """
+    g = jnp.take(row_matrix, idx, axis=1)  # [n_slices, B, V, W]
+    acc = lax.reduce(g, np.uint32(0), lax.bitwise_or, (2,))
+    return jnp.sum(lax.population_count(acc).astype(jnp.int32), axis=(0, 2))
+
+
+def np_gather_count_or_multi(row_matrix: np.ndarray, idx: np.ndarray) -> np.ndarray:
+    """numpy ground truth for gather_count_or_multi."""
+    g = row_matrix[:, idx, :]  # [S, B, V, W]
+    acc = np.bitwise_or.reduce(g, axis=2)
+    return np_popcount(acc).reshape(acc.shape[0], acc.shape[1], -1).sum(axis=(0, 2))
+
+
 def pair_gram(row_matrix):
     """All-pairs intersection-count Gram matrix G[i,j] = |row_i & row_j|
     summed over slices, via ONE int8 matmul on the MXU.

@@ -24,6 +24,7 @@ from pilosa_tpu.ops.pallas_kernels import (
     fused_count1,
     fused_count2,
     fused_gather_count2,
+    fused_gather_count_or,
     fused_resident_count2,
 )
 
@@ -132,6 +133,27 @@ def gather_count(op, row_matrix, pairs, allow_gram: bool = True):
             return fused_resident_count2(op, row_matrix, pairs)
         return fused_gather_count2(op, row_matrix, pairs)
     return bitwise.gather_count(op, row_matrix, pairs)
+
+
+def gather_count_or_multi(row_matrix, idx):
+    """Batched Count(Union of a V-row view cover) per query — the fused
+    time-quantum Range count.  idx: int32[B, V], short covers padded by
+    repeating a valid index (OR-idempotent)."""
+    if use_pallas() and _tileable(row_matrix.shape[-1]):
+        b, v = idx.shape
+        # Prefetched ids must fit SMEM: the pair kernels prefetch B*2 ids
+        # under _GATHER_BATCH_MAX, so bound B*V by the same id budget
+        # (wide view covers shrink the per-chunk batch).
+        chunk = max(1, (2 * _GATHER_BATCH_MAX) // max(1, v))
+        if b > chunk:
+            return jnp.concatenate(
+                [
+                    gather_count_or_multi(row_matrix, idx[i : i + chunk])
+                    for i in range(0, b, chunk)
+                ]
+            )
+        return fused_gather_count_or(row_matrix, idx)
+    return bitwise.gather_count_or_multi(row_matrix, idx)
 
 
 def batch_intersection_count(rows, src):

@@ -228,6 +228,63 @@ def fused_gather_count2(op: str, row_matrix, pairs, interpret: bool = False):
     return out.sum(axis=(1, 2))
 
 
+def _gather_or_kernel(n_views, idx_ref, row_ref, out_ref, acc_ref):
+    s, j = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _():
+        acc_ref[...] = row_ref[0, 0]
+
+    @pl.when(j != 0)
+    def _():
+        acc_ref[...] = acc_ref[...] | row_ref[0, 0]
+
+    @pl.when((j == n_views - 1) & (s == 0))
+    def _():
+        out_ref[0] = _partial_tile(acc_ref[...][None])
+
+    @pl.when((j == n_views - 1) & (s != 0))
+    def _():
+        out_ref[0] = out_ref[0] + _partial_tile(acc_ref[...][None])
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def fused_gather_count_or(row_matrix, idx, interpret: bool = False):
+    """Per-query ``sum_s popcount(OR_j rm[s, idx[q, j]])`` — the fused
+    time-quantum Range count over a view-cover of up to V rows per query.
+
+    row_matrix: uint32[n_slices, n_rows, W] (W % 1024 == 0);
+    idx: int32[B, V] row ids, short covers padded by repeating a valid id
+    (OR-idempotent, so no mask is needed).  Returns int32[B].
+
+    One row DMA per (query, slice, view) grid step ORs into a VMEM
+    scratch accumulator; at the last view the accumulated cover is
+    popcounted into the per-query output tile, which stays resident
+    across the slice axis.  The XLA fallback materializes the whole
+    [S, B, V, W] gather in HBM first.
+    """
+    n_slices, n_rows, w = row_matrix.shape
+    b, n_views = idx.shape
+    sub = w // _LANES
+    rm4 = row_matrix.reshape(n_slices, n_rows, sub, _LANES)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b, n_slices, n_views),
+        in_specs=[
+            pl.BlockSpec((1, 1, sub, _LANES), lambda q, s, j, pr: (s, pr[q, j], 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 8, _LANES), lambda q, s, j, pr: (q, 0, 0)),
+        scratch_shapes=[pltpu.VMEM((sub, _LANES), jnp.uint32)],
+    )
+    out = pl.pallas_call(
+        functools.partial(_gather_or_kernel, n_views),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, 8, _LANES), jnp.int32),
+        interpret=interpret,
+    )(idx, rm4)
+    return out.sum(axis=(1, 2))
+
+
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def fused_count1(a, interpret: bool = False):
     """sum(popcount(a)) over the last axis via a Pallas kernel."""

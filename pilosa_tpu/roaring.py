@@ -44,6 +44,9 @@ OP_SIZE = 13
 OP_ADD = 0
 OP_REMOVE = 1
 
+# Snapshot payload chunk size: one write syscall per ~8 MB of payloads.
+_SNAP_CHUNK = 8 << 20
+
 # Byte-popcount lookup table; np_count(words) = LUT[words.view(u8)].sum().
 _POPCNT8 = np.array([bin(i).count("1") for i in range(256)], dtype=np.uint32)
 
@@ -609,31 +612,43 @@ class Bitmap:
         scalar packing dominated snapshot cost in the SetBit hot path
         (snapshots fire every MaxOpN ops).
         """
-        sers = [
-            (k, s)
-            for k in self.sorted_keys()
-            if (s := self.containers[k].ser())[0] > 0
-        ]
-        n = len(sers)
+        # One pass over sorted keys reading the _ser slot directly: for a
+        # mostly-clean bitmap (the steady SetBit state) each container
+        # costs one attribute read, not repeated n-property calls.
+        keys: list[int] = []
+        ns_list: list[int] = []
+        conts: list[Container] = []
+        for k in self.sorted_keys():
+            c = self.containers[k]
+            s = c._ser
+            cn = s[0] if s is not None else c.n
+            if cn > 0:
+                keys.append(k)
+                ns_list.append(cn)
+                conts.append(c)
+        n = len(keys)
         written = w.write(np.array([COOKIE, n], dtype="<u4").tobytes())
         if n:
-            ns = np.fromiter((s[0] for _, s in sers), dtype=np.int64, count=n)
+            ns = np.asarray(ns_list, dtype=np.int64)
             meta = np.zeros(n, dtype=[("key", "<u8"), ("n1", "<u4")])
-            meta["key"] = np.fromiter((k for k, _ in sers), dtype=np.uint64, count=n)
+            meta["key"] = np.asarray(keys, dtype=np.uint64)
             meta["n1"] = (ns - 1).astype(np.uint32)
             written += w.write(meta.tobytes())
             sizes = np.where(ns <= ARRAY_MAX_SIZE, ns * 4, BITMAP_N * 8)
             offsets = HEADER_SIZE + n * 16 + np.concatenate(([0], np.cumsum(sizes[:-1])))
             written += w.write(offsets.astype("<u4").tobytes())
-            # Join payloads in bounded chunks: one write per ~8 MB keeps the
-            # syscall count low without transiently doubling a large
-            # snapshot's memory in a single join.
+            # Payloads are produced lazily (cached for small dirty-tracked
+            # arrays, fresh for dense containers) and written in ~8 MB
+            # joined chunks: few syscalls, and peak extra memory stays one
+            # chunk — never the whole serialized image.
             chunk: list[bytes] = []
             chunk_bytes = 0
-            for _, s in sers:
-                chunk.append(s[1])
-                chunk_bytes += len(s[1])
-                if chunk_bytes >= (8 << 20):
+            for c in conts:
+                s = c._ser
+                p = s[1] if s is not None else c.ser()[1]
+                chunk.append(p)
+                chunk_bytes += len(p)
+                if chunk_bytes >= _SNAP_CHUNK:
                     written += w.write(b"".join(chunk))
                     chunk, chunk_bytes = [], 0
             if chunk:

@@ -109,6 +109,8 @@ def test_random_range_queries_numpy_vs_jax(tmp_path, seed):
     e_jx = Executor(h, engine="jax")
     spans = [("2017-01-01T00:00", "2017-02-01T00:00"), ("2017-01-10T00:00", "2017-03-20T12:00"),
              ("2016-12-01T00:00", "2018-01-01T00:00"), ("2017-02-15T06:00", "2017-02-15T18:00")]
+    counts = []
+    singles = []
     for _ in range(12):
         r = rng.randrange(4)
         start, end = rng.choice(spans)
@@ -117,5 +119,13 @@ def test_random_range_queries_numpy_vs_jax(tmp_path, seed):
         got_jx = _norm(e_jx.execute("d", q))
         assert got_np == got_jx, f"divergence on: {q}"
         q2 = f"Count({q})"
-        assert e_np.execute("d", q2) == e_jx.execute("d", q2)
+        got_c = e_np.execute("d", q2)
+        assert got_c == e_jx.execute("d", q2)
+        counts.append(q2)
+        singles.extend(got_c)
+    # The same Counts as ONE batched request take the fused multi-view OR
+    # path in both engines and must match the sequential singles.
+    batch = " ".join(counts)
+    assert e_np.execute("d", batch) == singles
+    assert e_jx.execute("d", batch) == singles
     h.close()

@@ -668,3 +668,109 @@ def test_inverse_view_fused_batch(tmp_path, engine):
     ]
     assert got == want
     h.close()
+
+
+@pytest.mark.parametrize("engine", ["numpy", "jax"])
+def test_count_range_batch_fusion(tmp_path, engine):
+    """An all-Count(Range(...)) request runs through the fused multi-view
+    OR kernel and matches per-call execution, across frames and covers."""
+    h = Holder(str(tmp_path / "data"))
+    h.open()
+    idx = h.create_index("i")
+    idx.create_frame("f", FrameOptions(time_quantum="YMDH"))
+    idx.create_frame("g", FrameOptions(time_quantum="YM"))
+    idx.create_frame("plain", FrameOptions())  # no quantum: Range counts 0
+    e = Executor(h, engine=engine)
+    rng = np.random.default_rng(9)
+    stamps = [
+        "2017-01-05T10:00", "2017-02-14T00:00", "2017-03-02T15:00",
+        "2017-06-30T23:00", "2017-12-31T12:00",
+    ]
+    for fr_name in ("f", "g"):
+        for r in (1, 2):
+            for t in stamps:
+                for c in rng.choice(2 * SLICE_WIDTH, size=5, replace=False):
+                    e.execute(
+                        "i",
+                        f'SetBit(rowID={r}, frame="{fr_name}", columnID={int(c)}, timestamp="{t}")',
+                    )
+    ranges = [
+        ("f", 1, "2017-01-01T00:00", "2018-01-01T00:00"),
+        ("f", 2, "2017-03-01T00:00", "2017-04-01T00:00"),
+        ("f", 1, "2017-02-01T00:00", "2017-07-01T00:00"),
+        ("g", 1, "2017-01-01T00:00", "2017-07-01T00:00"),
+        ("g", 2, "2017-06-01T00:00", "2017-06-02T00:00"),
+        ("plain", 1, "2017-01-01T00:00", "2018-01-01T00:00"),
+        ("f", 1, "2017-05-01T00:00", "2017-05-01T00:00"),  # empty cover
+    ]
+    calls = [
+        f'Count(Range(rowID={r}, frame="{fr}", start="{s}", end="{en}"))'
+        for fr, r, s, en in ranges
+    ]
+    fused = e.execute("i", " ".join(calls))
+    singles = [e.execute("i", q)[0] for q in calls]  # len<2: no fusion
+    assert fused == singles
+    assert fused[0] > 0 and fused[5] == 0 and fused[6] == 0
+
+    # Writes invalidate the cached multi-view matrix (generation check).
+    before = e.execute("i", " ".join(calls))
+    e.execute(
+        "i",
+        'SetBit(rowID=1, frame="f", columnID=999999, timestamp="2017-03-15T00:00")',
+    )
+    after = e.execute("i", " ".join(calls))
+    assert after[0] == before[0] + 1  # year cover sees the new bit
+    assert after[2] == before[2] + 1  # Feb-Jul cover too
+    assert after[1] == before[1]      # row 2 unchanged
+    h.close()
+
+
+def test_fused_range_batch_distributed(tmp_path):
+    """Fused Count(Range) batches forward ONE Query per remote node and
+    sum per-call counts across the slice split, with replica failover."""
+    from pilosa_tpu.cluster import Cluster, Node
+
+    h = Holder(str(tmp_path / "data"))
+    h.open()
+    idx = h.create_index("i")
+    idx.create_frame("f", FrameOptions(time_quantum="YMD"))
+    e0 = Executor(h, engine="numpy")
+    for s in range(4):
+        for c in range(8):
+            e0.execute(
+                "i",
+                f'SetBit(rowID=1, frame="f", columnID={s * SLICE_WIDTH + c}, '
+                'timestamp="2017-03-02T00:00")',
+            )
+
+    hosts = ["h0:1", "h1:1"]
+    cluster = Cluster([Node(host) for host in hosts], replica_n=2)
+    remote_batches = []
+
+    class SpyClient:
+        def __init__(self, host):
+            self.host = host
+
+        def execute_remote(self, index, query, slices=None):
+            remote_batches.append((self.host, len(query.calls), list(slices)))
+            peer = Executor(h, engine="numpy")
+            return peer.execute(index, query, slices=slices, opt=ExecOptions(remote=True))
+
+    e = Executor(h, engine="numpy", cluster=cluster, client_factory=SpyClient, host="h0:1")
+    q = " ".join(
+        ['Count(Range(rowID=1, frame="f", start="2017-03-01T00:00", end="2017-04-01T00:00"))'] * 3
+    )
+    got = e.execute("i", q)
+    single = e0.execute(
+        "i", 'Count(Range(rowID=1, frame="f", start="2017-03-01T00:00", end="2017-04-01T00:00"))'
+    )
+    assert got == single * 3 == [32, 32, 32]
+    assert len(remote_batches) == 1 and remote_batches[0][1] == 3
+
+    class DyingClient(SpyClient):
+        def execute_remote(self, index, query, slices=None):
+            raise ConnectionError("node down")
+
+    e2 = Executor(h, engine="numpy", cluster=cluster, client_factory=DyingClient, host="h0:1")
+    assert e2.execute("i", q) == got
+    h.close()
