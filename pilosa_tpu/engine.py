@@ -37,6 +37,8 @@ _NP_OPS = {
 
 class NumpyEngine:
     name = "numpy"
+    # No jit: callers may use exact (ragged) dispatch shapes freely.
+    wants_static_shapes = False
 
     def stack(self, rows: list[np.ndarray]) -> np.ndarray:
         return np.stack(rows) if rows else np.zeros((0, 0), dtype=np.uint32)
@@ -72,10 +74,23 @@ class NumpyEngine:
     def gather_count_or_multi(self, row_matrix, idx) -> np.ndarray:
         """Batched Count(Union of a V-row view cover) — the fused Range
         count.  idx: int32[B, V], short covers padded by repeating a valid
-        index (OR is idempotent).  Returns int64[B]."""
-        g = row_matrix[:, idx, :]  # [S, B, V, W]
-        acc = np.bitwise_or.reduce(g, axis=2)
-        return self.count(acc).sum(axis=0)
+        index (OR is idempotent).  Returns int64[B].
+
+        Chunked over the batch so the gathered [S, chunk, V, W] stays a
+        few MB — one shot over the whole batch would materialize
+        S*B*V*W*4 bytes (easily hundreds of MB) for nothing.
+        """
+        from pilosa_tpu.pilosa import OR_MULTI_BUDGET_HOST, or_multi_chunk_size
+
+        s, _, w = row_matrix.shape
+        v = idx.shape[1]
+        chunk = or_multi_chunk_size(s, v, w, OR_MULTI_BUDGET_HOST)
+        out = np.empty(idx.shape[0], dtype=np.int64)
+        for i in range(0, idx.shape[0], chunk):
+            g = row_matrix[:, idx[i : i + chunk], :]
+            acc = np.bitwise_or.reduce(g, axis=2)
+            out[i : i + chunk] = self.count(acc).sum(axis=0)
+        return out
 
     def bit_and(self, a, b):
         return a & b
@@ -113,6 +128,14 @@ class NumpyEngine:
         """Append new rows (axis 1) to a row matrix: [S, R, W] + [S, R', W]."""
         return np.concatenate([matrix, block], axis=1)
 
+    def set_rows(self, matrix, row_start: int, block):
+        """Functionally write a block of rows at [.., row_start:, ..] —
+        fills preallocated capacity without changing the matrix shape
+        (shape changes would recompile jitted kernels downstream)."""
+        out = matrix.copy()
+        out[:, row_start : row_start + block.shape[1], :] = block
+        return out
+
     def pair_gram(self, matrix):
         """All-pairs AND-count Gram, or None when unsupported (host
         all-pairs popcount would dwarf the direct path)."""
@@ -124,6 +147,9 @@ class NumpyEngine:
 
 class JaxEngine:
     name = "jax"
+    # Jitted kernels recompile per distinct shape (seconds each on TPU):
+    # callers should pad dispatch shapes to canonical buckets.
+    wants_static_shapes = True
 
     def __init__(self):
         import jax.numpy as jnp  # deferred so numpy-only paths never init jax
@@ -206,6 +232,13 @@ class JaxEngine:
         """Device-side concat of new rows: only the new block crosses PCIe."""
         return self._jnp.concatenate([matrix, self._jnp.asarray(block)], axis=1)
 
+    def set_rows(self, matrix, row_start: int, block):
+        """Write rows into preallocated capacity device-side (shape
+        preserved, so downstream jitted kernels never recompile)."""
+        return matrix.at[:, row_start : row_start + block.shape[1], :].set(
+            self._jnp.asarray(block)
+        )
+
     def pair_gram(self, matrix):
         """All-pairs AND-count Gram via one MXU int8 matmul (exact)."""
         if not hasattr(self, "_gram_jit"):
@@ -287,6 +320,9 @@ class MeshEngine(JaxEngine):
     def append_rows(self, matrix, block):
         return self._repin(super().append_rows(matrix, block), matrix)
 
+    def set_rows(self, matrix, row_start, block):
+        return self._repin(super().set_rows(matrix, row_start, block), matrix)
+
     def gather_count(self, op, row_matrix, pairs):
         # Pallas can't lower under GSPMD partitioning; the jnp form is
         # partitioned by XLA (local gather + bitwise op + popcount per
@@ -299,11 +335,20 @@ class MeshEngine(JaxEngine):
         return np.asarray(out).astype(np.int64)
 
     def gather_count_or_multi(self, row_matrix, idx):
-        out = self._gather_or_jit(
-            self._shard_stack(self._jnp.asarray(row_matrix)),
-            self._jnp.asarray(idx),
-        )
-        return np.asarray(out).astype(np.int64)
+        # The jnp form materializes the [S, chunk, V, W] gather per shard;
+        # chunk the batch so that transient stays bounded (the same budget
+        # dispatch.py applies to its XLA fallback).
+        from pilosa_tpu.pilosa import OR_MULTI_BUDGET_DEVICE, or_multi_chunk_size
+
+        rm = self._shard_stack(self._jnp.asarray(row_matrix))
+        s, _, w = rm.shape
+        v = idx.shape[1]
+        chunk = or_multi_chunk_size(s, v, w, OR_MULTI_BUDGET_DEVICE)
+        outs = [
+            np.asarray(self._gather_or_jit(rm, self._jnp.asarray(idx[i : i + chunk])))
+            for i in range(0, idx.shape[0], chunk)
+        ]
+        return np.concatenate(outs).astype(np.int64)
 
 
 def new_engine(name: str = "auto"):

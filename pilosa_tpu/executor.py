@@ -472,6 +472,15 @@ class Executor:
             )
             matched[i] = (frame_name, row_id, views)
 
+        # Working-set guard: fusing pays through the cached multi-view
+        # matrix; a request whose distinct (frame, view, row) combos
+        # exceed the matrix row budget would rebuild+re-upload a giant
+        # matrix every time, so it takes the sequential path instead
+        # (per-fragment device row caches amortize there).
+        combos = {(f, v, r) for f, r, views in matched.values() for v in views}
+        if len(combos) > self._matrix_rows_max:
+            return None
+
         idxs = sorted(matched)
         totals = self._fused_dispatch(
             index, idxs, slices, opt,
@@ -506,29 +515,66 @@ class Executor:
             combos = sorted(
                 {(v, matched[i][1]) for i in live for v in matched[i][2]}
             )
-            id_pos, matrix = self._multi_view_matrix(index, frame_name, slices, combos)
-            vmax = max(len(matched[i][2]) for i in live)
-            idx_arr = np.zeros((len(live), vmax), dtype=np.int32)
-            for k, i in enumerate(live):
+            id_pos, matrix, memo = self._multi_view_matrix(index, frame_name, slices, combos)
+            # Count memo: the memo dict lives and dies with the cache entry
+            # (fresh on any write), so repeated ranges — the dashboard
+            # steady state — are answered host-side with zero device work,
+            # the Range analog of the Gram lane's count lookups.
+            misses = []
+            for i in live:
                 _, row_id, views = matched[i]
-                cover = [id_pos[(v, row_id)] for v in views]
-                idx_arr[k, : len(cover)] = cover
-                idx_arr[k, len(cover):] = cover[0]  # pad: OR-idempotent
-            counts = self.engine.gather_count_or_multi(matrix, idx_arr)
-            for k, i in enumerate(live):
-                out[i] = int(counts[k])
+                c = memo.get((row_id, tuple(views)))
+                if c is None:
+                    misses.append(i)
+                else:
+                    out[i] = c
+            if misses:
+                # On jitted engines, CANONICAL kernel shapes: the batch dim
+                # is chunked to a fixed 128 (padded by repeating the first
+                # miss's cover — extra counts computed and discarded) and
+                # the cover width padded to one of {4, 16, 64}
+                # (repeat-first-id padding is OR-idempotent).  Ragged
+                # shapes would trigger a jit recompile per distinct
+                # (miss count, max cover) pair — seconds each.  Engines
+                # without jit (numpy) use exact shapes: padding there is
+                # pure wasted gather/OR work.
+                vmax = max(len(matched[i][2]) for i in misses)
+                static = getattr(self.engine, "wants_static_shapes", False)
+                if static:
+                    vb = 4 if vmax <= 4 else 16 if vmax <= 16 else 64 if vmax <= 64 else vmax
+                    BB = 128
+                else:
+                    vb, BB = vmax, len(misses)
+                for c0 in range(0, len(misses), BB):
+                    part = misses[c0 : c0 + BB]
+                    idx_arr = np.zeros((BB, vb), dtype=np.int32)
+                    for k, i in enumerate(part):
+                        _, row_id, views = matched[i]
+                        cover = [id_pos[(v, row_id)] for v in views]
+                        idx_arr[k, : len(cover)] = cover
+                        idx_arr[k, len(cover):] = cover[0]
+                    idx_arr[len(part):] = idx_arr[0]
+                    counts = self.engine.gather_count_or_multi(matrix, idx_arr)
+                    for k, i in enumerate(part):
+                        c = int(counts[k])
+                        out[i] = c
+                        if len(memo) < 65536:  # bound host memory vs adversarial
+                            memo[(matched[i][1], tuple(matched[i][2]))] = c
         return [out[i] for i in idxs]
 
     def _multi_view_matrix(
         self, index: str, frame: str, slices, combos: list[tuple[str, int]]
-    ) -> tuple[dict[tuple[str, int], int], object]:
+    ) -> tuple[dict[tuple[str, int], int], object, dict]:
         """Engine matrix [n_slices, len(combos), W] whose row planes are
-        (view, row_id) combos — the fused Range path's working set.
+        (view, row_id) combos — the fused Range path's working set — plus
+        a per-entry count memo for repeated covers.
 
         Cached like the single-view matrix (LRU, validated by the write
         generations of every (view, slice) fragment involved); rebuilt
         whole on any change (Range covers touch many small time views, so
-        per-plane patching buys little).
+        per-plane patching buys little).  The memo dict is shared across
+        threads without a lock: entries are deterministic pure counts, so
+        a racing double-compute stores the same value.
         """
         views = sorted({v for v, _ in combos})
         frags = {
@@ -542,28 +588,75 @@ class Executor:
         with self._matrix_mu:
             hit = self._multi_matrix_cache.get(key)
             if hit is not None:
-                old_gens, old_id_pos, old_matrix = hit
-                if old_gens == gens and set(combos) <= old_id_pos.keys():
-                    self._multi_matrix_cache.move_to_end(key)
-                    return old_id_pos, old_matrix
+                old_gens, old_id_pos, old_matrix, old_memo = hit
+                if old_gens == gens:
+                    missing = sorted(set(combos) - old_id_pos.keys())
+                    if not missing:
+                        self._multi_matrix_cache.move_to_end(key)
+                        return old_id_pos, old_matrix, old_memo
+                else:
+                    old_id_pos = None  # writes: rebuild, fresh memo
+            else:
+                old_id_pos = None
+
+        def densify(combo_list, cap):
+            """[n_slices, cap, W] host block; rows beyond the combo list
+            stay zero (capacity padding — gathers never index them)."""
+            planes = []
+            for si in range(len(slices)):
+                block = np.zeros((cap, _WORDS), dtype=np.uint32)
+                for k, (v, r) in enumerate(combo_list):
+                    f = frags[v][si]
+                    if f is not None:
+                        block[k] = f.row_dense(r)
+                planes.append(block)
+            return np.stack(planes)
+
+        def pow2(n: int) -> int:
+            return 1 << (n - 1).bit_length() if n > 1 else 1
+
+        if old_id_pos is not None and len(old_id_pos) + len(missing) <= self._matrix_rows_max:
+            # Generations unchanged, new combos only: write them into the
+            # cached matrix's spare capacity, then append any overflow as a
+            # new power-of-two capacity block — and KEEP the memo (its
+            # counts are still valid).  Physical positions are assigned
+            # where the rows actually land (spare rows first, then the
+            # appended block), so id_pos always matches the matrix.
+            # Power-of-two capacity keeps the matrix SHAPE stable across
+            # most appends, so downstream jitted kernels rarely recompile.
+            n_old = 1 + max(old_id_pos.values()) if old_id_pos else 0
+            cap = old_matrix.shape[1]
+            spare = missing[: cap - n_old]
+            overflow = missing[len(spare):]
+            matrix = old_matrix
+            if spare:
+                matrix = self.engine.set_rows(matrix, n_old, densify(spare, len(spare)))
+            if overflow:
+                new_cap = pow2(cap + len(overflow))
+                matrix = self.engine.append_rows(
+                    matrix, densify(overflow, new_cap - cap)
+                )
+            id_pos = dict(old_id_pos)
+            for k, c in enumerate(spare):
+                id_pos[c] = n_old + k
+            for k, c in enumerate(overflow):
+                id_pos[c] = cap + k
+            memo = old_memo
+            with self._matrix_mu:
+                self._multi_matrix_cache[key] = (gens, id_pos, matrix, memo)
+                self._multi_matrix_cache.move_to_end(key)
+            return id_pos, matrix, memo
 
         id_pos = {c: k for k, c in enumerate(combos)}
-        planes = []
-        for si in range(len(slices)):
-            block = np.zeros((len(combos), _WORDS), dtype=np.uint32)
-            for k, (v, r) in enumerate(combos):
-                f = frags[v][si]
-                if f is not None:
-                    block[k] = f.row_dense(r)
-            planes.append(block)
-        matrix = self.engine.matrix(np.stack(planes))
+        matrix = self.engine.matrix(densify(combos, pow2(len(combos))))
+        memo = {}
         if len(combos) <= self._matrix_rows_max:
             with self._matrix_mu:
-                self._multi_matrix_cache[key] = (gens, id_pos, matrix)
+                self._multi_matrix_cache[key] = (gens, id_pos, matrix, memo)
                 self._multi_matrix_cache.move_to_end(key)
                 while len(self._multi_matrix_cache) > self._matrix_cache_entries:
                     self._multi_matrix_cache.popitem(last=False)
-        return id_pos, matrix
+        return id_pos, matrix, memo
 
     def _is_distributed(self, opt: ExecOptions) -> bool:
         """Whether this executor coordinates a multi-node fan-out (shared

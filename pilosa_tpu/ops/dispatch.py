@@ -139,8 +139,8 @@ def gather_count_or_multi(row_matrix, idx):
     """Batched Count(Union of a V-row view cover) per query — the fused
     time-quantum Range count.  idx: int32[B, V], short covers padded by
     repeating a valid index (OR-idempotent)."""
+    b, v = idx.shape
     if use_pallas() and _tileable(row_matrix.shape[-1]):
-        b, v = idx.shape
         # Prefetched ids must fit SMEM: the pair kernels prefetch B*2 ids
         # under _GATHER_BATCH_MAX, so bound B*V by the same id budget
         # (wide view covers shrink the per-chunk batch).
@@ -153,6 +153,19 @@ def gather_count_or_multi(row_matrix, idx):
                 ]
             )
         return fused_gather_count_or(row_matrix, idx)
+    # XLA fallback materializes the gather: bound its transient HBM/host
+    # footprint by chunking the batch (shared sizing helper).
+    from pilosa_tpu.pilosa import OR_MULTI_BUDGET_DEVICE, or_multi_chunk_size
+
+    s, _, w = row_matrix.shape
+    chunk = or_multi_chunk_size(s, v, w, OR_MULTI_BUDGET_DEVICE)
+    if b > chunk:
+        return jnp.concatenate(
+            [
+                bitwise.gather_count_or_multi(row_matrix, idx[i : i + chunk])
+                for i in range(0, b, chunk)
+            ]
+        )
     return bitwise.gather_count_or_multi(row_matrix, idx)
 
 

@@ -95,3 +95,20 @@ def validate_name(name: str) -> None:
 def validate_label(label: str) -> None:
     if not isinstance(label, str) or _LABEL_RE.fullmatch(label) is None:
         raise ErrLabel(f"invalid row or column label: {label!r}")
+
+
+# ---------------------------------------------------------------------------
+# Shared batch-chunk sizing for the multi-view OR gather (fused Range).
+# One source of truth for the three evaluators (numpy engine, mesh engine,
+# dispatch's XLA fallback): a materialized [S, chunk, V, W] gather must
+# stay under budget bytes.  Hosts chunk small (L3-cache friendly); device
+# engines afford a larger HBM transient.
+# ---------------------------------------------------------------------------
+
+OR_MULTI_BUDGET_HOST = 32 << 20
+OR_MULTI_BUDGET_DEVICE = 256 << 20
+
+
+def or_multi_chunk_size(n_slices: int, n_views: int, n_words: int, budget: int) -> int:
+    """Largest batch chunk whose gathered block fits ``budget`` bytes."""
+    return max(1, budget // max(1, n_slices * n_views * n_words * 4))

@@ -42,6 +42,8 @@ def _run(env_extra, script="bench.py", timeout=240):
         ("timerange", {"BENCH_ITERS": "4", "BENCH_BATCH": "2"}),
         ("executor", {"BENCH_ITERS": "3", "BENCH_SLICES": "2", "BENCH_ROWS": "4",
                       "BENCH_BATCH": "4", "BENCH_BITS_PER_ROW": "50", "BENCH_THREADS": "2"}),
+        ("range_executor", {"BENCH_ITERS": "3", "BENCH_SLICES": "2",
+                            "BENCH_BATCH": "4", "BENCH_BITS": "200"}),
     ],
 )
 def test_bench_config_emits_json(cfg, extra):

@@ -774,3 +774,44 @@ def test_fused_range_batch_distributed(tmp_path):
     e2 = Executor(h, engine="numpy", cluster=cluster, client_factory=DyingClient, host="h0:1")
     assert e2.execute("i", q) == got
     h.close()
+
+
+@pytest.mark.parametrize("engine", ["numpy", "jax"])
+def test_fused_range_matrix_grow_alignment(tmp_path, engine):
+    """Growing the cached multi-view matrix past its capacity must keep
+    id_pos aligned with physical rows (regression: append after spare
+    zero rows shifted every new cover onto the wrong plane and poisoned
+    the memo)."""
+    h = Holder(str(tmp_path / "data"))
+    h.open()
+    idx = h.create_index("i")
+    idx.create_frame("f", FrameOptions(time_quantum="YMD"))
+    e = Executor(h, engine=engine)
+    # One Y-covering span per row: each (row, span) is exactly one
+    # (view, row) combo, so combo counts are easy to control.
+    span = ('start="2017-01-01T00:00", end="2018-01-01T00:00"')
+    for r in range(8):
+        e.execute(
+            "i",
+            f'SetBit(rowID={r}, frame="f", columnID={100 + r}, '
+            'timestamp="2017-06-15T00:00")',
+        )
+        e.execute(
+            "i",
+            f'SetBit(rowID={r}, frame="f", columnID={200 + r}, '
+            'timestamp="2017-06-16T00:00")',
+        )
+
+    def counts(rows_):
+        q = " ".join(
+            f'Count(Range(rowID={r}, frame="f", {span}))' for r in rows_
+        )
+        return e.execute("i", q)
+
+    # 3 combos -> capacity pow2(3)=4; then +2 new combos forces a grow
+    # (one into spare capacity, one appended).
+    assert counts([0, 1, 2]) == [2, 2, 2]
+    assert counts([0, 1, 2, 3, 4]) == [2, 2, 2, 2, 2]
+    # Re-query only the grown rows: the memo must hold correct values.
+    assert counts([3, 4, 5, 6, 7]) == [2] * 5
+    h.close()
