@@ -298,7 +298,13 @@ def bench_executor() -> dict:
 
         ex = Executor(h)
         backend = ex.engine.name
-        ex.execute("bench", queries[0])  # warm: device cache + compile
+        # Warm past the strategy ladder: request 1 builds + caches the row
+        # matrix, request 2+ upgrade it to the Gram (single-flight build),
+        # after which steady state is host-side count lookups.  Timing
+        # from a cold cache would mostly measure the one-time matrix
+        # upload + Gram matmul, not the serving rate.
+        for q in queries[: min(4, len(queries))]:
+            ex.execute("bench", q)
         # Drive like a loaded server: concurrent requests overlap parse
         # (CPU) with device dispatch + result fetch, exactly as the
         # threaded HTTP server does.  BENCH_THREADS=1 for pure latency.
