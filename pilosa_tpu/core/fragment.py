@@ -46,6 +46,10 @@ DEFAULT_MAX_OPN = 2000
 
 DEFAULT_CACHE_SIZE = 50000
 
+# TopN candidate-scoring chunk; engine scorers pad to this for stable
+# jitted shapes, so both sites must share the constant.
+TOPN_SCORE_CHUNK = 256
+
 _WORDS = SLICE_WIDTH // 32
 
 # Process-global write-generation source (see Fragment.generation).
@@ -66,6 +70,12 @@ class TopOptions:
     # path passes this directly so the device-evaluated child bitmap never
     # round-trips through a roaring conversion.
     src_dense: Optional[np.ndarray] = None
+    # Optional batched scorer: callable(list[row_id]) -> int array of
+    # |row & src| per id, or None to decline a chunk (the fragment then
+    # scores it with its own host path).  The executor passes an
+    # engine-backed one so the candidate hot loop (fragment.go:553-560)
+    # runs on device against the cached HBM row matrix.
+    scorer: Optional[object] = None
     row_ids: Sequence[int] = field(default_factory=list)
     min_threshold: int = 0
     filter_field: str = ""
@@ -471,13 +481,19 @@ class Fragment:
             else opt.src.to_dense_words(self.slice * SLICE_WIDTH, SLICE_WIDTH)
         )
         results: list[cache_mod.Pair] = []
-        chunk = 256
+        chunk = TOPN_SCORE_CHUNK
         i = 0
         while i < len(cands):
             batch = cands[i : i + chunk]
             i += chunk
-            rows = np.stack([self.row_dense(p.id) for p in batch])
-            counts = _batch_intersection_counts(rows, src_dense)
+            counts = None
+            if opt.scorer is not None:
+                counts = opt.scorer([p.id for p in batch])
+            if counts is None:  # no scorer, or scorer declined this chunk
+                rows = np.stack([self.row_dense(p.id) for p in batch])
+                counts = _batch_intersection_counts(rows, src_dense)
+            else:
+                counts = np.asarray(counts)
             stop = False
             for p, count in zip(batch, counts.tolist()):
                 if n and len(results) >= n:

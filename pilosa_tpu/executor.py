@@ -1100,14 +1100,17 @@ class Executor:
                 raise PilosaError("TopN() can only have one input bitmap")
             src_batch = self.engine.to_numpy(self._eval_stack(index, c.children[0], slices))
 
+        scorer_for = self._topn_scorer_factory(index, frame_name, slices, src_batch)
         merged: list[cache_mod.Pair] = []
         for i, s in enumerate(slices):
             frag = self.holder.fragment(index, frame_name, VIEW_STANDARD, s)
             if frag is None:
                 continue
+            src_dense = src_batch[i] if src_batch is not None else None
             topt = TopOptions(
                 n=int(n),
-                src_dense=src_batch[i] if src_batch is not None else None,
+                src_dense=src_dense,
+                scorer=scorer_for(i, src_dense),
                 row_ids=row_ids,
                 min_threshold=int(min_threshold),
                 filter_field=field,
@@ -1116,6 +1119,61 @@ class Executor:
             )
             merged = cache_mod.pairs_add(merged, frag.top(topt))
         return merged
+
+    def _topn_scorer_factory(self, index, frame_name, slices, src_batch):
+        """Per-slice engine-backed |row & src| scorers for TopN candidates.
+
+        The reference scores candidates with a per-row scalar loop
+        (fragment.go:553-560); here each candidate chunk is one fused
+        device dispatch against the SAME generation-cached multi-slice
+        row matrix the fused Count lane uses (one cache entry for the
+        whole query, not one per slice -- per-slice keys would thrash the
+        small matrix LRU and evict the Count lane's Gram).  Chunks are
+        padded to the fragment scoring chunk so jitted shapes never vary.
+        A scorer returns None -- "score it yourself" -- once the
+        accumulated candidate set would exceed the matrix row budget
+        (the cache would thrash with rebuild-per-chunk uploads), and the
+        factory hands out None on the numpy engine (the fragment's host
+        path is the same math without an engine round trip).
+        """
+        if src_batch is None or self.engine.name == "numpy":
+            return lambda si, src_dense: None
+        from pilosa_tpu.core.fragment import TOPN_SCORE_CHUNK
+
+        state = {"src_dev": {}, "seen": set(), "host": False}
+        all_slices = list(slices)
+
+        def scorer_for(si: int, src_dense):
+            if src_dense is None:
+                return None
+
+            def score(ids):
+                state["seen"].update(ids)
+                if state["host"] or len(state["seen"]) > self._matrix_rows_max:
+                    state["host"] = True
+                    return None  # fragment scores this chunk host-side
+                id_pos, matrix, _ = self._frame_matrix(
+                    index, frame_name, all_slices, set(ids)
+                )
+                n = len(ids)
+                padded = (
+                    list(ids) + [ids[0]] * (TOPN_SCORE_CHUNK - n)
+                    if n < TOPN_SCORE_CHUNK
+                    else list(ids)
+                )
+                pos = np.fromiter(
+                    (id_pos[i] for i in padded), dtype=np.int64, count=len(padded)
+                )
+                src_dev = state["src_dev"].get(si)
+                if src_dev is None:
+                    src_dev = state["src_dev"][si] = self.engine.asarray(src_dense)
+                rows = matrix[si][pos]
+                counts = self.engine.batch_intersection_count(rows, src_dev)
+                return counts[:n]
+
+            return score
+
+        return scorer_for
 
     # -- writes (executor.go:702-805) --------------------------------------
 

@@ -815,3 +815,73 @@ def test_fused_range_matrix_grow_alignment(tmp_path, engine):
     # Re-query only the grown rows: the memo must hold correct values.
     assert counts([3, 4, 5, 6, 7]) == [2] * 5
     h.close()
+
+
+def test_topn_src_scoring_engine_parity(tmp_path):
+    """TopN(src) candidate scoring through the engine-backed device
+    scorer must match the numpy host path exactly (threshold pruning,
+    tanimoto band, two-phase refetch included)."""
+    h = Holder(str(tmp_path / "data"))
+    h.open()
+    idx = h.create_index("i")
+    idx.create_frame("r", FrameOptions(cache_type="ranked"))
+    idx.create_frame("f", FrameOptions())
+    fr = idx.frame("r")
+    rng = np.random.default_rng(21)
+    rows, cols = [], []
+    for r in range(40):
+        n_bits = int(rng.integers(5, 200))
+        rows.extend([r] * n_bits)
+        cols.extend(rng.choice(2 * SLICE_WIDTH, size=n_bits, replace=False).tolist())
+    fr.import_bits(rows, cols)
+    e_np = Executor(h, engine="numpy")
+    for c in range(0, 600, 3):
+        e_np.execute("i", f'SetBit(rowID=9, frame="f", columnID={c})')
+    e_jx = Executor(h, engine="jax")
+    for q in (
+        'TopN(Bitmap(rowID=9, frame="f"), frame="r", n=5)',
+        'TopN(Bitmap(rowID=9, frame="f"), frame="r", n=25)',
+        'TopN(Bitmap(rowID=9, frame="f"), frame="r")',
+        'TopN(Bitmap(rowID=9, frame="f"), frame="r", n=3, tanimotoThreshold=10)',
+        'TopN(Bitmap(rowID=9, frame="f"), frame="r", ids=[1,5,11,33])',
+    ):
+        got_np = [(p.id, p.count) for p in e_np.execute("i", q)[0]]
+        got_jx = [(p.id, p.count) for p in e_jx.execute("i", q)[0]]
+        assert got_np == got_jx, q
+    h.close()
+
+
+def test_topn_scorer_budget_crossover_parity(tmp_path):
+    """When the candidate set crosses the matrix row budget mid-query,
+    the scorer hands remaining chunks back to the fragment's host path;
+    results must still match the numpy engine exactly."""
+    h = Holder(str(tmp_path / "data"))
+    h.open()
+    idx = h.create_index("i")
+    idx.create_frame("r", FrameOptions(cache_type="ranked"))
+    idx.create_frame("f", FrameOptions())
+    fr = idx.frame("r")
+    rng = np.random.default_rng(33)
+    rows, cols = [], []
+    # >256 candidates so chunk 1 (256 ids) scores on-device under a 280
+    # budget and chunk 2 crosses it, handing back to the host path.
+    for r in range(300):
+        n_bits = int(rng.integers(5, 40))
+        rows.extend([r] * n_bits)
+        cols.extend(rng.choice(SLICE_WIDTH, size=n_bits, replace=False).tolist())
+    fr.import_bits(rows, cols)
+    e_np = Executor(h, engine="numpy")
+    for c in range(0, 800, 2):
+        e_np.execute("i", f'SetBit(rowID=7, frame="f", columnID={c})')
+    e_jx = Executor(h, engine="jax")
+    e_jx._matrix_rows_max = 280  # crossover between chunk 1 and chunk 2
+    q = 'TopN(Bitmap(rowID=7, frame="f"), frame="r", n=8)'
+    got_np = [(p.id, p.count) for p in e_np.execute("i", q)[0]]
+    got_jx = [(p.id, p.count) for p in e_jx.execute("i", q)[0]]
+    assert got_np == got_jx
+    # Also cover the decline-from-the-first-chunk shape.
+    e_jx2 = Executor(h, engine="jax")
+    e_jx2._matrix_rows_max = 16
+    got_jx2 = [(p.id, p.count) for p in e_jx2.execute("i", q)[0]]
+    assert got_np == got_jx2
+    h.close()
