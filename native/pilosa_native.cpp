@@ -55,6 +55,25 @@ uint64_t pn_popcount_and_u32(const uint32_t* a, const uint32_t* b, size_t n) {
 }
 
 // ---------------------------------------------------------------------------
+// Sorted-array container insert (roaring.go array containers): in-place
+// binary-search + memmove over a capacity-slack buffer — the single-SetBit
+// hot loop.  Returns -1 when the value is already present (no mutation),
+// else the new element count.  Caller guarantees capacity > n.
+// ---------------------------------------------------------------------------
+
+int64_t pn_array_insert_u32(uint32_t* arr, int64_t n, uint32_t v) {
+    int64_t lo = 0, hi = n;
+    while (lo < hi) {
+        int64_t mid = (lo + hi) >> 1;
+        if (arr[mid] < v) lo = mid + 1; else hi = mid;
+    }
+    if (lo < n && arr[lo] == v) return -1;
+    memmove(arr + lo + 1, arr + lo, (size_t)(n - lo) * sizeof(uint32_t));
+    arr[lo] = v;
+    return n + 1;
+}
+
+// ---------------------------------------------------------------------------
 // Protobuf varint packing (wire.py data plane: packed repeated uint64)
 // ---------------------------------------------------------------------------
 

@@ -862,13 +862,21 @@ class Executor:
 
         # Full build.  Oversized row sets are served but never cached: one
         # giant request must not pin rows_max-violating HBM in the LRU.
+        # Likewise a build that only happened because old rows + new rows
+        # exceeded the budget must NOT replace a still-valid LARGER entry
+        # (generations unchanged) — evicting it would force the other
+        # lane (fused Counts and their Gram) to re-upload everything on
+        # its next query, ping-ponging the cache.
         rows = sorted(want)
         id_pos = {r: k for k, r in enumerate(rows)}
         host = np.stack([densify(f, rows) for f in frags]) if rows else np.zeros(
             (len(slices), 0, _WORDS), dtype=np.uint32
         )
         matrix = self.engine.matrix(host)
-        if len(rows) <= self._matrix_rows_max:
+        preserve_old = (
+            old_id_pos is not None and not stale and len(old_id_pos) > len(rows)
+        )
+        if len(rows) <= self._matrix_rows_max and not preserve_old:
             box = {"hits": 1, "mu": threading.Lock()}
             with self._matrix_mu:
                 self._matrix_cache[key] = (gens, id_pos, matrix, box)
@@ -1140,7 +1148,7 @@ class Executor:
             return lambda si, src_dense: None
         from pilosa_tpu.core.fragment import TOPN_SCORE_CHUNK
 
-        state = {"src_dev": {}, "seen": set(), "host": False}
+        state = {"src_dev": {}, "seen": set(), "host": False, "base": None}
         all_slices = list(slices)
 
         def scorer_for(si: int, src_dense):
@@ -1149,7 +1157,21 @@ class Executor:
 
             def score(ids):
                 state["seen"].update(ids)
-                if state["host"] or len(state["seen"]) > self._matrix_rows_max:
+                if state["base"] is None:
+                    # Rows already resident in the shared cache entry count
+                    # against the budget too: growing past rows_max would
+                    # evict the Count lane's larger matrix (+ Gram) and
+                    # ping-pong the cache.  Conservative (overlap with the
+                    # candidate set double-counts) — worst case is an early
+                    # host fallback, never thrash.
+                    key = (index, frame_name, VIEW_STANDARD, tuple(all_slices))
+                    with self._matrix_mu:
+                        hit = self._matrix_cache.get(key)
+                        state["base"] = len(hit[1]) if hit is not None else 0
+                if (
+                    state["host"]
+                    or state["base"] + len(state["seen"]) > self._matrix_rows_max
+                ):
                     state["host"] = True
                     return None  # fragment scores this chunk host-side
                 id_pos, matrix, _ = self._frame_matrix(

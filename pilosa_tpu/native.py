@@ -78,6 +78,10 @@ def load() -> Optional[ctypes.CDLL]:
         lib.pn_oplog_encode.argtypes = [u8p, u64p, ctypes.c_size_t, u8p]
         lib.pn_op_encode1.restype = None
         lib.pn_op_encode1.argtypes = [ctypes.c_uint8, ctypes.c_uint64, u8p]
+        # c_void_p + raw .ctypes.data int: cheapest per-call marshalling on
+        # the SetBit hot path (data_as() allocates a pointer object).
+        lib.pn_array_insert_u32.restype = ctypes.c_int64
+        lib.pn_array_insert_u32.argtypes = [ctypes.c_void_p, ctypes.c_int64, ctypes.c_uint32]
         lib.pn_oplog_decode.restype = ctypes.c_int64
         lib.pn_oplog_decode.argtypes = [u8p, ctypes.c_size_t, u8p, u64p]
         lib.pn_parse_csv.restype = ctypes.c_int64

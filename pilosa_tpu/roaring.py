@@ -84,7 +84,7 @@ class Container:
     most ARRAY_MAX_SIZE=4096 values (roaring.go:833, 951-953).
     """
 
-    __slots__ = ("array", "bitmap", "_n", "_ser")
+    __slots__ = ("array", "bitmap", "_n", "_ser", "_buf")
 
     def __init__(self, array: Optional[np.ndarray] = None, bitmap: Optional[np.ndarray] = None):
         if array is None and bitmap is None:
@@ -99,6 +99,11 @@ class Container:
         # re-encode containers that changed since the last one (the
         # per-container-dirty incremental snapshot; cleared on mutation).
         self._ser: Optional[tuple[int, bytes]] = None
+        # Capacity-slack backing buffer for the native in-place insert:
+        # when set, ``array`` is ``_buf[:n]`` and single adds memmove
+        # inside the buffer (no per-op allocation).  Any bulk mutation or
+        # representation change drops it (array becomes standalone again).
+        self._buf: Optional[np.ndarray] = None
 
     # -- constructors -------------------------------------------------
 
@@ -152,6 +157,25 @@ class Container:
         """Insert lowbits value; True if it was newly added."""
         arr = self.array
         if arr is not None:
+            n = len(arr)
+            if n < ARRAY_MAX_SIZE:
+                lib = native.load()
+                if lib is not None:
+                    # Native in-place insert over a capacity-slack buffer:
+                    # one C call does the binary search, duplicate check,
+                    # and memmove — no per-op numpy dispatch or allocation.
+                    buf = self._buf
+                    if buf is None or n >= len(buf):
+                        cap = max(8, 2 * n)
+                        buf = np.empty(cap, dtype=np.uint32)
+                        buf[:n] = arr
+                        self._buf = buf
+                    newn = lib.pn_array_insert_u32(buf.ctypes.data, n, v)
+                    if newn < 0:
+                        return False
+                    self._ser = None
+                    self.array = buf[:newn]
+                    return True
             # Direct ndarray method: the np.searchsorted module wrapper pays
             # ~3µs of dispatch machinery per call on this hot path.
             i = int(arr.searchsorted(v))
@@ -159,6 +183,7 @@ class Container:
                 return False
             self._ser = None
             if len(arr) >= ARRAY_MAX_SIZE:
+                self._buf = None
                 self.bitmap = _values_to_bitmap(arr)
                 self._n = len(arr) + 1
                 self.array = None
@@ -170,6 +195,7 @@ class Container:
             new[:i] = arr[:i]
             new[i] = v
             new[i + 1:] = arr[i:]
+            self._buf = None
             self.array = new
             return True
         w, b = v >> 6, v & 63
@@ -187,6 +213,7 @@ class Container:
             if i >= len(self.array) or self.array[i] != v:
                 return False
             self._ser = None
+            self._buf = None
             self.array = np.delete(self.array, i)
             return True
         w, b = v >> 6, v & 63
@@ -198,6 +225,7 @@ class Container:
             self._n -= 1
         # Convert back to array when small enough (roaring.go remove path).
         if self.n <= ARRAY_MAX_SIZE:
+            self._buf = None
             self.array = _bitmap_to_values(self.bitmap)
             self.bitmap = None
             self._n = None  # array form owns the count now
@@ -209,6 +237,7 @@ class Container:
         if len(values) == 0:
             return 0
         self._ser = None
+        self._buf = None
         before = self.n
         if self.bitmap is not None:
             # Dense stays dense: OR the bits in directly, O(len + 1024)

@@ -885,3 +885,46 @@ def test_topn_scorer_budget_crossover_parity(tmp_path):
     got_jx2 = [(p.id, p.count) for p in e_jx2.execute("i", q)[0]]
     assert got_np == got_jx2
     h.close()
+
+
+def test_topn_does_not_evict_count_lane_matrix(tmp_path):
+    """A TopN whose candidates would overflow the shared matrix entry
+    must not replace the Count lane's larger still-valid matrix
+    (regression: rebuild ping-pong on alternating TopN/Count traffic)."""
+    h = Holder(str(tmp_path / "data"))
+    h.open()
+    idx = h.create_index("i")
+    idx.create_frame("r", FrameOptions(cache_type="ranked"))
+    idx.create_frame("f", FrameOptions())
+    fr = idx.frame("r")
+    rng = np.random.default_rng(44)
+    rows, cols = [], []
+    for r in range(30):
+        n_bits = int(rng.integers(10, 60))
+        rows.extend([r] * n_bits)
+        cols.extend(rng.choice(SLICE_WIDTH, size=n_bits, replace=False).tolist())
+    fr.import_bits(rows, cols)
+    e = Executor(h, engine="jax")
+    e._matrix_rows_max = 24
+    for c in range(0, 500, 2):
+        e.execute("i", f'SetBit(rowID=5, frame="f", columnID={c})')
+    # Count lane populates the shared entry with 20 rows.
+    pair_q = " ".join(
+        f'Count(Intersect(Bitmap(rowID={i}, frame="r"), Bitmap(rowID={i+1}, frame="r")))'
+        for i in range(0, 20, 2)
+    )
+    want_counts = e.execute("i", pair_q)
+    key = ("i", "r", "standard", (0,))
+    gens0, id_pos0, _, _ = e._matrix_cache[key]
+    n0 = len(id_pos0)
+    assert n0 >= 10
+    # TopN over 30 candidates: 20 resident + 30 seen > 24 budget -> the
+    # scorer must decline (host path) and leave the entry untouched.
+    topn_q = 'TopN(Bitmap(rowID=5, frame="f"), frame="r", n=5)'
+    got_np = [(p.id, p.count) for p in Executor(h, engine="numpy").execute("i", topn_q)[0]]
+    got = [(p.id, p.count) for p in e.execute("i", topn_q)[0]]
+    assert got == got_np
+    gens1, id_pos1, _, _ = e._matrix_cache[key]
+    assert gens1 == gens0 and len(id_pos1) == n0  # entry preserved
+    assert e.execute("i", pair_q) == want_counts  # still served correctly
+    h.close()
