@@ -69,9 +69,12 @@ def test_random_pql_numpy_vs_jax(tmp_path, seed):
         frame = rng.choice(["f", "g"])
         if roll < 0.45:
             return f"Count({tree(rng.choice([1, 2]), frame)})"
-        if roll < 0.8:
+        if roll < 0.75:
             return tree(rng.choice([1, 2]), frame)
-        return f'TopN(frame="{frame}", n={rng.randrange(1, 6)})'
+        if roll < 0.88:
+            return f'TopN(frame="{frame}", n={rng.randrange(1, 6)})'
+        # TopN with a src bitmap: the engine-backed candidate scorer path.
+        return f'TopN({bitmap(rng.choice(["f", "g"]))}, frame="f", n={rng.randrange(1, 6)})'
 
     for _ in range(35):
         q = " ".join(call() for _ in range(rng.randrange(1, 6)))
