@@ -270,6 +270,14 @@ class MeshEngine(JaxEngine):
 
     name = "mesh"
 
+    @property
+    def supports_row_scorer(self) -> bool:
+        """Eager per-chunk row indexing into a globally-sharded matrix is
+        not multi-host-safe; single-process meshes are fine."""
+        import jax
+
+        return jax.process_count() == 1
+
     def __init__(self, devices=None):
         super().__init__()
         from pilosa_tpu.parallel import SliceMesh
@@ -332,7 +340,30 @@ class MeshEngine(JaxEngine):
             self._shard_stack(self._jnp.asarray(row_matrix)),
             self._jnp.asarray(pairs),
         )
-        return np.asarray(out).astype(np.int64)
+        return self._fetch(out).astype(np.int64)
+
+    def _fetch(self, arr) -> np.ndarray:
+        """Fetch an engine array to host, allgathering when its shards
+        span other processes (multi-host mesh) — the DCN analog of the
+        reference streaming result segments back to the coordinator."""
+        if getattr(arr, "is_fully_addressable", True) or getattr(
+            arr, "is_fully_replicated", False
+        ):
+            return np.asarray(arr)
+        from jax.experimental import multihost_utils
+
+        return np.asarray(multihost_utils.process_allgather(arr, tiled=True))
+
+    def count(self, batch) -> np.ndarray:
+        # Per-slice counts stay sharded on the slice axis; on a
+        # multi-host mesh the base class's np.asarray would fail on
+        # non-addressable shards, so fetch via allgather.
+        if batch.size == 0:
+            return np.zeros(batch.shape[:-1], dtype=np.int64)
+        return self._fetch(self._dispatch.count(batch)).astype(np.int64)
+
+    def to_numpy(self, x) -> np.ndarray:
+        return self._fetch(x)
 
     def gather_count_or_multi(self, row_matrix, idx):
         # The jnp form materializes the [S, chunk, V, W] gather per shard;
@@ -345,7 +376,7 @@ class MeshEngine(JaxEngine):
         v = idx.shape[1]
         chunk = or_multi_chunk_size(s, v, w, OR_MULTI_BUDGET_DEVICE)
         outs = [
-            np.asarray(self._gather_or_jit(rm, self._jnp.asarray(idx[i : i + chunk])))
+            self._fetch(self._gather_or_jit(rm, self._jnp.asarray(idx[i : i + chunk])))
             for i in range(0, idx.shape[0], chunk)
         ]
         return np.concatenate(outs).astype(np.int64)

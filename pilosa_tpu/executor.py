@@ -1144,7 +1144,11 @@ class Executor:
         factory hands out None on the numpy engine (the fragment's host
         path is the same math without an engine round trip).
         """
-        if src_batch is None or self.engine.name == "numpy":
+        if (
+            src_batch is None
+            or self.engine.name == "numpy"
+            or not getattr(self.engine, "supports_row_scorer", True)
+        ):
             return lambda si, src_dense: None
         from pilosa_tpu.core.fragment import TOPN_SCORE_CHUNK
 

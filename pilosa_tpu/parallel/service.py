@@ -1,0 +1,214 @@
+"""Lockstep multi-host query service: one HTTP front end, SPMD execution.
+
+The reference serves distributed queries coordinator-style: the handler
+node parses, fans slice batches out to peers over HTTP+protobuf, and
+reduces (executor.go:1009-1244).  On a homogeneous TPU job the
+TPU-native alternative is SPMD LOCKSTEP: every process holds the same
+holder data, joins one ``jax.distributed`` mesh, and executes the SAME
+query program; device work is sharded over the global slice axis and
+XLA's collectives (psum over ICI/DCN) do the reduce that protobuf
+responses did in the reference.
+
+This module is the SERVICE shell around that execution model
+(tests/test_multihost.py proves the execution model itself):
+
+- rank 0 runs the HTTP front end (``POST /index/<name>/query``, the
+  reference's wire shape, handler.go:179-243) and a control-plane TCP
+  listener;
+- every other rank connects to the control plane and replays, in
+  arrival order, exactly the requests rank 0 serves;
+- rank 0 forwards each request to all ranks BEFORE executing it
+  locally, so every process enters the same jitted computations in the
+  same order — the lockstep invariant the collectives require.
+
+Requests are serialized through one total order (a lock on rank 0):
+lockstep has no concurrent-query mode by construction.  Writes (SetBit
+etc.) replay identically on every rank, keeping the replicated holders
+convergent.  Errors raised before device work (parse errors, unknown
+frames) raise identically everywhere — rank 0 reports them to the
+client, workers log and continue.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from pilosa_tpu.engine import MeshEngine
+from pilosa_tpu.executor import Executor
+from pilosa_tpu.pilosa import PilosaError
+from pilosa_tpu.server.handler import result_to_json
+
+_LEN = struct.Struct("<I")
+
+
+def _send_msg(sock: socket.socket, obj: dict) -> None:
+    data = json.dumps(obj).encode("utf-8")
+    sock.sendall(_LEN.pack(len(data)) + data)
+
+
+def _recv_msg(sock: socket.socket) -> Optional[dict]:
+    head = b""
+    while len(head) < 4:
+        chunk = sock.recv(4 - len(head))
+        if not chunk:
+            return None
+        head += chunk
+    (n,) = _LEN.unpack(head)
+    data = b""
+    while len(data) < n:
+        chunk = sock.recv(n - len(data))
+        if not chunk:
+            return None
+        data += chunk
+    return json.loads(data.decode("utf-8"))
+
+
+class LockstepService:
+    """SPMD query service over a joined ``jax.distributed`` job.
+
+    Construct AFTER ``init_multihost`` (or ``jax.distributed.initialize``)
+    on every process, with identical holder contents, then call
+    :meth:`serve_forever`.  Rank 0 needs ``http_addr`` and
+    ``control_addr``; workers need the same ``control_addr`` to connect.
+    """
+
+    def __init__(
+        self,
+        holder,
+        control_addr: tuple[str, int],
+        http_addr: Optional[tuple[str, int]] = None,
+        devices=None,
+    ):
+        import jax
+
+        self.holder = holder
+        self.rank = jax.process_index()
+        self.n_ranks = jax.process_count()
+        self.engine = MeshEngine(devices if devices is not None else jax.devices())
+        self.executor = Executor(holder, engine=self.engine)
+        self.control_addr = control_addr
+        self.http_addr = http_addr
+        self._workers: list[socket.socket] = []
+        self._mu = threading.Lock()  # the total order
+        self._httpd = None
+        self._stop = threading.Event()
+
+    # -- rank 0 ----------------------------------------------------------
+
+    def _accept_workers(self) -> None:
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind(self.control_addr)
+        srv.listen(self.n_ranks)
+        self.control_addr = srv.getsockname()
+        self._control_srv = srv
+        for _ in range(self.n_ranks - 1):
+            conn, _ = srv.accept()
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._workers.append(conn)
+
+    def _execute(self, index: str, query: str):
+        """Forward to every worker, then run locally (same order there)."""
+        with self._mu:
+            for w in self._workers:
+                _send_msg(w, {"op": "query", "index": index, "query": query})
+            return self.executor.execute(index, query)
+
+    class _Handler(BaseHTTPRequestHandler):
+        service: "LockstepService"
+
+        def log_message(self, *a):  # quiet
+            pass
+
+        def do_POST(self):
+            parts = self.path.strip("/").split("/")
+            if len(parts) != 3 or parts[0] != "index" or parts[2] != "query":
+                self.send_error(404)
+                return
+            index = parts[1]
+            n = int(self.headers.get("Content-Length", 0))
+            query = self.rfile.read(n).decode("utf-8")
+            try:
+                results = self.service._execute(index, query)
+                body = json.dumps(
+                    {"results": [result_to_json(r) for r in results]}
+                ).encode()
+                status = 200
+            except PilosaError as e:
+                body = json.dumps({"error": str(e)}).encode()
+                status = 400
+            except Exception as e:  # noqa: BLE001 — a dead worker (broken
+                # control pipe) or engine failure must surface as a 5xx,
+                # not a silently dropped connection.
+                body = json.dumps({"error": f"internal: {e}"}).encode()
+                status = 500
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    # -- workers ---------------------------------------------------------
+
+    def _worker_loop(self) -> None:
+        import time
+
+        # Rank 0 may still be binding its control listener; retry briefly
+        # (the same startup race the gossip seed-join retries handle).
+        deadline = time.monotonic() + 60
+        while True:
+            try:
+                sock = socket.create_connection(self.control_addr, timeout=5)
+                break
+            except OSError:
+                if time.monotonic() >= deadline:
+                    raise
+                time.sleep(0.2)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        sock.settimeout(None)
+        while not self._stop.is_set():
+            msg = _recv_msg(sock)
+            if msg is None or msg.get("op") == "shutdown":
+                break
+            try:
+                self.executor.execute(msg["index"], msg["query"])
+            except PilosaError:
+                # Rank 0 raised the same error before any device work and
+                # reported it to the client; stay in lockstep.
+                continue
+        sock.close()
+
+    # -- lifecycle -------------------------------------------------------
+
+    def serve_forever(self) -> None:
+        """Run the service until :meth:`shutdown` (rank 0) or a shutdown
+        message (workers).  Blocks."""
+        if self.rank == 0:
+            self._accept_workers()
+            handler = type("Bound", (self._Handler,), {"service": self})
+            self._httpd = ThreadingHTTPServer(self.http_addr or ("127.0.0.1", 0), handler)
+            self.http_addr = self._httpd.server_address
+            self._httpd.serve_forever(poll_interval=0.1)
+        else:
+            self._worker_loop()
+
+    def shutdown(self) -> None:
+        """Rank 0: stop the HTTP front end and release the workers."""
+        self._stop.set()
+        with self._mu:
+            for w in self._workers:
+                try:
+                    _send_msg(w, {"op": "shutdown"})
+                    w.close()
+                except OSError:
+                    pass
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        if getattr(self, "_control_srv", None) is not None:
+            self._control_srv.close()

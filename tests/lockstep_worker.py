@@ -1,0 +1,86 @@
+"""Worker for the lockstep-service test: joins a 2-process gloo job and
+runs pilosa_tpu.parallel.service.LockstepService.
+
+Run: python tests/lockstep_worker.py <coordinator> <nprocs> <pid> <control_port> <http_port>
+
+Rank 0 prints ``{"ready": ..., "http": ...}`` once serving, shuts down
+when a line arrives on stdin, then both ranks print a final JSON line
+with a host-side probe of their (replicated) holder state so the test
+can assert write convergence.
+"""
+
+import json
+import sys
+import threading
+
+
+def main() -> int:
+    coordinator, nprocs, pid, control_port, http_port = (
+        sys.argv[1],
+        int(sys.argv[2]),
+        int(sys.argv[3]),
+        int(sys.argv[4]),
+        int(sys.argv[5]),
+    )
+
+    from pilosa_tpu.parallel.multihost import init_multihost
+
+    init_multihost(coordinator, nprocs, pid, local_device_count=2)
+
+    import tempfile
+
+    from pilosa_tpu.core.frame import FrameOptions
+    from pilosa_tpu.core.holder import Holder
+    from pilosa_tpu.executor import Executor
+    from pilosa_tpu.parallel.service import LockstepService
+    from pilosa_tpu.pilosa import SLICE_WIDTH
+
+    with tempfile.TemporaryDirectory() as d:
+        h = Holder(d)
+        h.open()
+        idx = h.create_index("g")
+        idx.create_frame("f", FrameOptions(time_quantum="YM"))
+        fr = idx.frame("f")
+        # Identical seed data on every rank (replicated-holder model).
+        for r in range(4):
+            for s in range(4):
+                fr.set_bit("standard", r, s * SLICE_WIDTH + 10 + r)
+                fr.set_bit("standard", r, s * SLICE_WIDTH + 500)
+
+        svc = LockstepService(
+            h,
+            control_addr=("127.0.0.1", control_port),
+            http_addr=("127.0.0.1", http_port) if pid == 0 else None,
+        )
+        if pid == 0:
+            t = threading.Thread(target=svc.serve_forever, daemon=True)
+            t.start()
+            # Wait until the HTTP server is bound before announcing.
+            import time
+
+            deadline = time.monotonic() + 60
+            while svc._httpd is None and time.monotonic() < deadline:
+                time.sleep(0.05)
+            print(json.dumps({"ready": True}), flush=True)
+            sys.stdin.readline()  # parent signals shutdown
+            svc.shutdown()
+            t.join(timeout=30)
+        else:
+            svc.serve_forever()
+
+        # Post-run probe through the plain numpy path: writes served over
+        # HTTP must have replicated to every rank's holder.
+        e = Executor(h, engine="numpy")
+        (probe,) = e.execute("g", 'Count(Bitmap(rowID=0, frame="f"))')
+        (rprobe,) = e.execute(
+            "g",
+            'Count(Range(rowID=0, frame="f", start="2017-01-01T00:00", end="2018-01-01T00:00"))',
+        )
+        h.close()
+
+    print(json.dumps({"pid": pid, "probe": int(probe), "range_probe": int(rprobe)}), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -75,3 +75,127 @@ def test_two_process_global_mesh():
     assert by_pid[0]["exec_results"] == by_pid[1]["exec_results"]
     # Slice ownership is disjoint and covers the stack.
     assert sorted(by_pid[0]["owned"] + by_pid[1]["owned"]) == list(range(8))
+
+
+def test_lockstep_query_service():
+    """Full lockstep SERVICE: rank 0 serves HTTP, workers replay every
+    request over the control plane, device work runs SPMD over the
+    2-process global mesh, and writes replicate to every rank's holder."""
+    import urllib.request
+
+    coord_port, control_port, http_port = _free_port(), _free_port(), _free_port()
+    coordinator = f"127.0.0.1:{coord_port}"
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env["PYTHONPATH"] = REPO
+    env["XLA_FLAGS"] = ""
+
+    import tempfile
+    import threading
+
+    worker = os.path.join(REPO, "tests", "lockstep_worker.py")
+    # stderr goes to files (a chatty jax/gloo build filling a 64KB pipe
+    # would wedge a rank mid-request); stdout lines are drained by
+    # threads so the ranks never block on a full pipe either.
+    errfiles = [tempfile.NamedTemporaryFile("w+", delete=False) for _ in range(2)]
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker, coordinator, "2", str(pid),
+             str(control_port), str(http_port)],
+            stdin=subprocess.PIPE,
+            stdout=subprocess.PIPE,
+            stderr=errfiles[pid],
+            cwd=REPO,
+            env=env,
+            text=True,
+        )
+        for pid in range(2)
+    ]
+    out_lines: list[list[str]] = [[], []]
+
+    def _drain(i):
+        for line in procs[i].stdout:
+            out_lines[i].append(line)
+
+    drainers = [threading.Thread(target=_drain, args=(i,), daemon=True) for i in range(2)]
+    for t in drainers:
+        t.start()
+
+    def _stderr_tail(i):
+        errfiles[i].flush()
+        with open(errfiles[i].name) as f:
+            return f.read()[-2000:]
+
+    try:
+        # Wait for rank 0 to announce the HTTP server (bounded: a rank-1
+        # startup failure would otherwise hang the coordinator barrier
+        # and this wait forever).
+        deadline = 150
+        import time as _time
+
+        t0 = _time.monotonic()
+        while not out_lines[0] and _time.monotonic() - t0 < deadline:
+            if procs[0].poll() is not None or procs[1].poll() is not None:
+                pytest.fail(
+                    f"worker died at startup:\n0: {_stderr_tail(0)}\n1: {_stderr_tail(1)}"
+                )
+            _time.sleep(0.1)
+        assert out_lines[0], "rank 0 never became ready"
+        assert json.loads(out_lines[0][0]).get("ready"), out_lines[0][0]
+
+        def query(q):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{http_port}/index/g/query",
+                data=q.encode(),
+                method="POST",
+            )
+            with urllib.request.urlopen(req, timeout=60) as resp:
+                return json.loads(resp.read())
+
+        # Reads: counts over the replicated seed data (4 slices x 2 bits).
+        out = query('Count(Bitmap(rowID=0, frame="f")) '
+                    'Count(Intersect(Bitmap(rowID=0, frame="f"), Bitmap(rowID=1, frame="f")))')
+        assert out["results"] == [8, 4]  # row bits; shared col 500 per slice
+        # Writes: served once over HTTP, replayed on the worker rank.
+        out = query('SetBit(rowID=0, frame="f", columnID=77) '
+                    'SetBit(rowID=0, frame="f", columnID=78, timestamp="2017-03-02T00:00")')
+        assert out["results"] == [True, True]
+        out = query('Count(Bitmap(rowID=0, frame="f"))')
+        assert out["results"] == [10]
+        # Error path: rank 0 reports, workers stay in lockstep.
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{http_port}/index/g/query",
+            data=b'Bitmap(rowID=1, frame="nope")',
+            method="POST",
+        )
+        try:
+            urllib.request.urlopen(req, timeout=60)
+            assert False, "expected HTTP 400"
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+        out = query('Count(Bitmap(rowID=0, frame="f"))')  # still serving
+        assert out["results"] == [10]
+
+        procs[0].stdin.write("\n")
+        procs[0].stdin.flush()
+        outs = []
+        for i, p in enumerate(procs):
+            p.wait(timeout=120)
+            drainers[i].join(timeout=30)
+            assert p.returncode == 0, (
+                f"worker {i} failed:\nstdout={''.join(out_lines[i])}\nstderr={_stderr_tail(i)}"
+            )
+            outs.append(json.loads(out_lines[i][-1]))
+    except Exception:
+        for p in procs:
+            p.kill()
+        raise
+    finally:
+        for f in errfiles:
+            f.close()
+            os.unlink(f.name)
+    by_pid = {o["pid"]: o for o in outs}
+    # Both ranks converged: seed 8 bits + 2 served writes.
+    assert by_pid[0]["probe"] == by_pid[1]["probe"] == 10
+    # The timestamped write landed in both ranks' time views.
+    assert by_pid[0]["range_probe"] == by_pid[1]["range_probe"] == 1
