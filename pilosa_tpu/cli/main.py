@@ -50,6 +50,54 @@ def cmd_server(args) -> int:
     return 0
 
 
+# -- lockstep (TPU-native multi-host serving; no reference analog — the
+# reference's only multi-node mode is the coordinator-style cluster) --------
+
+def cmd_lockstep(args) -> int:
+    """Serve queries SPMD-lockstep over a jax.distributed job.
+
+    Run the SAME command on every process of the job; rank 0 serves HTTP
+    and the control plane, other ranks replay.  On TPU pods omit the
+    coordinator flags (topology comes from the runtime).
+    """
+    from pilosa_tpu.core.holder import Holder
+    from pilosa_tpu.parallel.multihost import init_multihost
+    from pilosa_tpu.parallel.service import LockstepService
+
+    cfg = _load_config(args)
+    init_multihost(
+        coordinator=args.coordinator,
+        num_processes=args.num_processes,
+        process_id=args.process_id,
+        local_device_count=args.local_devices,
+    )
+    holder = Holder(cfg.data_dir)
+    holder.open()
+    host, _, port = cfg.host.partition(":")
+    ctrl_host, _, ctrl_port = args.control.partition(":")
+    svc = LockstepService(
+        holder,
+        control_addr=(ctrl_host or "127.0.0.1", int(ctrl_port)),
+        http_addr=(host or "127.0.0.1", int(port or 10101)),
+    )
+    if svc.rank == 0:
+        print(
+            f"pilosa-tpu lockstep rank 0: http on {cfg.host}, "
+            f"control on {args.control}, {svc.n_ranks} ranks",
+            flush=True,
+        )
+    else:
+        print(f"pilosa-tpu lockstep rank {svc.rank}: replaying from {args.control}", flush=True)
+    try:
+        svc.serve_forever()
+    except KeyboardInterrupt:
+        if svc.rank == 0:
+            svc.shutdown()
+    finally:
+        holder.close()
+    return 0
+
+
 # -- import/export (ctl/import.go, ctl/export.go) ---------------------------
 
 def cmd_import(args) -> int:
@@ -235,6 +283,20 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--host", help="host:port to bind")
     s.add_argument("--test-exit", action="store_true", help=argparse.SUPPRESS)
     s.set_defaults(fn=cmd_server)
+
+    s = sub.add_parser(
+        "lockstep",
+        help="serve queries SPMD-lockstep over a jax.distributed job (run on every rank)",
+    )
+    s.add_argument("--data-dir", help="holder data directory (identical data on every rank)")
+    s.add_argument("--host", help="rank-0 HTTP bind host:port")
+    s.add_argument("--config", help="TOML config file")
+    s.add_argument("--control", default="127.0.0.1:14100", help="control-plane host:port (all ranks)")
+    s.add_argument("--coordinator", help="jax.distributed coordinator host:port (omit on TPU pods)")
+    s.add_argument("--num-processes", type=int, help="job size (with --coordinator)")
+    s.add_argument("--process-id", type=int, help="this rank (with --coordinator)")
+    s.add_argument("--local-devices", type=int, help="virtual CPU devices per process (dev rigs)")
+    s.set_defaults(fn=cmd_lockstep)
 
     s = sub.add_parser("import", help="bulk-import CSV row,col[,timestamp] bits")
     s.add_argument("--host", default="localhost:10101")

@@ -191,13 +191,13 @@ class JaxEngine:
         out = self._dispatch.gather_count(
             op, self._jnp.asarray(row_matrix), self._jnp.asarray(pairs), allow_gram=False
         )
-        return np.asarray(out).astype(np.int64)
+        return self.to_numpy(out).astype(np.int64)
 
     def gather_count_or_multi(self, row_matrix, idx) -> np.ndarray:
         out = self._dispatch.gather_count_or_multi(
             self._jnp.asarray(row_matrix), self._jnp.asarray(idx)
         )
-        return np.asarray(out).astype(np.int64)
+        return self.to_numpy(out).astype(np.int64)
 
     def bit_and(self, a, b):
         return self._jnp.bitwise_and(a, b)
@@ -217,10 +217,12 @@ class JaxEngine:
     def count(self, batch) -> np.ndarray:
         if batch.size == 0:
             return np.zeros(batch.shape[:-1], dtype=np.int64)
-        return np.asarray(self._dispatch.count(batch)).astype(np.int64)
+        return self.to_numpy(self._dispatch.count(batch)).astype(np.int64)
 
     def batch_intersection_count(self, rows, src) -> np.ndarray:
-        return np.asarray(self._dispatch.batch_intersection_count(rows, src)).astype(np.int64)
+        return self.to_numpy(
+            self._dispatch.batch_intersection_count(rows, src)
+        ).astype(np.int64)
 
     def update_slices(self, matrix, slice_idxs, planes):
         """Replace stale slice planes on-device: uploads only the changed
@@ -247,7 +249,7 @@ class JaxEngine:
             from pilosa_tpu.ops.bitwise import pair_gram
 
             self._gram_jit = jax.jit(pair_gram)
-        return np.asarray(self._gram_jit(self._jnp.asarray(matrix))).astype(np.int64)
+        return self.to_numpy(self._gram_jit(self._jnp.asarray(matrix))).astype(np.int64)
 
     def to_numpy(self, x) -> np.ndarray:
         return np.asarray(x)
@@ -354,15 +356,9 @@ class MeshEngine(JaxEngine):
 
         return np.asarray(multihost_utils.process_allgather(arr, tiled=True))
 
-    def count(self, batch) -> np.ndarray:
-        # Per-slice counts stay sharded on the slice axis; on a
-        # multi-host mesh the base class's np.asarray would fail on
-        # non-addressable shards, so fetch via allgather.
-        if batch.size == 0:
-            return np.zeros(batch.shape[:-1], dtype=np.int64)
-        return self._fetch(self._dispatch.count(batch)).astype(np.int64)
-
     def to_numpy(self, x) -> np.ndarray:
+        # Every inherited JaxEngine host conversion routes through here,
+        # so allgather-aware fetching covers them all on multi-host.
         return self._fetch(x)
 
     def gather_count_or_multi(self, row_matrix, idx):

@@ -95,6 +95,7 @@ class LockstepService:
         self.http_addr = http_addr
         self._workers: list[socket.socket] = []
         self._mu = threading.Lock()  # the total order
+        self._degraded = False
         self._httpd = None
         self._stop = threading.Event()
 
@@ -113,10 +114,29 @@ class LockstepService:
             self._workers.append(conn)
 
     def _execute(self, index: str, query: str):
-        """Forward to every worker, then run locally (same order there)."""
+        """Forward to every worker, then run locally (same order there).
+
+        FAIL-STOP on a broken control plane: once any forward fails the
+        ranks can no longer be guaranteed identical (a partial fan-out
+        may have replayed a write on some ranks only), so the service
+        refuses all further queries instead of serving diverged data —
+        an SPMD job with a dead rank needs a restart, exactly like a
+        collective hang would force anyway.
+        """
         with self._mu:
-            for w in self._workers:
-                _send_msg(w, {"op": "query", "index": index, "query": query})
+            if self._degraded:
+                raise PilosaError(
+                    "lockstep service degraded: control plane lost a rank; restart the job"
+                )
+            try:
+                for w in self._workers:
+                    _send_msg(w, {"op": "query", "index": index, "query": query})
+            except OSError as e:
+                self._degraded = True
+                raise PilosaError(
+                    f"lockstep control plane lost a rank mid-forward ({e}); "
+                    "service degraded — restart the job"
+                )
             return self.executor.execute(index, query)
 
     class _Handler(BaseHTTPRequestHandler):
@@ -177,9 +197,15 @@ class LockstepService:
                 break
             try:
                 self.executor.execute(msg["index"], msg["query"])
-            except PilosaError:
-                # Rank 0 raised the same error before any device work and
-                # reported it to the client; stay in lockstep.
+            except Exception:  # noqa: BLE001 — symmetric with rank 0's
+                # handler: it catches everything and keeps serving, so a
+                # worker must too.  PilosaErrors raise identically on
+                # every rank before device work; anything else is logged
+                # and the loop stays in FIFO lockstep (a true collective
+                # mismatch would have hung all ranks, not raised).
+                import traceback
+
+                traceback.print_exc()
                 continue
         sock.close()
 

@@ -88,3 +88,87 @@ def test_check_inspect(tmp_path, srv, capsys):
     bad = tmp_path / "bad"
     bad.write_bytes(b"\x00" * 32)
     assert main(["check", str(bad)]) == 1
+
+
+def test_lockstep_command(tmp_path):
+    """`pilosa-tpu lockstep` on two ranks: rank 0 serves HTTP, writes
+    replicate through the control plane; SIGINT shuts both down."""
+    import os
+    import signal
+    import socket
+    import subprocess
+    import sys
+    import time
+    import urllib.request
+
+    from pilosa_tpu.core.frame import FrameOptions
+    from pilosa_tpu.core.holder import Holder
+
+    def free_port():
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    dirs = [str(tmp_path / f"d{i}") for i in range(2)]
+    for d in dirs:  # identical replicated holder data per rank
+        h = Holder(d)
+        h.open()
+        idx = h.create_index("g")
+        idx.create_frame("f", FrameOptions())
+        for s in range(2):
+            idx.frame("f").set_bit("standard", 1, s * (1 << 20) + 3)
+        h.close()
+
+    coord, ctrl, http = free_port(), free_port(), free_port()
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env["PYTHONPATH"] = repo
+    env["XLA_FLAGS"] = ""
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-m", "pilosa_tpu.cli", "lockstep",
+             "--data-dir", dirs[pid], "--host", f"127.0.0.1:{http}",
+             "--control", f"127.0.0.1:{ctrl}",
+             "--coordinator", f"127.0.0.1:{coord}",
+             "--num-processes", "2", "--process-id", str(pid),
+             "--local-devices", "2"],
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+            cwd=repo,
+            env=env,
+        )
+        for pid in range(2)
+    ]
+    try:
+        deadline = time.monotonic() + 120
+        out = None
+        while time.monotonic() < deadline:
+            if any(p.poll() is not None for p in procs):
+                pytest.fail("lockstep rank died at startup")
+            try:
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{http}/index/g/query",
+                    data=b'Count(Bitmap(rowID=1, frame="f"))',
+                    method="POST",
+                )
+                with urllib.request.urlopen(req, timeout=10) as r:
+                    out = json.loads(r.read())
+                break
+            except OSError:
+                time.sleep(0.5)
+        assert out == {"results": [2]}, out
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{http}/index/g/query",
+            data=b'SetBit(rowID=1, frame="f", columnID=9)',
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=30) as r:
+            assert json.loads(r.read()) == {"results": [True]}
+        procs[0].send_signal(signal.SIGINT)
+        for p in procs:
+            assert p.wait(timeout=60) == 0
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
