@@ -290,7 +290,6 @@ def build_parser() -> argparse.ArgumentParser:
     )
     s.add_argument("--data-dir", help="holder data directory (identical data on every rank)")
     s.add_argument("--host", help="rank-0 HTTP bind host:port")
-    s.add_argument("--config", help="TOML config file")
     s.add_argument("--control", default="127.0.0.1:14100", help="control-plane host:port (all ranks)")
     s.add_argument("--coordinator", help="jax.distributed coordinator host:port (omit on TPU pods)")
     s.add_argument("--num-processes", type=int, help="job size (with --coordinator)")

@@ -131,13 +131,27 @@ class LockstepService:
             try:
                 for w in self._workers:
                     _send_msg(w, {"op": "query", "index": index, "query": query})
+                # Receipt acks BEFORE local execution: a dead worker is
+                # detected here instead of by hanging in the collective
+                # it will never enter.
+                for w in self._workers:
+                    if w.recv(1) != b"k":
+                        raise OSError("worker closed control connection")
             except OSError as e:
                 self._degraded = True
                 raise PilosaError(
-                    f"lockstep control plane lost a rank mid-forward ({e}); "
+                    f"lockstep control plane lost a rank ({e}); "
                     "service degraded — restart the job"
                 )
-            return self.executor.execute(index, query)
+            try:
+                return self.executor.execute(index, query)
+            except PilosaError:
+                raise  # deterministic; every rank raised it identically
+            except Exception:
+                # Workers replayed this request but rank 0 failed it:
+                # the replicas may have diverged — fail-stop.
+                self._degraded = True
+                raise
 
     class _Handler(BaseHTTPRequestHandler):
         service: "LockstepService"
@@ -196,17 +210,23 @@ class LockstepService:
             if msg is None or msg.get("op") == "shutdown":
                 break
             try:
+                sock.sendall(b"k")  # receipt ack (rank 0 waits on these)
                 self.executor.execute(msg["index"], msg["query"])
-            except Exception:  # noqa: BLE001 — symmetric with rank 0's
-                # handler: it catches everything and keeps serving, so a
-                # worker must too.  PilosaErrors raise identically on
-                # every rank before device work; anything else is logged
-                # and the loop stays in FIFO lockstep (a true collective
-                # mismatch would have hung all ranks, not raised).
+            except PilosaError:
+                # Deterministic: rank 0 raised the same error before any
+                # device work and reported it to the client; stay in
+                # lockstep.
+                continue
+            except Exception:  # noqa: BLE001
+                # Rank-LOCAL failure (disk full, engine fault): this
+                # replica may have diverged from its peers, so fail-stop —
+                # closing the socket trips rank 0's ack check on the next
+                # request and degrades the whole service, rather than
+                # silently serving collectives over diverged data.
                 import traceback
 
                 traceback.print_exc()
-                continue
+                break
         sock.close()
 
     # -- lifecycle -------------------------------------------------------

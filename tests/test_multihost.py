@@ -199,3 +199,36 @@ def test_lockstep_query_service():
     assert by_pid[0]["probe"] == by_pid[1]["probe"] == 10
     # The timestamped write landed in both ranks' time views.
     assert by_pid[0]["range_probe"] == by_pid[1]["range_probe"] == 1
+
+
+def test_lockstep_fail_stop_on_dead_worker(tmp_path):
+    """A broken control connection degrades the service: the failing
+    request errors and every subsequent request is refused (replicas can
+    no longer be guaranteed identical)."""
+    import socket as socket_mod
+
+    import pytest as _pytest
+
+    from pilosa_tpu.core.frame import FrameOptions
+    from pilosa_tpu.core.holder import Holder
+    from pilosa_tpu.parallel.service import LockstepService
+    from pilosa_tpu.pilosa import PilosaError
+
+    h = Holder(str(tmp_path / "d"))
+    h.open()
+    idx = h.create_index("g")
+    idx.create_frame("f", FrameOptions())
+    idx.frame("f").set_bit("standard", 1, 3)
+    svc = LockstepService(h, control_addr=("127.0.0.1", 0))
+    # Healthy single-rank service answers.
+    assert svc._execute("g", 'Count(Bitmap(rowID=1, frame="f"))') == [1]
+    # Inject a dead worker connection: the next request must degrade.
+    a, b = socket_mod.socketpair()
+    b.close()
+    svc._workers.append(a)
+    with _pytest.raises(PilosaError, match="degraded"):
+        svc._execute("g", 'Count(Bitmap(rowID=1, frame="f"))')
+    with _pytest.raises(PilosaError, match="degraded"):
+        svc._execute("g", 'Count(Bitmap(rowID=1, frame="f"))')
+    a.close()
+    h.close()
