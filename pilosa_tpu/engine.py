@@ -71,26 +71,37 @@ class NumpyEngine:
         r = _NP_OPS[op](a, b)
         return self.count(r).sum(axis=0)
 
-    def gather_count_or_multi(self, row_matrix, idx) -> np.ndarray:
-        """Batched Count(Union of a V-row view cover) — the fused Range
-        count.  idx: int32[B, V], short covers padded by repeating a valid
-        index (OR is idempotent).  Returns int64[B].
+    def gather_count_multi(self, op: str, row_matrix, idx) -> np.ndarray:
+        """Batched Count over a left-fold of K gathered rows — N-operand
+        Intersect/Union/Difference and the fused Range cover (op="or").
+        idx: int32[B, K], padded with fold-idempotent ids.  Returns
+        int64[B].
 
-        Chunked over the batch so the gathered [S, chunk, V, W] stays a
+        Chunked over the batch so the gathered [S, chunk, K, W] stays a
         few MB — one shot over the whole batch would materialize
-        S*B*V*W*4 bytes (easily hundreds of MB) for nothing.
+        S*B*K*W*4 bytes (easily hundreds of MB) for nothing.
         """
         from pilosa_tpu.pilosa import OR_MULTI_BUDGET_HOST, or_multi_chunk_size
 
         s, _, w = row_matrix.shape
-        v = idx.shape[1]
-        chunk = or_multi_chunk_size(s, v, w, OR_MULTI_BUDGET_HOST)
+        k = idx.shape[1]
+        chunk = or_multi_chunk_size(s, k, w, OR_MULTI_BUDGET_HOST)
         out = np.empty(idx.shape[0], dtype=np.int64)
         for i in range(0, idx.shape[0], chunk):
             g = row_matrix[:, idx[i : i + chunk], :]
-            acc = np.bitwise_or.reduce(g, axis=2)
+            if op == "or":
+                acc = np.bitwise_or.reduce(g, axis=2)
+            elif op == "and":
+                acc = np.bitwise_and.reduce(g, axis=2)
+            elif op == "andnot":
+                acc = g[:, :, 0] & ~np.bitwise_or.reduce(g[:, :, 1:], axis=2)
+            else:
+                raise ValueError(f"unsupported multi-op {op!r}")
             out[i : i + chunk] = self.count(acc).sum(axis=0)
         return out
+
+    def gather_count_or_multi(self, row_matrix, idx) -> np.ndarray:
+        return self.gather_count_multi("or", row_matrix, idx)
 
     def bit_and(self, a, b):
         return a & b
@@ -193,11 +204,14 @@ class JaxEngine:
         )
         return self.to_numpy(out).astype(np.int64)
 
-    def gather_count_or_multi(self, row_matrix, idx) -> np.ndarray:
-        out = self._dispatch.gather_count_or_multi(
-            self._jnp.asarray(row_matrix), self._jnp.asarray(idx)
+    def gather_count_multi(self, op: str, row_matrix, idx) -> np.ndarray:
+        out = self._dispatch.gather_count_multi(
+            op, self._jnp.asarray(row_matrix), self._jnp.asarray(idx)
         )
         return self.to_numpy(out).astype(np.int64)
+
+    def gather_count_or_multi(self, row_matrix, idx) -> np.ndarray:
+        return self.gather_count_multi("or", row_matrix, idx)
 
     def bit_and(self, a, b):
         return self._jnp.bitwise_and(a, b)
@@ -292,7 +306,7 @@ class MeshEngine(JaxEngine):
         # One jitted callable per fused path — constructing jax.jit per
         # call would re-trace and miss the dispatch cache every time.
         self._gather_jit = jax.jit(_bw.gather_count, static_argnums=0)
-        self._gather_or_jit = jax.jit(_bw.gather_count_or_multi)
+        self._gather_multi_jit = jax.jit(_bw.gather_count_multi, static_argnums=0)
 
     def _shard_stack(self, x):
         # Shard only cleanly-divisible leading axes (device_put requires
@@ -361,21 +375,24 @@ class MeshEngine(JaxEngine):
         # so allgather-aware fetching covers them all on multi-host.
         return self._fetch(x)
 
-    def gather_count_or_multi(self, row_matrix, idx):
-        # The jnp form materializes the [S, chunk, V, W] gather per shard;
+    def gather_count_multi(self, op, row_matrix, idx):
+        # The jnp form materializes the [S, chunk, K, W] gather per shard;
         # chunk the batch so that transient stays bounded (the same budget
         # dispatch.py applies to its XLA fallback).
         from pilosa_tpu.pilosa import OR_MULTI_BUDGET_DEVICE, or_multi_chunk_size
 
         rm = self._shard_stack(self._jnp.asarray(row_matrix))
         s, _, w = rm.shape
-        v = idx.shape[1]
-        chunk = or_multi_chunk_size(s, v, w, OR_MULTI_BUDGET_DEVICE)
+        k = idx.shape[1]
+        chunk = or_multi_chunk_size(s, k, w, OR_MULTI_BUDGET_DEVICE)
         outs = [
-            self._fetch(self._gather_or_jit(rm, self._jnp.asarray(idx[i : i + chunk])))
+            self._fetch(self._gather_multi_jit(op, rm, self._jnp.asarray(idx[i : i + chunk])))
             for i in range(0, idx.shape[0], chunk)
         ]
         return np.concatenate(outs).astype(np.int64)
+
+    def gather_count_or_multi(self, row_matrix, idx):
+        return self.gather_count_multi("or", row_matrix, idx)
 
 
 def new_engine(name: str = "auto"):

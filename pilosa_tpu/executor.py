@@ -318,8 +318,7 @@ class Executor:
                     frame_names[frame_ids[i]] if frame_ids[i] >= 0 else DEFAULT_FRAME,
                     VIEW_STANDARD,
                     native.PQL_PAIR_OPS[op_ids[i]],
-                    int(r1[i]),
-                    int(r2[i]),
+                    (int(r1[i]), int(r2[i])),
                 )
                 for i in range(len(op_ids))
             }
@@ -376,32 +375,36 @@ class Executor:
     def _fuse_count_pair_batch(
         self, index: str, calls, slices, inv_slices, opt: ExecOptions
     ) -> Optional[dict[int, int]]:
-        """Run all Count(<op>(Bitmap(a), Bitmap(b))) calls in a request as
-        fused device dispatches (one per distinct op).
+        """Run all Count(<op>(Bitmap, Bitmap, ...)) calls in a request as
+        fused device dispatches (one per distinct op/arity group).
 
         The TPU-native replacement for issuing the hot query shapes
-        (executor.go:576-605) one call at a time: row-id pairs are gathered
-        by the kernel straight from a device-resident row matrix
-        (ops.dispatch.gather_count), so a request carrying a batch of
-        pair-count queries costs one kernel launch per op instead of
-        2×batch row uploads + batch reductions.  Covers Intersect, Union,
-        Difference, and Xor with exactly two Bitmap children.  Only applies
-        to single-node/local execution; distributed requests go through the
-        per-call mapReduce with its node-failure retry.
+        (executor.go:576-605) one call at a time: row ids are gathered by
+        the kernel straight from a device-resident row matrix
+        (ops.dispatch.gather_count / gather_count_multi), so a request
+        carrying a batch of count queries costs one kernel launch per
+        op/arity group instead of per-call row uploads + reductions.
+        Covers Intersect, Union, and Difference over 2+ Bitmap children
+        (2-operand calls keep the Gram-eligible pair lane) and Xor over
+        exactly two.  Only applies to single-node/local execution;
+        distributed requests go through the per-call mapReduce with its
+        node-failure retry.
         """
         if not slices:
             return None
 
-        # call idx -> (frame, view, kernel_op, r1, r2)
-        matched: dict[int, tuple[str, str, str, int, int]] = {}
+        # call idx -> (frame, view, kernel_op, row-id tuple)
+        matched: dict[int, tuple[str, str, str, tuple[int, ...]]] = {}
         batch_view: Optional[str] = None
         for i, c in enumerate(calls):
             if c.name != "Count" or len(c.children) != 1:
                 continue
             ch = c.children[0]
             op = self._FUSABLE_OPS.get(ch.name)
-            if op is None or len(ch.children) != 2:
+            if op is None or len(ch.children) < 2:
                 continue
+            if op == "xor" and len(ch.children) != 2:
+                continue  # xor padding is not idempotent; sequential path
             leaves = []
             for leaf in ch.children:
                 if leaf.name != "Bitmap":
@@ -411,7 +414,9 @@ class Executor:
                 except PilosaError:
                     return None  # surface the error through the normal path
                 leaves.append((frame, view, row_id))
-            if len(leaves) != 2 or leaves[0][:2] != leaves[1][:2]:
+            if len(leaves) != len(ch.children) or any(
+                l[:2] != leaves[0][:2] for l in leaves[1:]
+            ):
                 continue
             # Uniform view across the batch: the slice domain (standard vs
             # inverse axis) is per-mapReduce, so mixed-view requests take
@@ -420,7 +425,12 @@ class Executor:
                 batch_view = leaves[0][1]
             elif leaves[0][1] != batch_view:
                 return None
-            matched[i] = (leaves[0][0], leaves[0][1], op, leaves[0][2], leaves[1][2])
+            matched[i] = (
+                leaves[0][0],
+                leaves[0][1],
+                op,
+                tuple(l[2] for l in leaves),
+            )
         # Fuse only when the WHOLE request is fusable reads: a write call
         # anywhere in the request must be observed by later Counts
         # (per-call ordering semantics), so mixed requests take the
@@ -714,38 +724,71 @@ class Executor:
     def _fused_local_counts(
         self, index: str, matched: dict, idxs: list[int], slices
     ) -> list[int]:
-        """Fused pair counts for the given slice batch, aligned with idxs."""
+        """Fused counts for the given slice batch, aligned with idxs.
+
+        2-operand groups keep the pair lane (Gram-eligible); 3+-operand
+        groups run the multi-fold kernel with the operand axis padded to
+        a power-of-two bucket (fold-idempotent pad: the first operand for
+        and/or, the second for andnot) so jitted shapes stay stable.
+        """
         slices = list(slices or [])
         out: dict[int, int] = {}
         if not slices:
             return [0] * len(idxs)
         # One row matrix per (frame, view): unique row ids -> device rows.
         by_fv: dict[tuple[str, str], list[int]] = {}
-        for frame, view, _, r1, r2 in matched.values():
-            by_fv.setdefault((frame, view), []).extend((r1, r2))
-        for (frame, view), ids in by_fv.items():
-            id_pos, matrix, box = self._frame_matrix(index, frame, slices, set(ids), view)
-            gram = self._frame_gram(matrix, box)
-            ops_here = sorted({op for f, v, op, _, _ in matched.values() if (f, v) == (frame, view)})
-            for op in ops_here:
-                op_idxs = [
-                    i for i, (f, v, o, _, _) in matched.items()
-                    if (f, v, o) == (frame, view, op)
-                ]
-                pairs = np.array(
-                    [[id_pos[matched[i][3]], id_pos[matched[i][4]]] for i in op_idxs],
-                    dtype=np.int32,
-                )
-                if gram is not None:
-                    # Lazy import is safe here: a non-None Gram implies the
-                    # jax engine built it, so jax is already loaded.
-                    from pilosa_tpu.ops.bitwise import gram_pair_counts
+        for frame, view, _, ids in matched.values():
+            by_fv.setdefault((frame, view), []).extend(ids)
+        for (frame, view), all_ids in by_fv.items():
+            id_pos, matrix, box = self._frame_matrix(index, frame, slices, set(all_ids), view)
+            # Group calls by (op, operand-count bucket): one dispatch each.
+            groups: dict[tuple[str, int], list[int]] = {}
+            for i, (f, v, op, ids) in matched.items():
+                if (f, v) != (frame, view):
+                    continue
+                k = len(ids)
+                kb = 2 if k == 2 else 1 << (k - 1).bit_length()
+                groups.setdefault((op, kb), []).append(i)
+            # The Gram only answers 2-operand counts — don't trigger its
+            # (expensive, cached) build for requests with no pair group.
+            gram = (
+                self._frame_gram(matrix, box)
+                if any(kb == 2 for _, kb in groups)
+                else None
+            )
+            static = getattr(self.engine, "wants_static_shapes", False)
+            for (op, kb), op_idxs in sorted(groups.items()):
+                if kb == 2:
+                    pairs = np.array(
+                        [
+                            [id_pos[matched[i][3][0]], id_pos[matched[i][3][1]]]
+                            for i in op_idxs
+                        ],
+                        dtype=np.int32,
+                    )
+                    if gram is not None:
+                        # Lazy import is safe here: a non-None Gram implies
+                        # the jax engine built it, so jax is already loaded.
+                        from pilosa_tpu.ops.bitwise import gram_pair_counts
 
-                    counts = gram_pair_counts(op, gram, pairs)
+                        counts = gram_pair_counts(op, gram, pairs)
+                    else:
+                        counts = self.engine.gather_count(op, matrix, pairs)
                 else:
-                    counts = self.engine.gather_count(op, matrix, pairs)
-                for k, i in enumerate(op_idxs):
-                    out[i] = int(counts[k])
+                    # Jitted engines get a padded batch bucket too (pad
+                    # rows repeat the first call's operands; extra counts
+                    # discarded) — ragged B recompiles per group size.
+                    n = len(op_idxs)
+                    bb = (1 << (n - 1).bit_length()) if (static and n > 1) else n
+                    idx_arr = np.zeros((bb, kb), dtype=np.int32)
+                    for r, i in enumerate(op_idxs):
+                        pos = [id_pos[x] for x in matched[i][3]]
+                        idx_arr[r, : len(pos)] = pos
+                        idx_arr[r, len(pos):] = pos[0] if op != "andnot" else pos[1]
+                    idx_arr[n:] = idx_arr[0]
+                    counts = self.engine.gather_count_multi(op, matrix, idx_arr)
+                for k2, i in enumerate(op_idxs):
+                    out[i] = int(counts[k2])
         return [out[i] for i in idxs]
 
     # Transient-HBM budget for the unpacked int8 bit matrix a Gram build

@@ -139,27 +139,56 @@ def gather_count(op: str, row_matrix, pairs):
     return jnp.sum(lax.population_count(apply_pair_op(op, a, b)).astype(jnp.int32), axis=(0, 2))
 
 
-def gather_count_or_multi(row_matrix, idx):
-    """Batched Count(Union(Bitmap_1 … Bitmap_V)) per query — the fused
-    time-quantum Range count (time.go:95-167 + executor.go:498-554: a
-    Range unions the minimal view cover, then Count popcounts it).
+def gather_count_multi(op: str, row_matrix, idx):
+    """Batched Count over a left-fold of K gathered rows per query —
+    N-operand Intersect ("and"), Union ("or"), Difference ("andnot"),
+    and the time-quantum Range view cover (op="or"; time.go:95-167 +
+    executor.go:498-554: a Range unions the minimal cover, then Count
+    popcounts it).
 
-    row_matrix: uint32[n_slices, n_rows, W]; idx: int32[B, V] row indices,
-    where short covers pad by REPEATING a valid index (OR is idempotent,
-    so padding needs no mask).  Returns int32[B] summed over slices.
-    XLA form (gather → OR-reduce → popcount); the Pallas version streams
-    one row per grid step without materializing the gather.
+    row_matrix: uint32[n_slices, n_rows, W]; idx: int32[B, K] row ids,
+    short operand lists padded with a fold-idempotent id (and/or: any
+    operand repeated; andnot: any non-first operand).  Returns int32[B]
+    summed over slices.  XLA form (gather → reduce → popcount); the
+    Pallas version streams one row per grid step without materializing
+    the gather.
     """
-    g = jnp.take(row_matrix, idx, axis=1)  # [n_slices, B, V, W]
-    acc = lax.reduce(g, np.uint32(0), lax.bitwise_or, (2,))
+    g = jnp.take(row_matrix, idx, axis=1)  # [n_slices, B, K, W]
+    if op == "or":
+        acc = lax.reduce(g, np.uint32(0), lax.bitwise_or, (2,))
+    elif op == "and":
+        acc = lax.reduce(g, np.uint32(0xFFFFFFFF), lax.bitwise_and, (2,))
+    elif op == "andnot":
+        # a &~ b &~ c … = a & ~(b | c | …)
+        rest = lax.reduce(g[:, :, 1:], np.uint32(0), lax.bitwise_or, (2,))
+        acc = jnp.bitwise_and(g[:, :, 0], jnp.bitwise_not(rest))
+    else:
+        raise ValueError(f"unsupported multi-op {op!r}")
     return jnp.sum(lax.population_count(acc).astype(jnp.int32), axis=(0, 2))
+
+
+def gather_count_or_multi(row_matrix, idx):
+    """OR-fold convenience wrapper (the fused Range cover count)."""
+    return gather_count_multi("or", row_matrix, idx)
+
+
+def np_gather_count_multi(op: str, row_matrix: np.ndarray, idx: np.ndarray) -> np.ndarray:
+    """numpy ground truth for gather_count_multi."""
+    g = row_matrix[:, idx, :]  # [S, B, K, W]
+    if op == "or":
+        acc = np.bitwise_or.reduce(g, axis=2)
+    elif op == "and":
+        acc = np.bitwise_and.reduce(g, axis=2)
+    elif op == "andnot":
+        acc = g[:, :, 0] & ~np.bitwise_or.reduce(g[:, :, 1:], axis=2)
+    else:
+        raise ValueError(f"unsupported multi-op {op!r}")
+    return np_popcount(acc).reshape(acc.shape[0], acc.shape[1], -1).sum(axis=(0, 2))
 
 
 def np_gather_count_or_multi(row_matrix: np.ndarray, idx: np.ndarray) -> np.ndarray:
     """numpy ground truth for gather_count_or_multi."""
-    g = row_matrix[:, idx, :]  # [S, B, V, W]
-    acc = np.bitwise_or.reduce(g, axis=2)
-    return np_popcount(acc).reshape(acc.shape[0], acc.shape[1], -1).sum(axis=(0, 2))
+    return np_gather_count_multi("or", row_matrix, idx)
 
 
 def pair_gram(row_matrix):

@@ -24,7 +24,7 @@ from pilosa_tpu.ops.pallas_kernels import (
     fused_count1,
     fused_count2,
     fused_gather_count2,
-    fused_gather_count_or,
+    fused_gather_count_multi,
     fused_resident_count2,
 )
 
@@ -135,38 +135,44 @@ def gather_count(op, row_matrix, pairs, allow_gram: bool = True):
     return bitwise.gather_count(op, row_matrix, pairs)
 
 
-def gather_count_or_multi(row_matrix, idx):
-    """Batched Count(Union of a V-row view cover) per query — the fused
-    time-quantum Range count.  idx: int32[B, V], short covers padded by
-    repeating a valid index (OR-idempotent)."""
-    b, v = idx.shape
+def gather_count_multi(op, row_matrix, idx):
+    """Batched Count over a left-fold of K gathered rows per query —
+    N-operand Intersect/Union/Difference trees and the fused Range view
+    cover (op="or").  idx: int32[B, K], padded with fold-idempotent
+    ids (and/or: any operand; andnot: any non-first operand)."""
+    b, k = idx.shape
     if use_pallas() and _tileable(row_matrix.shape[-1]):
         # Prefetched ids must fit SMEM: the pair kernels prefetch B*2 ids
-        # under _GATHER_BATCH_MAX, so bound B*V by the same id budget
-        # (wide view covers shrink the per-chunk batch).
-        chunk = max(1, (2 * _GATHER_BATCH_MAX) // max(1, v))
+        # under _GATHER_BATCH_MAX, so bound B*K by the same id budget
+        # (wide operand lists shrink the per-chunk batch).
+        chunk = max(1, (2 * _GATHER_BATCH_MAX) // max(1, k))
         if b > chunk:
             return jnp.concatenate(
                 [
-                    gather_count_or_multi(row_matrix, idx[i : i + chunk])
+                    gather_count_multi(op, row_matrix, idx[i : i + chunk])
                     for i in range(0, b, chunk)
                 ]
             )
-        return fused_gather_count_or(row_matrix, idx)
+        return fused_gather_count_multi(op, row_matrix, idx)
     # XLA fallback materializes the gather: bound its transient HBM/host
     # footprint by chunking the batch (shared sizing helper).
     from pilosa_tpu.pilosa import OR_MULTI_BUDGET_DEVICE, or_multi_chunk_size
 
     s, _, w = row_matrix.shape
-    chunk = or_multi_chunk_size(s, v, w, OR_MULTI_BUDGET_DEVICE)
+    chunk = or_multi_chunk_size(s, k, w, OR_MULTI_BUDGET_DEVICE)
     if b > chunk:
         return jnp.concatenate(
             [
-                bitwise.gather_count_or_multi(row_matrix, idx[i : i + chunk])
+                bitwise.gather_count_multi(op, row_matrix, idx[i : i + chunk])
                 for i in range(0, b, chunk)
             ]
         )
-    return bitwise.gather_count_or_multi(row_matrix, idx)
+    return bitwise.gather_count_multi(op, row_matrix, idx)
+
+
+def gather_count_or_multi(row_matrix, idx):
+    """OR-fold convenience wrapper (the fused Range cover count)."""
+    return gather_count_multi("or", row_matrix, idx)
 
 
 def batch_intersection_count(rows, src):

@@ -228,8 +228,20 @@ def fused_gather_count2(op: str, row_matrix, pairs, interpret: bool = False):
     return out.sum(axis=(1, 2))
 
 
-def _gather_or_kernel(n_views, idx_ref, row_ref, out_ref, acc_ref):
+# Left-fold step for the multi-operand gather kernels: how operand j>0
+# combines into the accumulator.  "andnot" folds acc &~ row (Difference's
+# left-associative chain); all are pad-idempotent for the right pad id
+# (and/or: any repeated operand; andnot: repeat any NON-first operand).
+_FOLD_OPS = {
+    "and": lambda acc, row: acc & row,
+    "or": lambda acc, row: acc | row,
+    "andnot": lambda acc, row: acc & ~row,
+}
+
+
+def _gather_multi_kernel(op, n_ops, idx_ref, row_ref, out_ref, acc_ref):
     s, j = pl.program_id(1), pl.program_id(2)
+    fold = _FOLD_OPS[op]
 
     @pl.when(j == 0)
     def _():
@@ -237,39 +249,42 @@ def _gather_or_kernel(n_views, idx_ref, row_ref, out_ref, acc_ref):
 
     @pl.when(j != 0)
     def _():
-        acc_ref[...] = acc_ref[...] | row_ref[0, 0]
+        acc_ref[...] = fold(acc_ref[...], row_ref[0, 0])
 
-    @pl.when((j == n_views - 1) & (s == 0))
+    @pl.when((j == n_ops - 1) & (s == 0))
     def _():
         out_ref[0] = _partial_tile(acc_ref[...][None])
 
-    @pl.when((j == n_views - 1) & (s != 0))
+    @pl.when((j == n_ops - 1) & (s != 0))
     def _():
         out_ref[0] = out_ref[0] + _partial_tile(acc_ref[...][None])
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
-def fused_gather_count_or(row_matrix, idx, interpret: bool = False):
-    """Per-query ``sum_s popcount(OR_j rm[s, idx[q, j]])`` — the fused
-    time-quantum Range count over a view-cover of up to V rows per query.
+@functools.partial(jax.jit, static_argnames=("op", "interpret"))
+def fused_gather_count_multi(op: str, row_matrix, idx, interpret: bool = False):
+    """Per-query ``sum_s popcount(fold_j rm[s, idx[q, j]])`` for a
+    left-fold of up to K gathered rows per query — the fused form of
+    Count over N-operand Intersect/Union/Difference trees AND the
+    time-quantum Range view cover (op="or").
 
     row_matrix: uint32[n_slices, n_rows, W] (W % 1024 == 0);
-    idx: int32[B, V] row ids, short covers padded by repeating a valid id
-    (OR-idempotent, so no mask is needed).  Returns int32[B].
+    idx: int32[B, K] row ids; short operand lists pad with an id whose
+    repeat is a no-op for the fold (and/or: any operand; andnot: any
+    non-first operand).  Returns int32[B].
 
-    One row DMA per (query, slice, view) grid step ORs into a VMEM
-    scratch accumulator; at the last view the accumulated cover is
+    One row DMA per (query, slice, operand) grid step folds into a VMEM
+    scratch accumulator; at the last operand the accumulated result is
     popcounted into the per-query output tile, which stays resident
     across the slice axis.  The XLA fallback materializes the whole
-    [S, B, V, W] gather in HBM first.
+    [S, B, K, W] gather in HBM first.
     """
     n_slices, n_rows, w = row_matrix.shape
-    b, n_views = idx.shape
+    b, n_ops = idx.shape
     sub = w // _LANES
     rm4 = row_matrix.reshape(n_slices, n_rows, sub, _LANES)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
-        grid=(b, n_slices, n_views),
+        grid=(b, n_slices, n_ops),
         in_specs=[
             pl.BlockSpec((1, 1, sub, _LANES), lambda q, s, j, pr: (s, pr[q, j], 0, 0)),
         ],
@@ -277,12 +292,17 @@ def fused_gather_count_or(row_matrix, idx, interpret: bool = False):
         scratch_shapes=[pltpu.VMEM((sub, _LANES), jnp.uint32)],
     )
     out = pl.pallas_call(
-        functools.partial(_gather_or_kernel, n_views),
+        functools.partial(_gather_multi_kernel, op, n_ops),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, 8, _LANES), jnp.int32),
         interpret=interpret,
     )(idx, rm4)
     return out.sum(axis=(1, 2))
+
+
+def fused_gather_count_or(row_matrix, idx, interpret: bool = False):
+    """OR-fold convenience wrapper (the fused Range cover count)."""
+    return fused_gather_count_multi("or", row_matrix, idx, interpret=interpret)
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))

@@ -928,3 +928,42 @@ def test_topn_does_not_evict_count_lane_matrix(tmp_path):
     assert gens1 == gens0 and len(id_pos1) == n0  # entry preserved
     assert e.execute("i", pair_q) == want_counts  # still served correctly
     h.close()
+
+
+@pytest.mark.parametrize("engine", ["numpy", "jax"])
+def test_count_multi_operand_batch_fusion(tmp_path, engine):
+    """Requests of Count over 3+-operand Intersect/Union/Difference trees
+    fuse into multi-fold kernel dispatches and match per-call results,
+    including mixed-arity batches (pairs share the same matrix/Gram)."""
+    h = Holder(str(tmp_path / "data"))
+    h.open()
+    idx = h.create_index("i")
+    idx.create_frame("f", FrameOptions())
+    fr = idx.frame("f")
+    rng = np.random.default_rng(6)
+    for r in range(8):
+        for c in rng.choice(2 * SLICE_WIDTH, size=60, replace=False):
+            fr.set_bit("standard", r, int(c))
+    e = Executor(h, engine=engine)
+
+    trees = [
+        "Intersect(Bitmap(rowID=0), Bitmap(rowID=1), Bitmap(rowID=2))",
+        "Union(Bitmap(rowID=1), Bitmap(rowID=2), Bitmap(rowID=3), Bitmap(rowID=4))",
+        "Difference(Bitmap(rowID=0), Bitmap(rowID=5), Bitmap(rowID=6))",
+        "Intersect(Bitmap(rowID=3), Bitmap(rowID=4))",  # pair lane
+        "Difference(Bitmap(rowID=7), Bitmap(rowID=0), Bitmap(rowID=1), Bitmap(rowID=2), Bitmap(rowID=3))",
+    ]
+    calls = [f"Count({t})".replace("Bitmap(", 'Bitmap(frame="f", ') for t in trees]
+    fused = e.execute("i", " ".join(calls))
+    singles = [e.execute("i", q)[0] for q in calls]
+    assert fused == singles
+    assert any(v > 0 for v in fused)
+
+    # Mutation invalidates the shared matrix; counts update.
+    before = e.execute("i", " ".join(calls))
+    fr.set_bit("standard", 0, 999_999)
+    fr.set_bit("standard", 1, 999_999)
+    fr.set_bit("standard", 2, 999_999)
+    after = e.execute("i", " ".join(calls))
+    assert after[0] == before[0] + 1  # 3-way intersect gained the bit
+    h.close()

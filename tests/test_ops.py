@@ -164,6 +164,11 @@ def test_pallas_kernels_on_tpu(rng):
     idx[0, 1:] = idx[0, 0]  # padded short cover (OR-idempotent)
     got_or = np.asarray(pk.fused_gather_count_or(jnp.asarray(rm), jnp.asarray(idx)))
     np.testing.assert_array_equal(got_or, bw.np_gather_count_or_multi(rm, idx))
+    for op in ("and", "andnot"):
+        got_m = np.asarray(
+            pk.fused_gather_count_multi(op, jnp.asarray(rm), jnp.asarray(idx))
+        )
+        np.testing.assert_array_equal(got_m, bw.np_gather_count_multi(op, rm, idx))
 
 
 def test_validate_names():
@@ -249,3 +254,20 @@ def test_gather_count_or_multi_matches_numpy(rng):
         [sum(bw.np_count(rm[s, idx[q, 0]]) for s in range(n_slices)) for q in range(batch)]
     )
     np.testing.assert_array_equal(one, want_one)
+
+
+@pytest.mark.parametrize("op", ["and", "or", "andnot"])
+def test_gather_count_multi_matches_numpy(rng, op):
+    # N-operand fold counts (Count over 3+-operand Intersect/Union/
+    # Difference trees) — jnp/XLA form vs numpy ground truth.
+    n_slices, n_rows, batch, k = 2, 9, 6, 5
+    rm = rand_words(rng, (n_slices, n_rows, W))
+    idx = rng.integers(0, n_rows, size=(batch, k)).astype(np.int32)
+    # Fold-idempotent padding: and/or repeat the first id, andnot a
+    # non-first id.
+    idx[0, 3:] = idx[0, 0] if op != "andnot" else idx[0, 1]
+    got = np.asarray(
+        dispatch.gather_count_multi(op, jnp.asarray(rm), jnp.asarray(idx))
+    )
+    want = bw.np_gather_count_multi(op, rm, idx)
+    np.testing.assert_array_equal(got, want)

@@ -498,3 +498,71 @@ def test_json_and_protobuf_codecs_agree(srv, client):
             return out
 
         assert norm(pb["results"]) == norm(js["results"]), q
+
+
+def test_crash_durability_sigkill(tmp_path):
+    """Acknowledged single-bit writes survive a SIGKILL: each SetBit's
+    WAL record reaches the kernel (unbuffered append) before the HTTP
+    response, so a crashed server replays them on reopen
+    (roaring.go:590-611 + fragment.go WAL semantics)."""
+    import os
+    import signal
+    import socket
+    import subprocess
+    import sys
+
+    def free_port():
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    data_dir = str(tmp_path / "crash")
+    port = free_port()
+    env = dict(os.environ)
+    env.pop("PYTHONPATH", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PILOSA_TPU_ENGINE"] = "numpy"
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "pilosa_tpu.cli", "server",
+         "--data-dir", data_dir, "--host", f"127.0.0.1:{port}"],
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+        cwd=repo,
+        env=env,
+    )
+    try:
+        deadline = time.monotonic() + 60
+        c = Client(f"127.0.0.1:{port}")
+        while True:
+            try:
+                c.create_index("i")
+                break
+            except OSError:
+                assert time.monotonic() < deadline, "server never came up"
+                time.sleep(0.2)
+        c.create_frame("i", "f")
+        # Individual SetBits: each is one durable WAL append (no snapshot
+        # for most of them), including time-view and inverse fan-out.
+        rng = np.random.default_rng(3)
+        cols = sorted(set(rng.integers(0, 2 * SLICE_WIDTH, size=120).tolist()))
+        for col in cols:
+            resp = c.execute_query("i", f'SetBit(rowID=5, frame="f", columnID={col})')
+            assert resp["results"] in ([True], [{"changed": True}])
+        # Hard kill: no close(), no flush hooks, no snapshot.
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=30)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+
+    # Reopen the same data dir in-process: WAL replay must restore every
+    # acknowledged bit.
+    s2 = Server(Config(data_dir=data_dir, host="127.0.0.1:0", engine="numpy"))
+    s2.open()
+    try:
+        c2 = Client(s2.host)
+        got = c2.execute_query("i", 'Bitmap(rowID=5, frame="f")')
+        assert got["results"][0]["bitmap"]["bits"] == cols
+    finally:
+        s2.close()
