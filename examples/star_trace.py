@@ -74,6 +74,44 @@ def main() -> None:
             c.execute_query("repository", 'SetRowAttrs(user_id=1, frame=stargazer, name="alice")')
             r = c.execute_query("repository", "Bitmap(user_id=1, frame=stargazer)")
             print("user 1 attrs:", r["results"][0]["bitmap"]["attrs"])
+
+            # Time-quantum views: stars carry timestamps, Range unions the
+            # minimal view cover; a batch of Count(Range) calls fuses into
+            # one multi-view kernel dispatch with a cover memo.
+            c.create_frame(
+                "repository", "stargazer_t",
+                {"rowLabel": "user_id", "timeQuantum": "YMD"},
+            )
+            c.execute_query(
+                "repository",
+                'SetBit(user_id=1, frame="stargazer_t", repo_id=10, timestamp="2017-03-02T00:00") '
+                'SetBit(user_id=1, frame="stargazer_t", repo_id=20, timestamp="2017-06-15T00:00") '
+                'SetBit(user_id=2, frame="stargazer_t", repo_id=10, timestamp="2017-03-05T00:00")',
+            )
+            r = c.execute_query(
+                "repository",
+                'Count(Range(user_id=1, frame="stargazer_t", start="2017-03-01T00:00", end="2017-04-01T00:00")) '
+                'Count(Range(user_id=1, frame="stargazer_t", start="2017-01-01T00:00", end="2018-01-01T00:00")) '
+                'Count(Range(user_id=2, frame="stargazer_t", start="2017-03-01T00:00", end="2017-04-01T00:00"))',
+            )
+            # proto3 omits zero-valued fields: a zero count decodes as {}.
+            counts = [res.get("n", 0) for res in r["results"]]
+            print("stars in March / all 2017 / user 2 March:", counts)
+            assert counts == [1, 2, 1]
+
+            # A batched dashboard request: many pair + 3-way counts in one
+            # POST — the executor fuses them into grouped kernel dispatches.
+            batch = " ".join(
+                f"Count(Intersect(Bitmap(user_id={u}, frame=stargazer),"
+                f" Bitmap(user_id={v}, frame=stargazer)))"
+                for u, v in [(1, 2), (3, 4), (5, 6), (7, 8)]
+            ) + (
+                " Count(Intersect(Bitmap(user_id=1, frame=stargazer),"
+                " Bitmap(user_id=2, frame=stargazer),"
+                " Bitmap(user_id=3, frame=stargazer)))"
+            )
+            r = c.execute_query("repository", batch)
+            print("fused dashboard batch:", [res.get("n", 0) for res in r["results"]])
         finally:
             server.close()
 

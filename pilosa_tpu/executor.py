@@ -742,12 +742,17 @@ class Executor:
         for (frame, view), all_ids in by_fv.items():
             id_pos, matrix, box = self._frame_matrix(index, frame, slices, set(all_ids), view)
             # Group calls by (op, operand-count bucket): one dispatch each.
+            # Jitted engines bucket the operand axis to powers of two
+            # (stable shapes); the numpy engine uses exact arities —
+            # padding there is pure wasted gather/fold work (same policy
+            # as the fused Range lane).
+            static = getattr(self.engine, "wants_static_shapes", False)
             groups: dict[tuple[str, int], list[int]] = {}
             for i, (f, v, op, ids) in matched.items():
                 if (f, v) != (frame, view):
                     continue
                 k = len(ids)
-                kb = 2 if k == 2 else 1 << (k - 1).bit_length()
+                kb = 2 if k == 2 else (1 << (k - 1).bit_length()) if static else k
                 groups.setdefault((op, kb), []).append(i)
             # The Gram only answers 2-operand counts — don't trigger its
             # (expensive, cached) build for requests with no pair group.
@@ -756,7 +761,6 @@ class Executor:
                 if any(kb == 2 for _, kb in groups)
                 else None
             )
-            static = getattr(self.engine, "wants_static_shapes", False)
             for (op, kb), op_idxs in sorted(groups.items()):
                 if kb == 2:
                     pairs = np.array(
