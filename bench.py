@@ -478,9 +478,11 @@ def main() -> None:
     n_rows = int(os.environ.get("BENCH_ROWS", "64"))
     batch = int(os.environ.get("BENCH_BATCH", "256"))
     # Long enough that the one-dispatch stream's fixed costs (tunnel round
-    # trip ~70ms + the hoisted Gram build) amortize — shorter streams
-    # measure the tunnel, not the sustained device rate.
-    iters = int(os.environ.get("BENCH_ITERS", "1280"))
+    # trip + the hoisted Gram build) amortize — shorter streams measure
+    # the tunnel, not the sustained device rate.  Measured plateau: 1280
+    # iters still under-reported ~2x on a slow-tunnel day; 2560 and 5120
+    # agree within noise (~1.1M), so 2560 is past the knee.
+    iters = int(os.environ.get("BENCH_ITERS", "2560"))
     # Bit density ~2^-k via AND of k random words (throughput over packed
     # words is density-independent; this just keeps counts realistic).
     density_k = int(os.environ.get("BENCH_DENSITY_K", "4"))
