@@ -77,94 +77,123 @@ def test_two_process_global_mesh():
     assert sorted(by_pid[0]["owned"] + by_pid[1]["owned"]) == list(range(8))
 
 
+class _LockstepJob:
+    """Shared harness for lockstep-service tests: spawns n ranks of
+    tests/lockstep_worker.py, drains stdout, keeps stderr in temp files
+    surfaced on failure, and collects the final per-rank JSON."""
+
+    def __init__(self, n_ranks: int):
+        import tempfile
+        import threading
+
+        self.n = n_ranks
+        self.coord, self.control, self.http = _free_port(), _free_port(), _free_port()
+        env = dict(os.environ)
+        env.pop("JAX_PLATFORMS", None)
+        env["PYTHONPATH"] = REPO
+        env["XLA_FLAGS"] = ""
+        worker = os.path.join(REPO, "tests", "lockstep_worker.py")
+        self.errfiles = [tempfile.NamedTemporaryFile("w+", delete=False) for _ in range(n_ranks)]
+        self.procs = [
+            subprocess.Popen(
+                [sys.executable, worker, f"127.0.0.1:{self.coord}", str(n_ranks),
+                 str(pid), str(self.control), str(self.http)],
+                stdin=subprocess.PIPE,
+                stdout=subprocess.PIPE,
+                stderr=self.errfiles[pid],
+                cwd=REPO,
+                env=env,
+                text=True,
+            )
+            for pid in range(n_ranks)
+        ]
+        self.out_lines = [[] for _ in range(n_ranks)]
+        self.drainers = [
+            threading.Thread(target=self._drain, args=(i,), daemon=True)
+            for i in range(n_ranks)
+        ]
+        for t in self.drainers:
+            t.start()
+
+    def _drain(self, i):
+        for line in self.procs[i].stdout:
+            self.out_lines[i].append(line)
+
+    def stderr_tail(self, i):
+        self.errfiles[i].flush()
+        with open(self.errfiles[i].name) as f:
+            return f.read()[-2000:]
+
+    def _all_stderr(self):
+        return "\n".join(f"rank {i}: {self.stderr_tail(i)}" for i in range(self.n))
+
+    def wait_ready(self, timeout=150):
+        import time as _time
+
+        t0 = _time.monotonic()
+        while not self.out_lines[0] and _time.monotonic() - t0 < timeout:
+            if any(p.poll() is not None for p in self.procs):
+                pytest.fail(f"a rank died at startup:\n{self._all_stderr()}")
+            _time.sleep(0.1)
+        assert self.out_lines[0], f"rank 0 never became ready:\n{self._all_stderr()}"
+        assert json.loads(self.out_lines[0][0]).get("ready"), self.out_lines[0][0]
+
+    def query(self, q, timeout=60):
+        import urllib.request
+
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{self.http}/index/g/query",
+            data=q.encode(),
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return json.loads(resp.read())
+
+    def shutdown_and_collect(self):
+        self.procs[0].stdin.write("\n")
+        self.procs[0].stdin.flush()
+        outs = []
+        for i, p in enumerate(self.procs):
+            p.wait(timeout=120)
+            self.drainers[i].join(timeout=30)
+            assert p.returncode == 0, (
+                f"rank {i} failed:\nstdout={''.join(self.out_lines[i])}\n"
+                f"stderr={self.stderr_tail(i)}"
+            )
+            outs.append(json.loads(self.out_lines[i][-1]))
+        return outs
+
+    def cleanup(self, kill: bool):
+        for p in self.procs:
+            if kill and p.poll() is None:
+                p.kill()
+        for f in self.errfiles:
+            f.close()
+            os.unlink(f.name)
+
+
 def test_lockstep_query_service():
     """Full lockstep SERVICE: rank 0 serves HTTP, workers replay every
     request over the control plane, device work runs SPMD over the
     2-process global mesh, and writes replicate to every rank's holder."""
+    import urllib.error
     import urllib.request
 
-    coord_port, control_port, http_port = _free_port(), _free_port(), _free_port()
-    coordinator = f"127.0.0.1:{coord_port}"
-    env = dict(os.environ)
-    env.pop("JAX_PLATFORMS", None)
-    env["PYTHONPATH"] = REPO
-    env["XLA_FLAGS"] = ""
-
-    import tempfile
-    import threading
-
-    worker = os.path.join(REPO, "tests", "lockstep_worker.py")
-    # stderr goes to files (a chatty jax/gloo build filling a 64KB pipe
-    # would wedge a rank mid-request); stdout lines are drained by
-    # threads so the ranks never block on a full pipe either.
-    errfiles = [tempfile.NamedTemporaryFile("w+", delete=False) for _ in range(2)]
-    procs = [
-        subprocess.Popen(
-            [sys.executable, worker, coordinator, "2", str(pid),
-             str(control_port), str(http_port)],
-            stdin=subprocess.PIPE,
-            stdout=subprocess.PIPE,
-            stderr=errfiles[pid],
-            cwd=REPO,
-            env=env,
-            text=True,
-        )
-        for pid in range(2)
-    ]
-    out_lines: list[list[str]] = [[], []]
-
-    def _drain(i):
-        for line in procs[i].stdout:
-            out_lines[i].append(line)
-
-    drainers = [threading.Thread(target=_drain, args=(i,), daemon=True) for i in range(2)]
-    for t in drainers:
-        t.start()
-
-    def _stderr_tail(i):
-        errfiles[i].flush()
-        with open(errfiles[i].name) as f:
-            return f.read()[-2000:]
-
+    job = _LockstepJob(2)
     try:
-        # Wait for rank 0 to announce the HTTP server (bounded: a rank-1
-        # startup failure would otherwise hang the coordinator barrier
-        # and this wait forever).
-        deadline = 150
-        import time as _time
-
-        t0 = _time.monotonic()
-        while not out_lines[0] and _time.monotonic() - t0 < deadline:
-            if procs[0].poll() is not None or procs[1].poll() is not None:
-                pytest.fail(
-                    f"worker died at startup:\n0: {_stderr_tail(0)}\n1: {_stderr_tail(1)}"
-                )
-            _time.sleep(0.1)
-        assert out_lines[0], "rank 0 never became ready"
-        assert json.loads(out_lines[0][0]).get("ready"), out_lines[0][0]
-
-        def query(q):
-            req = urllib.request.Request(
-                f"http://127.0.0.1:{http_port}/index/g/query",
-                data=q.encode(),
-                method="POST",
-            )
-            with urllib.request.urlopen(req, timeout=60) as resp:
-                return json.loads(resp.read())
-
+        job.wait_ready()
         # Reads: counts over the replicated seed data (4 slices x 2 bits).
-        out = query('Count(Bitmap(rowID=0, frame="f")) '
-                    'Count(Intersect(Bitmap(rowID=0, frame="f"), Bitmap(rowID=1, frame="f")))')
+        out = job.query('Count(Bitmap(rowID=0, frame="f")) '
+                        'Count(Intersect(Bitmap(rowID=0, frame="f"), Bitmap(rowID=1, frame="f")))')
         assert out["results"] == [8, 4]  # row bits; shared col 500 per slice
         # Writes: served once over HTTP, replayed on the worker rank.
-        out = query('SetBit(rowID=0, frame="f", columnID=77) '
-                    'SetBit(rowID=0, frame="f", columnID=78, timestamp="2017-03-02T00:00")')
+        out = job.query('SetBit(rowID=0, frame="f", columnID=77) '
+                        'SetBit(rowID=0, frame="f", columnID=78, timestamp="2017-03-02T00:00")')
         assert out["results"] == [True, True]
-        out = query('Count(Bitmap(rowID=0, frame="f"))')
-        assert out["results"] == [10]
+        assert job.query('Count(Bitmap(rowID=0, frame="f"))')["results"] == [10]
         # Error path: rank 0 reports, workers stay in lockstep.
         req = urllib.request.Request(
-            f"http://127.0.0.1:{http_port}/index/g/query",
+            f"http://127.0.0.1:{job.http}/index/g/query",
             data=b'Bitmap(rowID=1, frame="nope")',
             method="POST",
         )
@@ -173,27 +202,14 @@ def test_lockstep_query_service():
             assert False, "expected HTTP 400"
         except urllib.error.HTTPError as e:
             assert e.code == 400
-        out = query('Count(Bitmap(rowID=0, frame="f"))')  # still serving
-        assert out["results"] == [10]
+        assert job.query('Count(Bitmap(rowID=0, frame="f"))')["results"] == [10]
 
-        procs[0].stdin.write("\n")
-        procs[0].stdin.flush()
-        outs = []
-        for i, p in enumerate(procs):
-            p.wait(timeout=120)
-            drainers[i].join(timeout=30)
-            assert p.returncode == 0, (
-                f"worker {i} failed:\nstdout={''.join(out_lines[i])}\nstderr={_stderr_tail(i)}"
-            )
-            outs.append(json.loads(out_lines[i][-1]))
+        outs = job.shutdown_and_collect()
     except Exception:
-        for p in procs:
-            p.kill()
+        job.cleanup(kill=True)
         raise
-    finally:
-        for f in errfiles:
-            f.close()
-            os.unlink(f.name)
+    else:
+        job.cleanup(kill=False)
     by_pid = {o["pid"]: o for o in outs}
     # Both ranks converged: seed 8 bits + 2 served writes.
     assert by_pid[0]["probe"] == by_pid[1]["probe"] == 10
@@ -232,3 +248,21 @@ def test_lockstep_fail_stop_on_dead_worker(tmp_path):
         svc._execute("g", 'Count(Bitmap(rowID=1, frame="f"))')
     a.close()
     h.close()
+
+
+def test_lockstep_three_ranks():
+    """Three-rank lockstep job: two workers ack and replay, reads shard
+    over 6 virtual devices, writes replicate everywhere."""
+    job = _LockstepJob(3)
+    try:
+        job.wait_ready()
+        assert job.query('Count(Bitmap(rowID=0, frame="f"))')["results"] == [8]
+        assert job.query('SetBit(rowID=0, frame="f", columnID=321)')["results"] == [True]
+        assert job.query('Count(Bitmap(rowID=0, frame="f"))')["results"] == [9]
+        outs = job.shutdown_and_collect()
+    except Exception:
+        job.cleanup(kill=True)
+        raise
+    else:
+        job.cleanup(kill=False)
+    assert {o["probe"] for o in outs} == {9}  # all three ranks converged
