@@ -262,8 +262,10 @@ def bench_executor() -> dict:
     n_rows = int(os.environ.get("BENCH_ROWS", "32"))
     batch = int(os.environ.get("BENCH_BATCH", "256"))
     # Enough requests that cold-start (first uncached matrices + the one
-    # Gram build) amortizes; steady state is host-side count lookups.
-    iters = int(os.environ.get("BENCH_ITERS", "60"))
+    # Gram build) amortizes; steady state is ONE native gram-lane call
+    # per request (~0.25ms), so short runs would mostly time the few
+    # remaining warm-up stragglers.
+    iters = int(os.environ.get("BENCH_ITERS", "240"))
     bits_per_row = int(os.environ.get("BENCH_BITS_PER_ROW", "20000"))
     import tempfile
 

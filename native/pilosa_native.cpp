@@ -224,6 +224,48 @@ int64_t pn_parse_csv(const char* buf, size_t len, uint64_t* rows, uint64_t* cols
     return (int64_t)n;
 }
 
+// ---------------------------------------------------------------------------
+// Gram-lane batch evaluator: answer a matched pair-count batch straight
+// from the cached all-pairs AND-count Gram using the count identities
+// (|a|b| = |a|+|b|-|a&b| etc.) — the executor's steady-state serving
+// loop with zero per-call Python work.  Row ids map to matrix positions
+// by binary search over the sorted id table.  op ids match
+// pn_pql_match_pairs: 0=and 1=or 2=xor 3=andnot.
+// Returns 0, or -(i+1) for the first call whose row id is not in the
+// table (caller falls back to the Python path, which grows the matrix).
+// ---------------------------------------------------------------------------
+
+static inline int64_t pn_row_pos(const int64_t* rows, int64_t n, int64_t v) {
+    int64_t lo = 0, hi = n;
+    while (lo < hi) {
+        int64_t mid = (lo + hi) >> 1;
+        if (rows[mid] < v) lo = mid + 1; else hi = mid;
+    }
+    if (lo >= n || rows[lo] != v) return -1;
+    return lo;
+}
+
+int64_t pn_gram_counts(const uint8_t* op_ids, const int64_t* r1, const int64_t* r2,
+                       int64_t n_calls, const int64_t* rows_sorted, const int32_t* pos,
+                       int64_t n_rows, const int64_t* gram, int64_t gram_dim,
+                       int64_t* out) {
+    for (int64_t i = 0; i < n_calls; i++) {
+        int64_t i1 = pn_row_pos(rows_sorted, n_rows, r1[i]);
+        int64_t i2 = pn_row_pos(rows_sorted, n_rows, r2[i]);
+        if (i1 < 0 || i2 < 0) return -(i + 1);
+        int64_t p1 = pos[i1], p2 = pos[i2];
+        int64_t g = gram[p1 * gram_dim + p2];
+        switch (op_ids[i]) {
+            case 0: out[i] = g; break;                                          // and
+            case 1: out[i] = gram[p1 * gram_dim + p1] + gram[p2 * gram_dim + p2] - g; break;      // or
+            case 2: out[i] = gram[p1 * gram_dim + p1] + gram[p2 * gram_dim + p2] - 2 * g; break;  // xor
+            case 3: out[i] = gram[p1 * gram_dim + p1] - g; break;               // andnot
+            default: return -(i + 1);
+        }
+    }
+    return 0;
+}
+
 }  // extern "C"
 
 // ---------------------------------------------------------------------------

@@ -338,7 +338,12 @@ class Executor:
         """Vectorized local evaluator for the compiled-query lane: group by
         (frame, op) with numpy masks, map row ids to matrix positions via
         searchsorted, and answer each group with one Gram lookup batch or
-        kernel dispatch — no per-call Python loop."""
+        kernel dispatch — no per-call Python loop.  With a warm Gram the
+        whole batch collapses further into ONE native call
+        (pn_gram_counts: binary-search position mapping + count
+        identities in C++), the steady-state serving loop.
+        """
+        from pilosa_tpu import native
         from pilosa_tpu.native import PQL_PAIR_OPS
 
         out = np.zeros(len(op_ids), dtype=np.int64)
@@ -350,12 +355,33 @@ class Executor:
             id_pos, matrix, box = self._frame_matrix(
                 index, fname, slices, set(rows.tolist())
             )
+            gram = self._frame_gram(matrix, box)
+            if gram is not None:  # implies a live box (_frame_gram contract)
+                # Native lane: the gram_lut (sorted id table + positions)
+                # lives and dies with the cache box, like the Gram itself.
+                glut = box.get("gram_lut")
+                if glut is None:
+                    rs = np.array(sorted(id_pos), dtype=np.int64)
+                    ps = np.fromiter(
+                        (id_pos[int(v)] for v in rs), dtype=np.int32, count=len(rs)
+                    )
+                    glut = box["gram_lut"] = (rs, np.ascontiguousarray(gram), ps)
+                counts = native.gram_counts(
+                    np.ascontiguousarray(op_ids[fmask]),
+                    np.ascontiguousarray(fr1),
+                    np.ascontiguousarray(fr2),
+                    glut[0],
+                    glut[2],
+                    glut[1],
+                )
+                if counts is not None:
+                    out[fmask] = counts
+                    continue
             lut = np.fromiter(
                 (id_pos[int(rv)] for rv in rows), dtype=np.int32, count=len(rows)
             )
             p1 = lut[np.searchsorted(rows, fr1)]
             p2 = lut[np.searchsorted(rows, fr2)]
-            gram = self._frame_gram(matrix, box)
             fops = op_ids[fmask]
             fout = np.zeros(len(fr1), dtype=np.int64)
             for op_id in np.unique(fops):
@@ -370,7 +396,7 @@ class Executor:
                     counts = self.engine.gather_count(op, matrix, pairs)
                 fout[om] = counts
             out[fmask] = fout
-        return [int(v) for v in out]
+        return out.tolist()
 
     def _fuse_count_pair_batch(
         self, index: str, calls, slices, inv_slices, opt: ExecOptions

@@ -82,6 +82,12 @@ def load() -> Optional[ctypes.CDLL]:
         # the SetBit hot path (data_as() allocates a pointer object).
         lib.pn_array_insert_u32.restype = ctypes.c_int64
         lib.pn_array_insert_u32.argtypes = [ctypes.c_void_p, ctypes.c_int64, ctypes.c_uint32]
+        lib.pn_gram_counts.restype = ctypes.c_int64
+        lib.pn_gram_counts.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
+        ]
         lib.pn_oplog_decode.restype = ctypes.c_int64
         lib.pn_oplog_decode.argtypes = [u8p, ctypes.c_size_t, u8p, u64p]
         lib.pn_parse_csv.restype = ctypes.c_int64
@@ -386,6 +392,30 @@ def pql_match_pairs(src: bytes):
     return (
         op_ids[:n], frame_ids[:n], key_ids[:n], r1[:n], r2[:n], frames, keys,
     )
+
+
+def gram_counts(op_ids, r1, r2, rows_sorted, pos, gram):
+    """Answer a matched pair-count batch from the Gram via count
+    identities in one native call (the executor's steady-state lane).
+
+    op_ids: u8[N] (PQL_PAIR_OPS order); r1/r2: i64[N] row ids;
+    rows_sorted: i64[R] sorted row-id table; pos: i32[R] matrix positions
+    aligned with rows_sorted; gram: C-contiguous i64[D, D].
+    Returns i64[N] counts, or None when unavailable or some row id is
+    not in the table (caller takes the Python path).
+    """
+    lib = load()
+    if lib is None or not len(op_ids):
+        return None
+    out = np.empty(len(op_ids), dtype=np.int64)
+    rc = lib.pn_gram_counts(
+        op_ids.ctypes.data, r1.ctypes.data, r2.ctypes.data, len(op_ids),
+        rows_sorted.ctypes.data, pos.ctypes.data, len(rows_sorted),
+        gram.ctypes.data, gram.shape[0], out.ctypes.data,
+    )
+    if rc != 0:
+        return None
+    return out
 
 
 def fnv1a64(data: bytes) -> int:

@@ -163,9 +163,11 @@ class _LockstepJob:
             outs.append(json.loads(self.out_lines[i][-1]))
         return outs
 
-    def cleanup(self, kill: bool):
+    def cleanup(self):
+        """Always runs (finally): kills any rank still alive (a no-op
+        after a clean shutdown) and removes the stderr temp files."""
         for p in self.procs:
-            if kill and p.poll() is None:
+            if p.poll() is None:
                 p.kill()
         for f in self.errfiles:
             f.close()
@@ -205,11 +207,11 @@ def test_lockstep_query_service():
         assert job.query('Count(Bitmap(rowID=0, frame="f"))')["results"] == [10]
 
         outs = job.shutdown_and_collect()
-    except Exception:
-        job.cleanup(kill=True)
-        raise
-    else:
-        job.cleanup(kill=False)
+    finally:
+        # finally (not except Exception): pytest.fail raises a
+        # BaseException subclass, and ranks blocked on the coordinator
+        # barrier must never outlive the test.
+        job.cleanup()
     by_pid = {o["pid"]: o for o in outs}
     # Both ranks converged: seed 8 bits + 2 served writes.
     assert by_pid[0]["probe"] == by_pid[1]["probe"] == 10
@@ -260,9 +262,9 @@ def test_lockstep_three_ranks():
         assert job.query('SetBit(rowID=0, frame="f", columnID=321)')["results"] == [True]
         assert job.query('Count(Bitmap(rowID=0, frame="f"))')["results"] == [9]
         outs = job.shutdown_and_collect()
-    except Exception:
-        job.cleanup(kill=True)
-        raise
-    else:
-        job.cleanup(kill=False)
+    finally:
+        # finally (not except Exception): pytest.fail raises a
+        # BaseException subclass, and ranks blocked on the coordinator
+        # barrier must never outlive the test.
+        job.cleanup()
     assert {o["probe"] for o in outs} == {9}  # all three ranks converged
