@@ -366,13 +366,10 @@ class Executor:
                         (id_pos[int(v)] for v in rs), dtype=np.int32, count=len(rs)
                     )
                     glut = box["gram_lut"] = (rs, np.ascontiguousarray(gram), ps)
+                # Mask indexing yields fresh C-contiguous arrays, so the
+                # raw pointers hand off to C directly.
                 counts = native.gram_counts(
-                    np.ascontiguousarray(op_ids[fmask]),
-                    np.ascontiguousarray(fr1),
-                    np.ascontiguousarray(fr2),
-                    glut[0],
-                    glut[2],
-                    glut[1],
+                    op_ids[fmask], fr1, fr2, glut[0], glut[2], glut[1]
                 )
                 if counts is not None:
                     out[fmask] = counts

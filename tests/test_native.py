@@ -153,3 +153,35 @@ def test_varint_decode_max_uint64_both_paths(force_fallback):
     if not native.available():
         pytest.skip("native lib unavailable")
     np.testing.assert_array_equal(native.varint_decode(native.varint_encode(m)), m)
+
+
+def test_gram_counts_native():
+    """pn_gram_counts answers all four pair ops via count identities and
+    returns None (Python fallback) when a row id is absent."""
+    from pilosa_tpu import native
+
+    if not native.available():
+        pytest.skip("native library unavailable")
+    rng = np.random.default_rng(8)
+    R = 7
+    # Symmetric PSD-ish gram with plausible count structure.
+    bits = rng.integers(0, 2, size=(R, 64))
+    gram = (bits @ bits.T).astype(np.int64)
+    rows_sorted = np.array([2, 5, 9, 11, 20, 31, 40], dtype=np.int64)
+    pos = np.array([3, 0, 6, 1, 4, 2, 5], dtype=np.int32)
+    n = 40
+    r1 = rows_sorted[rng.integers(0, R, size=n)].astype(np.int64)
+    r2 = rows_sorted[rng.integers(0, R, size=n)].astype(np.int64)
+    op_ids = rng.integers(0, 4, size=n).astype(np.uint8)
+    got = native.gram_counts(op_ids, r1, r2, rows_sorted, pos, gram)
+    assert got is not None
+    id_pos = dict(zip(rows_sorted.tolist(), pos.tolist()))
+    for i in range(n):
+        p1, p2 = id_pos[int(r1[i])], id_pos[int(r2[i])]
+        g, d1, d2 = gram[p1, p2], gram[p1, p1], gram[p2, p2]
+        want = [g, d1 + d2 - g, d1 + d2 - 2 * g, d1 - g][op_ids[i]]
+        assert got[i] == want, i
+    # Unknown row id -> None (caller takes the Python path).
+    r1_bad = r1.copy()
+    r1_bad[5] = 999
+    assert native.gram_counts(op_ids, r1_bad, r2, rows_sorted, pos, gram) is None
