@@ -184,9 +184,21 @@ def test_soak_two_engines_with_snapshots(tmp_path):
                 eng.execute(
                     "i",
                     'Count(Intersect(Bitmap(rowID=0, frame="f"), Bitmap(rowID=1, frame="f")))'
-                    ' Count(Union(Bitmap(rowID=2, frame="f"), Bitmap(rowID=3, frame="f")))',
+                    ' Count(Union(Bitmap(rowID=2, frame="f"), Bitmap(rowID=3, frame="f")))'
+                    # 3-operand tree: the multi-fold lane shares the matrix.
+                    ' Count(Intersect(Bitmap(rowID=0, frame="f"), Bitmap(rowID=1, frame="f"), Bitmap(rowID=2, frame="f")))',
+                )
+                # Fused Range batch: multi-view matrix + cover memo under
+                # concurrent timestamped writes (generation invalidation).
+                eng.execute(
+                    "i",
+                    'Count(Range(rowID=0, frame="f", start="2017-01-01T00:00", end="2018-01-01T00:00"))'
+                    ' Count(Range(rowID=1, frame="f", start="2017-03-01T00:00", end="2017-06-01T00:00"))',
                 )
                 eng.execute("i", 'TopN(frame="f", n=3)')
+                # TopN(src): the engine-backed candidate scorer against the
+                # shared row matrix while writers mutate it.
+                eng.execute("i", 'TopN(Bitmap(rowID=4, frame="f"), frame="f", n=3)')
                 eng.execute("i", 'Bitmap(columnID=5, frame="f")')
         except BaseException as x:  # pragma: no cover
             errors.append(("r", x))
