@@ -57,6 +57,7 @@ _LAZY = {
     "Server": ("pilosa_tpu.server.server", "Server"),
     "Client": ("pilosa_tpu.server.client", "Client"),
     "Config": ("pilosa_tpu.config", "Config"),
+    "LockstepService": ("pilosa_tpu.parallel.service", "LockstepService"),
 }
 
 

@@ -21,3 +21,14 @@ from pilosa_tpu.parallel.sharded import (  # noqa: F401
     sharded_count_call,
     sharded_union_reduce,
 )
+
+
+def __getattr__(name):
+    # PEP 562 lazy export: service.py transitively imports jax (executor,
+    # kernels, server stack), and this package must stay importable on
+    # numpy-only hosts — same contract as pilosa_tpu/__init__.py.
+    if name == "LockstepService":
+        from pilosa_tpu.parallel.service import LockstepService
+
+        return LockstepService
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
