@@ -36,7 +36,12 @@ import numpy as np
 from pilosa_tpu import roaring
 from pilosa_tpu.core import cache as cache_mod
 from pilosa_tpu.ops import bitwise as bw
-from pilosa_tpu.pilosa import SLICE_WIDTH
+from pilosa_tpu.pilosa import ErrFragmentLocked, SLICE_WIDTH
+
+try:
+    import fcntl
+except ImportError:  # non-POSIX: no inter-process lock (reference is
+    fcntl = None  # POSIX-only here too: syscall.Flock, fragment.go:187)
 
 # Number of rows in a checksum block (fragment.go:59 HashBlockSize).
 HASH_BLOCK_SIZE = 100
@@ -148,6 +153,7 @@ class Fragment:
         self._row_counts: OrderedDict[int, int] = OrderedDict()
         self._row_counts_max = 4096
         self._open = False
+        self._lock_fd: Optional[int] = None
         # Write generation: refreshed on every mutation from a
         # process-global counter, so engine-side assembled row matrices
         # (executor fused path) can validate their cache without hashing
@@ -162,13 +168,42 @@ class Fragment:
         if self._open:
             return
         os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
-        if os.path.exists(self.path):
-            with open(self.path, "rb") as f:
-                data = f.read()
-            if data:
-                self.storage = roaring.Bitmap.from_bytes(data)
-        self._attach_wal()
-        self._load_cache()
+        self._acquire_flock()
+        # A crash between the snapshot temp write and the rename leaves an
+        # orphaned .snapshotting file; the data file is still the previous
+        # good state (os.replace is atomic), so just sweep the orphans.
+        import glob
+
+        for stale in glob.glob(glob.escape(self.path) + ".*.snapshotting"):
+            try:
+                os.unlink(stale)
+            except OSError:
+                pass
+        try:
+            if os.path.exists(self.path):
+                with open(self.path, "rb") as f:
+                    data = f.read()
+                if data:
+                    try:
+                        self.storage = roaring.Bitmap.from_bytes(data)
+                    except ValueError:
+                        # Torn WAL tail (crash mid-append): recover the
+                        # valid prefix and truncate the file there.  Real
+                        # snapshot-body corruption re-raises from inside
+                        # from_bytes_recover's strict body parse.
+                        self.storage, valid_len = roaring.Bitmap.from_bytes_recover(data)
+                        with open(self.path, "r+b") as f:
+                            f.truncate(valid_len)
+                        self.stats.count("walRecoveredN", 1)
+            self._attach_wal()
+            self._load_cache()
+        except BaseException:
+            if self._wal is not None:  # mirror close(): no fd leak, and no
+                self._wal.close()  # live append handle past the lock release
+                self._wal = None
+                self.storage.op_writer = None
+            self._release_flock()
+            raise
         self._open = True
 
     def close(self) -> None:
@@ -176,7 +211,48 @@ class Fragment:
             self._wal.close()
             self._wal = None
         self._save_cache()
+        self._release_flock()
         self._open = False
+
+    def _acquire_flock(self) -> None:
+        """Exclusive inter-process lock for this fragment's files.
+
+        The reference flocks the storage file itself for the process
+        lifetime (fragment.go:179-234).  Here snapshots replace the data
+        file by rename, which would silently break inode-based lock
+        continuity, so the lock lives on a ``.lock`` sidecar whose inode
+        never changes.  Non-blocking: a second opener fails immediately
+        (ErrFragmentLocked) instead of corrupting a shared data dir.
+        """
+        if fcntl is None:
+            return
+        import errno
+
+        fd = os.open(self.path + ".lock", os.O_CREAT | os.O_RDWR, 0o644)
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except OSError as e:
+            os.close(fd)
+            if e.errno in (errno.EWOULDBLOCK, errno.EAGAIN, errno.EACCES):
+                raise ErrFragmentLocked(
+                    f"fragment file locked by another process: {self.path}"
+                )
+            if e.errno in (errno.ENOLCK, errno.EOPNOTSUPP, errno.ENOTSUP):
+                # Filesystem can't do flock (some NFS mounts): degrade to
+                # unlocked operation rather than bricking every open with
+                # a misleading "locked by another process".
+                return
+            raise  # real I/O error: surface as-is
+        self._lock_fd = fd
+
+    def _release_flock(self) -> None:
+        fd = getattr(self, "_lock_fd", None)
+        if fd is not None:
+            self._lock_fd = None
+            try:
+                fcntl.flock(fd, fcntl.LOCK_UN)
+            finally:
+                os.close(fd)
 
     def _attach_wal(self) -> None:
         if self._wal is not None:
@@ -327,7 +403,11 @@ class Fragment:
 
         t0 = _time.perf_counter()
         dirname = os.path.dirname(self.path) or "."
-        fd, tmp = tempfile.mkstemp(prefix=os.path.basename(self.path), suffix=".snapshotting", dir=dirname)
+        # The "<name>." prefix + suffix pair makes the orphan-sweep glob in
+        # open() precise: fragment "0" must not match fragment "01"'s temps.
+        fd, tmp = tempfile.mkstemp(
+            prefix=os.path.basename(self.path) + ".", suffix=".snapshotting", dir=dirname
+        )
         try:
             with os.fdopen(fd, "wb") as f:
                 self.storage.write_to(f)

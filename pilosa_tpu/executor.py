@@ -609,28 +609,41 @@ class Executor:
         threads without a lock: entries are deterministic pure counts, so
         a racing double-compute stores the same value.
         """
-        views = sorted({v for v, _ in combos})
+        # Keyed by (index, frame, slices) — NOT the view set: a batch whose
+        # union of Range covers introduces a new view must take the append
+        # path below, not miss the whole entry (heterogeneous dashboard
+        # batches cycle distinct view sets; per-view-set keys would thrash
+        # the small LRU with rebuild+re-upload).  Views live inside the
+        # (view, row) combo space; generations are tracked per (view,
+        # slice) for every view resident in the matrix.
+        key = (index, frame, tuple(slices))
+        with self._matrix_mu:
+            hit = self._multi_matrix_cache.get(key)
+        old_id_pos = old_matrix = old_memo = None
+        old_views: list[str] = []
+        if hit is not None:
+            old_gens, old_id_pos, old_matrix, old_memo = hit
+            old_views = sorted(old_gens)
+        views = sorted({v for v, _ in combos} | set(old_views))
         frags = {
             v: [self.holder.fragment(index, frame, v, s) for s in slices]
             for v in views
         }
-        gens = tuple(
-            tuple(-1 if f is None else f.generation for f in frags[v]) for v in views
-        )
-        key = (index, frame, tuple(views), tuple(slices))
-        with self._matrix_mu:
-            hit = self._multi_matrix_cache.get(key)
-            if hit is not None:
-                old_gens, old_id_pos, old_matrix, old_memo = hit
-                if old_gens == gens:
-                    missing = sorted(set(combos) - old_id_pos.keys())
-                    if not missing:
-                        self._multi_matrix_cache.move_to_end(key)
-                        return old_id_pos, old_matrix, old_memo
-                else:
-                    old_id_pos = None  # writes: rebuild, fresh memo
+        gens = {
+            v: tuple(-1 if f is None else f.generation for f in frags[v])
+            for v in views
+        }
+        missing: list[tuple[str, int]] = []
+        if old_id_pos is not None:
+            if all(gens[v] == old_gens[v] for v in old_views):
+                missing = sorted(set(combos) - old_id_pos.keys())
+                if not missing:
+                    with self._matrix_mu:
+                        if key in self._multi_matrix_cache:
+                            self._multi_matrix_cache.move_to_end(key)
+                    return old_id_pos, old_matrix, old_memo
             else:
-                old_id_pos = None
+                old_id_pos = None  # writes: rebuild, fresh memo
 
         def densify(combo_list, cap):
             """[n_slices, cap, W] host block; rows beyond the combo list
@@ -678,14 +691,21 @@ class Executor:
             with self._matrix_mu:
                 self._multi_matrix_cache[key] = (gens, id_pos, matrix, memo)
                 self._multi_matrix_cache.move_to_end(key)
+                while len(self._multi_matrix_cache) > self._matrix_cache_entries:
+                    self._multi_matrix_cache.popitem(last=False)
             return id_pos, matrix, memo
 
         id_pos = {c: k for k, c in enumerate(combos)}
         matrix = self.engine.matrix(densify(combos, pow2(len(combos))))
         memo = {}
+        # Store generations only for views actually resident in the matrix:
+        # a rebuild drops old views whose combos this batch no longer
+        # references, and tracking their gens would invalidate the entry on
+        # writes to rows it doesn't even hold.
+        store_gens = {v: gens[v] for v in {vv for vv, _ in combos}}
         if len(combos) <= self._matrix_rows_max:
             with self._matrix_mu:
-                self._multi_matrix_cache[key] = (gens, id_pos, matrix, memo)
+                self._multi_matrix_cache[key] = (store_gens, id_pos, matrix, memo)
                 self._multi_matrix_cache.move_to_end(key)
                 while len(self._multi_matrix_cache) > self._matrix_cache_entries:
                     self._multi_matrix_cache.popitem(last=False)

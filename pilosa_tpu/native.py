@@ -232,6 +232,42 @@ def oplog_decode(data: bytes) -> tuple[np.ndarray, np.ndarray]:
     return np.array(types_l, dtype=np.uint8), np.array(values_l, dtype=np.uint64)
 
 
+def oplog_decode_prefix(data: bytes) -> tuple[np.ndarray, np.ndarray, int]:
+    """Decode the longest valid record prefix of a WAL tail.
+
+    Crash-recovery variant of :func:`oplog_decode`: a torn tail — the
+    partial or checksum-corrupt record a crash mid-append leaves — stops
+    the decode instead of raising.  Returns (types, values, valid_bytes)
+    where ``valid_bytes`` is the byte length of the valid prefix (the
+    caller truncates the file there).
+    """
+    n_full = len(data) // 13
+    if n_full == 0:
+        return np.empty(0, np.uint8), np.empty(0, np.uint64), 0
+    trunc = data[: n_full * 13]
+    lib = load()
+    if lib is not None:
+        buf = np.frombuffer(trunc, dtype=np.uint8)
+        types = np.empty(n_full, dtype=np.uint8)
+        values = np.empty(n_full, dtype=np.uint64)
+        got = lib.pn_oplog_decode(_u8(buf), len(buf), _u8(types), _u64(values))
+        k = int(-got - 1) if got < 0 else int(got)
+        return types[:k], values[:k], k * 13
+    from pilosa_tpu.roaring import decode_op
+
+    types_l, values_l = [], []
+    k = 0
+    for i in range(n_full):
+        try:
+            t, v = decode_op(trunc[i * 13 : (i + 1) * 13])
+        except ValueError:
+            break
+        types_l.append(t)
+        values_l.append(v)
+        k = i + 1
+    return np.array(types_l, dtype=np.uint8), np.array(values_l, dtype=np.uint64), k * 13
+
+
 def _ascii_digits(s: str) -> bool:
     """Plain ASCII decimal digits only — matches pn_parse_csv exactly."""
     return s.isascii() and s.isdigit()

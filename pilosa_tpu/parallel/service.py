@@ -32,6 +32,7 @@ client, workers log and continue.
 from __future__ import annotations
 
 import json
+import os
 import socket
 import struct
 import threading
@@ -94,6 +95,11 @@ class LockstepService:
         self.control_addr = control_addr
         self.http_addr = http_addr
         self._workers: list[socket.socket] = []
+        # Bound on how long rank 0 waits for a worker's receipt ack (and
+        # for the send buffer to drain) while holding the total-order
+        # lock.  Must exceed the worst single-query device time: a worker
+        # acks request n+1 only after finishing request n's execute.
+        self.ack_timeout = float(os.environ.get("PILOSA_TPU_LOCKSTEP_ACK_TIMEOUT", "120"))
         self._mu = threading.Lock()  # the total order
         self._degraded = False
         self._httpd = None
@@ -130,14 +136,20 @@ class LockstepService:
                 )
             try:
                 for w in self._workers:
+                    w.settimeout(self.ack_timeout)
                     _send_msg(w, {"op": "query", "index": index, "query": query})
                 # Receipt acks BEFORE local execution: a dead worker is
                 # detected here instead of by hanging in the collective
-                # it will never enter.
+                # it will never enter.  The socket timeout (set above for
+                # both the send and this recv) bounds how long the
+                # total-order lock can be held by a hung-but-open rank:
+                # a timeout counts as a lost rank (degrade + raise), so
+                # shutdown() — which also takes the lock — stays
+                # reachable instead of deadlocking behind a stuck recv.
                 for w in self._workers:
                     if w.recv(1) != b"k":
                         raise OSError("worker closed control connection")
-            except OSError as e:
+            except (OSError, socket.timeout) as e:
                 self._degraded = True
                 raise PilosaError(
                     f"lockstep control plane lost a rank ({e}); "

@@ -41,6 +41,11 @@ class ErrFragmentNotFound(PilosaError):
     pass
 
 
+class ErrFragmentLocked(PilosaError):
+    """Another process holds the fragment's exclusive file lock
+    (fragment.go:179-234 flock analog)."""
+
+
 class ErrQueryRequired(PilosaError):
     pass
 

@@ -130,8 +130,19 @@ class Container:
         return self._n
 
     def values(self) -> np.ndarray:
-        """Sorted lowbits values as uint32."""
+        """Sorted lowbits values as uint32.
+
+        The returned array is safe to retain across later mutations: when
+        the container is backed by the capacity-slack insert buffer (whose
+        contents single adds memmove in place), it is detached here —
+        published as a standalone array once — so no caller ever holds a
+        live view of mutating storage.  The next native add re-creates the
+        slack buffer.
+        """
         if self.array is not None:
+            if self._buf is not None:
+                self.array = self.array.copy()
+                self._buf = None
             return self.array
         return _bitmap_to_values(self.bitmap)
 
@@ -690,8 +701,8 @@ class Bitmap:
         return buf.getvalue()
 
     @classmethod
-    def from_bytes(cls, data: bytes) -> "Bitmap":
-        """Decode the reference format, applying any trailing op log."""
+    def _parse_snapshot(cls, data: bytes) -> tuple["Bitmap", int]:
+        """Strict snapshot-body decode; returns (bitmap, op-log offset)."""
         if len(data) < HEADER_SIZE:
             raise ValueError("data too small")
         head = np.frombuffer(data[:8], dtype="<u4")
@@ -718,21 +729,75 @@ class Bitmap:
                 words = np.frombuffer(data[off : off + payload], dtype="<u8").astype(np.uint64)
                 bm.containers[key] = Container(bitmap=words)
             ops_offset = off + payload
+        return bm, ops_offset
+
+    def _apply_ops(self, types: np.ndarray, values: np.ndarray) -> None:
+        for typ, value in zip(types.tolist(), values.tolist()):
+            value = int(value)
+            if typ == OP_ADD:
+                self._container_for(value).add(lowbits(value))
+            else:
+                c = self.containers.get(highbits(value))
+                if c is not None and c.remove(lowbits(value)) and c.n == 0:
+                    del self.containers[highbits(value)]
+            self.op_n += 1
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "Bitmap":
+        """Decode the reference format, applying any trailing op log.
+
+        Strict: any invalid op record raises (the reference's open
+        behavior, roaring.go:590-611).  Crash recovery is the caller's
+        policy — see :meth:`from_bytes_recover`.
+        """
+        bm, ops_offset = cls._parse_snapshot(data)
         # Trailing op log (roaring.go:590-611); decoded+verified in one
         # native pass when the C++ kernels are available.
         buf = data[ops_offset:]
         if buf:
             types, values = native.oplog_decode(bytes(buf))
-            for typ, value in zip(types.tolist(), values.tolist()):
-                value = int(value)
-                if typ == OP_ADD:
-                    bm._container_for(value).add(lowbits(value))
-                else:
-                    c = bm.containers.get(highbits(value))
-                    if c is not None and c.remove(lowbits(value)) and c.n == 0:
-                        del bm.containers[highbits(value)]
-                bm.op_n += 1
+            bm._apply_ops(types, values)
         return bm
+
+    @classmethod
+    def from_bytes_recover(cls, data: bytes) -> tuple["Bitmap", int]:
+        """Crash-recovery decode: snapshot body strictly, op log leniently.
+
+        A torn tail — the partial or checksum-corrupt record a crash
+        mid-append leaves behind — stops the op replay at the last valid
+        record instead of failing the open (the reference errors there and
+        leaves trimming to hand repair; roaring.go:599-601 FIXME).  The
+        snapshot body itself is still parsed strictly: container damage is
+        real corruption, not an interrupted append, and must surface.
+
+        Returns ``(bitmap, valid_len)`` where ``valid_len`` is the byte
+        length of the recoverable file prefix (snapshot + valid ops); the
+        caller truncates the file there to discard the torn tail.
+        """
+        bm, ops_offset = cls._parse_snapshot(data)
+        buf = bytes(data[ops_offset:])
+        valid_len = ops_offset
+        if buf:
+            types, values, valid_bytes = native.oplog_decode_prefix(buf)
+            # Tear vs corruption: a crash tears only the TAIL of the log (a
+            # partial final append, possibly a lost page of trailing
+            # records) — it can never leave VALID records after the bad
+            # one.  If any later record still checksums, record boundaries
+            # are intact and a mid-log byte flipped: that destroyed acked
+            # ops and must surface, not be silently truncated away.
+            rest = buf[valid_bytes:]
+            for i in range(13, len(rest) - 12, 13):
+                try:
+                    decode_op(rest[i : i + 13])
+                except ValueError:
+                    continue
+                raise ValueError(
+                    f"op log corrupt mid-stream at byte {valid_bytes} "
+                    "(valid records follow the damage; refusing to truncate)"
+                )
+            bm._apply_ops(types, values)
+            valid_len += valid_bytes
+        return bm, valid_len
 
 
 def _c_copy(c: Container) -> Container:
