@@ -103,6 +103,15 @@ class NumpyEngine:
     def gather_count_or_multi(self, row_matrix, idx) -> np.ndarray:
         return self.gather_count_multi("or", row_matrix, idx)
 
+    def gather_count_dev(self, op: str, row_matrix, pairs):
+        """Like gather_count but returns an ENGINE array without forcing a
+        host sync — slice-streaming accumulates these so the next chunk's
+        upload overlaps the previous chunk's compute."""
+        return self.gather_count(op, row_matrix, pairs)
+
+    def gather_count_multi_dev(self, op: str, row_matrix, idx):
+        return self.gather_count_multi(op, row_matrix, idx)
+
     def bit_and(self, a, b):
         return a & b
 
@@ -145,6 +154,28 @@ class NumpyEngine:
         (shape changes would recompile jitted kernels downstream)."""
         out = matrix.copy()
         out[:, row_start : row_start + block.shape[1], :] = block
+        return out
+
+    def set_rows_at(self, matrix, slots, block):
+        """Functionally write rows into ARBITRARY slots (row-pool paging:
+        a miss batch scatters into freed slots in one call)."""
+        out = matrix.copy()
+        out[:, list(slots), :] = block
+        return out
+
+    def grow_rows(self, matrix, n: int):
+        """Append n zero rows of capacity (row-pool doubling)."""
+        s, _, w = matrix.shape
+        return np.concatenate(
+            [matrix, np.zeros((s, n, w), dtype=matrix.dtype)], axis=1
+        )
+
+    def set_plane_rows(self, matrix, slice_idxs, slots, block):
+        """Functionally write block[i, j] into (slice_idxs[i], slots[j]) —
+        the stale-plane refresh touches only RESIDENT slots, transferring
+        resident-rows x stale-slices bytes, not whole capacity planes."""
+        out = matrix.copy()
+        out[np.ix_(list(slice_idxs), list(slots))] = block
         return out
 
     def pair_gram(self, matrix):
@@ -213,6 +244,19 @@ class JaxEngine:
     def gather_count_or_multi(self, row_matrix, idx) -> np.ndarray:
         return self.gather_count_multi("or", row_matrix, idx)
 
+    def gather_count_dev(self, op: str, row_matrix, pairs):
+        """Async variant: the dispatch is enqueued and the device array
+        returned un-fetched, so a streaming loop pipelines chunk k+1's
+        host->device upload behind chunk k's kernel."""
+        return self._dispatch.gather_count(
+            op, self._jnp.asarray(row_matrix), self._jnp.asarray(pairs), allow_gram=False
+        )
+
+    def gather_count_multi_dev(self, op: str, row_matrix, idx):
+        return self._dispatch.gather_count_multi(
+            op, self._jnp.asarray(row_matrix), self._jnp.asarray(idx)
+        )
+
     def bit_and(self, a, b):
         return self._jnp.bitwise_and(a, b)
 
@@ -254,6 +298,25 @@ class JaxEngine:
         return matrix.at[:, row_start : row_start + block.shape[1], :].set(
             self._jnp.asarray(block)
         )
+
+    def set_rows_at(self, matrix, slots, block):
+        """Scatter a miss batch into arbitrary pool slots: only the new
+        rows cross host->device; the scatter itself is HBM->HBM."""
+        idx = self._jnp.asarray(np.asarray(slots, dtype=np.int32))
+        return matrix.at[:, idx, :].set(self._jnp.asarray(block))
+
+    def grow_rows(self, matrix, n: int):
+        """Append n zero capacity rows DEVICE-side (no host transfer)."""
+        s, _, w = matrix.shape
+        z = self._jnp.zeros((s, n, w), dtype=matrix.dtype)
+        return self._jnp.concatenate([matrix, z], axis=1)
+
+    def set_plane_rows(self, matrix, slice_idxs, slots, block):
+        """Scatter (stale slice, resident slot) cells: only the touched
+        rows cross host->device."""
+        si = self._jnp.asarray(np.asarray(slice_idxs, dtype=np.int32))
+        sl = self._jnp.asarray(np.asarray(slots, dtype=np.int32))
+        return matrix.at[si[:, None], sl[None, :], :].set(self._jnp.asarray(block))
 
     def pair_gram(self, matrix):
         """All-pairs AND-count Gram via one MXU int8 matmul (exact)."""
@@ -347,6 +410,15 @@ class MeshEngine(JaxEngine):
     def set_rows(self, matrix, row_start, block):
         return self._repin(super().set_rows(matrix, row_start, block), matrix)
 
+    def set_rows_at(self, matrix, slots, block):
+        return self._repin(super().set_rows_at(matrix, slots, block), matrix)
+
+    def grow_rows(self, matrix, n):
+        return self._repin(super().grow_rows(matrix, n), matrix)
+
+    def set_plane_rows(self, matrix, slice_idxs, slots, block):
+        return self._repin(super().set_plane_rows(matrix, slice_idxs, slots, block), matrix)
+
     def gather_count(self, op, row_matrix, pairs):
         # Pallas can't lower under GSPMD partitioning; the jnp form is
         # partitioned by XLA (local gather + bitwise op + popcount per
@@ -393,6 +465,15 @@ class MeshEngine(JaxEngine):
 
     def gather_count_or_multi(self, row_matrix, idx):
         return self.gather_count_multi("or", row_matrix, idx)
+
+    def gather_count_dev(self, op, row_matrix, pairs):
+        # Sharded matrices go through the GSPMD-partitioned jnp form (the
+        # Pallas dispatch the Jax parent would pick can't lower under
+        # GSPMD); the result is small, so the sync fetch costs little.
+        return self.gather_count(op, row_matrix, pairs)
+
+    def gather_count_multi_dev(self, op, row_matrix, idx):
+        return self.gather_count_multi(op, row_matrix, idx)
 
 
 def new_engine(name: str = "auto"):

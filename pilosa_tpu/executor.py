@@ -35,6 +35,7 @@ from pilosa_tpu.core.fragment import TopOptions
 from pilosa_tpu.core import timequantum as tq
 from pilosa_tpu.core.view import VIEW_INVERSE, VIEW_STANDARD
 from pilosa_tpu.engine import new_engine
+from pilosa_tpu.rowpool import DeviceRowPool, chunk_queries
 from pilosa_tpu.pilosa import (
     ErrFrameInverseDisabled,
     ErrFrameNotFound,
@@ -308,6 +309,14 @@ class Executor:
         std_slices = list(slices) if slices else list(range(idx_obj.max_slice() + 1))
         if not std_slices:
             return None
+        from pilosa_tpu.rowpool import pool_capacity
+
+        if pool_capacity(len(std_slices), _WORDS) < 64:
+            # Slice-streaming regime (working set >> HBM pool budget): the
+            # AST fused path owns the slice-chunked accumulation loop; the
+            # flat lane's whole point (skipping per-call Python) is noise
+            # against per-chunk upload costs anyway.
+            return None
         opt = opt or ExecOptions()
 
         if self._is_distributed(opt):
@@ -348,51 +357,70 @@ class Executor:
 
         out = np.zeros(len(op_ids), dtype=np.int64)
         for f_id in np.unique(frame_ids):
-            fmask = frame_ids == f_id
+            fmask0 = frame_ids == f_id
             fname = frame_names[f_id] if f_id >= 0 else DEFAULT_FRAME
-            fr1, fr2 = r1[fmask], r2[fmask]
-            rows = np.unique(np.concatenate([fr1, fr2]))
-            id_pos, matrix, box = self._frame_matrix(
-                index, fname, slices, set(rows.tolist())
-            )
-            gram = self._frame_gram(matrix, box)
-            if gram is not None:  # implies a live box (_frame_gram contract)
-                # Native lane: the gram_lut (sorted id table + positions)
-                # lives and dies with the cache box, like the Gram itself.
-                glut = box.get("gram_lut")
-                if glut is None:
-                    rs = np.array(sorted(id_pos), dtype=np.int64)
-                    ps = np.fromiter(
-                        (id_pos[int(v)] for v in rs), dtype=np.int32, count=len(rs)
+            pool = self._pool_for(index, fname, VIEW_STANDARD, slices)
+            rows_all = np.unique(np.concatenate([r1[fmask0], r2[fmask0]]))
+            if len(rows_all) <= pool.cap_max:
+                qparts = [np.nonzero(fmask0)[0]]
+            else:
+                # Paging regime: partition the frame's queries so each
+                # chunk's unique rows fit the pool; rows stream through
+                # HBM chunk by chunk instead of falling back to host.
+                qparts = [
+                    np.asarray(p)
+                    for p in chunk_queries(
+                        np.nonzero(fmask0)[0].tolist(),
+                        lambda qi: (int(r1[qi]), int(r2[qi])),
+                        pool.cap_max,
                     )
-                    glut = box["gram_lut"] = (rs, np.ascontiguousarray(gram), ps)
-                # Mask indexing yields fresh C-contiguous arrays, so the
-                # raw pointers hand off to C directly.
-                counts = native.gram_counts(
-                    op_ids[fmask], fr1, fr2, glut[0], glut[2], glut[1]
+                ]
+            for qpart in qparts:
+                fmask = np.zeros(len(op_ids), dtype=bool)
+                fmask[qpart] = True
+                fr1, fr2 = r1[fmask], r2[fmask]
+                rows = np.unique(np.concatenate([fr1, fr2]))
+                id_pos, matrix, box = self._frame_matrix(
+                    index, fname, slices, set(rows.tolist())
                 )
-                if counts is not None:
-                    out[fmask] = counts
-                    continue
-            lut = np.fromiter(
-                (id_pos[int(rv)] for rv in rows), dtype=np.int32, count=len(rows)
-            )
-            p1 = lut[np.searchsorted(rows, fr1)]
-            p2 = lut[np.searchsorted(rows, fr2)]
-            fops = op_ids[fmask]
-            fout = np.zeros(len(fr1), dtype=np.int64)
-            for op_id in np.unique(fops):
-                om = fops == op_id
-                pairs = np.stack([p1[om], p2[om]], axis=1).astype(np.int32)
-                op = PQL_PAIR_OPS[int(op_id)]
-                if gram is not None:
-                    from pilosa_tpu.ops.bitwise import gram_pair_counts
+                gram = self._frame_gram(matrix, box)
+                if gram is not None:  # implies a live box (_frame_gram contract)
+                    # Native lane: the gram_lut (sorted id table + positions)
+                    # lives and dies with the cache box, like the Gram itself.
+                    glut = box.get("gram_lut")
+                    if glut is None:
+                        rs = np.array(sorted(id_pos), dtype=np.int64)
+                        ps = np.fromiter(
+                            (id_pos[int(v)] for v in rs), dtype=np.int32, count=len(rs)
+                        )
+                        glut = box["gram_lut"] = (rs, np.ascontiguousarray(gram), ps)
+                    # Mask indexing yields fresh C-contiguous arrays, so the
+                    # raw pointers hand off to C directly.
+                    counts = native.gram_counts(
+                        op_ids[fmask], fr1, fr2, glut[0], glut[2], glut[1]
+                    )
+                    if counts is not None:
+                        out[fmask] = counts
+                        continue
+                lut = np.fromiter(
+                    (id_pos[int(rv)] for rv in rows), dtype=np.int32, count=len(rows)
+                )
+                p1 = lut[np.searchsorted(rows, fr1)]
+                p2 = lut[np.searchsorted(rows, fr2)]
+                fops = op_ids[fmask]
+                fout = np.zeros(len(fr1), dtype=np.int64)
+                for op_id in np.unique(fops):
+                    om = fops == op_id
+                    pairs = np.stack([p1[om], p2[om]], axis=1).astype(np.int32)
+                    op = PQL_PAIR_OPS[int(op_id)]
+                    if gram is not None:
+                        from pilosa_tpu.ops.bitwise import gram_pair_counts
 
-                    counts = gram_pair_counts(op, gram, pairs)
-                else:
-                    counts = self.engine.gather_count(op, matrix, pairs)
-                fout[om] = counts
-            out[fmask] = fout
+                        counts = gram_pair_counts(op, gram, pairs)
+                    else:
+                        counts = self.engine.gather_count(op, matrix, pairs)
+                    fout[om] = counts
+                out[fmask] = fout
         return out.tolist()
 
     def _fuse_count_pair_batch(
@@ -773,70 +801,151 @@ class Executor:
         groups run the multi-fold kernel with the operand axis padded to
         a power-of-two bucket (fold-idempotent pad: the first operand for
         and/or, the second for andnot) so jitted shapes stay stable.
+        Batches whose unique row set exceeds the pool capacity are chunked
+        (rows page through HBM per chunk) instead of falling back to host.
         """
         slices = list(slices or [])
         out: dict[int, int] = {}
         if not slices:
             return [0] * len(idxs)
-        # One row matrix per (frame, view): unique row ids -> device rows.
+        static = getattr(self.engine, "wants_static_shapes", False)
+        # One row pool per (frame, view): unique row ids -> device slots.
         by_fv: dict[tuple[str, str], list[int]] = {}
-        for frame, view, _, ids in matched.values():
-            by_fv.setdefault((frame, view), []).extend(ids)
-        for (frame, view), all_ids in by_fv.items():
-            id_pos, matrix, box = self._frame_matrix(index, frame, slices, set(all_ids), view)
-            # Group calls by (op, operand-count bucket): one dispatch each.
-            # Jitted engines bucket the operand axis to powers of two
-            # (stable shapes); the numpy engine uses exact arities —
-            # padding there is pure wasted gather/fold work (same policy
-            # as the fused Range lane).
-            static = getattr(self.engine, "wants_static_shapes", False)
-            groups: dict[tuple[str, int], list[int]] = {}
-            for i, (f, v, op, ids) in matched.items():
-                if (f, v) != (frame, view):
-                    continue
-                k = len(ids)
-                kb = 2 if k == 2 else (1 << (k - 1).bit_length()) if static else k
-                groups.setdefault((op, kb), []).append(i)
-            # The Gram only answers 2-operand counts — don't trigger its
-            # (expensive, cached) build for requests with no pair group.
-            gram = (
-                self._frame_gram(matrix, box)
-                if any(kb == 2 for _, kb in groups)
-                else None
-            )
-            for (op, kb), op_idxs in sorted(groups.items()):
-                if kb == 2:
-                    pairs = np.array(
-                        [
-                            [id_pos[matched[i][3][0]], id_pos[matched[i][3][1]]]
-                            for i in op_idxs
-                        ],
-                        dtype=np.int32,
-                    )
-                    if gram is not None:
-                        # Lazy import is safe here: a non-None Gram implies
-                        # the jax engine built it, so jax is already loaded.
-                        from pilosa_tpu.ops.bitwise import gram_pair_counts
+        for i in idxs:
+            by_fv.setdefault(tuple(matched[i][:2]), []).append(i)
+        for (frame, view), f_idxs in by_fv.items():
+            pool = self._pool_for(index, frame, view, slices)
+            # Row-chunk bound: the pool's budgeted capacity, but never so
+            # small that chunking degenerates (at huge slice counts the
+            # budget shrinks cap below usefulness — those shapes stream
+            # the SLICE axis below instead of pooling).
+            row_cap = max(64, pool.cap_max)
+            # oversize_ok: one Count over more operands than row_cap has no
+            # valid row-chunking — it becomes its own part and the
+            # streaming branch below (which handles any row count) runs it.
+            for part in chunk_queries(
+                f_idxs, lambda i: matched[i][3], row_cap, oversize_ok=True
+            ):
+                want = sorted({x for i in part for x in matched[i][3]})
+                # Group calls by (op, operand-count bucket): one dispatch
+                # each.  Jitted engines bucket the operand axis to powers
+                # of two (stable shapes); the numpy engine uses exact
+                # arities — padding there is pure wasted gather/fold work
+                # (same policy as the fused Range lane).
+                groups: dict[tuple[str, int], list[int]] = {}
+                for i in part:
+                    k = len(matched[i][3])
+                    kb = 2 if k == 2 else (1 << (k - 1).bit_length()) if static else k
+                    groups.setdefault((matched[i][2], kb), []).append(i)
 
-                        counts = gram_pair_counts(op, gram, pairs)
-                    else:
-                        counts = self.engine.gather_count(op, matrix, pairs)
+                if len(want) <= pool.cap_max:
+                    # Resident regime: rows live (or page) in the pool.
+                    id_pos, matrix, box = self._frame_matrix(
+                        index, frame, slices, set(want), view
+                    )
+                    # The Gram only answers 2-operand counts — don't
+                    # trigger its (expensive, cached) build for requests
+                    # without a pair group.
+                    gram = (
+                        self._frame_gram(matrix, box)
+                        if any(kb == 2 for _, kb in groups)
+                        else None
+                    )
+                    for gk, op_idxs in sorted(groups.items()):
+                        counts = self.engine.to_numpy(
+                            self._group_counts(
+                                gk, op_idxs, matched, id_pos, matrix, static, gram
+                            )
+                        )
+                        for k2, i in enumerate(op_idxs):
+                            out[i] = int(counts[k2])
                 else:
-                    # Jitted engines get a padded batch bucket too (pad
-                    # rows repeat the first call's operands; extra counts
-                    # discarded) — ragged B recompiles per group size.
-                    n = len(op_idxs)
-                    bb = (1 << (n - 1).bit_length()) if (static and n > 1) else n
-                    idx_arr = np.zeros((bb, kb), dtype=np.int32)
-                    for r, i in enumerate(op_idxs):
-                        pos = [id_pos[x] for x in matched[i][3]]
-                        idx_arr[r, : len(pos)] = pos
-                        idx_arr[r, len(pos):] = pos[0] if op != "andnot" else pos[1]
-                    idx_arr[n:] = idx_arr[0]
-                    counts = self.engine.gather_count_multi(op, matrix, idx_arr)
-                for k2, i in enumerate(op_idxs):
-                    out[i] = int(counts[k2])
+                    # Streaming regime (SURVEY §7 hard part (d) at scale):
+                    # the working set exceeds the HBM pool budget, so the
+                    # SLICE axis is chunked — each chunk's rows are
+                    # densified host-side, moved once, counted, and
+                    # discarded; per-query counts accumulate across
+                    # chunks.  Device results stay un-fetched inside the
+                    # loop (gather_count_dev) so chunk k+1's upload
+                    # pipelines behind chunk k's kernel.
+                    id_pos = {r: k for k, r in enumerate(want)}
+                    s_chunk = max(
+                        1, self._stream_bytes() // max(1, len(want) * _WORDS * 4)
+                    )
+                    acc: dict[tuple, list] = {}
+                    for c0 in range(0, len(slices), s_chunk):
+                        matrix = self._transient_matrix(
+                            index, frame, view, slices[c0 : c0 + s_chunk], want
+                        )
+                        for gk, op_idxs in sorted(groups.items()):
+                            acc.setdefault(gk, []).append(
+                                self._group_counts(
+                                    gk, op_idxs, matched, id_pos, matrix, static, None
+                                )
+                            )
+                    for gk, op_idxs in sorted(groups.items()):
+                        total = sum(
+                            self.engine.to_numpy(a).astype(np.int64) for a in acc[gk]
+                        )
+                        for k2, i in enumerate(op_idxs):
+                            out[i] = int(total[k2])
         return [out[i] for i in idxs]
+
+    def _group_counts(self, gk, op_idxs, matched, id_pos, matrix, static, gram):
+        """One fused dispatch for an (op, arity-bucket) call group; returns
+        the engine-native count array (fetch deferred to the caller)."""
+        op, kb = gk
+        if kb == 2:
+            pairs = np.array(
+                [
+                    [id_pos[matched[i][3][0]], id_pos[matched[i][3][1]]]
+                    for i in op_idxs
+                ],
+                dtype=np.int32,
+            )
+            if gram is not None:
+                # Lazy import is safe here: a non-None Gram implies the
+                # jax engine built it, so jax is already loaded.
+                from pilosa_tpu.ops.bitwise import gram_pair_counts
+
+                return gram_pair_counts(op, gram, pairs)
+            return self.engine.gather_count_dev(op, matrix, pairs)
+        # Jitted engines get a padded batch bucket too (pad rows repeat
+        # the first call's operands; extra counts discarded) — ragged B
+        # recompiles per group size.
+        n = len(op_idxs)
+        bb = (1 << (n - 1).bit_length()) if (static and n > 1) else n
+        idx_arr = np.zeros((bb, kb), dtype=np.int32)
+        for r, i in enumerate(op_idxs):
+            pos = [id_pos[x] for x in matched[i][3]]
+            idx_arr[r, : len(pos)] = pos
+            idx_arr[r, len(pos):] = pos[0] if op != "andnot" else pos[1]
+        idx_arr[n:] = idx_arr[0]
+        return self.engine.gather_count_multi_dev(op, matrix, idx_arr)
+
+    def _stream_bytes(self) -> int:
+        """Per-chunk byte budget for slice-streaming transient matrices."""
+        return int(os.environ.get("PILOSA_TPU_STREAM_BYTES", str(1 << 31)))
+
+    def _densify_block(self, index, frame, view, chunk_slices, rows) -> np.ndarray:
+        """Host block uint32[len(chunk_slices), len(rows), W] of dense rows
+        (the ONE densify loop — pool fetches and transient streaming
+        matrices share it)."""
+        block = np.zeros((len(chunk_slices), len(rows), _WORDS), dtype=np.uint32)
+        for bi, s in enumerate(chunk_slices):
+            f = self.holder.fragment(index, frame, view, s)
+            if f is not None:
+                for k, r in enumerate(rows):
+                    block[bi, k] = f.row_dense(r)
+        return block
+
+    def _transient_matrix(self, index, frame, view, chunk_slices, rows_sorted):
+        """One slice chunk's [len(chunk), len(rows), W] matrix, built
+        host-side and moved in a single transfer; NOT cached — streaming
+        shapes would evict every steady-state pool for nothing."""
+        return self.engine.matrix(
+            self._densify_block(index, frame, view, chunk_slices, rows_sorted)
+        )
 
     # Transient-HBM budget for the unpacked int8 bit matrix a Gram build
     # streams through the MXU (ops/dispatch.py uses the same bound).
@@ -860,8 +969,18 @@ class Executor:
         if gram is not None:
             return gram
         shape = getattr(matrix, "shape", None)
+        if not shape:
+            return None
+        # Pool matrices carry free capacity slots past n_used; the Gram
+        # only needs the occupied slot range (power-of-two bucketed so the
+        # matmul shape stays jit-stable).  Slot ids in id_pos are all
+        # < n_used, so a gram over the truncated matrix answers every pair.
+        n_used = box.get("n_used", shape[1])
+        bucket = min(shape[1], 1 << max(0, (n_used - 1)).bit_length()) if n_used else 0
+        if bucket == 0:
+            return None
         # Unpacked int8 bits are 32 bytes per uint32 word.
-        if not shape or shape[0] * shape[1] * shape[2] * 32 > self._GRAM_BYTES_BUDGET:
+        if shape[0] * bucket * shape[2] * 32 > self._GRAM_BYTES_BUDGET:
             return None
         mu = box.get("mu")
         if mu is None or not mu.acquire(blocking=False):
@@ -871,7 +990,8 @@ class Executor:
         try:
             gram = box.get("gram")
             if gram is None:
-                gram = self.engine.pair_gram(matrix)
+                m = matrix if bucket == shape[1] else matrix[:, :bucket, :]
+                gram = self.engine.pair_gram(m)
                 if gram is None:
                     box["hits"] = -(1 << 30)  # engine can't: stop re-checking
                     return None
@@ -880,101 +1000,54 @@ class Executor:
         finally:
             mu.release()
 
+    def _pool_for(
+        self, index: str, frame: str, view: str, slices, lane: str = ""
+    ) -> "DeviceRowPool":
+        """The paged device row pool for one (frame, view, slice batch).
+
+        Pools live in the same small LRU the old fixed matrices did; each
+        is bounded by the PILOSA_TPU_POOL_BYTES HBM budget and pages rows
+        in/out on demand (rowpool.DeviceRowPool) — the row-count ceiling
+        of the old design is gone.  ``lane`` separates workloads with
+        different paging patterns (TopN candidate streams vs fused count
+        working sets) so one can't evict the other's residency.
+        """
+        key = (index, frame, view, tuple(slices), lane)
+        with self._matrix_mu:
+            pool = self._matrix_cache.get(key)
+            if pool is None:
+
+                def fetch(row_ids, slice_idxs, _key=key):
+                    # Re-resolves fragments per fetch (they may be created
+                    # by a first write after the pool exists).
+                    idx_n, frame_n, view_n, slc, _lane = _key
+                    return self._densify_block(
+                        idx_n, frame_n, view_n, [slc[si] for si in slice_idxs], row_ids
+                    )
+
+                pool = DeviceRowPool(self.engine, len(slices), _WORDS, fetch)
+                self._matrix_cache[key] = pool
+            self._matrix_cache.move_to_end(key)
+            while len(self._matrix_cache) > self._matrix_cache_entries:
+                self._matrix_cache.popitem(last=False)
+        return pool
+
     def _frame_matrix(
         self, index: str, frame: str, slices, want: set[int], view: str = VIEW_STANDARD
     ) -> tuple[dict[int, int], object, Optional[dict]]:
-        """Assembled engine row matrix [n_slices, n_rows, W] for a frame view.
+        """Device row matrix holding (at least) ``want`` for a frame view.
 
-        Cached across requests keyed by (index, frame, view, slices) and
-        validated against the fragments' write generations; a cache hit
-        whose row set covers ``want`` is returned as-is, so steady-state
-        fused queries re-use HBM-resident rows.  On miss the matrix is
-        assembled HOST-side and moved in one engine.matrix transfer
-        (per-row device stacking costs one dispatch per row).  Generations
-        are read BEFORE the rows: a concurrent mutation mid-assembly can
-        only make the recorded generations stale, forcing a rebuild next
+        Pool-backed: rows page into HBM slots on demand and stay resident
+        across requests; the returned id_pos maps every RESIDENT row to
+        its slot in the returned (immutable) matrix snapshot.  Generations
+        are read BEFORE acquire: a concurrent mutation mid-fetch can only
+        make the recorded generations stale, forcing a refresh next
         request — never a stale hit.
         """
-        key = (index, frame, view, tuple(slices))
         frags = [self.holder.fragment(index, frame, view, s) for s in slices]
         gens = tuple(-1 if f is None else f.generation for f in frags)
-        with self._matrix_mu:
-            hit = self._matrix_cache.get(key)
-            if hit is not None:
-                old_gens, old_id_pos, old_matrix, old_box = hit
-                stale = [si for si in range(len(slices)) if old_gens[si] != gens[si]]
-                covered = want <= old_id_pos.keys()
-                if not stale and covered:
-                    self._matrix_cache.move_to_end(key)
-                    old_box["hits"] = old_box.get("hits", 0) + 1
-                    return old_id_pos, old_matrix, old_box
-            else:
-                old_gens = old_id_pos = old_matrix = None
-
-        def densify(f, row_ids):
-            block = np.zeros((len(row_ids), _WORDS), dtype=np.uint32)
-            if f is not None:
-                for k, r in enumerate(row_ids):
-                    block[k] = f.row_dense(r)
-            return block
-
-        # Incremental refresh paths: a cached matrix is only patched, never
-        # rebuilt, when (a) writes touched a subset of slices (stale slice
-        # planes re-densified and scattered in place — one SetBit no longer
-        # costs a full matrix re-upload) and/or (b) the request references
-        # new rows (appended as a device-side concat).  Generations were
-        # read BEFORE any rows, so a concurrent mutation mid-refresh can
-        # only make the stored generations stale — never a stale hit.
-        if old_id_pos is not None:
-            ordered = sorted(old_id_pos, key=old_id_pos.get)
-            new_rows = sorted(want - old_id_pos.keys())
-            if len(ordered) + len(new_rows) <= self._matrix_rows_max:
-                matrix = old_matrix
-                if stale:
-                    planes = np.stack([densify(frags[si], ordered) for si in stale])
-                    matrix = self.engine.update_slices(matrix, stale, planes)
-                if new_rows:
-                    block = np.stack([densify(f, new_rows) for f in frags])
-                    matrix = self.engine.append_rows(matrix, block)
-                id_pos = dict(old_id_pos)
-                for r in new_rows:
-                    id_pos[r] = len(id_pos)
-                # Fresh box: a patched/extended matrix invalidates any Gram
-                # (this path always changed something — an unchanged covered
-                # hit returned above).
-                box = {"hits": 1, "mu": threading.Lock()}
-                with self._matrix_mu:
-                    self._matrix_cache[key] = (gens, id_pos, matrix, box)
-                    self._matrix_cache.move_to_end(key)
-                    while len(self._matrix_cache) > self._matrix_cache_entries:
-                        self._matrix_cache.popitem(last=False)
-                return id_pos, matrix, box
-
-        # Full build.  Oversized row sets are served but never cached: one
-        # giant request must not pin rows_max-violating HBM in the LRU.
-        # Likewise a build that only happened because old rows + new rows
-        # exceeded the budget must NOT replace a still-valid LARGER entry
-        # (generations unchanged) — evicting it would force the other
-        # lane (fused Counts and their Gram) to re-upload everything on
-        # its next query, ping-ponging the cache.
-        rows = sorted(want)
-        id_pos = {r: k for k, r in enumerate(rows)}
-        host = np.stack([densify(f, rows) for f in frags]) if rows else np.zeros(
-            (len(slices), 0, _WORDS), dtype=np.uint32
-        )
-        matrix = self.engine.matrix(host)
-        preserve_old = (
-            old_id_pos is not None and not stale and len(old_id_pos) > len(rows)
-        )
-        if len(rows) <= self._matrix_rows_max and not preserve_old:
-            box = {"hits": 1, "mu": threading.Lock()}
-            with self._matrix_mu:
-                self._matrix_cache[key] = (gens, id_pos, matrix, box)
-                self._matrix_cache.move_to_end(key)
-                while len(self._matrix_cache) > self._matrix_cache_entries:
-                    self._matrix_cache.popitem(last=False)
-            return id_pos, matrix, box
-        return id_pos, matrix, None
+        pool = self._pool_for(index, frame, view, slices)
+        return pool.acquire(sorted(want), gens)
 
     # -- call dispatch (executor.go:156-179) ------------------------------
 
@@ -1223,16 +1296,15 @@ class Executor:
 
         The reference scores candidates with a per-row scalar loop
         (fragment.go:553-560); here each candidate chunk is one fused
-        device dispatch against the SAME generation-cached multi-slice
-        row matrix the fused Count lane uses (one cache entry for the
-        whole query, not one per slice -- per-slice keys would thrash the
-        small matrix LRU and evict the Count lane's Gram).  Chunks are
+        device dispatch against a paged device row pool.  The pool lives
+        on its OWN lane key ("topn") so streaming tens of thousands of
+        candidates through HBM pages against the scorer's slots without
+        evicting the fused Count lane's hot rows or its Gram.  Chunks are
         padded to the fragment scoring chunk so jitted shapes never vary.
-        A scorer returns None -- "score it yourself" -- once the
-        accumulated candidate set would exceed the matrix row budget
-        (the cache would thrash with rebuild-per-chunk uploads), and the
-        factory hands out None on the numpy engine (the fragment's host
-        path is the same math without an engine round trip).
+        Unbounded candidate sets just page (rank-cache scale included);
+        the only host fallback left is an engine that can't score rows
+        (numpy: the fragment's host path is the same math without an
+        engine round trip) or a pool too small for even one chunk.
         """
         if (
             src_batch is None
@@ -1242,35 +1314,23 @@ class Executor:
             return lambda si, src_dense: None
         from pilosa_tpu.core.fragment import TOPN_SCORE_CHUNK
 
-        state = {"src_dev": {}, "seen": set(), "host": False, "base": None}
+        state = {"src_dev": {}}
         all_slices = list(slices)
+        pool = self._pool_for(index, frame_name, VIEW_STANDARD, all_slices, lane="topn")
+        if pool.cap_max < TOPN_SCORE_CHUNK:
+            return lambda si, src_dense: None  # can't hold one chunk
 
         def scorer_for(si: int, src_dense):
             if src_dense is None:
                 return None
 
             def score(ids):
-                state["seen"].update(ids)
-                if state["base"] is None:
-                    # Rows already resident in the shared cache entry count
-                    # against the budget too: growing past rows_max would
-                    # evict the Count lane's larger matrix (+ Gram) and
-                    # ping-pong the cache.  Conservative (overlap with the
-                    # candidate set double-counts) — worst case is an early
-                    # host fallback, never thrash.
-                    key = (index, frame_name, VIEW_STANDARD, tuple(all_slices))
-                    with self._matrix_mu:
-                        hit = self._matrix_cache.get(key)
-                        state["base"] = len(hit[1]) if hit is not None else 0
-                if (
-                    state["host"]
-                    or state["base"] + len(state["seen"]) > self._matrix_rows_max
-                ):
-                    state["host"] = True
-                    return None  # fragment scores this chunk host-side
-                id_pos, matrix, _ = self._frame_matrix(
-                    index, frame_name, all_slices, set(ids)
-                )
+                frags = [
+                    self.holder.fragment(index, frame_name, VIEW_STANDARD, s)
+                    for s in all_slices
+                ]
+                gens = tuple(-1 if f is None else f.generation for f in frags)
+                id_pos, matrix, _ = pool.acquire(sorted(set(ids)), gens)
                 n = len(ids)
                 padded = (
                     list(ids) + [ids[0]] * (TOPN_SCORE_CHUNK - n)
@@ -1414,14 +1474,31 @@ class Executor:
         call).
         """
         slices = list(slices or [])
+
+        def local_chunked(node_slices):
+            # Slice-axis chunking for LOCAL evaluation: an index bigger
+            # than device memory executes as a sequence of bounded slice
+            # batches folded through reduce_fn (reduce identities hold:
+            # int sum, segment merge, Pairs.Add are all zero-safe).  The
+            # reference's per-slice goroutine loop has no size limit
+            # either (executor.go:1115-1244); this is its bounded-memory
+            # analog.
+            chunk = int(os.environ.get("PILOSA_TPU_SLICE_CHUNK", "2048"))
+            if len(node_slices) <= chunk:
+                return local_map(node_slices)
+            result = zero
+            for i in range(0, len(node_slices), chunk):
+                result = reduce_fn(result, local_map(node_slices[i : i + chunk]))
+            return result
+
         if self.cluster is None or opt.remote or self.client_factory is None:
-            return reduce_fn(zero, local_map(slices))
+            return reduce_fn(zero, local_chunked(slices))
 
         import concurrent.futures
 
         def run_node(node, node_slices):
             if node.host == self.host:
-                return local_map(node_slices)
+                return local_chunked(node_slices)
             client = self.client_factory(node.host)
             if remote_map is not None:
                 return remote_map(client, node_slices)

@@ -460,12 +460,13 @@ def test_fused_matrix_incremental_refresh(tmp_path, engine):
     h.close()
 
 
-def test_fused_matrix_oversized_not_cached(env):
-    """A single request whose row set exceeds the cap is served but must
-    not pin an oversized matrix in the LRU cache."""
+def test_fused_batch_pages_past_pool_capacity(env):
+    """A request whose unique row set exceeds the pool capacity is served
+    by CHUNKING the batch and paging rows through the device pool (the
+    old design fell back to an uncached one-shot matrix; the row ceiling
+    is gone)."""
     h, e = env
     fr = h.index("i").frame("general")
-    e._matrix_rows_max = 4
     for r in range(8):
         fr.set_bit("standard", r, r)
         fr.set_bit("standard", r, 100)
@@ -473,15 +474,23 @@ def test_fused_matrix_oversized_not_cached(env):
         f'Count(Intersect(Bitmap(rowID={r}, frame="general"), Bitmap(rowID={(r + 1) % 8}, frame="general")))'
         for r in range(8)
     )
+    pool = e._pool_for("i", "general", "standard", [0])
+    pool.cap_max = 4  # force the paging regime for this 8-row batch
     assert e.execute("i", q) == [1] * 8
-    assert len(e._matrix_cache) == 0  # oversized -> not cached
-    # A small request afterwards is cached as usual.
+    assert pool.stat_evictions > 0  # rows actually paged out and back
+    assert pool.cap <= 4
+    # Repeat request stays correct while still paging.
+    assert e.execute("i", q) == [1] * 8
+    # A small request afterwards is served resident (no new evictions
+    # once its rows are in).
     small = (
         'Count(Intersect(Bitmap(rowID=0, frame="general"), Bitmap(rowID=1, frame="general"))) '
         'Count(Intersect(Bitmap(rowID=2, frame="general"), Bitmap(rowID=3, frame="general")))'
     )
     assert e.execute("i", small) == [1, 1]
-    assert len(e._matrix_cache) == 1
+    ev = pool.stat_evictions
+    assert e.execute("i", small) == [1, 1]
+    assert pool.stat_evictions == ev
 
 
 def test_fused_batch_distributed_one_request_per_node(tmp_path):
@@ -559,11 +568,11 @@ def test_fused_gram_upgrade_and_invalidation(tmp_path):
         'Count(Union(Bitmap(rowID=2, frame="f"), Bitmap(rowID=3, frame="f")))'
     )
     first = e.execute("i", q)
-    boxes = [entry[3] for entry in e._matrix_cache.values()]
+    boxes = [pool.box for pool in e._matrix_cache.values()]
     assert boxes and all("gram" not in b for b in boxes)  # cold: direct kernels
     second = e.execute("i", q)
     assert second == first
-    boxes = [entry[3] for entry in e._matrix_cache.values()]
+    boxes = [pool.box for pool in e._matrix_cache.values()]
     assert any("gram" in b for b in boxes)  # upgraded on 2nd hit
     third = e.execute("i", q)  # served from Gram lookups
     assert third == first
@@ -887,10 +896,10 @@ def test_topn_scorer_budget_crossover_parity(tmp_path):
     h.close()
 
 
-def test_topn_does_not_evict_count_lane_matrix(tmp_path):
-    """A TopN whose candidates would overflow the shared matrix entry
-    must not replace the Count lane's larger still-valid matrix
-    (regression: rebuild ping-pong on alternating TopN/Count traffic)."""
+def test_topn_does_not_evict_count_lane_pool(tmp_path):
+    """TopN candidate streaming pages through its OWN pool lane, leaving
+    the Count lane's pool residency and Gram untouched (regression:
+    alternating TopN/Count traffic must not ping-pong either lane)."""
     h = Holder(str(tmp_path / "data"))
     h.open()
     idx = h.create_index("i")
@@ -905,27 +914,27 @@ def test_topn_does_not_evict_count_lane_matrix(tmp_path):
         cols.extend(rng.choice(SLICE_WIDTH, size=n_bits, replace=False).tolist())
     fr.import_bits(rows, cols)
     e = Executor(h, engine="jax")
-    e._matrix_rows_max = 24
     for c in range(0, 500, 2):
         e.execute("i", f'SetBit(rowID=5, frame="f", columnID={c})')
-    # Count lane populates the shared entry with 20 rows.
+    # Count lane populates its pool with 20 rows (and a Gram on repeat).
     pair_q = " ".join(
         f'Count(Intersect(Bitmap(rowID={i}, frame="r"), Bitmap(rowID={i+1}, frame="r")))'
         for i in range(0, 20, 2)
     )
     want_counts = e.execute("i", pair_q)
-    key = ("i", "r", "standard", (0,))
-    gens0, id_pos0, _, _ = e._matrix_cache[key]
-    n0 = len(id_pos0)
+    assert e.execute("i", pair_q) == want_counts  # builds the Gram
+    count_pool = e._pool_for("i", "r", "standard", [0])
+    box0 = count_pool.box
+    n0 = len(count_pool.slot_of)
     assert n0 >= 10
-    # TopN over 30 candidates: 20 resident + 30 seen > 24 budget -> the
-    # scorer must decline (host path) and leave the entry untouched.
+    # TopN over 30 candidates pages through the "topn" lane only.
     topn_q = 'TopN(Bitmap(rowID=5, frame="f"), frame="r", n=5)'
     got_np = [(p.id, p.count) for p in Executor(h, engine="numpy").execute("i", topn_q)[0]]
     got = [(p.id, p.count) for p in e.execute("i", topn_q)[0]]
     assert got == got_np
-    gens1, id_pos1, _, _ = e._matrix_cache[key]
-    assert gens1 == gens0 and len(id_pos1) == n0  # entry preserved
+    assert e._pool_for("i", "r", "standard", [0], lane="topn") is not count_pool
+    assert count_pool.box is box0  # count lane box (and Gram) untouched
+    assert len(count_pool.slot_of) == n0  # residency preserved
     assert e.execute("i", pair_q) == want_counts  # still served correctly
     h.close()
 
@@ -966,4 +975,110 @@ def test_count_multi_operand_batch_fusion(tmp_path, engine):
     fr.set_bit("standard", 2, 999_999)
     after = e.execute("i", " ".join(calls))
     assert after[0] == before[0] + 1  # 3-way intersect gained the bit
+    h.close()
+
+
+@pytest.mark.parametrize("engine", ["numpy", "jax"])
+def test_fused_batch_slice_streaming(tmp_path, monkeypatch, engine):
+    """When the working set exceeds the HBM pool budget, fused count
+    batches stream the SLICE axis: transient per-chunk matrices,
+    accumulated counts — identical results to sequential execution.
+    Tiny budgets force the regime on a small index."""
+    monkeypatch.setenv("PILOSA_TPU_POOL_BYTES", str(1 << 20))
+    monkeypatch.setenv("PILOSA_TPU_STREAM_BYTES", str(1 << 20))
+    h = Holder(str(tmp_path / "data"))
+    h.open()
+    idx = h.create_index("i")
+    idx.create_frame("f", FrameOptions())
+    fr = idx.frame("f")
+    rng = np.random.default_rng(9)
+    n_slices, n_rows = 4, 8
+    rows = rng.integers(0, n_rows, size=3000).astype(np.uint64)
+    cols = rng.integers(0, n_slices * SLICE_WIDTH, size=3000).astype(np.uint64)
+    fr.import_bits(rows, cols)
+    e = Executor(h, engine=engine)
+    pool = e._pool_for("i", "f", "standard", list(range(n_slices)))
+    assert pool.cap_max < n_rows  # proves the streaming regime is forced
+    pairs = rng.integers(0, n_rows, size=(24, 2))
+    q = " ".join(
+        f'Count(Intersect(Bitmap(rowID={a}, frame="f"), Bitmap(rowID={b}, frame="f")))'
+        for a, b in pairs
+    ) + (
+        # Mixed arity in the same batch: a 3-operand union streams too.
+        ' Count(Union(Bitmap(rowID=0, frame="f"), Bitmap(rowID=1, frame="f"),'
+        ' Bitmap(rowID=2, frame="f")))'
+    )
+    got = e.execute("i", q)
+    # Ground truth: one call at a time (no fusion possible).
+    e_seq = Executor(h, engine="numpy")
+    want = [
+        e_seq.execute(
+            "i",
+            f'Count(Intersect(Bitmap(rowID={a}, frame="f"), Bitmap(rowID={b}, frame="f")))',
+        )[0]
+        for a, b in pairs
+    ] + [
+        e_seq.execute(
+            "i",
+            'Count(Union(Bitmap(rowID=0, frame="f"), Bitmap(rowID=1, frame="f"),'
+            ' Bitmap(rowID=2, frame="f")))',
+        )[0]
+    ]
+    assert got == want
+    h.close()
+
+
+def test_map_reduce_slice_chunking(tmp_path, monkeypatch):
+    """Non-fused calls fold local slice chunks through reduce_fn — a
+    Count/Bitmap/TopN over many slices never materializes them all at
+    once, and results match the unchunked evaluation."""
+    monkeypatch.setenv("PILOSA_TPU_SLICE_CHUNK", "3")
+    h = Holder(str(tmp_path / "data"))
+    h.open()
+    idx = h.create_index("i")
+    idx.create_frame("f", FrameOptions(cache_type="ranked"))
+    fr = idx.frame("f")
+    rng = np.random.default_rng(10)
+    n_slices = 10
+    rows = rng.integers(0, 5, size=2000).astype(np.uint64)
+    cols = rng.integers(0, n_slices * SLICE_WIDTH, size=2000).astype(np.uint64)
+    fr.import_bits(rows, cols)
+    e = Executor(h, engine="numpy")
+    got_count = e.execute(
+        "i", 'Count(Union(Bitmap(rowID=0, frame="f"), Bitmap(rowID=1, frame="f")))'
+    )
+    got_bits = e.execute("i", 'Bitmap(rowID=2, frame="f")')[0].bits()
+    got_top = [(p.id, p.count) for p in e.execute("i", 'TopN(frame="f", n=3)')[0]]
+    monkeypatch.setenv("PILOSA_TPU_SLICE_CHUNK", "2048")
+    e2 = Executor(h, engine="numpy")
+    assert got_count == e2.execute(
+        "i", 'Count(Union(Bitmap(rowID=0, frame="f"), Bitmap(rowID=1, frame="f")))'
+    )
+    assert got_bits == e2.execute("i", 'Bitmap(rowID=2, frame="f")')[0].bits()
+    assert got_top == [(p.id, p.count) for p in e2.execute("i", 'TopN(frame="f", n=3)')[0]]
+    h.close()
+
+
+@pytest.mark.parametrize("engine", ["numpy", "jax"])
+def test_single_wide_count_streams_instead_of_raising(tmp_path, monkeypatch, engine):
+    """One Count(Union(...)) whose operand rows exceed the pool row cap
+    must stream the slice axis, not fail the request."""
+    monkeypatch.setenv("PILOSA_TPU_POOL_BYTES", str(1 << 20))
+    monkeypatch.setenv("PILOSA_TPU_STREAM_BYTES", str(1 << 21))
+    h = Holder(str(tmp_path / "data"))
+    h.open()
+    idx = h.create_index("i")
+    idx.create_frame("f", FrameOptions())
+    fr = idx.frame("f")
+    n_rows = 10
+    for r in range(n_rows):
+        fr.set_bit("standard", r, r)
+        fr.set_bit("standard", r, SLICE_WIDTH + 2 * r)
+    e = Executor(h, engine=engine)
+    pool = e._pool_for("i", "f", "standard", [0, 1])
+    assert pool.cap_max < n_rows
+    operands = ", ".join(f'Bitmap(rowID={r}, frame="f")' for r in range(n_rows))
+    # Two fusable calls so the fused lane (not the sequential path) runs.
+    q = f"Count(Union({operands})) Count(Union({operands}))"
+    assert e.execute("i", q) == [2 * n_rows, 2 * n_rows]
     h.close()

@@ -1,0 +1,192 @@
+"""Unit tests for the paged device row pool (rowpool.py).
+
+The pool is the round-2 replacement for the fixed row-matrix cache: rows
+page in on demand, LRU rows page out, capacity doubles up to a budget.
+Ground truth is a plain dict of host rows; the pool must agree under
+arbitrary interleavings of acquire / mutation (generation bumps).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.engine import NumpyEngine
+from pilosa_tpu.rowpool import DeviceRowPool, chunk_queries, pool_capacity
+
+W = 16  # small word count: pool logic is W-agnostic
+
+
+def make_pool(n_slices=2, cap_max=8, rows=None, fetch_log=None):
+    rows = rows if rows is not None else {}
+
+    def fetch(row_ids, slice_idxs):
+        if fetch_log is not None:
+            fetch_log.append((tuple(row_ids), tuple(slice_idxs)))
+        block = np.zeros((len(slice_idxs), len(row_ids), W), dtype=np.uint32)
+        for bi, si in enumerate(slice_idxs):
+            for k, r in enumerate(row_ids):
+                block[bi, k] = rows.get((si, r), np.zeros(W, np.uint32))
+        return block
+
+    return DeviceRowPool(NumpyEngine(), n_slices, W, fetch, cap_max=cap_max), rows
+
+
+def fill_rows(rng, n_slices, row_ids):
+    return {
+        (si, r): rng.integers(0, 1 << 32, size=W, dtype=np.uint32)
+        for si in range(n_slices)
+        for r in row_ids
+    }
+
+
+def check(pool, rows, want, gens):
+    id_pos, matrix, box = pool.acquire(want, gens)
+    for r in want:
+        for si in range(pool.n_slices):
+            np.testing.assert_array_equal(
+                matrix[si, id_pos[r]], rows.get((si, r), np.zeros(W, np.uint32))
+            )
+    return id_pos, matrix, box
+
+
+def test_grow_and_hit():
+    rng = np.random.default_rng(1)
+    rows = fill_rows(rng, 2, range(10))
+    pool, _ = make_pool(rows=rows, cap_max=16)
+    g = (1, 1)
+    check(pool, rows, [0, 1], g)
+    assert pool.cap == 2
+    check(pool, rows, [2, 3, 4], g)
+    assert pool.cap == 8  # doubled past 5 -> pow2
+    # Pure hit: box persists, hits climb.
+    _, _, box = pool.acquire([0, 4], g)
+    hits = box["hits"]
+    _, _, box2 = pool.acquire([1, 2], g)
+    assert box2 is box and box2["hits"] == hits + 1
+    assert pool.stat_evictions == 0
+
+
+def test_eviction_lru_order():
+    rng = np.random.default_rng(2)
+    rows = fill_rows(rng, 2, range(20))
+    pool, _ = make_pool(rows=rows, cap_max=4)
+    g = (1, 1)
+    check(pool, rows, [0, 1, 2, 3], g)
+    check(pool, rows, [0, 1], g)  # refresh 0,1 in LRU
+    check(pool, rows, [4], g)  # evicts 2 (least recent)
+    assert 2 not in pool.slot_of and 4 in pool.slot_of
+    assert pool.stat_evictions == 1
+    # Evicted row pages back in correctly.
+    check(pool, rows, [2], g)
+    assert 3 not in pool.slot_of  # 3 was next-least-recent
+    # The request's own rows are never chosen as victims.
+    check(pool, rows, [5, 6, 7, 8], g)
+    assert all(r in pool.slot_of for r in (5, 6, 7, 8))
+
+
+def test_acquire_too_large_raises():
+    pool, _ = make_pool(cap_max=4)
+    with pytest.raises(ValueError, match="chunk the batch"):
+        pool.acquire(list(range(5)), (1, 1))
+
+
+def test_snapshot_isolation_across_eviction():
+    """A reader's (id_pos, matrix) snapshot stays valid after later
+    acquires evict its rows (functional updates: new array each time)."""
+    rng = np.random.default_rng(3)
+    rows = fill_rows(rng, 2, range(8))
+    pool, _ = make_pool(rows=rows, cap_max=4)
+    g = (1, 1)
+    id_pos, matrix, _ = pool.acquire([0, 1, 2, 3], g)
+    snap = {r: (id_pos[r], np.array([matrix[si, id_pos[r]] for si in range(2)])) for r in (0, 1)}
+    pool.acquire([4, 5, 6], g)  # evicts some of 0..3
+    for r, (slot, want_rows) in snap.items():
+        for si in range(2):
+            np.testing.assert_array_equal(matrix[si, slot], want_rows[si])
+
+
+def test_stale_slice_plane_refresh():
+    rng = np.random.default_rng(4)
+    rows = fill_rows(rng, 3, range(6))
+    pool, live = make_pool(n_slices=3, rows=rows, cap_max=8)
+    g1 = (1, 1, 1)
+    check(pool, rows, [0, 1, 2], g1)
+    box1 = pool.box
+    # Mutate slice 1's data for rows 0 and 5; bump slice 1's generation.
+    live[(1, 0)] = rng.integers(0, 1 << 32, size=W, dtype=np.uint32)
+    g2 = (1, 2, 1)
+    id_pos, matrix, box2 = check(pool, rows, [0, 1], g2)
+    assert box2 is not box1  # content changed -> fresh box (Gram dies)
+    # Unchanged slices kept their planes; changed slice reflects new data.
+    np.testing.assert_array_equal(matrix[1, id_pos[0]], live[(1, 0)])
+    assert pool.stat_resets == 0
+
+
+def test_stale_refresh_over_budget_resets(monkeypatch):
+    monkeypatch.setenv("PILOSA_TPU_POOL_REFRESH_BYTES", "8")  # force reset
+    rng = np.random.default_rng(5)
+    rows = fill_rows(rng, 2, range(6))
+    pool, live = make_pool(rows=rows, cap_max=8)
+    check(pool, rows, [0, 1, 2], (1, 1))
+    live[(0, 1)] = rng.integers(0, 1 << 32, size=W, dtype=np.uint32)
+    check(pool, rows, [0, 1, 2], (2, 1))
+    assert pool.stat_resets == 1  # repopulated on demand, still correct
+
+
+def test_box_id_pos_is_full_resident_snapshot():
+    rng = np.random.default_rng(6)
+    rows = fill_rows(rng, 2, range(6))
+    pool, _ = make_pool(rows=rows, cap_max=8)
+    g = (1, 1)
+    pool.acquire([0, 1, 2], g)
+    id_pos, _, box = pool.acquire([1], g)
+    assert set(id_pos) == {0, 1, 2}  # full resident set, not just want
+    assert box["n_used"] == 3
+
+
+def test_fifty_thousand_rows_page_through_small_pool():
+    """Rank-cache scale (DefaultCacheSize=50000, frame.go:33-40): 50k
+    distinct rows stream through a 512-slot pool; counts stay exact."""
+    pool, rows = make_pool(n_slices=1, cap_max=512)
+    # Virtual rows: row r has word pattern r (cheap, deterministic).
+    def fetch(row_ids, slice_idxs):
+        block = np.zeros((len(slice_idxs), len(row_ids), W), dtype=np.uint32)
+        for k, r in enumerate(row_ids):
+            block[:, k, :] = np.uint32(r)
+        return block
+
+    pool.fetch = fetch
+    g = (1,)
+    rng = np.random.default_rng(7)
+    seen = 0
+    for _ in range(100):
+        want = sorted(set(rng.integers(0, 50000, size=256).tolist()))
+        id_pos, matrix, _ = pool.acquire(want, g)
+        sample = want[:: max(1, len(want) // 8)]
+        for r in sample:
+            assert int(matrix[0, id_pos[r], 0]) == r
+        seen += len(want)
+    assert pool.cap <= 512
+    assert pool.stat_evictions > 20000  # genuinely paged, not grown
+
+
+def test_chunk_queries():
+    qs = [(0, 1), (1, 2), (3, 4), (5, 6), (0, 5)]
+    chunks = chunk_queries(qs, lambda q: q, 4)
+    assert [len(c) for c in chunks] == [2, 2, 1]
+    assert sum(chunks, []) == qs  # order preserved
+    with pytest.raises(ValueError):
+        chunk_queries([(0, 1, 2)], lambda q: q, 2)
+    assert chunk_queries([], lambda q: q, 4) == []
+
+
+def test_pool_capacity_budget():
+    assert pool_capacity(16, 32768, budget_bytes=2 << 30) == 1024
+    assert pool_capacity(1024, 32768, budget_bytes=2 << 30) == 16
+
+
+def test_chunk_queries_oversize_ok():
+    qs = [(0, 1), tuple(range(10)), (2, 3)]
+    chunks = chunk_queries(qs, lambda q: q, 4, oversize_ok=True)
+    assert chunks == [[(0, 1)], [tuple(range(10))], [(2, 3)]]
