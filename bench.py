@@ -57,29 +57,68 @@ def _best_of_runs(fn, default_runs=5):
 
 
 def bench_setbit() -> dict:
-    """Config 2: SetBit op/sec through the fragment write path (the
-    `pilosa bench --operation set-bit` analog, ctl/bench.go:71-102)."""
+    """Config 2: SetBit op/sec (the `pilosa bench --operation set-bit`
+    analog, ctl/bench.go:71-102).  Reports the CONCURRENT server ingest
+    shape as the headline — singleton SetBit requests from BENCH_THREADS
+    clients group-committing through the write queue (executor ->
+    vectorized fragment batches + one WAL append per commit) — with the
+    sequential per-op-durable fragment rate in the unit string for
+    apples-to-apples against the reference's single client."""
     n = int(os.environ.get("BENCH_OPS", "20000"))
+    n_threads = int(os.environ.get("BENCH_THREADS", "8"))
     import tempfile
+    from concurrent.futures import ThreadPoolExecutor
 
     from pilosa_tpu.core.fragment import Fragment
+    from pilosa_tpu.core.frame import FrameOptions
+    from pilosa_tpu.core.holder import Holder
+    from pilosa_tpu.executor import Executor
 
     rng = np.random.default_rng(7)
     rows = rng.integers(0, 1000, size=n)
     cols = rng.integers(0, 1 << 20, size=n)
+
+    # (a) sequential fragment loop, per-op durability (reference shape).
     with tempfile.TemporaryDirectory() as d:
         f = Fragment(os.path.join(d, "frag"), "i", "f", "standard", 0)
         f.open()
         t0 = time.perf_counter()
         for r, c in zip(rows.tolist(), cols.tolist()):
             f.set_bit(r, c)
-        dt = time.perf_counter() - t0
+        seq_dt = time.perf_counter() - t0
         f.close()
+
+    # (b) concurrent singleton requests through the ingest queue: each
+    # client thread issues one PQL SetBit request at a time and waits for
+    # its durable ack (exactly the threaded-HTTP-server shape, minus HTTP).
+    with tempfile.TemporaryDirectory() as d:
+        h = Holder(d)
+        h.open()
+        h.create_index("b").create_frame("f", FrameOptions())
+        ex = Executor(h, engine="numpy", write_queue=True)
+        queries = [
+            f'SetBit(rowID={r}, frame="f", columnID={c})'
+            for r, c in zip(rows.tolist(), cols.tolist())
+        ]
+        ex.execute("b", queries[0])  # warm (frame/fragment creation)
+        t0 = time.perf_counter()
+        with ThreadPoolExecutor(n_threads) as pool:
+            for _ in pool.map(lambda q: ex.execute("b", q), queries[1:]):
+                pass
+        q_dt = time.perf_counter() - t0
+        wq = ex._write_queue
+        mean_batch = wq.stat_items / max(1, wq.stat_batches)
+        h.close()
+    q_ops = (n - 1) / q_dt
     return {
         "metric": "setbit_ops_per_sec",
-        "value": round(n / dt, 1),
-        "unit": "SetBit/sec (single fragment, WAL on)",
-        "vs_baseline": 1.0,  # host-side path; no device analog
+        "value": round(q_ops, 1),
+        "unit": (
+            f"SetBit/sec ({n_threads} concurrent clients, group-commit queue, "
+            f"mean batch {mean_batch:.0f}; sequential per-op-durable fragment "
+            f"rate {n / seq_dt:,.0f}/s)"
+        ),
+        "vs_baseline": round(q_ops / (n / seq_dt), 2),
     }
 
 
@@ -463,6 +502,211 @@ def bench_range_executor() -> dict:
     }
 
 
+# v5e single-chip HBM bandwidth roofline (bytes/sec) for bandwidth_util
+# accounting; override for other parts (v4: ~1.2e12, v5p: ~2.8e12).
+HBM_ROOFLINE = float(os.environ.get("BENCH_HBM_ROOFLINE", str(819e9)))
+
+
+def bench_intersect_stream() -> dict:
+    """Headline shape PAST device memory: the slice axis streams through
+    HBM in chunks (the executor's slice-streaming regime, made measurable
+    in isolation).  Default 2048 slices x 64 rows = ~17 GB of packed
+    bitmaps — larger than one v5e chip's HBM — answered for a whole query
+    stream per pass: each chunk uploads once and serves EVERY query's
+    partial counts before the next chunk replaces it (double-buffered
+    device_put so upload k+1 overlaps compute k).
+
+    Throughput is expected to be upload-bound: the interesting outputs
+    are qps AND the effective host->device bandwidth; on a tunneled TPU
+    the bandwidth number IS the tunnel, which the unit string flags.
+    """
+    n_slices = int(os.environ.get("BENCH_SLICES", "2048"))
+    n_rows = int(os.environ.get("BENCH_ROWS", "64"))
+    batch = int(os.environ.get("BENCH_BATCH", "256"))
+    iters = int(os.environ.get("BENCH_ITERS", "32"))
+    chunk_slices = int(os.environ.get("BENCH_CHUNK_SLICES", "256"))
+
+    import jax
+    from jax import lax
+
+    from pilosa_tpu.ops import dispatch
+    from pilosa_tpu.ops.bitwise import WORDS_PER_SLICE
+
+    W = WORDS_PER_SLICE
+    rng = np.random.default_rng(42)
+    # One host buffer per chunk, filled once (host RAM holds the whole
+    # index; the DEVICE never holds more than two chunks).
+    n_chunks = (n_slices + chunk_slices - 1) // chunk_slices
+    chunks = [
+        rng.integers(0, 1 << 32, size=(chunk_slices, n_rows, W), dtype=np.uint32)
+        for _ in range(n_chunks)
+    ]
+    all_pairs = rng.integers(0, n_rows, size=(iters, batch, 2), dtype=np.int32)
+    dpairs = jax.device_put(all_pairs)
+
+    @jax.jit
+    def chunk_counts(rm, pairs_stream):
+        def step(carry, prs):
+            return carry, dispatch.gather_count("and", rm, prs, allow_gram=False)
+
+        return lax.scan(step, 0, pairs_stream)[1]  # [iters, batch] int32
+
+    def one_pass():
+        acc = None
+        nxt = jax.device_put(chunks[0])
+        for k in range(n_chunks):
+            cur = nxt
+            if k + 1 < n_chunks:
+                nxt = jax.device_put(chunks[k + 1])  # overlaps compute below
+            part = chunk_counts(cur, dpairs)
+            acc = part if acc is None else acc + part
+        return np.asarray(acc.astype(jax.numpy.int64))
+
+    out = one_pass()  # warm + compile
+    dt, out = _best_of_runs(lambda: one_pass(), default_runs=3)
+    total_q = iters * batch
+    qps = total_q / dt
+    bytes_streamed = n_chunks * chunks[0].nbytes
+    upload_gbps = bytes_streamed / dt / 1e9
+
+    # Ground truth on a few queries against the host copy.
+    from pilosa_tpu.roaring import _POPCNT8
+
+    q = all_pairs[0]
+    want = np.zeros(batch, dtype=np.int64)
+    for c in range(n_chunks):
+        a = chunks[c][:, q[:, 0], :]
+        b = chunks[c][:, q[:, 1], :]
+        want += _POPCNT8[(a & b).view(np.uint8)].reshape(chunk_slices, batch, -1).sum(
+            axis=(0, 2), dtype=np.int64
+        )
+    assert np.array_equal(out[0], want), "stream result mismatch"
+
+    cols = n_slices * (1 << 20)
+    return {
+        "metric": "intersect_count_stream_qps",
+        "value": round(qps, 1),
+        "unit": (
+            f"queries/sec over {cols/1e9:.2f}B columns ({n_slices} slices, "
+            f"{n_rows} rows, ~{bytes_streamed/2**30:.1f} GiB/pass streamed at "
+            f"{upload_gbps:.2f} GB/s host->device incl. tunnel, backend {jax.default_backend()})"
+        ),
+        "vs_baseline": round(upload_gbps * 1e9 / HBM_ROOFLINE, 4),
+    }
+
+
+def bench_intersect_4krows() -> dict:
+    """Gram-INELIGIBLE headline: 4096 distinct rows (>> 16x batch, so the
+    all-pairs MXU shortcut can't precompute the answers) forces the
+    scalar-prefetch gather kernel — the shape a real workload with
+    thousands of distinct rows hits.  Reports HBM bandwidth utilization
+    vs the v5e roofline: the gather kernel's true traffic is two operand
+    rows per (query, slice) DMA'd HBM->VMEM."""
+    n_slices = int(os.environ.get("BENCH_SLICES", "4"))
+    n_rows = int(os.environ.get("BENCH_ROWS", "4096"))
+    batch = int(os.environ.get("BENCH_BATCH", "256"))
+    iters = int(os.environ.get("BENCH_ITERS", "256"))
+
+    import jax
+    from jax import lax
+
+    from pilosa_tpu.ops import dispatch
+    from pilosa_tpu.ops.bitwise import WORDS_PER_SLICE
+
+    W = WORDS_PER_SLICE
+    rng = np.random.default_rng(42)
+    row_matrix = rng.integers(0, 1 << 32, size=(n_slices, n_rows, W), dtype=np.uint32)
+    all_pairs = rng.integers(0, n_rows, size=(iters, batch, 2), dtype=np.int32)
+
+    @jax.jit
+    def run_stream(rm, pairs_stream):
+        def step(carry, prs):
+            return carry, dispatch.gather_count("and", rm, prs, allow_gram=False)
+
+        return lax.scan(step, 0, pairs_stream)[1]
+
+    drm = jax.device_put(row_matrix)
+    dpairs = jax.device_put(all_pairs)
+    out = np.asarray(run_stream(drm, dpairs))  # warm + compile
+    dt, out = _best_of_runs(lambda: np.asarray(run_stream(drm, dpairs)))
+    qps = iters * batch / dt
+    # Gather kernel traffic: 2 rows x n_slices per query, W*4 bytes each.
+    bytes_moved = iters * batch * 2 * n_slices * W * 4
+    bw_util = bytes_moved / dt / HBM_ROOFLINE
+
+    from pilosa_tpu.roaring import _POPCNT8
+
+    p = all_pairs[0]
+    a = row_matrix[:, p[:, 0], :]
+    b = row_matrix[:, p[:, 1], :]
+    want = _POPCNT8[(a & b).view(np.uint8)].reshape(n_slices, batch, -1).sum(axis=(0, 2))
+    assert np.array_equal(out[0], want)
+    return {
+        "metric": "intersect_count_4krows_qps",
+        "value": round(qps, 1),
+        "unit": (
+            f"queries/sec, Gram-ineligible ({n_rows} rows x {n_slices} slices, "
+            f"batch {batch}, gather kernel, backend {jax.default_backend()})"
+        ),
+        "vs_baseline": round(bw_util, 4),
+        "bandwidth_util": round(bw_util, 4),
+    }
+
+
+def bench_topn_p50() -> dict:
+    """TopN latency at a billion columns (BASELINE.json's 'TopN p50 @ 1B
+    cols' metric): score EVERY row against a src bitmap over all slices
+    (the candidate phase's device work, fragment.go:493-625 analog) + the
+    host-side heap merge; report p50/p90 over many queries.  Default 960
+    slices x 64 rows = ~1.01B columns, ~7.9 GiB resident on one chip."""
+    n_slices = int(os.environ.get("BENCH_SLICES", "960"))
+    n_rows = int(os.environ.get("BENCH_ROWS", "64"))
+    n_q = int(os.environ.get("BENCH_ITERS", "64"))
+
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from pilosa_tpu.ops.bitwise import WORDS_PER_SLICE
+
+    W = WORDS_PER_SLICE
+    rng = np.random.default_rng(42)
+    rows = rng.integers(0, 1 << 32, size=(n_slices, n_rows, W), dtype=np.uint32)
+    src = rng.integers(0, 1 << 32, size=(n_slices, W), dtype=np.uint32)
+    masks = rng.integers(0, 1 << 32, size=(n_q,), dtype=np.uint32)
+
+    @jax.jit
+    def topn_counts(rws, s, m):
+        inter = jnp.bitwise_and(rws, jnp.bitwise_xor(s, m)[:, None, :])
+        return jnp.sum(lax.population_count(inter).astype(jnp.int64), axis=(0, 2))
+
+    drows, dsrc = jax.device_put(rows), jax.device_put(src)
+    np.asarray(topn_counts(drows, dsrc, masks[0]))  # warm + compile
+    lat = []
+    for i in range(n_q):
+        t0 = time.perf_counter()
+        counts = np.asarray(topn_counts(drows, dsrc, masks[i]))
+        top = sorted(zip(counts.tolist(), range(n_rows)), reverse=True)[:10]
+        lat.append(time.perf_counter() - t0)
+    lat.sort()
+    p50 = lat[len(lat) // 2]
+    p90 = lat[int(len(lat) * 0.9)]
+    # Device traffic: whole row matrix + src per query.
+    bw_util = (rows.nbytes + src.nbytes) / p50 / HBM_ROOFLINE
+    assert top[0][0] > 0
+    return {
+        "metric": "topn_p50_ms",
+        "value": round(p50 * 1e3, 2),
+        "unit": (
+            f"ms p50 per TopN over {n_slices * (1 << 20) / 1e6:.0f}M columns "
+            f"({n_rows} rows resident, p90={p90 * 1e3:.2f} ms, "
+            f"backend {jax.default_backend()})"
+        ),
+        "vs_baseline": round(bw_util, 4),
+        "bandwidth_util": round(bw_util, 4),
+    }
+
+
 def main() -> None:
     cfg = os.environ.get("BENCH_CONFIG", "intersect_count")
     if cfg != "intersect_count":
@@ -473,12 +717,25 @@ def main() -> None:
             "timerange": bench_timerange,
             "executor": bench_executor,
             "range_executor": bench_range_executor,
+            "intersect_count_stream": bench_intersect_stream,
+            "intersect_count_4krows": bench_intersect_4krows,
+            "topn_p50": bench_topn_p50,
         }[cfg]()
         print(json.dumps(result))
         return
     n_slices = int(os.environ.get("BENCH_SLICES", "16"))
     n_rows = int(os.environ.get("BENCH_ROWS", "64"))
     batch = int(os.environ.get("BENCH_BATCH", "256"))
+    # Billion-column shapes can't sit resident on one chip (the kernels'
+    # tiled-layout relayout transiently doubles the matrix footprint), so
+    # the headline config transparently switches to the slice-streaming
+    # executor regime — the same decision the product mapReduce makes.
+    from pilosa_tpu.ops.bitwise import WORDS_PER_SLICE as _W
+
+    resident_max = int(os.environ.get("BENCH_RESIDENT_MAX", str(12 << 30)))
+    if 2 * n_slices * n_rows * _W * 4 > resident_max:
+        print(json.dumps(bench_intersect_stream()))
+        return
     # Long enough that the one-dispatch stream's fixed costs (tunnel round
     # trip + the hoisted Gram build) amortize — shorter streams measure
     # the tunnel, not the sustained device rate.  Measured plateau: 1280
@@ -550,6 +807,20 @@ def main() -> None:
         "unit": f"queries/sec ({n_slices} slices x 2^20 cols, batch {batch}, backend {jax.default_backend()})",
         "vs_baseline": round(qps / base_qps, 2),
     }
+    # HBM-bandwidth accounting is only meaningful when the strategy
+    # actually MOVES the bitmaps per batch: with the Gram shortcut active
+    # each query is a table lookup, so bandwidth_util is reported null
+    # (the honest answer — see BASELINE.md's strategy ablation).
+    from pilosa_tpu.ops.dispatch import _use_gram
+
+    if not _use_gram(n_slices, n_rows, W, batch):
+        if n_rows < 2 * batch:  # resident kernel: whole row set per batch
+            bytes_moved = iters * row_matrix.nbytes
+        else:  # gather kernel: two operand rows per (query, slice)
+            bytes_moved = iters * batch * 2 * n_slices * W * 4
+        result["bandwidth_util"] = round(bytes_moved / dt / HBM_ROOFLINE, 4)
+    else:
+        result["bandwidth_util"] = None
     print(json.dumps(result))
 
 

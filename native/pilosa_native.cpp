@@ -670,3 +670,137 @@ int64_t pn_pql_parse(const char* src, int64_t len,
 }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Incremental snapshot encoder (fragment.go:1017-1057 snapshot analog)
+//
+// A fragment snapshot rewrites the whole cookie-12346 image every MaxOpN
+// ops; rebuilding it container-by-container in Python costs ~4us per
+// container, which dominates the SetBit hot path on sparse fragments
+// (tens of thousands of tiny containers).  This keeps a C++-side mirror
+// of the encoded per-container payloads: Python pushes only the DIRTY
+// containers after each batch of mutations, and emit() streams the full
+// image (header + offsets + payloads) from C state in one call.
+// ---------------------------------------------------------------------------
+
+#include <map>
+#include <mutex>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+struct SnapEntry {
+    uint32_t n;
+    std::string payload;
+};
+struct SnapState {
+    std::map<uint64_t, SnapEntry> containers;  // sorted by key
+    size_t payload_bytes = 0;
+};
+std::mutex g_snap_mu;
+std::unordered_map<int64_t, SnapState*>& snap_registry() {
+    static auto* r = new std::unordered_map<int64_t, SnapState*>();
+    return *r;
+}
+int64_t g_snap_next = 1;
+
+SnapState* snap_get(int64_t h) {
+    auto& r = snap_registry();
+    auto it = r.find(h);
+    return it == r.end() ? nullptr : it->second;
+}
+}  // namespace
+
+extern "C" {
+
+int64_t pn_snap_new() {
+    std::lock_guard<std::mutex> g(g_snap_mu);
+    int64_t h = g_snap_next++;
+    snap_registry()[h] = new SnapState();
+    return h;
+}
+
+void pn_snap_free(int64_t h) {
+    std::lock_guard<std::mutex> g(g_snap_mu);
+    auto& r = snap_registry();
+    auto it = r.find(h);
+    if (it != r.end()) {
+        delete it->second;
+        r.erase(it);
+    }
+}
+
+// Upsert one container's encoded payload (n values; len payload bytes).
+void pn_snap_set(int64_t h, uint64_t key, uint32_t n, const uint8_t* payload,
+                 size_t len) {
+    std::lock_guard<std::mutex> g(g_snap_mu);
+    SnapState* s = snap_get(h);
+    if (!s) return;
+    auto it = s->containers.find(key);
+    if (it != s->containers.end()) {
+        s->payload_bytes -= it->second.payload.size();
+        it->second.n = n;
+        it->second.payload.assign(reinterpret_cast<const char*>(payload), len);
+        s->payload_bytes += len;
+    } else {
+        auto& e = s->containers[key];
+        e.n = n;
+        e.payload.assign(reinterpret_cast<const char*>(payload), len);
+        s->payload_bytes += len;
+    }
+}
+
+void pn_snap_del(int64_t h, uint64_t key) {
+    std::lock_guard<std::mutex> g(g_snap_mu);
+    SnapState* s = snap_get(h);
+    if (!s) return;
+    auto it = s->containers.find(key);
+    if (it != s->containers.end()) {
+        s->payload_bytes -= it->second.payload.size();
+        s->containers.erase(it);
+    }
+}
+
+int64_t pn_snap_image_size(int64_t h) {
+    std::lock_guard<std::mutex> g(g_snap_mu);
+    SnapState* s = snap_get(h);
+    if (!s) return -1;
+    size_t n = s->containers.size();
+    return (int64_t)(8 + n * 16 + s->payload_bytes);
+}
+
+// Emit the full cookie-12346 image; returns bytes written or -1 if cap is
+// too small / the handle is unknown.
+int64_t pn_snap_emit(int64_t h, uint8_t* out, size_t cap) {
+    std::lock_guard<std::mutex> g(g_snap_mu);
+    SnapState* s = snap_get(h);
+    if (!s) return -1;
+    size_t n = s->containers.size();
+    size_t total = 8 + n * 16 + s->payload_bytes;
+    if (cap < total) return -1;
+    uint32_t cookie = 12346;
+    std::memcpy(out, &cookie, 4);
+    uint32_t n32 = (uint32_t)n;
+    std::memcpy(out + 4, &n32, 4);
+    uint8_t* hdr = out + 8;
+    uint8_t* offs = out + 8 + n * 12;
+    uint8_t* pay = out + 8 + n * 16;
+    uint32_t off = (uint32_t)(8 + n * 16);
+    for (auto& kv : s->containers) {
+        uint64_t key = kv.first;
+        uint32_t n1 = kv.second.n - 1;
+        std::memcpy(hdr, &key, 8);
+        std::memcpy(hdr + 8, &n1, 4);
+        hdr += 12;
+        std::memcpy(offs, &off, 4);
+        offs += 4;
+        size_t len = kv.second.payload.size();
+        std::memcpy(pay, kv.second.payload.data(), len);
+        pay += len;
+        off += (uint32_t)len;
+    }
+    return (int64_t)total;
+}
+
+}  // extern "C"

@@ -152,6 +152,9 @@ class Fragment:
         # stored container counts).
         self._row_counts: OrderedDict[int, int] = OrderedDict()
         self._row_counts_max = 4096
+        # Deferred (row -> bit-count delta) bookkeeping from the ingest
+        # hot path; drained by _flush_row_bookkeeping before cache reads.
+        self._pending_rows: dict[int, int] = {}
         self._open = False
         self._lock_fd: Optional[int] = None
         # Write generation: refreshed on every mutation from a
@@ -210,6 +213,8 @@ class Fragment:
         if self._wal is not None:
             self._wal.close()
             self._wal = None
+        with self._mu:
+            self._flush_row_bookkeeping()
         self._save_cache()
         self._release_flock()
         self._open = False
@@ -294,8 +299,18 @@ class Fragment:
             f.write(ids.tobytes())
         os.replace(tmp, self.cache_path)
 
+    def recalculate_cache(self) -> None:
+        """Force the rank cache's rankings current: drain deferred write
+        bookkeeping, then rebuild (bypasses the 10s invalidate debounce —
+        the fragment-level equivalent of cache.Recalculate)."""
+        with self._mu:
+            self._flush_row_bookkeeping()
+            self.cache.recalculate()
+
     def flush_cache(self) -> None:
         """Persist the rank cache sidecar (holder cache-flush loop target)."""
+        with self._mu:
+            self._flush_row_bookkeeping()
         self._save_cache()
 
     # -- positions ------------------------------------------------------
@@ -310,7 +325,15 @@ class Fragment:
         with self._mu:
             changed = self.storage.add(self.pos(row_id, column_id))
             if changed:
-                self._on_row_mutated(row_id, delta=1)
+                # Row bookkeeping (cache invalidation + rank-cache update)
+                # is DEFERRED: the hot ingest loop only records the delta;
+                # any reader that consults the caches flushes first
+                # (_flush_row_bookkeeping).  Storage itself is always
+                # current, and the write generation bumps eagerly so
+                # engine-side matrices never serve stale hits.
+                self.generation = next(_generation_counter)
+                p = self._pending_rows
+                p[row_id] = p.get(row_id, 0) + 1
                 self._increment_opn()
                 self.stats.count("setN", 1)  # fragment.go:410
             return changed
@@ -339,11 +362,13 @@ class Fragment:
             added = self.storage.add_many_unlogged(positions)
             if len(added):
                 self.stats.count("setN", len(added))
+                self.generation = next(_generation_counter)
                 rows_added, per_row = np.unique(
                     added // np.uint64(SLICE_WIDTH), return_counts=True
                 )
+                p = self._pending_rows
                 for row_id, cnt in zip(rows_added.tolist(), per_row.tolist()):
-                    self._on_row_mutated(int(row_id), delta=int(cnt))
+                    p[row_id] = p.get(row_id, 0) + cnt
                 if len(added) >= self.max_opn:
                     self._snapshot()
                 else:
@@ -360,7 +385,9 @@ class Fragment:
         with self._mu:
             changed = self.storage.remove(self.pos(row_id, column_id))
             if changed:
-                self._on_row_mutated(row_id, delta=-1)
+                self.generation = next(_generation_counter)
+                p = self._pending_rows
+                p[row_id] = p.get(row_id, 0) - 1
                 self._increment_opn()
                 self.stats.count("clearN", 1)  # fragment.go:456
             return changed
@@ -369,23 +396,35 @@ class Fragment:
         with self._mu:
             return self.storage.contains(self.pos(row_id, column_id))
 
-    def _on_row_mutated(self, row_id: int, delta: Optional[int] = None) -> None:
-        self.generation = next(_generation_counter)
-        self._row_cache.pop(row_id, None)
-        dropped = self._row_dev_cache.pop(row_id, None)
-        if dropped is not None:
-            self._row_dev_cache_arrays -= len(dropped)
-        self._checksums.pop(row_id // HASH_BLOCK_SIZE, None)
-        rc = None
-        if delta is not None:
+    def _flush_row_bookkeeping(self) -> None:
+        """Apply deferred per-row cache invalidations + rank updates.
+
+        Called (with the lock held) by every reader that consults the
+        row/device/checksum/count caches or the rank cache; the ingest
+        hot path only records (row, delta) so a burst of writes pays the
+        bookkeeping once per touched row, not once per op.  Storage is
+        never deferred — only derived caches are.
+        """
+        if not self._pending_rows:
+            return
+        pending = self._pending_rows
+        self._pending_rows = {}
+        for row_id, delta in pending.items():
+            self._row_cache.pop(row_id, None)
+            dropped = self._row_dev_cache.pop(row_id, None)
+            if dropped is not None:
+                self._row_dev_cache_arrays -= len(dropped)
+            self._checksums.pop(row_id // HASH_BLOCK_SIZE, None)
             cached = self._row_counts.get(row_id)
             if cached is not None:
                 rc = cached + delta
                 self._row_counts[row_id] = rc
                 self._row_counts.move_to_end(row_id)
-        if rc is None:
-            rc = self._row_count_locked(row_id)
-        self.cache.add(row_id, rc)
+            else:
+                # Counts from storage AFTER the ops applied — the delta is
+                # already included, so no adjustment here.
+                rc = self._row_count_locked(row_id)
+            self.cache.add(row_id, rc)
 
     def _increment_opn(self) -> None:
         if self.storage.op_n >= self.max_opn:
@@ -427,6 +466,7 @@ class Fragment:
     def row_dense(self, row_id: int) -> np.ndarray:
         """One row of this slice as packed uint32 words (device layout)."""
         with self._mu:
+            self._flush_row_bookkeeping()
             cached = self._row_cache.get(row_id)
             if cached is not None:
                 self._row_cache.move_to_end(row_id)
@@ -450,6 +490,7 @@ class Fragment:
         # with a stale row.
         ename = getattr(engine, "name", "?")
         with self._mu:
+            self._flush_row_bookkeeping()
             per_row = self._row_dev_cache.get(row_id)
             if per_row is not None:
                 cached = per_row.get(ename)
@@ -476,6 +517,7 @@ class Fragment:
 
     def row_count(self, row_id: int) -> int:
         with self._mu:
+            self._flush_row_bookkeeping()
             return self._row_count_locked(row_id)
 
     def _row_count_locked(self, row_id: int) -> int:
@@ -504,6 +546,8 @@ class Fragment:
 
     def top_pairs(self, row_ids: Sequence[int]) -> list[cache_mod.Pair]:
         """Candidate (id, count) pairs, count-descending (topBitmapPairs)."""
+        with self._mu:
+            self._flush_row_bookkeeping()
         if not row_ids:
             self.cache.invalidate()
             return list(self.cache.top())
@@ -641,6 +685,7 @@ class Fragment:
     def blocks(self) -> list[tuple[int, bytes]]:
         """(block id, sha1) for each non-empty block of HASH_BLOCK_SIZE rows."""
         with self._mu:
+            self._flush_row_bookkeeping()
             return self._blocks()
 
     def _blocks(self) -> list[tuple[int, bytes]]:

@@ -115,6 +115,7 @@ class Executor:
         client_factory=None,
         host: str = "",
         max_writes_per_request: int = 0,
+        write_queue: bool = False,
     ):
         self.holder = holder
         self.engine = new_engine(engine) if isinstance(engine, str) else engine
@@ -137,6 +138,13 @@ class Executor:
         self._matrix_rows_max = int(
             os.environ.get("PILOSA_TPU_MATRIX_ROWS_MAX", "1024")
         )
+        # Group-commit micro-batching for singleton SetBit requests (the
+        # server enables this; see pilosa_tpu/ingest.py).
+        self._write_queue = None
+        if write_queue:
+            from pilosa_tpu.ingest import WriteQueue
+
+            self._write_queue = WriteQueue(self._apply_queued_writes)
 
     # -- top level (executor.go:65-153) ----------------------------------
 
@@ -168,6 +176,23 @@ class Executor:
         if std_slices is None and needs_slices(query.calls):
             std_slices = list(range(idx.max_slice() + 1))
             inv_slices = list(range(idx.max_inverse_slice() + 1))
+
+        if (
+            self._write_queue is not None
+            and not opt.remote
+            and len(query.calls) == 1
+            and query.calls[0].name == "SetBit"
+        ):
+            # Singleton SetBit: group-commit through the ingest queue.
+            # Args are parsed HERE (one client's malformed call raises on
+            # its own request, never poisoning a shared batch) and the
+            # parsed tuple rides along so the committer doesn't re-parse.
+            try:
+                parsed = self._set_bit_args(index, query.calls[0])
+            except (PilosaError, ValueError):
+                pass  # sequential path surfaces the exact error
+            else:
+                return [self._write_queue.submit((index, query.calls[0], parsed))]
 
         batched_writes = self._fuse_set_bit_batch(index, query.calls, opt)
         if batched_writes is not None:
@@ -223,7 +248,12 @@ class Executor:
             # preserves its partial-commit semantics (calls before the bad
             # one take effect, exactly as if executed one by one).
             return None
+        return self._commit_set_bits(index, calls, parsed, opt)
 
+    def _commit_set_bits(self, index: str, calls, parsed, opt: ExecOptions) -> list[bool]:
+        """Apply pre-parsed SetBit tuples: vectorized local writes + one
+        forwarded request per remote owner node (shared by the fused
+        batch path and the ingest queue's committer)."""
         changed = [False] * len(calls)
 
         # Ownership split: local writes for slices this node owns, one
@@ -261,6 +291,50 @@ class Executor:
                 if res and res[k]:
                     changed[i] = True
         return changed
+
+    def _apply_queued_writes(self, items) -> list:
+        """Commit one drained queue batch: [(index, call, parsed)] ->
+        per-item changed bools, via the fused vectorized write path (one
+        fragment pass + one WAL append per touched view/slice, cluster
+        forwarding included).  Uses the parse results captured at submit;
+        a frame deleted/recreated in between is caught by ONE re-resolve
+        per (index, frame) group and that item re-parsed (an error becomes
+        that item's result only — never the batch's)."""
+        by_index: dict[str, list[int]] = {}
+        for i, (idx_name, _, _) in enumerate(items):
+            by_index.setdefault(idx_name, []).append(i)
+        results: list = [None] * len(items)
+        opt = ExecOptions()
+        for idx_name, positions in by_index.items():
+            calls = [items[i][1] for i in positions]
+            parsed = [items[i][2] for i in positions]
+            live = {}
+            for k, p in enumerate(parsed):
+                fr = p[0]
+                ok = live.get(id(fr))
+                if ok is None:
+                    ok = live[id(fr)] = (
+                        self.holder.frame(idx_name, fr.name) is fr
+                    )
+                if not ok:
+                    try:  # stale frame object: re-parse against the holder
+                        parsed[k] = self._set_bit_args(idx_name, calls[k])
+                    except (PilosaError, ValueError) as e:
+                        parsed[k] = e
+            ok_pos = [k for k, p in enumerate(parsed) if not isinstance(p, BaseException)]
+            for k, p in enumerate(parsed):
+                if isinstance(p, BaseException):
+                    results[positions[k]] = p  # raised on that submitter only
+            if ok_pos:
+                res = self._commit_set_bits(
+                    idx_name,
+                    [calls[k] for k in ok_pos],
+                    [parsed[k] for k in ok_pos],
+                    opt,
+                )
+                for j, k in enumerate(ok_pos):
+                    results[positions[k]] = res[j]
+        return results
 
     # PQL pair-op -> kernel op for the fused batch path.
     _FUSABLE_OPS = {

@@ -1,0 +1,78 @@
+"""Group-commit ingest queue: concurrent singleton writes -> one batch.
+
+The reference ingests singleton SetBits at a few hundred ns each because
+its whole write path is compiled Go (fragment.go:371-459).  Here the
+per-op interpreter cost is the bottleneck, so the server routes singleton
+SetBit requests through a micro-batching queue: whoever finds the queue
+leaderless commits ONE drained batch (a vectorized fragment pass + one
+WAL append per touched view/slice), then hands leadership off — under
+sustained load leadership rotates FIFO through the waiting threads, so no
+request is starved behind other clients' batches.  An idle queue adds no
+artificial latency (the first writer leads immediately; no timer).
+
+Read-your-writes: a client's next request can only be sent after its ack,
+and the ack happens after the batch (including its op) committed, so its
+subsequent reads observe the write.  Per-item errors: apply_batch may
+return an exception INSTANCE as an item's result — it is raised on that
+submitter only; an exception RAISED by apply_batch poisons the whole
+batch (transport-level failures; SetBit is idempotent, retries converge).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Sequence
+
+
+class WriteQueue:
+    """Rotating-leader group commit (no dedicated thread, no idle timer)."""
+
+    def __init__(self, apply_batch: Callable[[Sequence], list], max_batch: int = 4096):
+        self._apply = apply_batch
+        self.max_batch = max_batch
+        self._mu = threading.Lock()
+        self._cv = threading.Condition(self._mu)
+        self._items: list = []  # [(item, slot)]
+        self._committing = False
+        # Telemetry: batches committed / items seen (bench + tests).
+        self.stat_batches = 0
+        self.stat_items = 0
+
+    def submit(self, item):
+        """Enqueue one item; blocks until its batch commits.  Returns the
+        per-item result from apply_batch (raising it if it is an
+        exception), or raises the whole batch's error."""
+        slot = [False, None, None]  # done, result, exception
+        with self._cv:
+            self._items.append((item, slot))
+            while not slot[0]:
+                if not self._committing and self._items:
+                    # Leaderless with work pending: this thread commits
+                    # exactly ONE batch, then re-checks its own slot —
+                    # leadership rotates instead of camping on one thread.
+                    self._committing = True
+                    batch = self._items[: self.max_batch]
+                    del self._items[: len(batch)]
+                    self.stat_batches += 1
+                    self.stat_items += len(batch)
+                    self._mu.release()
+                    try:
+                        results = self._apply([it for it, _ in batch])
+                        for (_, s), r in zip(batch, results):
+                            s[1] = r
+                            s[0] = True
+                    except BaseException as e:  # noqa: BLE001 — poison batch
+                        for _, s in batch:
+                            s[2] = e
+                            s[0] = True
+                    finally:
+                        self._mu.acquire()
+                        self._committing = False
+                        self._cv.notify_all()
+                    continue
+                self._cv.wait()
+        if slot[2] is not None:
+            raise slot[2]
+        if isinstance(slot[1], BaseException):
+            raise slot[1]
+        return slot[1]

@@ -100,6 +100,21 @@ def load() -> Optional[ctypes.CDLL]:
             i32p, i32p, i32p, i64p, i32p, i32p,
             ctypes.c_int64, i64p,
         ]
+        lib.pn_snap_new.restype = ctypes.c_int64
+        lib.pn_snap_new.argtypes = []
+        lib.pn_snap_free.restype = None
+        lib.pn_snap_free.argtypes = [ctypes.c_int64]
+        lib.pn_snap_set.restype = None
+        lib.pn_snap_set.argtypes = [
+            ctypes.c_int64, ctypes.c_uint64, ctypes.c_uint32,
+            ctypes.c_char_p, ctypes.c_size_t,
+        ]
+        lib.pn_snap_del.restype = None
+        lib.pn_snap_del.argtypes = [ctypes.c_int64, ctypes.c_uint64]
+        lib.pn_snap_image_size.restype = ctypes.c_int64
+        lib.pn_snap_image_size.argtypes = [ctypes.c_int64]
+        lib.pn_snap_emit.restype = ctypes.c_int64
+        lib.pn_snap_emit.argtypes = [ctypes.c_int64, ctypes.c_void_p, ctypes.c_size_t]
         lib.pn_pql_match_pairs.restype = ctypes.c_int64
         lib.pn_pql_match_pairs.argtypes = [
             ctypes.c_char_p, ctypes.c_int64,

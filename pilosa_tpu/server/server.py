@@ -62,6 +62,11 @@ class Server:
             client_factory=self.client_factory,
             host=self.host,
             max_writes_per_request=self.config.max_writes_per_request,
+            # Server ingest routes singleton SetBits through the
+            # group-commit queue (concurrent clients batch into one
+            # fragment pass + WAL append); opt out via env for A/B runs.
+            write_queue=os.environ.get("PILOSA_TPU_WRITE_QUEUE", "1").lower()
+            not in ("0", "false", "no"),
         )
         self.broadcaster, self.receiver = self._build_broadcast()
         self.handler = Handler(

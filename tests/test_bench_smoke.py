@@ -44,13 +44,19 @@ def _run(env_extra, script="bench.py", timeout=240):
                       "BENCH_BATCH": "4", "BENCH_BITS_PER_ROW": "50", "BENCH_THREADS": "2"}),
         ("range_executor", {"BENCH_ITERS": "3", "BENCH_SLICES": "2",
                             "BENCH_BATCH": "4", "BENCH_BITS": "200"}),
+        ("intersect_count_stream", {"BENCH_ITERS": "2", "BENCH_SLICES": "4",
+                                    "BENCH_ROWS": "4", "BENCH_BATCH": "4",
+                                    "BENCH_CHUNK_SLICES": "2"}),
+        ("intersect_count_4krows", {"BENCH_ITERS": "2", "BENCH_SLICES": "2",
+                                    "BENCH_ROWS": "64", "BENCH_BATCH": "4"}),
+        ("topn_p50", {"BENCH_ITERS": "4", "BENCH_SLICES": "2", "BENCH_ROWS": "4"}),
     ],
 )
 def test_bench_config_emits_json(cfg, extra):
     stdout = _run({"BENCH_CONFIG": cfg, **extra})
     line = stdout.strip().splitlines()[-1]
     result = json.loads(line)
-    assert set(result) == {"metric", "value", "unit", "vs_baseline"}
+    assert {"metric", "value", "unit", "vs_baseline"} <= set(result)
     assert result["value"] > 0
 
 

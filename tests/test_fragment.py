@@ -102,7 +102,7 @@ def test_top_basic(frag):
     for r, n in [(0, 3), (1, 2), (2, 1)]:
         for c in range(n):
             frag.set_bit(r, c)
-    frag.cache.recalculate()
+    frag.recalculate_cache()
     top = frag.top(TopOptions(n=2))
     assert [(p.id, p.count) for p in top] == [(0, 3), (1, 2)]
 
@@ -114,7 +114,7 @@ def test_top_with_src_intersection(frag):
         frag.set_bit(1, c)  # 5..19
     for c in range(100, 103):
         frag.set_bit(2, c)
-    frag.cache.recalculate()
+    frag.recalculate_cache()
     src = roaring.Bitmap(range(0, 8))  # intersects row0 by 8, row1 by 3
     top = frag.top(TopOptions(n=5, src=src))
     assert [(p.id, p.count) for p in top] == [(0, 8), (1, 3)]
@@ -124,7 +124,7 @@ def test_top_row_ids_no_truncate(frag):
     for r in range(5):
         for c in range(r + 1):
             frag.set_bit(r, c)
-    frag.cache.recalculate()
+    frag.recalculate_cache()
     top = frag.top(TopOptions(n=1, row_ids=[0, 3]))
     assert {p.id for p in top} == {0, 3}
 
@@ -133,7 +133,7 @@ def test_top_min_threshold(frag):
     for r, n in [(0, 10), (1, 2)]:
         for c in range(n):
             frag.set_bit(r, c)
-    frag.cache.recalculate()
+    frag.recalculate_cache()
     top = frag.top(TopOptions(n=10, min_threshold=5))
     assert [p.id for p in top] == [0]
 
@@ -146,7 +146,7 @@ def test_top_tanimoto(frag):
         frag.set_bit(101, c)
     for c in [1, 2, 3, 4]:
         frag.set_bit(102, c)
-    frag.cache.recalculate()
+    frag.recalculate_cache()
     src = roaring.Bitmap([1, 2, 3])
     top = frag.top(TopOptions(tanimoto_threshold=70, src=src))
     got = {p.id: p.count for p in top}
@@ -218,7 +218,7 @@ def test_cache_sidecar_persistence(tmp_path):
     assert os.path.exists(f.cache_path)
     g = Fragment(f.path, "i", "f", "standard", 0, cache_type="ranked")
     g.open()
-    g.cache.recalculate()
+    g.recalculate_cache()
     assert g.cache.get(9) == 50
     g.close()
 

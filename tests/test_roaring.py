@@ -326,3 +326,39 @@ def test_serialization_independent_decoder():
         decoded.append(np.asarray(lows, dtype=np.uint64) + np.uint64(key << 16))
     got = np.concatenate(decoded)
     np.testing.assert_array_equal(np.sort(got), vals)
+
+
+def test_snapshot_mirror_gate_and_equivalence():
+    """The native incremental-snapshot mirror engages only on sparse
+    many-container shapes; images stay byte-identical to the Python
+    writer either way, including across the regime switch."""
+    import io
+
+    from pilosa_tpu import native as native_mod
+
+    if native_mod.load() is None:
+        import pytest
+
+        pytest.skip("native lib unavailable")
+    rng = np.random.default_rng(8)
+    bm = Bitmap()
+    # Sparse: 2000 containers x ~2 values -> mirror engages.
+    pos = (rng.integers(0, 2000, 6000).astype(np.uint64) << np.uint64(16)) | (
+        rng.integers(0, 1 << 16, 6000).astype(np.uint64)
+    )
+    bm.add_many(pos)
+    assert bm._snap_profitable()
+    img = bm.to_bytes()
+    b2 = io.BytesIO()
+    bm._write_to_python(b2)
+    assert b2.getvalue() == img
+    assert bm._snap_handle is not None
+    # Densify heavily -> avg payload rises past the gate.
+    for k in range(2000):
+        bm.add_many((np.uint64(k) << np.uint64(16)) | np.arange(5000, dtype=np.uint64))
+    assert not bm._snap_profitable()
+    img2 = bm.to_bytes()  # python writer now; mirror released
+    assert bm._snap_handle is None
+    b3 = io.BytesIO()
+    bm._write_to_python(b3)
+    assert b3.getvalue() == img2
