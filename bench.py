@@ -707,11 +707,135 @@ def bench_topn_p50() -> dict:
     }
 
 
+def bench_lockstep() -> dict:
+    """Lockstep-service throughput: a 2-rank SPMD job (CPU gloo mesh —
+    the shape this box can spawn; on a pod the same path rides ICI)
+    serving batched PQL over HTTP with concurrent clients, vs the SAME
+    requests through a single in-process executor.  Exercises the
+    pipelined total order: N requests in flight on the control plane,
+    execution in sequence order on both ranks."""
+    import re
+    import subprocess
+    import sys
+    import tempfile
+    import urllib.request
+    from concurrent.futures import ThreadPoolExecutor
+
+    batch = int(os.environ.get("BENCH_BATCH", "64"))
+    iters = int(os.environ.get("BENCH_ITERS", "60"))
+    n_clients = int(os.environ.get("BENCH_THREADS", "6"))
+    repo = os.path.dirname(os.path.abspath(__file__))
+
+    def free_port():
+        import socket
+
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        p = s.getsockname()[1]
+        s.close()
+        return p
+
+    coord, control, http = free_port(), free_port(), free_port()
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env["PYTHONPATH"] = repo
+    env["XLA_FLAGS"] = ""
+    worker = os.path.join(repo, "tests", "lockstep_worker.py")
+    errs = [tempfile.NamedTemporaryFile("w+", delete=False) for _ in range(2)]
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker, f"127.0.0.1:{coord}", "2", str(pid),
+             str(control), str(http)],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE, stderr=errs[pid],
+            cwd=repo, env=env, text=True)
+        for pid in range(2)
+    ]
+    try:
+        line = procs[0].stdout.readline()
+        assert json.loads(line).get("ready"), line
+
+        rng = np.random.default_rng(17)
+        def mk_query():
+            pairs = rng.integers(0, 4, size=(batch, 2))
+            return " ".join(
+                f'Count(Intersect(Bitmap(rowID={a}, frame="f"), Bitmap(rowID={b}, frame="f")))'
+                for a, b in pairs
+            )
+        queries = [mk_query() for _ in range(iters)]
+
+        def post(q):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{http}/index/g/query", data=q.encode(), method="POST")
+            return json.loads(urllib.request.urlopen(req, timeout=120).read())
+
+        for q in queries[:6]:
+            post(q)  # warm: matrices, jit, memo
+        t0 = time.perf_counter()
+        with ThreadPoolExecutor(n_clients) as pool:
+            outs = list(pool.map(post, queries))
+        dt = time.perf_counter() - t0
+        qps = iters * batch / dt
+        assert all("results" in o and len(o["results"]) == batch for o in outs)
+    finally:
+        try:
+            procs[0].stdin.write("\n")
+            procs[0].stdin.flush()
+        except Exception:
+            pass
+        for p in procs:
+            try:
+                p.wait(timeout=60)
+            except Exception:
+                p.kill()
+        for f in errs:
+            f.close()
+            os.unlink(f.name)
+
+    # Single-rank baseline: same queries through one in-process executor.
+    import tempfile as _tf
+
+    from pilosa_tpu.core.frame import FrameOptions
+    from pilosa_tpu.core.holder import Holder
+    from pilosa_tpu.executor import Executor
+    from pilosa_tpu.pilosa import SLICE_WIDTH
+
+    with _tf.TemporaryDirectory() as d:
+        h = Holder(d)
+        h.open()
+        idx = h.create_index("g")
+        idx.create_frame("f", FrameOptions(time_quantum="YM"))
+        fr = idx.frame("f")
+        for r in range(4):
+            for s in range(4):
+                fr.set_bit("standard", r, s * SLICE_WIDTH + 10 + r)
+                fr.set_bit("standard", r, s * SLICE_WIDTH + 500)
+        ex = Executor(h)
+        for q in queries[:6]:
+            ex.execute("g", q)
+        t0 = time.perf_counter()
+        for q in queries:
+            ex.execute("g", q)
+        base_dt = time.perf_counter() - t0
+        h.close()
+    base_qps = iters * batch / base_dt
+    return {
+        "metric": "lockstep_service_qps",
+        "value": round(qps, 1),
+        "unit": (
+            f"PQL queries/sec via 2-rank lockstep HTTP ({n_clients} clients, "
+            f"batch {batch}, pipelined; single-rank in-process executor "
+            f"{base_qps:,.0f} q/s on this host)"
+        ),
+        "vs_baseline": round(qps / base_qps, 3),
+    }
+
+
 def main() -> None:
     cfg = os.environ.get("BENCH_CONFIG", "intersect_count")
     if cfg != "intersect_count":
         result = {
             "setbit": bench_setbit,
+            "lockstep": bench_lockstep,
             "topn": bench_topn,
             "union64": bench_union64,
             "timerange": bench_timerange,

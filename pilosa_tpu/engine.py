@@ -419,15 +419,41 @@ class MeshEngine(JaxEngine):
     def set_plane_rows(self, matrix, slice_idxs, slots, block):
         return self._repin(super().set_plane_rows(matrix, slice_idxs, slots, block), matrix)
 
+    def _pallas_mode(self, n_slices: int, w: int) -> str:
+        """How to run kernels under the mesh: "pallas" (shard_map'd
+        hand-tuned kernels, TPU), "interpret" (same composition, Pallas
+        interpret mode — CPU meshes under PILOSA_TPU_PALLAS_INTERPRET=1,
+        used by tests and the driver dryrun), or "" (jnp fallback)."""
+        from pilosa_tpu.ops.pallas_kernels import _tileable
+
+        if n_slices < 2 or n_slices % self.mesh.n_devices or not _tileable(w):
+            return ""
+        from pilosa_tpu.ops.dispatch import use_pallas
+
+        if use_pallas():
+            return "pallas"
+        if os.environ.get("PILOSA_TPU_PALLAS_INTERPRET", "").lower() in ("1", "true", "yes"):
+            return "interpret"
+        return ""
+
     def gather_count(self, op, row_matrix, pairs):
-        # Pallas can't lower under GSPMD partitioning; the jnp form is
-        # partitioned by XLA (local gather + bitwise op + popcount per
-        # shard, psum over the slice axis).
-        out = self._gather_jit(
-            op,
-            self._shard_stack(self._jnp.asarray(row_matrix)),
-            self._jnp.asarray(pairs),
-        )
+        # A pallas_call can't lower under GSPMD partitioning directly, but
+        # shard_map restores the kernel tier: each shard runs the SAME
+        # hand-tuned Pallas kernel on its local block and psum merges over
+        # ICI (parallel/sharded.py).  Shapes the mesh can't shard evenly
+        # (or non-TPU without interpret mode) keep the jnp form, which XLA
+        # partitions itself.
+        rm = self._shard_stack(self._jnp.asarray(row_matrix))
+        mode = self._pallas_mode(rm.shape[0], rm.shape[-1])
+        if mode:
+            from pilosa_tpu.parallel.sharded import sharded_gather_count
+
+            out = sharded_gather_count(
+                self.mesh, op, rm, self._jnp.asarray(pairs),
+                interpret=(mode == "interpret"),
+            )
+            return self._fetch(out).astype(np.int64)
+        out = self._gather_jit(op, rm, self._jnp.asarray(pairs))
         return self._fetch(out).astype(np.int64)
 
     def _fetch(self, arr) -> np.ndarray:
@@ -448,14 +474,31 @@ class MeshEngine(JaxEngine):
         return self._fetch(x)
 
     def gather_count_multi(self, op, row_matrix, idx):
+        rm = self._shard_stack(self._jnp.asarray(row_matrix))
+        s, _, w = rm.shape
+        k = idx.shape[1]
+        mode = self._pallas_mode(s, w)
+        if mode:
+            # Kernel tier under the mesh (no materialized gather); bound
+            # the prefetched id footprint like single-chip dispatch does.
+            from pilosa_tpu.parallel.sharded import sharded_gather_count_multi
+
+            chunk = max(1, 2048 // max(1, k))
+            outs = [
+                self._fetch(
+                    sharded_gather_count_multi(
+                        self.mesh, op, rm, self._jnp.asarray(idx[i : i + chunk]),
+                        interpret=(mode == "interpret"),
+                    )
+                )
+                for i in range(0, idx.shape[0], chunk)
+            ]
+            return np.concatenate(outs).astype(np.int64)
         # The jnp form materializes the [S, chunk, K, W] gather per shard;
         # chunk the batch so that transient stays bounded (the same budget
         # dispatch.py applies to its XLA fallback).
         from pilosa_tpu.pilosa import OR_MULTI_BUDGET_DEVICE, or_multi_chunk_size
 
-        rm = self._shard_stack(self._jnp.asarray(row_matrix))
-        s, _, w = rm.shape
-        k = idx.shape[1]
         chunk = or_multi_chunk_size(s, k, w, OR_MULTI_BUDGET_DEVICE)
         outs = [
             self._fetch(self._gather_multi_jit(op, rm, self._jnp.asarray(idx[i : i + chunk])))

@@ -19,13 +19,13 @@ import jax.numpy as jnp
 
 from pilosa_tpu.ops import bitwise
 from pilosa_tpu.ops.pallas_kernels import (
-    _resident_chunk_sub,
     _tileable,
     fused_count1,
     fused_count2,
     fused_gather_count2,
     fused_gather_count_multi,
     fused_resident_count2,
+    resident_strategy,
 )
 
 
@@ -127,9 +127,9 @@ def gather_count(op, row_matrix, pairs, allow_gram: bool = True):
                 ]
             )
         # Resident kernel wins whenever streaming ALL rows once beats
-        # gathering 2 rows per query (R < 2B) and an all-rows chunk fits
-        # the VMEM budget; otherwise fall back to the per-query gather.
-        if n_rows < 2 * b and _resident_chunk_sub(n_rows, w, b):
+        # gathering 2 rows per query (shared predicate with the mesh
+        # tier); otherwise fall back to the per-query gather.
+        if resident_strategy(n_rows, w, b):
             return fused_resident_count2(op, row_matrix, pairs)
         return fused_gather_count2(op, row_matrix, pairs)
     return bitwise.gather_count(op, row_matrix, pairs)

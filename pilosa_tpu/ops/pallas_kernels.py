@@ -135,6 +135,15 @@ def _resident_chunk_sub(
     return best
 
 
+def resident_strategy(n_rows: int, w: int, batch: int) -> bool:
+    """Whether the VMEM-resident kernel beats the per-query gather for a
+    pair-count batch: streaming ALL rows once must beat gathering 2 rows
+    per query (R < 2B) and an all-rows chunk must fit the VMEM budget.
+    Shared by single-chip dispatch and the shard_map'd mesh tier so the
+    heuristic can't drift between them."""
+    return n_rows < 2 * batch and bool(_resident_chunk_sub(n_rows, w, batch))
+
+
 @functools.partial(jax.jit, static_argnames=("op", "interpret"))
 def fused_resident_count2(op: str, row_matrix, pairs, interpret: bool = False):
     """Row-resident variant of :func:`fused_gather_count2` for small row

@@ -21,12 +21,16 @@ This module is the SERVICE shell around that execution model
   locally, so every process enters the same jitted computations in the
   same order — the lockstep invariant the collectives require.
 
-Requests are serialized through one total order (a lock on rank 0):
-lockstep has no concurrent-query mode by construction.  Writes (SetBit
-etc.) replay identically on every rank, keeping the replicated holders
-convergent.  Errors raised before device work (parse errors, unknown
-frames) raise identically everywhere — rank 0 reports them to the
-client, workers log and continue.
+Requests flow through ONE total order — a sequence number assigned on
+rank 0 — but execution is PIPELINED: N requests can be in flight on the
+control plane (sends, receipt acks) while device execution proceeds
+strictly in sequence order on every rank, so concurrent HTTP clients
+overlap their network/parse time with each other's device time without
+ever breaking the lockstep invariant.  Writes (SetBit etc.) replay
+identically on every rank, keeping the replicated holders convergent.
+Errors raised before device work (parse errors, unknown frames) raise
+identically everywhere — rank 0 reports them to the client, workers log
+and continue.
 """
 
 from __future__ import annotations
@@ -96,11 +100,22 @@ class LockstepService:
         self.http_addr = http_addr
         self._workers: list[socket.socket] = []
         # Bound on how long rank 0 waits for a worker's receipt ack (and
-        # for the send buffer to drain) while holding the total-order
-        # lock.  Must exceed the worst single-query device time: a worker
-        # acks request n+1 only after finishing request n's execute.
+        # for the send buffer to drain).  Acks come from the workers'
+        # reader threads (receipt, not completion), so this only needs to
+        # cover control-plane latency plus scheduling hiccups.
         self.ack_timeout = float(os.environ.get("PILOSA_TPU_LOCKSTEP_ACK_TIMEOUT", "120"))
-        self._mu = threading.Lock()  # the total order
+        # PIPELINED total order: _order_mu only covers sequence assignment
+        # + the worker sends (cheap), so N requests can be in flight on
+        # the control plane at once; local execution is serialized in
+        # sequence order by the _exec_cv gate, matching the workers'
+        # socket-order replay.  _ack_mu[i]/_acked[i] track each worker's
+        # ordered receipt-ack stream.
+        self._order_mu = threading.Lock()
+        self._next_seq = 1
+        self._exec_cv = threading.Condition()
+        self._exec_next = 1
+        self._ack_mu: list[threading.Lock] = []
+        self._acked: list[int] = []
         self._degraded = False
         self._httpd = None
         self._stop = threading.Event()
@@ -118,52 +133,98 @@ class LockstepService:
             conn, _ = srv.accept()
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             self._workers.append(conn)
+            self._ack_mu.append(threading.Lock())
+            self._acked.append(0)
+
+    def _degrade(self, e) -> "PilosaError":
+        self._degraded = True
+        with self._exec_cv:
+            self._exec_cv.notify_all()
+        return PilosaError(
+            f"lockstep control plane lost a rank ({e}); "
+            "service degraded — restart the job"
+        )
+
+    def _await_acks(self, seq: int) -> None:
+        """Wait until every worker has acked receipt of request ``seq``.
+
+        Each worker's control socket delivers one ack byte per request in
+        order, so "acked seq n" == "n ack bytes consumed"; any thread may
+        consume acks for earlier sequences on the way (the per-worker
+        lock keeps consumption single-threaded).  A timeout counts as a
+        lost rank — detected here instead of by hanging in the collective
+        the dead worker will never enter.
+        """
+        for i, w in enumerate(self._workers):
+            with self._ack_mu[i]:
+                while self._acked[i] < seq:
+                    b = w.recv(1)
+                    if b != b"k":
+                        raise OSError("worker closed control connection")
+                    self._acked[i] += 1
 
     def _execute(self, index: str, query: str):
-        """Forward to every worker, then run locally (same order there).
+        """Forward to every worker, then run locally in sequence order.
 
-        FAIL-STOP on a broken control plane: once any forward fails the
-        ranks can no longer be guaranteed identical (a partial fan-out
-        may have replayed a write on some ranks only), so the service
-        refuses all further queries instead of serving diverged data —
-        an SPMD job with a dead rank needs a restart, exactly like a
-        collective hang would force anyway.
+        PIPELINED: the total order is a sequence number assigned under a
+        short send-lock, so several requests can be in flight — request
+        n+1's parse/forward/ack network time overlaps request n's device
+        execution; local execution (and each worker's replay, by socket
+        order) still happens in exactly one total order, which is the
+        invariant the collectives require.
+
+        FAIL-STOP on a broken control plane: once any forward or ack
+        fails, the ranks can no longer be guaranteed identical (a partial
+        fan-out may have replayed a write on some ranks only), so the
+        whole service degrades: new queries are refused, and in-flight
+        requests behind the failed sequence error out WITHOUT executing
+        locally even though live workers may replay them — after a
+        degrade the replicas are presumed diverged and nothing more is
+        served from any of them, so rank 0 skipping those requests is
+        safe; clients retry against a restarted job (SetBit is
+        idempotent).  A dead rank forces a restart exactly like the
+        collective hang it would otherwise cause.
         """
-        with self._mu:
+        with self._order_mu:
             if self._degraded:
                 raise PilosaError(
                     "lockstep service degraded: control plane lost a rank; restart the job"
                 )
+            seq = self._next_seq
+            self._next_seq += 1
             try:
                 for w in self._workers:
                     w.settimeout(self.ack_timeout)
-                    _send_msg(w, {"op": "query", "index": index, "query": query})
-                # Receipt acks BEFORE local execution: a dead worker is
-                # detected here instead of by hanging in the collective
-                # it will never enter.  The socket timeout (set above for
-                # both the send and this recv) bounds how long the
-                # total-order lock can be held by a hung-but-open rank:
-                # a timeout counts as a lost rank (degrade + raise), so
-                # shutdown() — which also takes the lock — stays
-                # reachable instead of deadlocking behind a stuck recv.
-                for w in self._workers:
-                    if w.recv(1) != b"k":
-                        raise OSError("worker closed control connection")
+                    _send_msg(w, {"op": "query", "index": index, "query": query, "seq": seq})
             except (OSError, socket.timeout) as e:
-                self._degraded = True
-                raise PilosaError(
-                    f"lockstep control plane lost a rank ({e}); "
-                    "service degraded — restart the job"
-                )
-            try:
-                return self.executor.execute(index, query)
-            except PilosaError:
-                raise  # deterministic; every rank raised it identically
-            except Exception:
-                # Workers replayed this request but rank 0 failed it:
-                # the replicas may have diverged — fail-stop.
-                self._degraded = True
-                raise
+                raise self._degrade(e)
+        try:
+            self._await_acks(seq)
+        except (OSError, socket.timeout) as e:
+            raise self._degrade(e)
+        with self._exec_cv:
+            while self._exec_next != seq:
+                if self._degraded:
+                    # An earlier in-flight request hit a lost rank: its
+                    # seq will never execute here, so waiting would
+                    # deadlock — every later request reports degraded.
+                    raise PilosaError(
+                        "lockstep service degraded mid-flight; restart the job"
+                    )
+                self._exec_cv.wait(timeout=1.0)
+        try:
+            return self.executor.execute(index, query)
+        except PilosaError:
+            raise  # deterministic; every rank raised it identically
+        except Exception:
+            # Workers replayed this request but rank 0 failed it:
+            # the replicas may have diverged — fail-stop.
+            self._degraded = True
+            raise
+        finally:
+            with self._exec_cv:
+                self._exec_next = seq + 1
+                self._exec_cv.notify_all()
 
     class _Handler(BaseHTTPRequestHandler):
         service: "LockstepService"
@@ -217,12 +278,36 @@ class LockstepService:
                 time.sleep(0.2)
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         sock.settimeout(None)
+
+        # Receipt acks come from a dedicated reader thread so they track
+        # RECEIPT, not completion — with one loop doing recv+ack+execute,
+        # rank 0's ack wait for request n+1 would block behind this
+        # rank's execution of n and the pipeline depth would collapse to
+        # one.  Execution itself stays strictly in arrival order.
+        import queue as _queue
+
+        jobs: "_queue.Queue[Optional[dict]]" = _queue.Queue()
+
+        def reader():
+            while True:
+                msg = _recv_msg(sock)
+                if msg is None or msg.get("op") == "shutdown":
+                    jobs.put(None)
+                    return
+                try:
+                    sock.sendall(b"k")  # receipt ack (rank 0 waits on these)
+                except OSError:
+                    jobs.put(None)
+                    return
+                jobs.put(msg)
+
+        rt = threading.Thread(target=reader, daemon=True)
+        rt.start()
         while not self._stop.is_set():
-            msg = _recv_msg(sock)
-            if msg is None or msg.get("op") == "shutdown":
+            msg = jobs.get()
+            if msg is None:
                 break
             try:
-                sock.sendall(b"k")  # receipt ack (rank 0 waits on these)
                 self.executor.execute(msg["index"], msg["query"])
             except PilosaError:
                 # Deterministic: rank 0 raised the same error before any
@@ -258,7 +343,7 @@ class LockstepService:
     def shutdown(self) -> None:
         """Rank 0: stop the HTTP front end and release the workers."""
         self._stop.set()
-        with self._mu:
+        with self._order_mu:
             for w in self._workers:
                 try:
                     _send_msg(w, {"op": "shutdown"})

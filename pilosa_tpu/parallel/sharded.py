@@ -151,6 +151,129 @@ def sharded_count_call(mesh: SliceMesh, op: str, a, b):
     return jax.jit(kernel)(a, b)
 
 
+@functools.lru_cache(maxsize=None)
+def _sharded_pair_kernel(mesh_obj, axis: str, op: str, resident: bool, interpret: bool):
+    """Jitted shard_map'd Pallas pair-count kernel, cached per (mesh, op,
+    strategy) — a fresh closure per call would retrace + recompile every
+    query (jax.Mesh is hashable, so it keys the cache directly)."""
+    import jax
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from pilosa_tpu.ops.pallas_kernels import (
+        fused_gather_count2,
+        fused_resident_count2,
+    )
+
+    @functools.partial(
+        jax.shard_map,
+        mesh=mesh_obj,
+        in_specs=(P(axis, None, None), P(None, None)),
+        out_specs=P(),
+        check_vma=False,
+    )
+    def kernel(rm_shard, prs):
+        if resident:
+            local = fused_resident_count2(op, rm_shard, prs, interpret=interpret)
+        else:
+            local = fused_gather_count2(op, rm_shard, prs, interpret=interpret)
+        return lax.psum(local, axis)
+
+    return jax.jit(kernel)
+
+
+@functools.lru_cache(maxsize=None)
+def _sharded_multi_kernel(mesh_obj, axis: str, op: str, interpret: bool):
+    import jax
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from pilosa_tpu.ops.pallas_kernels import fused_gather_count_multi
+
+    @functools.partial(
+        jax.shard_map,
+        mesh=mesh_obj,
+        in_specs=(P(axis, None, None), P(None, None)),
+        out_specs=P(),
+        check_vma=False,
+    )
+    def kernel(rm_shard, ids):
+        local = fused_gather_count_multi(op, rm_shard, ids, interpret=interpret)
+        return lax.psum(local, axis)
+
+    return jax.jit(kernel)
+
+
+# The Pallas kernels scalar-prefetch the pair ids into SMEM; bound the
+# per-dispatch id footprint exactly like single-chip dispatch does
+# (observed hard failure at B=4096 on v5e, see ops/dispatch.py).
+_SHARDED_BATCH_MAX = 1024
+
+
+def sharded_gather_count(
+    mesh: SliceMesh, op: str, row_matrix, pairs, interpret: bool = False
+):
+    """Batched pair counts with the HAND-TUNED Pallas kernels under GSPMD.
+
+    ``shard_map`` gives each device its local ``[S/n, R, W]`` block of the
+    slice-sharded row matrix; inside the per-shard body the same Pallas
+    kernels as single-chip dispatch run (resident or gather strategy by
+    the SHARD's shape, shared predicate), and ``lax.psum`` merges the
+    per-shard counts over ICI — multi-chip execution keeps the kernel
+    tier instead of demoting to the jnp fallback.  ``interpret=True``
+    runs the kernels in Pallas interpret mode (CPU meshes: tests and the
+    driver dryrun).
+
+    Requires the slice axis divisible by the mesh; callers fall back to
+    the GSPMD-partitioned jnp form otherwise.
+    """
+    import jax.numpy as jnp
+
+    from pilosa_tpu.ops.pallas_kernels import resident_strategy
+
+    n_slices, n_rows, w = row_matrix.shape
+    _require_divisible(n_slices, mesh.n_devices)
+    b = pairs.shape[0]
+    if b > _SHARDED_BATCH_MAX:
+        return jnp.concatenate(
+            [
+                sharded_gather_count(
+                    mesh, op, row_matrix, pairs[i : i + _SHARDED_BATCH_MAX], interpret
+                )
+                for i in range(0, b, _SHARDED_BATCH_MAX)
+            ]
+        )
+    kernel = _sharded_pair_kernel(
+        mesh.mesh, mesh.AXIS, op, resident_strategy(n_rows, w, b), interpret
+    )
+    return kernel(row_matrix, pairs)
+
+
+def sharded_gather_count_multi(
+    mesh: SliceMesh, op: str, row_matrix, idx, interpret: bool = False
+):
+    """Multi-operand fold counts (N-ary Intersect/Union/Difference, Range
+    covers) through the Pallas multi-gather kernel per shard + psum.
+    Chunks the batch so prefetched ids stay inside the SMEM budget."""
+    import jax.numpy as jnp
+
+    n_slices = row_matrix.shape[0]
+    _require_divisible(n_slices, mesh.n_devices)
+    b, k = idx.shape
+    chunk = max(1, (2 * _SHARDED_BATCH_MAX) // max(1, k))
+    if b > chunk:
+        return jnp.concatenate(
+            [
+                sharded_gather_count_multi(
+                    mesh, op, row_matrix, idx[i : i + chunk], interpret
+                )
+                for i in range(0, b, chunk)
+            ]
+        )
+    kernel = _sharded_multi_kernel(mesh.mesh, mesh.AXIS, op, interpret)
+    return kernel(row_matrix, idx)
+
+
 def sharded_topn_counts(mesh: SliceMesh, rows, src):
     """Per-row global intersection counts for TopN over a sharded slice axis.
 

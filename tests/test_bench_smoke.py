@@ -104,3 +104,13 @@ def test_graft_entry_compiles_single_chip():
     )
     assert out.returncode == 0, out.stderr[-2000:]
     assert "entry OK" in out.stdout
+
+
+def test_bench_lockstep_emits_json():
+    stdout = _run(
+        {"BENCH_CONFIG": "lockstep", "BENCH_ITERS": "6", "BENCH_BATCH": "4",
+         "BENCH_THREADS": "2"},
+        timeout=300,
+    )
+    result = json.loads(stdout.strip().splitlines()[-1])
+    assert result["metric"] == "lockstep_service_qps" and result["value"] > 0

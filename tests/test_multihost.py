@@ -268,3 +268,36 @@ def test_lockstep_three_ranks():
         # barrier must never outlive the test.
         job.cleanup()
     assert {o["probe"] for o in outs} == {9}  # all three ranks converged
+
+
+def test_lockstep_pipelined_concurrent_clients():
+    """Concurrent HTTP clients against the pipelined lockstep service:
+    N requests in flight on the control plane, execution still one total
+    order on every rank — results correct, replicated writes convergent."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    svc = _LockstepJob(2)
+    try:
+        svc.wait_ready()
+        q_read = 'Count(Bitmap(rowID=0, frame="f"))'
+        base = svc.query(q_read)["results"][0]
+        # 40 interleaved reads + writes from 6 concurrent clients.
+        wcols = list(range(700, 720))
+        jobs = [q_read] * 20 + [
+            f'SetBit(rowID=0, frame="f", columnID={c})' for c in wcols
+        ]
+        import random
+
+        random.Random(3).shuffle(jobs)
+        with ThreadPoolExecutor(6) as pool:
+            outs = list(pool.map(svc.query, jobs))
+        for q, o in zip(jobs, outs):
+            assert "results" in o, (q, o)
+        # All writes landed exactly once.
+        after = svc.query(q_read)["results"][0]
+        assert after == base + len(wcols)
+        outs = svc.shutdown_and_collect()
+        # Every rank's replicated holder converged to the same state.
+        assert outs[0]["probe"] == outs[1]["probe"] == after
+    finally:
+        svc.cleanup()
