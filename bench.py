@@ -337,7 +337,10 @@ def bench_executor() -> dict:
             ) + np.uint64(s * SLICE_WIDTH)
             fr.import_bits(rows, cols)
 
-        ex = Executor(h)
+        # write_queue=True is the SERVER's executor configuration; it also
+        # enables read coalescing, so the threaded variant measures what
+        # concurrent clients actually hit.
+        ex = Executor(h, write_queue=True)
         backend = ex.engine.name
         # Warm past the strategy ladder: request 1 builds + caches the row
         # matrix, request 2+ upgrade it to the Gram (single-flight build),

@@ -139,12 +139,19 @@ class Executor:
             os.environ.get("PILOSA_TPU_MATRIX_ROWS_MAX", "1024")
         )
         # Group-commit micro-batching for singleton SetBit requests (the
-        # server enables this; see pilosa_tpu/ingest.py).
+        # server enables this; see pilosa_tpu/ingest.py), and read
+        # COALESCING for concurrent flat-lane count requests: under
+        # thread contention the rotating leader concatenates many
+        # requests' pair arrays into ONE vectorized evaluation (one
+        # native gram-lane call for the union), instead of N threads
+        # fighting over the interpreter per request.
         self._write_queue = None
+        self._serve_queue = None
         if write_queue:
             from pilosa_tpu.ingest import WriteQueue
 
             self._write_queue = WriteQueue(self._apply_queued_writes)
+            self._serve_queue = WriteQueue(self._apply_queued_reads, max_batch=64)
 
     # -- top level (executor.go:65-153) ----------------------------------
 
@@ -393,6 +400,19 @@ class Executor:
             return None
         opt = opt or ExecOptions()
 
+        if self._serve_queue is not None and slices is None and not self._is_distributed(opt):
+            # Read coalescing: hand the matched arrays to the serve queue;
+            # the current leader concatenates every queued request with
+            # the same (index, name tables, slice set) into one vectorized
+            # evaluation.  Uncontended, the batch is just this request.
+            return self._serve_queue.submit(
+                (
+                    index,
+                    (op_ids, frame_ids, r1, r2),
+                    (tuple(frames_b), tuple(keys_b)),
+                    tuple(std_slices),
+                )
+            )
         if self._is_distributed(opt):
             # Cluster hop: build the matched dict + forwarded Query (from
             # the parse cache) and reuse the failover machinery.
@@ -414,6 +434,39 @@ class Executor:
         return self._fused_local_counts_arrays(
             index, frame_names, op_ids, frame_ids, r1, r2, std_slices
         )
+
+    def _apply_queued_reads(self, items) -> list:
+        """Evaluate one drained serve-queue batch of flat-lane requests.
+
+        Requests sharing (index, name tables, slices) concatenate their
+        op/frame/row arrays and run through ONE
+        ``_fused_local_counts_arrays`` pass — with a warm Gram that is a
+        single native call answering every queued request — then split
+        back per request.
+        """
+        results: list = [None] * len(items)
+        groups: dict[tuple, list[int]] = {}
+        for i, (index, _arrays, tables, slices) in enumerate(items):
+            groups.setdefault((index, tables, slices), []).append(i)
+        for (index, tables, slices), idxs in groups.items():
+            frame_names = [b.decode("utf-8") for b in tables[0]]
+            if len(idxs) == 1:
+                arrs = items[idxs[0]][1]
+                ops, fids, rr1, rr2 = arrs
+            else:
+                ops = np.concatenate([items[i][1][0] for i in idxs])
+                fids = np.concatenate([items[i][1][1] for i in idxs])
+                rr1 = np.concatenate([items[i][1][2] for i in idxs])
+                rr2 = np.concatenate([items[i][1][3] for i in idxs])
+            counts = self._fused_local_counts_arrays(
+                index, frame_names, ops, fids, rr1, rr2, list(slices)
+            )
+            off = 0
+            for i in idxs:
+                n = len(items[i][1][0])
+                results[i] = counts[off : off + n]
+                off += n
+        return results
 
     def _fused_local_counts_arrays(
         self, index: str, frame_names, op_ids, frame_ids, r1, r2, slices

@@ -1,0 +1,22 @@
+#!/bin/bash
+# One-off round-2 big-shape bench runs (slow: ~8-17 GB uploads through the
+# ~9 MB/s tunnel). Results append to big_bench_results.jsonl.
+set -u
+cd /root/repo
+OUT=big_bench_results.jsonl
+run() {
+  echo "=== $* $(date +%H:%M:%S)" >> $OUT
+  timeout 7200 env "$@" python bench.py >> $OUT 2>>big_bench_errors.log
+  echo "--- exit=$? $(date +%H:%M:%S)" >> $OUT
+}
+# 1) >=1B columns resident on one chip (VERDICT round-2 item 1 'Done').
+run BENCH_CONFIG=intersect_count BENCH_SLICES=1024 BENCH_ITERS=128 BENCH_TIMED_RUNS=2
+# 2) TopN p50 @ 1.01B columns (BASELINE.json metric).
+run BENCH_CONFIG=topn_p50 BENCH_ITERS=64
+# 3) Gram-ineligible 4k-row gather-kernel headline with bandwidth_util.
+run BENCH_CONFIG=intersect_count_4krows BENCH_TIMED_RUNS=3
+# 4) Resident-kernel bandwidth_util at the classic 16-slice shape.
+run BENCH_CONFIG=intersect_count PILOSA_TPU_NO_GRAM=1 BENCH_ITERS=512 BENCH_TIMED_RUNS=3
+# 5) Bigger-than-HBM stream (17 GB/pass; upload-bound through the tunnel).
+run BENCH_CONFIG=intersect_count_stream BENCH_TIMED_RUNS=1 BENCH_ITERS=32
+echo "ALL DONE $(date +%H:%M:%S)" >> $OUT
