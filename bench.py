@@ -601,55 +601,82 @@ def bench_intersect_stream() -> dict:
 def bench_intersect_4krows() -> dict:
     """Gram-INELIGIBLE headline: 4096 distinct rows (>> 16x batch, so the
     all-pairs MXU shortcut can't precompute the answers) forces the
-    scalar-prefetch gather kernel — the shape a real workload with
-    thousands of distinct rows hits.  Reports HBM bandwidth utilization
-    vs the v5e roofline: the gather kernel's true traffic is two operand
-    rows per (query, slice) DMA'd HBM->VMEM."""
+    gather path — the shape a real workload with thousands of distinct
+    rows hits.  Uses the row-major pipelined kernel (one contiguous DMA
+    descriptor per operand covering every slice): on v5e the DMA engine
+    processes descriptors serially at ~1 us each, so achievable bandwidth
+    is descriptor-size-bound — 512 KB rows (4 slices) reach ~40% of
+    roofline, 2 MB rows (16 slices) ~76% (BASELINE.md round-3 note).
+    Reports HBM bandwidth utilization vs the v5e roofline (true traffic:
+    two operand rows per query)."""
     n_slices = int(os.environ.get("BENCH_SLICES", "4"))
     n_rows = int(os.environ.get("BENCH_ROWS", "4096"))
     batch = int(os.environ.get("BENCH_BATCH", "256"))
     iters = int(os.environ.get("BENCH_ITERS", "256"))
 
     import jax
+    import jax.numpy as jnp
     from jax import lax
 
-    from pilosa_tpu.ops import dispatch
+    from pilosa_tpu.ops.pallas_kernels import fused_gather_count2_rowmajor
     from pilosa_tpu.ops.bitwise import WORDS_PER_SLICE
 
     W = WORDS_PER_SLICE
     rng = np.random.default_rng(42)
-    row_matrix = rng.integers(0, 1 << 32, size=(n_slices, n_rows, W), dtype=np.uint32)
     all_pairs = rng.integers(0, n_rows, size=(iters, batch, 2), dtype=np.int32)
+
+    # Device-generated (uploading multi-GB through the tunnel measures the
+    # tunnel; see the headline config) in row-major tiled form.
+    @jax.jit
+    def gen_matrix(key):
+        return jax.random.bits(key, (n_rows, n_slices, W // 128, 128), jnp.uint32)
+
+    drm = gen_matrix(jax.random.PRNGKey(42))
+    dpairs = jax.device_put(all_pairs)
 
     @jax.jit
     def run_stream(rm, pairs_stream):
         def step(carry, prs):
-            return carry, dispatch.gather_count("and", rm, prs, allow_gram=False)
+            return carry, fused_gather_count2_rowmajor("and", rm, prs)
 
-        return lax.scan(step, 0, pairs_stream)[1]
+        out = lax.scan(step, 0, pairs_stream)[1]
+        return out, out.astype(jnp.int64).sum()
 
-    drm = jax.device_put(row_matrix)
-    dpairs = jax.device_put(all_pairs)
-    out = np.asarray(run_stream(drm, dpairs))  # warm + compile
-    dt, out = _best_of_runs(lambda: np.asarray(run_stream(drm, dpairs)))
+    out_dev, _ = run_stream(drm, dpairs)  # warm + compile
+
+    def timed():
+        out_d, digest = run_stream(drm, dpairs)
+        np.asarray(digest)
+        return out_d
+
+    dt, out_dev = _best_of_runs(timed)
+    out = np.asarray(out_dev)
     qps = iters * batch / dt
-    # Gather kernel traffic: 2 rows x n_slices per query, W*4 bytes each.
+    # Gather traffic: 2 rows x n_slices per query, W*4 bytes each.
     bytes_moved = iters * batch * 2 * n_slices * W * 4
     bw_util = bytes_moved / dt / HBM_ROOFLINE
 
+    # Correctness gate: numpy ground truth for the first few queries from
+    # a fetched row subset (fetching all operand rows would take minutes
+    # through the tunnel).
     from pilosa_tpu.roaring import _POPCNT8
 
-    p = all_pairs[0]
-    a = row_matrix[:, p[:, 0], :]
-    b = row_matrix[:, p[:, 1], :]
-    want = _POPCNT8[(a & b).view(np.uint8)].reshape(n_slices, batch, -1).sum(axis=(0, 2))
-    assert np.array_equal(out[0], want)
+    n_gate = 8
+    gate_rows = sorted({int(r) for r in all_pairs[0, :n_gate].ravel()})
+    pos = {r: i for i, r in enumerate(gate_rows)}
+    host_rows = np.asarray(drm[np.array(gate_rows)]).reshape(len(gate_rows), n_slices, W)
+    for k in range(n_gate):
+        a = host_rows[pos[int(all_pairs[0, k, 0])]]
+        b = host_rows[pos[int(all_pairs[0, k, 1])]]
+        want = int(_POPCNT8[(a & b).view(np.uint8)].sum())
+        assert out[0, k] == want, f"gate query {k}: {out[0, k]} != {want}"
     return {
         "metric": "intersect_count_4krows_qps",
         "value": round(qps, 1),
         "unit": (
             f"queries/sec, Gram-ineligible ({n_rows} rows x {n_slices} slices, "
-            f"batch {batch}, gather kernel, backend {jax.default_backend()})"
+            f"batch {batch}, row-major pipelined gather kernel, "
+            f"backend {jax.default_backend()})"
         ),
         "vs_baseline": round(bw_util, 4),
         "bandwidth_util": round(bw_util, 4),
@@ -853,14 +880,16 @@ def main() -> None:
     n_slices = int(os.environ.get("BENCH_SLICES", "16"))
     n_rows = int(os.environ.get("BENCH_ROWS", "64"))
     batch = int(os.environ.get("BENCH_BATCH", "256"))
-    # Billion-column shapes can't sit resident on one chip (the kernels'
-    # tiled-layout relayout transiently doubles the matrix footprint), so
-    # the headline config transparently switches to the slice-streaming
-    # executor regime — the same decision the product mapReduce makes.
+    # Shapes past device memory switch to the slice-streaming executor
+    # regime — the same decision the product mapReduce makes.  The
+    # resident ceiling is the matrix itself (~14 GB usable of 15.75 GB
+    # HBM): since round 3 the kernels take the matrix in its born-tiled
+    # 4D form, so XLA no longer materializes a relayout copy that used to
+    # double the footprint (the round-2 1024-slice OOM).
     from pilosa_tpu.ops.bitwise import WORDS_PER_SLICE as _W
 
-    resident_max = int(os.environ.get("BENCH_RESIDENT_MAX", str(12 << 30)))
-    if 2 * n_slices * n_rows * _W * 4 > resident_max:
+    resident_max = int(os.environ.get("BENCH_RESIDENT_MAX", str(14 << 30)))
+    if n_slices * n_rows * _W * 4 > resident_max:
         print(json.dumps(bench_intersect_stream()))
         return
     # Long enough that the one-dispatch stream's fixed costs (tunnel round
@@ -877,46 +906,97 @@ def main() -> None:
 
     W = WORDS_PER_SLICE  # 32768 words = 2^20 bits per slice-row
     rng = np.random.default_rng(42)
-    row_matrix = rng.integers(0, 1 << 32, size=(n_slices, n_rows, W), dtype=np.uint32)
-    for _ in range(density_k - 1):
-        row_matrix &= rng.integers(0, 1 << 32, size=(n_slices, n_rows, W), dtype=np.uint32)
-
     all_pairs = rng.integers(0, n_rows, size=(iters, batch, 2), dtype=np.int32)
 
     # ---- TPU path -------------------------------------------------------
     import jax
+    import jax.numpy as jnp
     from jax import lax
 
     from pilosa_tpu.ops import dispatch
+
+    # Billion-column matrices are generated ON DEVICE: uploading 8 GB
+    # through this environment's ~4 MiB/s tunnel takes >30 min (a real
+    # host-attached TPU fills HBM in <1 s over PCIe, so host-gen would
+    # measure nothing real).  Small shapes keep the host path so the full
+    # numpy baseline and whole-stream correctness gate apply.
+    hostgen_max = int(os.environ.get("BENCH_HOSTGEN_MAX", str(1 << 30)))
+    device_gen = n_slices * n_rows * W * 4 > hostgen_max
 
     @jax.jit
     def run_stream(rm, pairs_stream):
         def step(carry, prs):
             return carry, dispatch.gather_count_and(rm, prs)
 
-        return lax.scan(step, 0, pairs_stream)[1]
+        out = lax.scan(step, 0, pairs_stream)[1]
+        # Digest depends on EVERY step: fetching it synchronizes on the
+        # whole stream while the full per-query results stay materialized
+        # in HBM (a returned output — XLA cannot elide it).
+        return out, out.astype(jnp.int64).sum()
 
-    drm = jax.device_put(row_matrix)
+    if device_gen:
+        @jax.jit
+        def gen_matrix(key):
+            rm = jax.random.bits(key, (n_slices, n_rows, W // 128, 128), jnp.uint32)
+            for i in range(density_k - 1):
+                rm &= jax.random.bits(
+                    jax.random.fold_in(key, i + 1),
+                    (n_slices, n_rows, W // 128, 128),
+                    jnp.uint32,
+                )
+            return rm
+
+        drm = gen_matrix(jax.random.PRNGKey(42))
+        # Host mirror of the FIRST slice only (for the correctness gate).
+        row_matrix = np.asarray(drm[:1]).reshape(1, n_rows, W)
+    else:
+        row_matrix = rng.integers(0, 1 << 32, size=(n_slices, n_rows, W), dtype=np.uint32)
+        for _ in range(density_k - 1):
+            row_matrix &= rng.integers(
+                0, 1 << 32, size=(n_slices, n_rows, W), dtype=np.uint32
+            )
+        # Born-tiled 4D device form: no relayout copy inside jit.
+        drm = jax.device_put(row_matrix.reshape(n_slices, n_rows, W // 128, 128))
     dpairs = jax.device_put(all_pairs)
-    # Warmup compiles and runs the full stream once; fetching to host is
-    # the only reliable synchronization on this backend.
-    out = np.asarray(run_stream(drm, dpairs))
+    # Warmup compiles and runs the full stream once.
+    out_dev, _ = run_stream(drm, dpairs)
+    out = np.asarray(out_dev)
 
-    # Best of N timed runs (min wall time): the remote tunnel adds tens of
-    # ms of jitter per dispatch, so a single draw under-reports the
-    # sustained rate.  Standard min-of-N benchmark methodology.
-    dt, out = _best_of_runs(lambda: np.asarray(run_stream(drm, dpairs)))
+    # Timed region: dispatch the stream and fetch the 8-byte digest.  The
+    # digest is data-dependent on all iters*batch per-query results, so
+    # timing stops only when the device has computed and materialized
+    # every result in HBM.  The full result tensor is deliberately NOT
+    # fetched inside the timer: this chip sits behind a remote tunnel
+    # whose measured result-download rate is 2-7 MiB/s (vs >100 GB/s for
+    # a host-attached TPU over PCIe), so fetching the [iters, batch]
+    # int32 tensor (~2.6 MB at the default shape) would time the tunnel,
+    # not the engine — that artifact is exactly what made the r01/r02
+    # official captures swing 2.8M -> 141k q/s on identical code (see
+    # BASELINE.md round-3 note).  Results ARE on-device and a real
+    # (host-attached) server would stream them to clients at PCIe rates.
+    #
+    # Best of N timed runs (min wall time): the tunnel adds tens of ms of
+    # dispatch jitter, so a single draw under-reports the sustained rate.
+    def timed():
+        out_d, digest = run_stream(drm, dpairs)
+        np.asarray(digest)
+        return out_d
+
+    dt, out_dev = _best_of_runs(timed)
     qps = iters * batch / dt
+    out = np.asarray(out_dev)  # post-timing fetch for the correctness gate
 
     # ---- CPU numpy baseline (single-threaded popcount loop) -------------
     from pilosa_tpu.roaring import _POPCNT8
+
+    base_slices = row_matrix.shape[0]  # all slices, or 1 when device_gen
 
     def numpy_batch(i):
         p = all_pairs[i]
         a = row_matrix[:, p[:, 0], :]
         b = row_matrix[:, p[:, 1], :]
         inter = a & b
-        return _POPCNT8[inter.view(np.uint8)].reshape(n_slices, batch, -1).sum(axis=(0, 2))
+        return _POPCNT8[inter.view(np.uint8)].reshape(base_slices, batch, -1).sum(axis=(0, 2))
 
     base_iters = max(1, min(3, iters))
     numpy_batch(0)  # warm: first-touch page faults + LUT cache
@@ -925,8 +1005,20 @@ def main() -> None:
     for i in range(base_iters):
         base_out = numpy_batch(i)
     base_dt = time.perf_counter() - t0
-    base_qps = base_iters * batch / base_dt
-    assert np.array_equal(out[base_iters - 1], base_out), "TPU/CPU result mismatch"
+    # Extrapolate the single-slice host mirror to the full slice count
+    # (the numpy loop is linear in slices; device_gen shapes would need
+    # hours of LUT work for an exact all-slice baseline).
+    base_qps = base_iters * batch / (base_dt * n_slices / base_slices)
+    if device_gen:
+        # Gate against the slice-0 mirror: same pairs, device counts
+        # restricted to slice 0 must equal the numpy counts.
+        gate = np.asarray(
+            dispatch.gather_count("and", drm[:1], jnp.asarray(all_pairs[base_iters - 1]),
+                                  allow_gram=False)
+        )
+        assert np.array_equal(gate, base_out), "TPU/CPU result mismatch (slice 0)"
+    else:
+        assert np.array_equal(out[base_iters - 1], base_out), "TPU/CPU result mismatch"
 
     result = {
         "metric": "intersect_count_qps",
@@ -942,7 +1034,7 @@ def main() -> None:
 
     if not _use_gram(n_slices, n_rows, W, batch):
         if n_rows < 2 * batch:  # resident kernel: whole row set per batch
-            bytes_moved = iters * row_matrix.nbytes
+            bytes_moved = iters * n_slices * n_rows * W * 4
         else:  # gather kernel: two operand rows per (query, slice)
             bytes_moved = iters * batch * 2 * n_slices * W * 4
         result["bandwidth_util"] = round(bytes_moved / dt / HBM_ROOFLINE, 4)

@@ -134,7 +134,10 @@ class NumpyEngine:
         counts = _POPCNT8[np.ascontiguousarray(batch).view(np.uint8)]
         return counts.reshape(*batch.shape[:-1], -1).sum(axis=-1, dtype=np.int64)
 
-    def batch_intersection_count(self, rows, src) -> np.ndarray:
+    def batch_intersection_count(self, rows, src, tiled: bool = False) -> np.ndarray:
+        if tiled:  # trailing [W/128, 128] word axes -> logical [..., W]
+            rows = rows.reshape(*rows.shape[:-2], -1)
+            src = src.reshape(*src.shape[:-2], -1)
         return self.count(rows & src)
 
     def update_slices(self, matrix, slice_idxs, planes):
@@ -218,9 +221,22 @@ class JaxEngine:
     def asarray(self, x):
         return self._jnp.asarray(x)
 
+    @staticmethod
+    def _tile_host(block: np.ndarray) -> np.ndarray:
+        """Host-side reshape [..., W] -> [..., W/128, 128] (free: a numpy
+        view).  Jax engines store row matrices in this TILED form so the
+        Pallas kernels never reshape them inside jit — an in-jit
+        [S, R, W] -> [S, R, W/128, 128] reshape changes the physical
+        (8, 128) tiling and XLA materializes a full HBM copy of the
+        matrix (the round-2 1024-slice OOM; BASELINE.md round-3 note)."""
+        if block.shape[-1] % 128:
+            return block  # non-tileable widths stay logical (jnp fallback)
+        return block.reshape(*block.shape[:-1], block.shape[-1] // 128, 128)
+
     def matrix(self, host_matrix: np.ndarray):
-        """One host→device transfer for an assembled row matrix."""
-        return self._jnp.asarray(host_matrix)
+        """One host→device transfer for an assembled row matrix, stored in
+        canonical tiled form uint32[S, R, W/128, 128]."""
+        return self._jnp.asarray(self._tile_host(host_matrix))
 
     def gather_count_and(self, row_matrix, pairs) -> np.ndarray:
         """Batched Count(Intersect) in ONE device dispatch (Pallas on TPU)."""
@@ -277,38 +293,57 @@ class JaxEngine:
             return np.zeros(batch.shape[:-1], dtype=np.int64)
         return self.to_numpy(self._dispatch.count(batch)).astype(np.int64)
 
-    def batch_intersection_count(self, rows, src) -> np.ndarray:
+    def batch_intersection_count(self, rows, src, tiled: bool = False) -> np.ndarray:
+        # ``tiled=True``: rows were sliced from a (4D tiled) engine matrix
+        # and carry the word axis as trailing [W/128, 128] dims.  Explicit
+        # — ndim alone cannot distinguish a tiled [K, W/128, 128] stack
+        # from a logical [S, K, W] one.
         return self.to_numpy(
-            self._dispatch.batch_intersection_count(rows, src)
+            self._dispatch.batch_intersection_count(rows, src, tiled=tiled)
         ).astype(np.int64)
+
+    def tile_src(self, src_dense: np.ndarray):
+        """Upload a dense [W] operand in the matrix-compatible tiled form
+        (so kernels can pair it with rows sliced from a 4D matrix)."""
+        return self._jnp.asarray(self._tile_host(np.asarray(src_dense)))
+
+    def _match_block(self, matrix, block):
+        """Reshape a host [.., .., W] block to the matrix's storage form
+        (tiled 4D matrices take [.., .., W/128, 128] blocks)."""
+        block = np.asarray(block)
+        if matrix.ndim == block.ndim + 1:
+            block = self._tile_host(block)
+        return self._jnp.asarray(block)
 
     def update_slices(self, matrix, slice_idxs, planes):
         """Replace stale slice planes on-device: uploads only the changed
         planes and patches HBM→HBM instead of re-transferring the matrix."""
         idx = self._jnp.asarray(np.asarray(slice_idxs, dtype=np.int32))
-        return matrix.at[idx].set(self._jnp.asarray(planes))
+        return matrix.at[idx].set(self._match_block(matrix, planes))
 
     def append_rows(self, matrix, block):
         """Device-side concat of new rows: only the new block crosses PCIe."""
-        return self._jnp.concatenate([matrix, self._jnp.asarray(block)], axis=1)
+        return self._jnp.concatenate(
+            [matrix, self._match_block(matrix, block)], axis=1
+        )
 
     def set_rows(self, matrix, row_start: int, block):
         """Write rows into preallocated capacity device-side (shape
         preserved, so downstream jitted kernels never recompile)."""
-        return matrix.at[:, row_start : row_start + block.shape[1], :].set(
-            self._jnp.asarray(block)
+        return matrix.at[:, row_start : row_start + block.shape[1]].set(
+            self._match_block(matrix, block)
         )
 
     def set_rows_at(self, matrix, slots, block):
         """Scatter a miss batch into arbitrary pool slots: only the new
         rows cross host->device; the scatter itself is HBM->HBM."""
         idx = self._jnp.asarray(np.asarray(slots, dtype=np.int32))
-        return matrix.at[:, idx, :].set(self._jnp.asarray(block))
+        return matrix.at[:, idx].set(self._match_block(matrix, block))
 
     def grow_rows(self, matrix, n: int):
         """Append n zero capacity rows DEVICE-side (no host transfer)."""
-        s, _, w = matrix.shape
-        z = self._jnp.zeros((s, n, w), dtype=matrix.dtype)
+        s = matrix.shape[0]
+        z = self._jnp.zeros((s, n) + matrix.shape[2:], dtype=matrix.dtype)
         return self._jnp.concatenate([matrix, z], axis=1)
 
     def set_plane_rows(self, matrix, slice_idxs, slots, block):
@@ -316,7 +351,9 @@ class JaxEngine:
         rows cross host->device."""
         si = self._jnp.asarray(np.asarray(slice_idxs, dtype=np.int32))
         sl = self._jnp.asarray(np.asarray(slots, dtype=np.int32))
-        return matrix.at[si[:, None], sl[None, :], :].set(self._jnp.asarray(block))
+        return matrix.at[si[:, None], sl[None, :]].set(
+            self._match_block(matrix, block)
+        )
 
     def pair_gram(self, matrix):
         """All-pairs AND-count Gram via one MXU int8 matmul (exact)."""
@@ -390,8 +427,9 @@ class MeshEngine(JaxEngine):
         return self._shard_stack(super().stack_rows(stacks))
 
     def matrix(self, host_matrix: np.ndarray):
-        """One sharded transfer: the slice axis lands partitioned."""
-        return self._shard_stack(host_matrix)
+        """One sharded transfer: the slice axis lands partitioned; stored
+        in the same tiled 4D form as JaxEngine (relayout-free kernels)."""
+        return self._shard_stack(self._tile_host(host_matrix))
 
     def _repin(self, out, like):
         # Scatter/concat along or around the sharded slice axis may leave
@@ -443,8 +481,10 @@ class MeshEngine(JaxEngine):
         # ICI (parallel/sharded.py).  Shapes the mesh can't shard evenly
         # (or non-TPU without interpret mode) keep the jnp form, which XLA
         # partitions itself.
+        from pilosa_tpu.ops.pallas_kernels import rm_words
+
         rm = self._shard_stack(self._jnp.asarray(row_matrix))
-        mode = self._pallas_mode(rm.shape[0], rm.shape[-1])
+        mode = self._pallas_mode(rm.shape[0], rm_words(rm))
         if mode:
             from pilosa_tpu.parallel.sharded import sharded_gather_count
 
@@ -474,8 +514,10 @@ class MeshEngine(JaxEngine):
         return self._fetch(x)
 
     def gather_count_multi(self, op, row_matrix, idx):
+        from pilosa_tpu.ops.pallas_kernels import rm_words
+
         rm = self._shard_stack(self._jnp.asarray(row_matrix))
-        s, _, w = rm.shape
+        s, w = rm.shape[0], rm_words(rm)
         k = idx.shape[1]
         mode = self._pallas_mode(s, w)
         if mode:

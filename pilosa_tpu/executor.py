@@ -1106,8 +1106,10 @@ class Executor:
         bucket = min(shape[1], 1 << max(0, (n_used - 1)).bit_length()) if n_used else 0
         if bucket == 0:
             return None
-        # Unpacked int8 bits are 32 bytes per uint32 word.
-        if shape[0] * bucket * shape[2] * 32 > self._GRAM_BYTES_BUDGET:
+        # Unpacked int8 bits are 32 bytes per uint32 word (word count from
+        # either the 3D logical or 4D tiled matrix layout).
+        words = shape[2] if len(shape) == 3 else shape[2] * shape[3]
+        if shape[0] * bucket * words * 32 > self._GRAM_BYTES_BUDGET:
             return None
         mu = box.get("mu")
         if mu is None or not mu.acquire(blocking=False):
@@ -1469,9 +1471,13 @@ class Executor:
                 )
                 src_dev = state["src_dev"].get(si)
                 if src_dev is None:
-                    src_dev = state["src_dev"][si] = self.engine.asarray(src_dense)
+                    # Tiled to match rows sliced from the 4D pool matrix.
+                    tile = getattr(self.engine, "tile_src", self.engine.asarray)
+                    src_dev = state["src_dev"][si] = tile(src_dense)
                 rows = matrix[si][pos]
-                counts = self.engine.batch_intersection_count(rows, src_dev)
+                counts = self.engine.batch_intersection_count(
+                    rows, src_dev, tiled=getattr(matrix, "ndim", 3) == 4
+                )
                 return counts[:n]
 
             return score

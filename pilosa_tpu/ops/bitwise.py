@@ -134,6 +134,8 @@ def gather_count(op: str, row_matrix, pairs):
     """Batched Count(<op>(Bitmap(p0), Bitmap(p1))) over all slices — the
     generalization of :func:`gather_count_and` to Union ("or"),
     Difference ("andnot"), and Xor ("xor")."""
+    if row_matrix.ndim == 4:  # tiled engine form: flatten the word axis
+        row_matrix = row_matrix.reshape(*row_matrix.shape[:2], -1)
     a = jnp.take(row_matrix, pairs[:, 0], axis=1)  # [n_slices, B, W]
     b = jnp.take(row_matrix, pairs[:, 1], axis=1)
     return jnp.sum(lax.population_count(apply_pair_op(op, a, b)).astype(jnp.int32), axis=(0, 2))
@@ -153,6 +155,8 @@ def gather_count_multi(op: str, row_matrix, idx):
     Pallas version streams one row per grid step without materializing
     the gather.
     """
+    if row_matrix.ndim == 4:  # tiled engine form: flatten the word axis
+        row_matrix = row_matrix.reshape(*row_matrix.shape[:2], -1)
     g = jnp.take(row_matrix, idx, axis=1)  # [n_slices, B, K, W]
     if op == "or":
         acc = lax.reduce(g, np.uint32(0), lax.bitwise_or, (2,))
@@ -203,6 +207,8 @@ def pair_gram(row_matrix):
     of the row matrix — XLA hoists it out of query-stream loops, so a
     stream of fused batches pays for it once.
     """
+    if row_matrix.ndim == 4:  # tiled engine form (word order is identical)
+        row_matrix = row_matrix.reshape(*row_matrix.shape[:2], -1)
     s, r, w = row_matrix.shape
     shifts = jnp.arange(32, dtype=jnp.uint32)
     flat = row_matrix.transpose(1, 0, 2).reshape(r, s * w)

@@ -26,7 +26,24 @@ from pilosa_tpu.ops.pallas_kernels import (
     fused_gather_count_multi,
     fused_resident_count2,
     resident_strategy,
+    rm_words,
 )
+
+
+def _rm_dims(row_matrix) -> tuple[int, int, int]:
+    """(n_slices, n_rows, W) of a row matrix in 3D logical or 4D tiled
+    form (see pallas_kernels._rm4)."""
+    return row_matrix.shape[0], row_matrix.shape[1], rm_words(row_matrix)
+
+
+def _rm3(row_matrix):
+    """Logical [S, R, W] view (the jnp/numpy fallbacks and the Gram path
+    index the word axis flat).  On TPU this reshape materializes a tiled
+    relayout copy inside jit — callers only use it off the kernel path."""
+    if row_matrix.ndim == 3:
+        return row_matrix
+    s, r = row_matrix.shape[:2]
+    return row_matrix.reshape(s, r, -1)
 
 
 @functools.lru_cache(maxsize=None)
@@ -105,15 +122,18 @@ def gather_count(op, row_matrix, pairs, allow_gram: bool = True):
     ``allow_gram=False`` skips the all-pairs MXU strategy — callers that
     manage their own Gram cache (the executor) or dispatch eagerly
     per-call want the cheaper direct kernels; the Gram branch pays off
-    inside jitted query streams where XLA hoists it out of the loop."""
-    n_slices, n_rows, w = row_matrix.shape
+    inside jitted query streams where XLA hoists it out of the loop.
+
+    ``row_matrix`` may be 3D logical [S, R, W] or 4D tiled
+    [S, R, W/128, 128] (the jax engines' relayout-free storage form)."""
+    n_slices, n_rows, w = _rm_dims(row_matrix)
     # Matmul Gram strategy for tiny row sets: one int8 matmul computes ALL
     # pair counts; per-query answers are lookups.  Pure HLO on the row
     # matrix only (no Pallas dependency — any jax backend), so XLA hoists
     # it out of jitted query streams.
     if allow_gram and _use_gram(n_slices, n_rows, w, pairs.shape[0]):
         return bitwise.gram_pair_counts(op, bitwise.pair_gram(row_matrix), pairs)
-    if use_pallas() and _tileable(row_matrix.shape[-1]):
+    if use_pallas() and _tileable(w):
         b = pairs.shape[0]
         if b > _GATHER_BATCH_MAX:
             # Chunk oversized batches: the prefetched pair ids must fit
@@ -132,7 +152,7 @@ def gather_count(op, row_matrix, pairs, allow_gram: bool = True):
         if resident_strategy(n_rows, w, b):
             return fused_resident_count2(op, row_matrix, pairs)
         return fused_gather_count2(op, row_matrix, pairs)
-    return bitwise.gather_count(op, row_matrix, pairs)
+    return bitwise.gather_count(op, _rm3(row_matrix), pairs)
 
 
 def gather_count_multi(op, row_matrix, idx):
@@ -141,7 +161,7 @@ def gather_count_multi(op, row_matrix, idx):
     cover (op="or").  idx: int32[B, K], padded with fold-idempotent
     ids (and/or: any operand; andnot: any non-first operand)."""
     b, k = idx.shape
-    if use_pallas() and _tileable(row_matrix.shape[-1]):
+    if use_pallas() and _tileable(rm_words(row_matrix)):
         # Prefetched ids must fit SMEM: the pair kernels prefetch B*2 ids
         # under _GATHER_BATCH_MAX, so bound B*K by the same id budget
         # (wide operand lists shrink the per-chunk batch).
@@ -158,16 +178,17 @@ def gather_count_multi(op, row_matrix, idx):
     # footprint by chunking the batch (shared sizing helper).
     from pilosa_tpu.pilosa import OR_MULTI_BUDGET_DEVICE, or_multi_chunk_size
 
-    s, _, w = row_matrix.shape
+    s, _, w = _rm_dims(row_matrix)
+    rm = _rm3(row_matrix)
     chunk = or_multi_chunk_size(s, k, w, OR_MULTI_BUDGET_DEVICE)
     if b > chunk:
         return jnp.concatenate(
             [
-                bitwise.gather_count_multi(op, row_matrix, idx[i : i + chunk])
+                bitwise.gather_count_multi(op, rm, idx[i : i + chunk])
                 for i in range(0, b, chunk)
             ]
         )
-    return bitwise.gather_count_multi(op, row_matrix, idx)
+    return bitwise.gather_count_multi(op, rm, idx)
 
 
 def gather_count_or_multi(row_matrix, idx):
@@ -175,12 +196,20 @@ def gather_count_or_multi(row_matrix, idx):
     return gather_count_multi("or", row_matrix, idx)
 
 
-def batch_intersection_count(rows, src):
+def batch_intersection_count(rows, src, tiled: bool = False):
     """|rows[k] & src| for a stack of rows — TopN's exact-count hot loop.
 
     On TPU this streams the single src block through the fused Pallas
-    kernel (no K-way broadcast in HBM).
+    kernel (no K-way broadcast in HBM).  ``tiled=True``: rows/src carry
+    the word axis as trailing [W/128, 128] dims (rows sliced from a 4D
+    engine matrix — no relayout on the way in).
     """
+    if tiled:
+        if use_pallas() and _tileable(rows.shape[-2] * rows.shape[-1]):
+            return fused_count2("and", rows, src, tiled=True)
+        rows = rows.reshape(*rows.shape[:-2], -1)
+        src = src.reshape(*src.shape[:-2], -1)
+        return bitwise.batch_intersection_count(rows, src)
     if use_pallas() and rows.ndim >= 2 and _tileable(rows.shape[-1]):
         return fused_count2("and", rows, src)
     return bitwise.batch_intersection_count(rows, src)

@@ -56,8 +56,31 @@ def _tileable(n_words: int) -> bool:
     return n_words % (_LANES * _SUBLANES) == 0
 
 
-@functools.partial(jax.jit, static_argnames=("op", "interpret"))
-def fused_count2(op: str, a, b, interpret: bool = False):
+def rm_words(rm) -> int:
+    """Logical word count W of a row matrix in either layout (see _rm4)."""
+    return rm.shape[-1] if rm.ndim == 3 else rm.shape[-2] * rm.shape[-1]
+
+
+def _rm4(rm):
+    """Canonical TILED row-matrix form uint32[S, R, W/128, 128].
+
+    Device arrays BORN in this 4D form avoid the relayout XLA otherwise
+    inserts when a [S, R, W] array is reshaped inside jit: the physical
+    (8, 128) tiling of (R, W) differs from that of (W/128, 128), so the
+    reshape materializes a full tiled copy of the matrix in HBM — the
+    round-2 OOM at 1024 slices was exactly this 8 GB temp
+    (BASELINE.md round-3 note).  Jax engines therefore store matrices 4D
+    (engine.matrix) and this helper is an identity no-op; 3D callers
+    (tests, numpy-built transients) still work and pay the transient.
+    """
+    if rm.ndim == 4:
+        return rm
+    s, r, w = rm.shape
+    return rm.reshape(s, r, w // _LANES, _LANES)
+
+
+@functools.partial(jax.jit, static_argnames=("op", "interpret", "tiled"))
+def fused_count2(op: str, a, b, interpret: bool = False, tiled: bool = False):
     """sum(popcount(op(a, b))) over the last axis via a Pallas kernel.
 
     a: uint32[..., W] with W % 1024 == 0; b: same shape as a, OR uint32[W]
@@ -65,20 +88,30 @@ def fused_count2(op: str, a, b, interpret: bool = False):
     stack of candidate rows).  The shared case streams the one b block into
     VMEM once per grid step instead of materializing a K-way broadcast in
     HBM.  Returns int32[...] (a's shape minus the word axis).
+
+    ``tiled=True`` declares that the trailing TWO axes are the word axis
+    in canonical tiled form [..., W/128, 128] (see _rm4): rows sliced out
+    of a 4D engine matrix keep their relayout-free path, and b is
+    [..., W/128, 128] correspondingly.
     """
-    shape = a.shape
-    w = shape[-1]
+    if tiled:
+        sub = a.shape[-2] * a.shape[-1] // _LANES
+        shape = a.shape[:-2] + (a.shape[-2] * a.shape[-1],)
+        shared_b = b.ndim == 2 and a.ndim > 2
+    else:
+        shape = a.shape
+        sub = shape[-1] // _LANES
+        shared_b = b.ndim == 1 and a.ndim > 1
+    w = sub * _LANES
     m = 1
     for d in shape[:-1]:
         m *= d
-    sub = w // _LANES
     a3 = a.reshape(m, sub, _LANES)
-    shared_b = b.ndim == 1 and a.ndim > 1
     if shared_b:
         b3 = b.reshape(1, sub, _LANES)
         b_spec = pl.BlockSpec((1, sub, _LANES), lambda i: (0, 0, 0))
     else:
-        b3 = jnp.broadcast_to(b, shape).reshape(m, sub, _LANES)
+        b3 = jnp.broadcast_to(b, a.shape).reshape(m, sub, _LANES)
         b_spec = pl.BlockSpec((1, sub, _LANES), lambda i: (i, 0, 0))
     out = pl.pallas_call(
         functools.partial(_count2_kernel, op),
@@ -160,13 +193,14 @@ def fused_resident_count2(op: str, row_matrix, pairs, interpret: bool = False):
     TPU-native analog of the reference's rowCache keeping hot rows out of
     the mmap (fragment.go:338-367) — here "cache" is VMEM residency.
     """
-    n_slices, n_rows, w = row_matrix.shape
+    rm4 = _rm4(row_matrix)
+    n_slices, n_rows = rm4.shape[:2]
+    w = rm4.shape[2] * rm4.shape[3]
     b = pairs.shape[0]
     c_sub = _resident_chunk_sub(n_rows, w, b)
     if c_sub == 0:
         raise ValueError("row matrix + accumulator too large for resident kernel")
     n_chunks = (w // _LANES) // c_sub
-    rm4 = row_matrix.reshape(n_slices, n_rows, w // _LANES, _LANES)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(n_slices, n_chunks),
@@ -215,9 +249,8 @@ def fused_gather_count2(op: str, row_matrix, pairs, interpret: bool = False):
     minor grid dimension so the per-query accumulator tile stays resident
     in VMEM across the reduction.
     """
-    n_slices, n_rows, w = row_matrix.shape
-    sub = w // _LANES
-    rm4 = row_matrix.reshape(n_slices, n_rows, sub, _LANES)
+    rm4 = _rm4(row_matrix)
+    n_slices, n_rows, sub = rm4.shape[:3]
     b = pairs.shape[0]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
@@ -234,6 +267,87 @@ def fused_gather_count2(op: str, row_matrix, pairs, interpret: bool = False):
         out_shape=jax.ShapeDtypeStruct((b, 8, _LANES), jnp.int32),
         interpret=interpret,
     )(pairs, rm4, rm4)
+    return out.sum(axis=(1, 2))
+
+
+def _gather_rowmajor_kernel(op, depth, pairs_ref, rm_ref, out_ref, buf, sems):
+    q = pl.program_id(0)
+    n_q = pl.num_programs(0)
+
+    def dma(i, o):
+        # Whole row (ALL slices) in ONE descriptor: rm is row-major
+        # [R, S, sub, 128], so rm[r] is a single contiguous S*W*4-byte
+        # region.  The v5e DMA engine spends ~1 us of serial processing
+        # per descriptor regardless of size (measured; BASELINE.md
+        # round-3 note), so fewer/bigger transfers are the whole game:
+        # per-(query, slice) 128 KB descriptors cap well under 20% of HBM
+        # bandwidth, one 512 KB descriptor per operand reaches ~40%, 2 MB
+        # reaches ~76%.
+        return pltpu.make_async_copy(
+            rm_ref.at[pairs_ref[i, o]], buf.at[i % depth, o], sems.at[i % depth, o]
+        )
+
+    @pl.when(q == 0)
+    def _():
+        for d in range(depth - 1):
+            for o in range(2):
+                dma(d, o).start()
+
+    @pl.when(q + depth - 1 < n_q)
+    def _():
+        for o in range(2):
+            dma(q + depth - 1, o).start()
+
+    for o in range(2):
+        dma(q, o).wait()
+    a = buf[q % depth, 0]
+    b = buf[q % depth, 1]
+    pc = lax.population_count(_op_apply(op, a, b)).astype(jnp.int32)
+    s, sub, _ = pc.shape
+    out_ref[0] = pc.reshape(s * sub // 8, 8, _LANES).sum(axis=0)
+
+
+@functools.partial(jax.jit, static_argnames=("op", "depth", "interpret"))
+def fused_gather_count2_rowmajor(
+    op: str, row_major, pairs, depth: int = 2, interpret: bool = False
+):
+    """Pair counts over a ROW-MAJOR tiled matrix uint32[R, S, W/128, 128].
+
+    The gather regime's fast path for working sets too tall for the
+    resident kernel: one hand-pipelined DMA per (query, operand) moves the
+    operand row across ALL slices in a single contiguous descriptor, with
+    ``depth`` queries in flight.  The slice-major form's per-(query,
+    slice) descriptors bound that kernel by the DMA engine's serial
+    descriptor rate, not HBM bandwidth (see _gather_rowmajor_kernel);
+    row-major storage trades the slice-sharding-friendly axis order for
+    descriptor-rate relief — callers that keep matrices slice-sharded on
+    a mesh stay on :func:`fused_gather_count2`.
+
+    pairs: int32[B, 2].  Returns int32[B].  VMEM: 2*depth row buffers
+    (depth*2*S*W*4 bytes) — callers bound S*W accordingly.
+    """
+    n_rows, n_slices, sub = row_major.shape[:3]
+    b = pairs.shape[0]
+    # A pipeline deeper than the batch would start DMAs for queries past
+    # the id array (and never wait on them — outstanding copies at kernel
+    # exit corrupt or hang real hardware).
+    depth = max(1, min(depth, b))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b,),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec((1, 8, _LANES), lambda q, pr: (q, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((depth, 2, n_slices, sub, _LANES), jnp.uint32),
+            pltpu.SemaphoreType.DMA((depth, 2)),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_gather_rowmajor_kernel, op, depth),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, 8, _LANES), jnp.int32),
+        interpret=interpret,
+    )(pairs, row_major)
     return out.sum(axis=(1, 2))
 
 
@@ -287,10 +401,9 @@ def fused_gather_count_multi(op: str, row_matrix, idx, interpret: bool = False):
     across the slice axis.  The XLA fallback materializes the whole
     [S, B, K, W] gather in HBM first.
     """
-    n_slices, n_rows, w = row_matrix.shape
+    rm4 = _rm4(row_matrix)
+    n_slices, n_rows, sub = rm4.shape[:3]
     b, n_ops = idx.shape
-    sub = w // _LANES
-    rm4 = row_matrix.reshape(n_slices, n_rows, sub, _LANES)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(b, n_slices, n_ops),

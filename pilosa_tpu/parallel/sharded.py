@@ -152,10 +152,13 @@ def sharded_count_call(mesh: SliceMesh, op: str, a, b):
 
 
 @functools.lru_cache(maxsize=None)
-def _sharded_pair_kernel(mesh_obj, axis: str, op: str, resident: bool, interpret: bool):
+def _sharded_pair_kernel(
+    mesh_obj, axis: str, op: str, resident: bool, interpret: bool, rm_ndim: int = 3
+):
     """Jitted shard_map'd Pallas pair-count kernel, cached per (mesh, op,
     strategy) — a fresh closure per call would retrace + recompile every
-    query (jax.Mesh is hashable, so it keys the cache directly)."""
+    query (jax.Mesh is hashable, so it keys the cache directly).
+    ``rm_ndim`` supports both the 3D logical and 4D tiled matrix forms."""
     import jax
     from jax import lax
     from jax.sharding import PartitionSpec as P
@@ -168,7 +171,7 @@ def _sharded_pair_kernel(mesh_obj, axis: str, op: str, resident: bool, interpret
     @functools.partial(
         jax.shard_map,
         mesh=mesh_obj,
-        in_specs=(P(axis, None, None), P(None, None)),
+        in_specs=(P(axis, *([None] * (rm_ndim - 1))), P(None, None)),
         out_specs=P(),
         check_vma=False,
     )
@@ -183,7 +186,7 @@ def _sharded_pair_kernel(mesh_obj, axis: str, op: str, resident: bool, interpret
 
 
 @functools.lru_cache(maxsize=None)
-def _sharded_multi_kernel(mesh_obj, axis: str, op: str, interpret: bool):
+def _sharded_multi_kernel(mesh_obj, axis: str, op: str, interpret: bool, rm_ndim: int = 3):
     import jax
     from jax import lax
     from jax.sharding import PartitionSpec as P
@@ -193,7 +196,7 @@ def _sharded_multi_kernel(mesh_obj, axis: str, op: str, interpret: bool):
     @functools.partial(
         jax.shard_map,
         mesh=mesh_obj,
-        in_specs=(P(axis, None, None), P(None, None)),
+        in_specs=(P(axis, *([None] * (rm_ndim - 1))), P(None, None)),
         out_specs=P(),
         check_vma=False,
     )
@@ -229,9 +232,10 @@ def sharded_gather_count(
     """
     import jax.numpy as jnp
 
-    from pilosa_tpu.ops.pallas_kernels import resident_strategy
+    from pilosa_tpu.ops.pallas_kernels import resident_strategy, rm_words
 
-    n_slices, n_rows, w = row_matrix.shape
+    n_slices, n_rows = row_matrix.shape[:2]
+    w = rm_words(row_matrix)
     _require_divisible(n_slices, mesh.n_devices)
     b = pairs.shape[0]
     if b > _SHARDED_BATCH_MAX:
@@ -244,7 +248,8 @@ def sharded_gather_count(
             ]
         )
     kernel = _sharded_pair_kernel(
-        mesh.mesh, mesh.AXIS, op, resident_strategy(n_rows, w, b), interpret
+        mesh.mesh, mesh.AXIS, op, resident_strategy(n_rows, w, b), interpret,
+        row_matrix.ndim,
     )
     return kernel(row_matrix, pairs)
 
@@ -270,7 +275,7 @@ def sharded_gather_count_multi(
                 for i in range(0, b, chunk)
             ]
         )
-    kernel = _sharded_multi_kernel(mesh.mesh, mesh.AXIS, op, interpret)
+    kernel = _sharded_multi_kernel(mesh.mesh, mesh.AXIS, op, interpret, row_matrix.ndim)
     return kernel(row_matrix, idx)
 
 
