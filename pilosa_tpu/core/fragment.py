@@ -157,6 +157,7 @@ class Fragment:
         self._pending_rows: dict[int, int] = {}
         self._open = False
         self._lock_fd: Optional[int] = None
+        self._storage_map = None  # live mmap backing zero-copy containers
         # Write generation: refreshed on every mutation from a
         # process-global counter, so engine-side assembled row matrices
         # (executor fused path) can validate their cache without hashing
@@ -184,20 +185,27 @@ class Fragment:
                 pass
         try:
             if os.path.exists(self.path):
-                with open(self.path, "rb") as f:
-                    data = f.read()
-                if data:
+                data, mm = self._map_storage()
+                if data is not None:
                     try:
-                        self.storage = roaring.Bitmap.from_bytes(data)
+                        self.storage = roaring.Bitmap.from_bytes(
+                            data, zero_copy=mm is not None
+                        )
                     except ValueError:
                         # Torn WAL tail (crash mid-append): recover the
                         # valid prefix and truncate the file there.  Real
                         # snapshot-body corruption re-raises from inside
-                        # from_bytes_recover's strict body parse.
-                        self.storage, valid_len = roaring.Bitmap.from_bytes_recover(data)
+                        # from_bytes_recover's strict body parse.  Safe
+                        # with the mmap: valid_len covers the snapshot
+                        # body, so no container view extends past the
+                        # truncation point.
+                        self.storage, valid_len = roaring.Bitmap.from_bytes_recover(
+                            data, zero_copy=mm is not None
+                        )
                         with open(self.path, "r+b") as f:
                             f.truncate(valid_len)
                         self.stats.count("walRecoveredN", 1)
+                    self._storage_map = mm
             self._attach_wal()
             self._load_cache()
         except BaseException:
@@ -209,6 +217,32 @@ class Fragment:
             raise
         self._open = True
 
+    def _map_storage(self):
+        """(buffer, mmap-or-None) for the storage file: an mmap when
+        possible (zero-copy attach: open cost is O(container headers),
+        payloads page in on demand, the index can exceed host RAM —
+        fragment.go:179-234), else the file bytes.  ``PILOSA_TPU_MMAP=0``
+        forces the read path."""
+        use_mmap = os.environ.get("PILOSA_TPU_MMAP", "1").lower() not in (
+            "0", "false", "no",
+        )
+        if use_mmap:
+            import mmap as _mmap
+
+            try:
+                with open(self.path, "rb") as f:
+                    mm = _mmap.mmap(f.fileno(), 0, access=_mmap.ACCESS_READ)
+                if hasattr(mm, "madvise"):
+                    # The query access pattern is random container touches
+                    # (the reference's MADV_RANDOM, fragment.go:205).
+                    mm.madvise(_mmap.MADV_RANDOM)
+                return mm, mm
+            except (OSError, ValueError):
+                pass  # empty file or fs without mmap: fall through
+        with open(self.path, "rb") as f:
+            data = f.read()
+        return (data if data else None), None
+
     def close(self) -> None:
         if self._wal is not None:
             self._wal.close()
@@ -217,6 +251,20 @@ class Fragment:
             self._flush_row_bookkeeping()
         self._save_cache()
         self._release_flock()
+        # Drop the storage containers BEFORE closing the map: mmap.close()
+        # with live exported views would fail (BufferError) — replace
+        # storage so no view outlives the mapping.  Under _mu so a reader
+        # mid-query (e.g. delete_frame closing while a row read holds the
+        # lock) never observes the swapped-in empty bitmap.
+        mm = getattr(self, "_storage_map", None)
+        if mm is not None:
+            with self._mu:
+                self.storage = roaring.Bitmap()
+                self._storage_map = None
+            try:
+                mm.close()
+            except BufferError:
+                pass  # a caller still holds a row view; GC will finish it
         self._open = False
 
     def _acquire_flock(self) -> None:

@@ -178,6 +178,20 @@ class Container:
         words = self.bitmap[(lows >> np.uint32(6)).astype(np.int64)]
         return ((words >> (lows & np.uint32(63)).astype(np.uint64)) & np.uint64(1)).astype(bool)
 
+    def _writable_bitmap(self) -> np.ndarray:
+        """Copy-on-write gate for in-place bitmap-container mutation.
+
+        mmap-attached containers (zero-copy snapshot views,
+        Bitmap.from_bytes(..., zero_copy=True)) hold READ-ONLY views into
+        the mapped file; the first mutation promotes the container to a
+        private heap copy — the reference's equivalent is the op log
+        keeping mutations out of the mmap entirely (roaring.go:84-103 adds
+        go to the WAL; the mmap stays immutable until snapshot)."""
+        bm = self.bitmap
+        if not bm.flags.writeable:
+            bm = self.bitmap = bm.copy()
+        return bm
+
     def add(self, v: int) -> bool:
         """Insert lowbits value; True if it was newly added."""
         arr = self.array
@@ -228,7 +242,7 @@ class Container:
         if (int(self.bitmap[w]) >> b) & 1:
             return False
         self._ser = None
-        self.bitmap[w] |= np.uint64(1 << b)
+        self._writable_bitmap()[w] |= np.uint64(1 << b)
         if self._n is not None:
             self._n += 1
         return True
@@ -246,7 +260,7 @@ class Container:
         if not (int(self.bitmap[w]) >> b) & 1:
             return False
         self._ser = None
-        self.bitmap[w] &= np.uint64(~(1 << b) & 0xFFFFFFFFFFFFFFFF)
+        self._writable_bitmap()[w] &= np.uint64(~(1 << b) & 0xFFFFFFFFFFFFFFFF)
         if self._n is not None:
             self._n -= 1
         # Convert back to array when small enough (roaring.go remove path).
@@ -269,7 +283,7 @@ class Container:
             # Dense stays dense: OR the bits in directly, O(len + 1024)
             # instead of a full unpack + union sort.
             np.bitwise_or.at(
-                self.bitmap,
+                self._writable_bitmap(),
                 (values >> np.uint32(6)).astype(np.int64),
                 np.uint64(1) << (values & np.uint32(63)).astype(np.uint64),
             )
@@ -807,19 +821,30 @@ class Bitmap:
         return buf.getvalue()
 
     @classmethod
-    def _parse_snapshot(cls, data: bytes) -> tuple["Bitmap", int]:
-        """Strict snapshot-body decode; returns (bitmap, op-log offset)."""
+    def _parse_snapshot(cls, data, zero_copy: bool = False) -> tuple["Bitmap", int]:
+        """Strict snapshot-body decode; returns (bitmap, op-log offset).
+
+        ``zero_copy=True`` (little-endian hosts): container payloads become
+        READ-ONLY numpy views into ``data`` — pass an ``mmap.mmap`` and the
+        open is O(headers); payload bytes page in on first touch and the
+        index can exceed host RAM (the reference's mmap attach,
+        roaring.go:536-614 + fragment.go:179-234).  Mutations
+        copy-on-write per container (Container._writable_bitmap /
+        the array insert paths, which already allocate fresh arrays).
+        """
         if len(data) < HEADER_SIZE:
             raise ValueError("data too small")
-        head = np.frombuffer(data[:8], dtype="<u4")
+        raw = np.frombuffer(data, dtype=np.uint8)
+        zero_copy = zero_copy and _NATIVE_LE
+        head = raw[:8].view("<u4")
         if int(head[0]) != COOKIE:
             raise ValueError("invalid roaring file")
         n = int(head[1])
         bm = cls()
-        hdr = np.frombuffer(data[8 : 8 + n * 12], dtype=np.uint8)
+        hdr = raw[8 : 8 + n * 12]
         keys = hdr.reshape(n, 12)[:, :8].copy().view("<u8").ravel() if n else np.empty(0, "<u8")
         counts = (hdr.reshape(n, 12)[:, 8:12].copy().view("<u4").ravel() + 1) if n else []
-        offsets = np.frombuffer(data[8 + n * 12 : 8 + n * 16], dtype="<u4")
+        offsets = raw[8 + n * 12 : 8 + n * 16].view("<u4")
         ops_offset = HEADER_SIZE + n * 16
         for i in range(n):
             key, cnt, off = int(keys[i]), int(counts[i]), int(offsets[i])
@@ -828,12 +853,14 @@ class Bitmap:
                 raise ValueError(
                     f"container payload out of bounds: off={off}, need={payload}, len={len(data)}"
                 )
+            view = raw[off : off + payload]
             if cnt <= ARRAY_MAX_SIZE:
-                arr = np.frombuffer(data[off : off + payload], dtype="<u4").astype(np.uint32)
-                bm.containers[key] = Container(array=arr)
+                arr = view.view("<u4") if zero_copy else view.view("<u4").astype(np.uint32)
+                c = bm.containers[key] = Container(array=arr)
             else:
-                words = np.frombuffer(data[off : off + payload], dtype="<u8").astype(np.uint64)
-                bm.containers[key] = Container(bitmap=words)
+                words = view.view("<u8") if zero_copy else view.view("<u8").astype(np.uint64)
+                c = bm.containers[key] = Container(bitmap=words)
+                c._n = cnt  # header carries the exact cardinality
             ops_offset = off + payload
         return bm, ops_offset
 
@@ -849,14 +876,15 @@ class Bitmap:
             self.op_n += 1
 
     @classmethod
-    def from_bytes(cls, data: bytes) -> "Bitmap":
+    def from_bytes(cls, data, zero_copy: bool = False) -> "Bitmap":
         """Decode the reference format, applying any trailing op log.
 
         Strict: any invalid op record raises (the reference's open
         behavior, roaring.go:590-611).  Crash recovery is the caller's
-        policy — see :meth:`from_bytes_recover`.
+        policy — see :meth:`from_bytes_recover`.  ``zero_copy``: see
+        :meth:`_parse_snapshot` (pass an mmap; containers view it).
         """
-        bm, ops_offset = cls._parse_snapshot(data)
+        bm, ops_offset = cls._parse_snapshot(data, zero_copy=zero_copy)
         # Trailing op log (roaring.go:590-611); decoded+verified in one
         # native pass when the C++ kernels are available.
         buf = data[ops_offset:]
@@ -866,7 +894,7 @@ class Bitmap:
         return bm
 
     @classmethod
-    def from_bytes_recover(cls, data: bytes) -> tuple["Bitmap", int]:
+    def from_bytes_recover(cls, data, zero_copy: bool = False) -> tuple["Bitmap", int]:
         """Crash-recovery decode: snapshot body strictly, op log leniently.
 
         A torn tail — the partial or checksum-corrupt record a crash
@@ -880,7 +908,7 @@ class Bitmap:
         length of the recoverable file prefix (snapshot + valid ops); the
         caller truncates the file there to discard the torn tail.
         """
-        bm, ops_offset = cls._parse_snapshot(data)
+        bm, ops_offset = cls._parse_snapshot(data, zero_copy=zero_copy)
         buf = bytes(data[ops_offset:])
         valid_len = ops_offset
         if buf:

@@ -345,3 +345,63 @@ def test_set_bits_mostly_duplicate_batch_uses_wal(tmp_path):
     f = reopen(f)  # replayed from snapshot + WAL
     assert f.contains(0, 1002) and f.row_count(0) == 503
     f.close()
+
+
+def test_mmap_open_bounded_rss(tmp_path):
+    """mmap attach: opening a large fragment costs O(container headers) of
+    heap, not O(file) — payloads stay in the page cache until touched
+    (fragment.go:179-234).  Measured in a subprocess so interpreter noise
+    can't mask the difference between the mmap and read-everything paths."""
+    import subprocess
+    import sys
+
+    import numpy as np
+
+    from pilosa_tpu import roaring
+
+    # Build a ~256 MB snapshot fast: 32k full dense containers written
+    # straight into the container map (an import loop would dominate the
+    # test's runtime for no extra coverage).
+    bm = roaring.Bitmap()
+    full = np.full(roaring.BITMAP_N, 0xFFFFFFFFFFFFFFFF, dtype=np.uint64)
+    for key in range(32768):
+        c = roaring.Container(bitmap=full)
+        c._n = 1 << 16
+        bm.containers[key] = c
+    path = tmp_path / "frag"
+    with open(path, "wb") as f:
+        bm.write_to(f)
+    assert path.stat().st_size > 250 << 20
+
+    child = """
+import os, resource, sys
+from pilosa_tpu.core.fragment import Fragment
+base = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss  # post-import
+f = Fragment(sys.argv[1], "i", "f", "standard", 0)
+f.open()
+assert f.storage.count() == 32768 * 65536
+row = f.row_dense(0)          # touch ONE row's containers
+assert row.any()
+peak = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+print(base, peak)             # KiB on linux
+f.close()
+"""
+    env = dict(os.environ, PYTHONPATH=os.path.dirname(os.path.dirname(__file__)))
+    def deltas(mmap_on: str) -> int:
+        env2 = dict(env, PILOSA_TPU_MMAP=mmap_on)
+        out = subprocess.run(
+            [sys.executable, "-c", child, str(path)],
+            capture_output=True, text=True, env=env2, timeout=120,
+        )
+        assert out.returncode == 0, out.stderr[-2000:]
+        base, peak = (int(x) * 1024 for x in out.stdout.split())
+        return peak - base
+
+    # Deltas over each child's own post-import baseline, so the ~200 MB
+    # interpreter+numpy footprint (environment-dependent) cancels out.
+    delta_mmap = deltas("1")
+    delta_read = deltas("0")
+    # read path holds file bytes + parsed copies (> the 256 MB file)...
+    assert delta_read > 220 << 20, f"read delta {delta_read >> 20} MB"
+    # ...the mmap path opens the same file for headers + one row only.
+    assert delta_mmap < 64 << 20, f"mmap open delta {delta_mmap >> 20} MB"

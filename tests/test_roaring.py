@@ -362,3 +362,40 @@ def test_snapshot_mirror_gate_and_equivalence():
     b3 = io.BytesIO()
     bm._write_to_python(b3)
     assert b3.getvalue() == img2
+
+
+def test_zero_copy_parse_and_cow():
+    """Zero-copy decode: containers view the buffer (no payload copies);
+    mutations promote to private copies (roaring.go:536-614 mmap attach)."""
+    rng = np.random.default_rng(31)
+    bm = Bitmap()
+    vals = np.unique(rng.integers(0, 1 << 19, size=120000)).astype(np.uint64)
+    bm.add_many_unlogged(vals)
+    data = bm.to_bytes()
+
+    z = Bitmap.from_bytes(data, zero_copy=True)
+    assert z.count() == bm.count()
+    z.check()
+    # bitmap containers really are views into the buffer...
+    dense = [c for c in z.containers.values() if c.bitmap is not None]
+    assert dense, "shape should produce dense containers"
+    assert all(not c.bitmap.flags.writeable for c in dense)
+    assert all(c.bitmap.base is not None for c in dense)
+    # ...and copy-on-write on mutation, without touching siblings.
+    key = next(k for k, c in z.containers.items() if c.bitmap is not None)
+    c = z.containers[key]
+    v = (key << 16) | 7
+    added = z.add(v)
+    assert z.contains(v)
+    if added:
+        assert c.bitmap.flags.writeable  # promoted private copy
+    assert z.count() == bm.count() + (1 if added else 0)
+    # equivalence with the copying decode after a WAL-ish mutation mix
+    z2 = Bitmap.from_bytes(data, zero_copy=True)
+    c2 = Bitmap.from_bytes(data)
+    for x in rng.integers(0, 1 << 21, size=500).tolist():
+        assert z2.add(x) == c2.add(x)
+    for x in rng.integers(0, 1 << 21, size=500).tolist():
+        assert z2.remove(x) == c2.remove(x)
+    assert z2.count() == c2.count()
+    assert z2.to_bytes() == c2.to_bytes()
