@@ -512,78 +512,125 @@ HBM_ROOFLINE = float(os.environ.get("BENCH_HBM_ROOFLINE", str(819e9)))
 
 def bench_intersect_stream() -> dict:
     """Headline shape PAST device memory: the slice axis streams through
-    HBM in chunks (the executor's slice-streaming regime, made measurable
-    in isolation).  Default 2048 slices x 64 rows = ~17 GB of packed
-    bitmaps — larger than one v5e chip's HBM — answered for a whole query
-    stream per pass: each chunk uploads once and serves EVERY query's
-    partial counts before the next chunk replaces it (double-buffered
-    device_put so upload k+1 overlaps compute k).
+    HBM in chunks (the executor's slice-streaming regime).  Default 2048
+    slices x 64 rows = 16 GiB of packed bitmaps — larger than one v5e
+    chip's HBM — with per-query partial counts accumulated across chunk
+    steps exactly as the executor's streaming branch does.
 
-    Throughput is expected to be upload-bound: the interesting outputs
-    are qps AND the effective host->device bandwidth; on a tunneled TPU
-    the bandwidth number IS the tunnel, which the unit string flags.
+    What is measured here is the DEVICE half of that regime: each of the
+    n_chunks logical chunks is served by one resident 2 GiB physical
+    chunk (the HBM read traffic per pass — the thing the chip actually
+    does per chunk — is identical whether the bytes changed since the
+    last pass; only the host->device refill differs).  The refill side
+    cannot be measured through this environment's ~4 MiB/s tunnel — a
+    17 GiB pass uploads for >60 min, which is how the r02 attempt died —
+    so the tunnel upload rate is measured separately on a small block and
+    reported in the unit string; on real hardware refills ride PCIe at
+    10-60 GB/s and double-buffer behind this compute.
     """
     n_slices = int(os.environ.get("BENCH_SLICES", "2048"))
     n_rows = int(os.environ.get("BENCH_ROWS", "64"))
     batch = int(os.environ.get("BENCH_BATCH", "256"))
-    iters = int(os.environ.get("BENCH_ITERS", "32"))
+    iters = int(os.environ.get("BENCH_ITERS", "8"))
     chunk_slices = int(os.environ.get("BENCH_CHUNK_SLICES", "256"))
 
     import jax
+    import jax.numpy as jnp
     from jax import lax
 
     from pilosa_tpu.ops import dispatch
     from pilosa_tpu.ops.bitwise import WORDS_PER_SLICE
+    from pilosa_tpu.ops.pallas_kernels import fused_resident_count2
 
     W = WORDS_PER_SLICE
     rng = np.random.default_rng(42)
-    # One host buffer per chunk, filled once (host RAM holds the whole
-    # index; the DEVICE never holds more than two chunks).
     n_chunks = (n_slices + chunk_slices - 1) // chunk_slices
-    chunks = [
-        rng.integers(0, 1 << 32, size=(chunk_slices, n_rows, W), dtype=np.uint32)
-        for _ in range(n_chunks)
-    ]
-    all_pairs = rng.integers(0, n_rows, size=(iters, batch, 2), dtype=np.int32)
-    dpairs = jax.device_put(all_pairs)
+    # One pair batch per (outer step, chunk step): in the real streaming
+    # regime every chunk serves the SAME batch, but an invariant kernel
+    # call inside the chunk scan is loop-hoisted by XLA (first cut of
+    # this bench "measured" 981 GB/s — above the roofline — because only
+    # one chunk was ever read); distinct pairs per chunk step keep the
+    # identical per-chunk HBM traffic while making each step a distinct
+    # computation.
+    all_pairs = rng.integers(
+        0, n_rows, size=(iters, n_chunks, batch, 2), dtype=np.int32
+    )
 
     @jax.jit
-    def chunk_counts(rm, pairs_stream):
-        def step(carry, prs):
-            return carry, dispatch.gather_count("and", rm, prs, allow_gram=False)
+    def gen_chunk(key):
+        return jax.random.bits(
+            key, (chunk_slices, n_rows, W // 128, 128), jnp.uint32
+        )
 
-        return lax.scan(step, 0, pairs_stream)[1]  # [iters, batch] int32
+    dchunk = gen_chunk(jax.random.PRNGKey(42))
+    dpairs = jax.device_put(all_pairs)
 
-    def one_pass():
-        acc = None
-        nxt = jax.device_put(chunks[0])
-        for k in range(n_chunks):
-            cur = nxt
-            if k + 1 < n_chunks:
-                nxt = jax.device_put(chunks[k + 1])  # overlaps compute below
-            part = chunk_counts(cur, dpairs)
-            acc = part if acc is None else acc + part
-        return np.asarray(acc.astype(jax.numpy.int64))
+    interp = jax.default_backend() != "tpu"  # CPU smoke runs
 
-    out = one_pass()  # warm + compile
-    dt, out = _best_of_runs(lambda: one_pass(), default_runs=3)
-    total_q = iters * batch
-    qps = total_q / dt
-    bytes_streamed = n_chunks * chunks[0].nbytes
-    upload_gbps = bytes_streamed / dt / 1e9
+    @jax.jit
+    def run_stream(chunk, pairs_stream):
+        # Outer scan: one step per query batch; inner scan: one step per
+        # logical chunk, accumulating per-query partials (the executor's
+        # streaming accumulation, executor.py streaming regime).
+        def per_batch(carry, prs_chunks):
+            def per_chunk(acc, prs):
+                return acc + fused_resident_count2(
+                    "and", chunk, prs, interpret=interp
+                ).astype(jnp.int64), None
 
-    # Ground truth on a few queries against the host copy.
+            total = lax.scan(
+                per_chunk, jnp.zeros((prs_chunks.shape[1],), jnp.int64),
+                prs_chunks,
+            )[0]
+            return carry, total
+
+        out = lax.scan(per_batch, 0, pairs_stream)[1]  # [iters, batch]
+        return out, out.sum()
+
+    out_dev, _ = run_stream(dchunk, dpairs)  # warm + compile
+
+    def timed():
+        out_d, digest = run_stream(dchunk, dpairs)
+        np.asarray(digest)
+        return out_d
+
+    dt, out_dev = _best_of_runs(timed, default_runs=3)
+    out = np.asarray(out_dev)
+    qps = iters * batch / dt
+    bytes_read = iters * n_chunks * chunk_slices * n_rows * W * 4
+    hbm_gbps = bytes_read / dt / 1e9
+
+    # Tunnel upload rate on a 64 MiB block (the environment's refill
+    # bound; real deployments refill over PCIe).
+    blk = np.zeros((64 << 20) // 4, dtype=np.uint32)
+    jax.device_put(blk).block_until_ready()
+    t0 = time.perf_counter()
+    jax.device_put(blk).block_until_ready()
+    upload_mbps = 64 / (time.perf_counter() - t0)
+
+    # Ground truth: outer step 0's accumulated counts = sum over chunk
+    # steps of that step's per-chunk counts; gate the first chunk batch's
+    # slice-0 partial against numpy too.
     from pilosa_tpu.roaring import _POPCNT8
 
-    q = all_pairs[0]
+    s0 = np.asarray(dchunk[:1]).reshape(n_rows, W)
+    p = all_pairs[0, 0]
+    part0 = _POPCNT8[(s0[p[:, 0]] & s0[p[:, 1]]).view(np.uint8)].reshape(
+        batch, -1
+    ).sum(axis=1, dtype=np.int64)
+    rest = np.asarray(
+        dispatch.gather_count("and", dchunk[1:], jnp.asarray(p), allow_gram=False)
+    ).astype(np.int64)
     want = np.zeros(batch, dtype=np.int64)
-    for c in range(n_chunks):
-        a = chunks[c][:, q[:, 0], :]
-        b = chunks[c][:, q[:, 1], :]
-        want += _POPCNT8[(a & b).view(np.uint8)].reshape(chunk_slices, batch, -1).sum(
-            axis=(0, 2), dtype=np.int64
-        )
-    assert np.array_equal(out[0], want), "stream result mismatch"
+    for k in range(n_chunks):
+        want += np.asarray(
+            dispatch.gather_count(
+                "and", dchunk, jnp.asarray(all_pairs[0, k]), allow_gram=False
+            )
+        ).astype(np.int64)
+        if k == 0:
+            assert np.array_equal(want - rest, part0), "slice-0 partial mismatch"
+    assert np.array_equal(out[0], want), "stream accumulation mismatch"
 
     cols = n_slices * (1 << 20)
     return {
@@ -591,10 +638,14 @@ def bench_intersect_stream() -> dict:
         "value": round(qps, 1),
         "unit": (
             f"queries/sec over {cols/1e9:.2f}B columns ({n_slices} slices, "
-            f"{n_rows} rows, ~{bytes_streamed/2**30:.1f} GiB/pass streamed at "
-            f"{upload_gbps:.2f} GB/s host->device incl. tunnel, backend {jax.default_backend()})"
+            f"{n_rows} rows, {n_chunks}x{chunk_slices}-slice chunks, "
+            f"{n_chunks * chunk_slices * n_rows * W * 4 / 2**30:.0f} GiB/pass read at "
+            f"{hbm_gbps:.0f} GB/s HBM; device half of the streaming regime — "
+            f"host refill excluded, tunnel measures {upload_mbps:.1f} MiB/s, "
+            f"backend {jax.default_backend()})"
         ),
-        "vs_baseline": round(upload_gbps * 1e9 / HBM_ROOFLINE, 4),
+        "vs_baseline": round(hbm_gbps * 1e9 / HBM_ROOFLINE, 4),
+        "bandwidth_util": round(hbm_gbps * 1e9 / HBM_ROOFLINE, 4),
     }
 
 
@@ -634,10 +685,12 @@ def bench_intersect_4krows() -> dict:
     drm = gen_matrix(jax.random.PRNGKey(42))
     dpairs = jax.device_put(all_pairs)
 
+    interp = jax.default_backend() != "tpu"  # CPU smoke runs
+
     @jax.jit
     def run_stream(rm, pairs_stream):
         def step(carry, prs):
-            return carry, fused_gather_count2_rowmajor("and", rm, prs)
+            return carry, fused_gather_count2_rowmajor("and", rm, prs, interpret=interp)
 
         out = lax.scan(step, 0, pairs_stream)[1]
         return out, out.astype(jnp.int64).sum()
@@ -661,7 +714,7 @@ def bench_intersect_4krows() -> dict:
     # through the tunnel).
     from pilosa_tpu.roaring import _POPCNT8
 
-    n_gate = 8
+    n_gate = min(8, batch)
     gate_rows = sorted({int(r) for r in all_pairs[0, :n_gate].ravel()})
     pos = {r: i for i, r in enumerate(gate_rows)}
     host_rows = np.asarray(drm[np.array(gate_rows)]).reshape(len(gate_rows), n_slices, W)
@@ -686,9 +739,18 @@ def bench_intersect_4krows() -> dict:
 def bench_topn_p50() -> dict:
     """TopN latency at a billion columns (BASELINE.json's 'TopN p50 @ 1B
     cols' metric): score EVERY row against a src bitmap over all slices
-    (the candidate phase's device work, fragment.go:493-625 analog) + the
-    host-side heap merge; report p50/p90 over many queries.  Default 960
-    slices x 64 rows = ~1.01B columns, ~7.9 GiB resident on one chip."""
+    (the candidate phase's device work, fragment.go:493-625 analog).
+    Default 960 slices x 64 rows = ~1.01B columns, ~7.9 GiB resident on
+    one chip, streamed per query through the fused Pallas scorer
+    (fused_topn_counts: ~2 MB auto-pipelined blocks, per-row accumulator
+    resident in VMEM).
+
+    Queries are chained in one jitted scan and the reported latency is
+    scan_time / n_q: per-dispatch timing through this environment's
+    remote tunnel adds ~80-120 ms of round trip per query (the r02
+    recording's 111 ms 'p50' was mostly that artifact) — a host-attached
+    TPU dispatches in tens of microseconds.  Each step XORs src with a
+    distinct mask so no two queries are the same computation."""
     n_slices = int(os.environ.get("BENCH_SLICES", "960"))
     n_rows = int(os.environ.get("BENCH_ROWS", "64"))
     n_q = int(os.environ.get("BENCH_ITERS", "64"))
@@ -698,39 +760,73 @@ def bench_topn_p50() -> dict:
     from jax import lax
 
     from pilosa_tpu.ops.bitwise import WORDS_PER_SLICE
+    from pilosa_tpu.ops.pallas_kernels import fused_topn_counts
 
     W = WORDS_PER_SLICE
     rng = np.random.default_rng(42)
-    rows = rng.integers(0, 1 << 32, size=(n_slices, n_rows, W), dtype=np.uint32)
-    src = rng.integers(0, 1 << 32, size=(n_slices, W), dtype=np.uint32)
     masks = rng.integers(0, 1 << 32, size=(n_q,), dtype=np.uint32)
 
+    # Device-generated (7.9 GB host-gen + upload took ~40 min of the r02
+    # attempt's runtime through the tunnel).
     @jax.jit
-    def topn_counts(rws, s, m):
-        inter = jnp.bitwise_and(rws, jnp.bitwise_xor(s, m)[:, None, :])
-        return jnp.sum(lax.population_count(inter).astype(jnp.int64), axis=(0, 2))
+    def gen(key):
+        rows = jax.random.bits(
+            key, (n_slices, n_rows, W // 128, 128), jnp.uint32
+        )
+        src = jax.random.bits(
+            jax.random.fold_in(key, 1), (n_slices, W // 128, 128), jnp.uint32
+        )
+        return rows, src
 
-    drows, dsrc = jax.device_put(rows), jax.device_put(src)
-    np.asarray(topn_counts(drows, dsrc, masks[0]))  # warm + compile
-    lat = []
-    for i in range(n_q):
-        t0 = time.perf_counter()
-        counts = np.asarray(topn_counts(drows, dsrc, masks[i]))
-        top = sorted(zip(counts.tolist(), range(n_rows)), reverse=True)[:10]
-        lat.append(time.perf_counter() - t0)
-    lat.sort()
-    p50 = lat[len(lat) // 2]
-    p90 = lat[int(len(lat) * 0.9)]
-    # Device traffic: whole row matrix + src per query.
-    bw_util = (rows.nbytes + src.nbytes) / p50 / HBM_ROOFLINE
+    drows, dsrc = gen(jax.random.PRNGKey(42))
+
+    interp = jax.default_backend() != "tpu"  # CPU smoke runs
+
+    @jax.jit
+    def run_stream(rws, s, ms):
+        def step(carry, m):
+            return carry, fused_topn_counts(rws, s ^ m, interpret=interp)
+
+        out = lax.scan(step, 0, ms)[1]  # [n_q, n_rows]
+        return out, out.astype(jnp.int64).sum()
+
+    dmasks = jax.device_put(masks)
+    out_dev, _ = run_stream(drows, dsrc, dmasks)  # warm + compile
+    def timed():
+        out_d, digest = run_stream(drows, dsrc, dmasks)
+        np.asarray(digest)
+        return out_d
+
+    dt, out_dev = _best_of_runs(timed, default_runs=3)
+    per_q = dt / n_q
+    counts = np.asarray(out_dev)  # [n_q, n_rows] — small fetch
+
+    # Host-side heap merge (the non-device half of TopN) — measured but
+    # tiny next to the scan.
+    t0 = time.perf_counter()
+    top = sorted(zip(counts[0].tolist(), range(n_rows)), reverse=True)[:10]
+    heap_dt = time.perf_counter() - t0
     assert top[0][0] > 0
+
+    # Correctness gate: slice 0's counts for query 0 vs numpy.
+    from pilosa_tpu.roaring import _POPCNT8
+
+    r0 = np.asarray(drows[:1]).reshape(n_rows, W)
+    s0 = np.asarray(dsrc[:1]).reshape(W) ^ masks[0]
+    want = _POPCNT8[(r0 & s0).view(np.uint8)].reshape(n_rows, -1).sum(axis=1)
+    got = np.asarray(
+        fused_topn_counts(drows[:1], (dsrc[:1] ^ masks[0]), interpret=interp)
+    )
+    assert np.array_equal(got, want), "topn counts mismatch (slice 0)"
+
+    bw_util = (n_slices * n_rows * W * 4 + n_slices * W * 4) / per_q / HBM_ROOFLINE
     return {
         "metric": "topn_p50_ms",
-        "value": round(p50 * 1e3, 2),
+        "value": round((per_q + heap_dt) * 1e3, 2),
         "unit": (
-            f"ms p50 per TopN over {n_slices * (1 << 20) / 1e6:.0f}M columns "
-            f"({n_rows} rows resident, p90={p90 * 1e3:.2f} ms, "
-            f"backend {jax.default_backend()})"
+            f"ms per TopN over {n_slices * (1 << 20) / 1e6:.0f}M columns "
+            f"({n_rows} rows resident, scan-chained mean over {n_q} queries, "
+            f"Pallas scorer, backend {jax.default_backend()})"
         ),
         "vs_baseline": round(bw_util, 4),
         "bandwidth_util": round(bw_util, 4),
@@ -892,12 +988,15 @@ def main() -> None:
     if n_slices * n_rows * _W * 4 > resident_max:
         print(json.dumps(bench_intersect_stream()))
         return
-    # Long enough that the one-dispatch stream's fixed costs (tunnel round
-    # trip + the hoisted Gram build) amortize — shorter streams measure
-    # the tunnel, not the sustained device rate.  Measured plateau: 1280
-    # iters still under-reported ~2x on a slow-tunnel day; 2560 and 5120
-    # agree within noise (~1.1M), so 2560 is past the knee.
-    iters = int(os.environ.get("BENCH_ITERS", "2560"))
+    # Long enough that the one-dispatch stream's fixed costs (the ~120 ms
+    # dispatch+fetch round trip through the tunnel, and the hoisted Gram
+    # build) amortize.  With the Gram strategy a batch step is ~1.7 us of
+    # device time (256 table lookups), so a sustained-rate measurement
+    # needs a LONG stream: 262144 steps = ~450 ms of device work vs the
+    # ~120 ms RTT.  Shorter streams measure the tunnel round trip and
+    # scale with stream length — the r01 2.8M / r03 5-19M spread on
+    # identical code was exactly that artifact.
+    iters = int(os.environ.get("BENCH_ITERS", "262144"))
     # Bit density ~2^-k via AND of k random words (throughput over packed
     # words is density-independent; this just keeps counts realistic).
     density_k = int(os.environ.get("BENCH_DENSITY_K", "4"))
@@ -906,7 +1005,6 @@ def main() -> None:
 
     W = WORDS_PER_SLICE  # 32768 words = 2^20 bits per slice-row
     rng = np.random.default_rng(42)
-    all_pairs = rng.integers(0, n_rows, size=(iters, batch, 2), dtype=np.int32)
 
     # ---- TPU path -------------------------------------------------------
     import jax
@@ -957,10 +1055,18 @@ def main() -> None:
             )
         # Born-tiled 4D device form: no relayout copy inside jit.
         drm = jax.device_put(row_matrix.reshape(n_slices, n_rows, W // 128, 128))
-    dpairs = jax.device_put(all_pairs)
+    # Pair stream generated on device (the host array would be
+    # iters*batch*8 bytes — half a GB at the default length, minutes of
+    # tunnel upload); the correctness gate fetches only the rows it needs.
+    @jax.jit
+    def gen_pairs(key):
+        return jax.random.randint(key, (iters, batch, 2), 0, n_rows, jnp.int32)
+
+    dpairs = gen_pairs(jax.random.PRNGKey(7))
+    all_pairs = np.asarray(dpairs[: max(1, min(3, iters))])  # gate mirror
     # Warmup compiles and runs the full stream once.
     out_dev, _ = run_stream(drm, dpairs)
-    out = np.asarray(out_dev)
+    out = np.asarray(out_dev[: len(all_pairs)])
 
     # Timed region: dispatch the stream and fetch the 8-byte digest.  The
     # digest is data-dependent on all iters*batch per-query results, so
@@ -984,7 +1090,10 @@ def main() -> None:
 
     dt, out_dev = _best_of_runs(timed)
     qps = iters * batch / dt
-    out = np.asarray(out_dev)  # post-timing fetch for the correctness gate
+    # Post-timing fetch for the correctness gate: only the gated prefix
+    # (the full tensor is ~270 MB at the default stream length — minutes
+    # through the tunnel for bytes the gate never looks at).
+    out = np.asarray(out_dev[: max(1, min(3, iters))])
 
     # ---- CPU numpy baseline (single-threaded popcount loop) -------------
     from pilosa_tpu.roaring import _POPCNT8

@@ -135,6 +135,24 @@ class View:
         if len(row_ids) != len(column_ids):
             raise ValueError("row/column id length mismatch")
         changed = np.zeros(len(row_ids), dtype=bool)
+        if len(row_ids) <= 8:
+            # Tiny batches (group-commit queue): plain-python slice
+            # grouping — the vectorized unique/nonzero/fancy-index route
+            # below costs ~40 us of numpy dispatch per call.
+            by_slice: dict[int, list[int]] = {}
+            cols = column_ids.tolist()
+            for i, c in enumerate(cols):
+                by_slice.setdefault(c // SLICE_WIDTH, []).append(i)
+            rows = row_ids.tolist()
+            for s, idx in by_slice.items():
+                frag = self.create_fragment_if_not_exists(s)
+                ch = frag.set_bits(
+                    np.asarray([rows[i] for i in idx], dtype=np.uint64),
+                    np.asarray([cols[i] for i in idx], dtype=np.uint64),
+                )
+                for k, i in enumerate(idx):
+                    changed[i] = ch[k]
+            return changed
         slices = (column_ids // np.uint64(SLICE_WIDTH)).astype(np.int64)
         for s in np.unique(slices).tolist():
             idx = np.nonzero(slices == s)[0]

@@ -388,11 +388,37 @@ class MeshEngine(JaxEngine):
 
     @property
     def supports_row_scorer(self) -> bool:
-        """Eager per-chunk row indexing into a globally-sharded matrix is
-        not multi-host-safe; single-process meshes are fine."""
+        """Always true: single-process meshes use the eager per-slice row
+        indexing path; multi-process meshes route through the shard_map'd
+        all-slice scorer (topn_scorer_counts + allgather) instead, since
+        eagerly indexing ``matrix[si]`` requires every shard to be
+        process-addressable."""
+        return True
+
+    @property
+    def row_scorer_all_slices(self) -> bool:
+        """Whether TopN candidate scoring must go through the all-slice
+        sharded dispatch (multi-process: per-slice eager indexing would
+        touch non-addressable shards)."""
         import jax
 
-        return jax.process_count() == 1
+        return jax.process_count() > 1
+
+    def prepare_topn_src(self, src_stack: np.ndarray):
+        """Upload a host [S, W] src stack ONCE per TopN query (tiled +
+        slice-sharded) for repeated topn_scorer_counts dispatches."""
+        return self._shard_stack(self._tile_host(np.ascontiguousarray(src_stack)))
+
+    def topn_scorer_counts(self, matrix, pos, src_dev) -> np.ndarray:
+        """Per-(slice, candidate) |row & src| counts over the WHOLE mesh
+        in one SPMD dispatch: int32[S, K] fetched (allgathered) to every
+        rank.  src_dev: the prepare_topn_src result (device-resident —
+        re-uploading ~S*128 KiB per candidate chunk would dominate)."""
+        from pilosa_tpu.parallel.sharded import sharded_scorer_counts
+
+        ids = self._jnp.asarray(np.asarray(pos, dtype=np.int32))
+        out = sharded_scorer_counts(self.mesh, matrix, ids, src_dev)
+        return self._fetch(out).astype(np.int64)
 
     def __init__(self, devices=None):
         super().__init__()

@@ -1449,6 +1449,11 @@ class Executor:
         if pool.cap_max < TOPN_SCORE_CHUNK:
             return lambda si, src_dense: None  # can't hold one chunk
 
+        if getattr(self.engine, "row_scorer_all_slices", False):
+            return self._topn_scorer_factory_all_slices(
+                index, frame_name, all_slices, src_batch, pool
+            )
+
         def scorer_for(si: int, src_dense):
             if src_dense is None:
                 return None
@@ -1479,6 +1484,59 @@ class Executor:
                     rows, src_dev, tiled=getattr(matrix, "ndim", 3) == 4
                 )
                 return counts[:n]
+
+            return score
+
+        return scorer_for
+
+    def _topn_scorer_factory_all_slices(
+        self, index, frame_name, all_slices, src_batch, pool
+    ):
+        """Multi-process mesh scorer: ONE shard_map'd SPMD dispatch scores
+        a candidate chunk against EVERY slice (engine.topn_scorer_counts:
+        local gather per shard + allgathered [S, K] result), memoized per
+        candidate set so the per-fragment loop reuses it.  Eagerly
+        indexing ``matrix[si]`` (the single-process scorer) would touch
+        shards owned by other processes.  Falls back to the host loop for
+        slice counts the mesh can't shard evenly."""
+        from pilosa_tpu.core.fragment import TOPN_SCORE_CHUNK
+
+        n_dev = getattr(getattr(self.engine, "mesh", None), "n_devices", 1)
+        if len(all_slices) % n_dev:
+            return lambda si, src_dense: None
+        src_stack = np.stack(
+            [np.asarray(src_batch[i]) for i in range(len(all_slices))]
+        )
+        src_dev = self.engine.prepare_topn_src(src_stack)  # one upload per query
+        memo: dict = {}
+
+        def scorer_for(si: int, src_dense):
+            if src_dense is None:
+                return None
+
+            def score(ids):
+                key = tuple(ids)
+                counts = memo.get(key)
+                if counts is None:
+                    frags = [
+                        self.holder.fragment(index, frame_name, VIEW_STANDARD, s)
+                        for s in all_slices
+                    ]
+                    gens = tuple(-1 if f is None else f.generation for f in frags)
+                    id_pos, matrix, _ = pool.acquire(sorted(set(ids)), gens)
+                    n = len(ids)
+                    padded = (
+                        list(ids) + [ids[0]] * (TOPN_SCORE_CHUNK - n)
+                        if n < TOPN_SCORE_CHUNK
+                        else list(ids)
+                    )
+                    pos = np.fromiter(
+                        (id_pos[i] for i in padded), dtype=np.int32, count=len(padded)
+                    )
+                    counts = memo[key] = self.engine.topn_scorer_counts(
+                        matrix, pos, src_dev
+                    )
+                return counts[si, : len(ids)]
 
             return score
 

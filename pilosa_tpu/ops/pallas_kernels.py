@@ -270,6 +270,65 @@ def fused_gather_count2(op: str, row_matrix, pairs, interpret: bool = False):
     return out.sum(axis=(1, 2))
 
 
+def _topn_counts_kernel(rows_ref, src_ref, out_ref):
+    s, k = pl.program_id(1), pl.program_id(2)
+
+    @pl.when((s == 0) & (k == 0))
+    def _():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    inter = rows_ref[0] & src_ref[0][None]  # [r_c, c_sub, 128]
+    pc = lax.population_count(inter).astype(jnp.int32)
+    r, c_sub, _ = pc.shape
+    out_ref[...] = out_ref[...] + pc.reshape(r, c_sub // 8, 8, _LANES).sum(axis=1)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def fused_topn_counts(row_matrix, src, interpret: bool = False):
+    """|row & src| for EVERY row over every slice — TopN's candidate
+    scoring phase when the whole row set is scored (fragment.go:493-625's
+    device half).
+
+    row_matrix: [S, R, W] or tiled [S, R, W/128, 128]; src: [S, W] or
+    tiled [S, W/128, 128].  Returns int32[R].  One auto-pipelined pass
+    over the matrix in ~2 MB blocks (near-roofline HBM streaming) with
+    the per-row-chunk accumulator tile resident in VMEM — the jnp
+    broadcast form ran at 9% of roofline on this shape (BASELINE.md
+    round-3 note).  The row axis is chunked too (outermost grid axis, so
+    the accumulator block stays resident across its (slice, word-chunk)
+    reduction): tall row sets would otherwise need an over-VMEM block.
+    """
+    rm4 = _rm4(row_matrix)
+    if src.ndim == 2:
+        src = src.reshape(src.shape[0], src.shape[1] // _LANES, _LANES)
+    n_slices, n_rows, sub = rm4.shape[:3]
+    budget = 4 * 1024 * 1024
+    # Row chunk: halve (stays a divisor of R) until the minimal
+    # (r_c, 8, 128) input block + (r_c, 8, 128) accumulator fit.
+    r_c = n_rows
+    while r_c > 1 and r_c % 2 == 0 and 2 * r_c * 8 * _LANES * 4 > budget:
+        r_c //= 2
+    c_sub = 8
+    c = 8
+    while c <= sub:
+        if sub % c == 0 and r_c * c * _LANES * 4 + r_c * 8 * _LANES * 4 <= budget:
+            c_sub = c
+        c *= 2
+    n_chunks = sub // c_sub
+    out = pl.pallas_call(
+        _topn_counts_kernel,
+        grid=(n_rows // r_c, n_slices, n_chunks),
+        in_specs=[
+            pl.BlockSpec((1, r_c, c_sub, _LANES), lambda r, s, k: (s, r, k, 0)),
+            pl.BlockSpec((1, c_sub, _LANES), lambda r, s, k: (s, k, 0)),
+        ],
+        out_specs=pl.BlockSpec((r_c, 8, _LANES), lambda r, s, k: (r, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((n_rows, 8, _LANES), jnp.int32),
+        interpret=interpret,
+    )(rm4, src)
+    return out.sum(axis=(1, 2))
+
+
 def _gather_rowmajor_kernel(op, depth, pairs_ref, rm_ref, out_ref, buf, sems):
     q = pl.program_id(0)
     n_q = pl.num_programs(0)

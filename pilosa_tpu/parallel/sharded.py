@@ -279,6 +279,62 @@ def sharded_gather_count_multi(
     return kernel(row_matrix, idx)
 
 
+@functools.lru_cache(maxsize=None)
+def _sharded_scorer_kernel(mesh_obj, axis: str, rm_ndim: int, src_ndim: int):
+    """Jitted shard_map'd scorer kernel, cached per (mesh, layouts) — a
+    fresh closure per call would retrace + recompile every candidate
+    chunk (same policy as _sharded_pair_kernel above)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    @functools.partial(
+        jax.shard_map,
+        mesh=mesh_obj,
+        in_specs=(
+            P(axis, *([None] * (rm_ndim - 1))),
+            P(None),
+            P(axis, *([None] * (src_ndim - 1))),
+        ),
+        out_specs=P(axis, None),
+        check_vma=False,
+    )
+    def kernel(rm, idv, s):
+        g = jnp.take(rm, idv, axis=1)  # [s_local, k, ...words]
+        inter = g & s[:, None]
+        axes = tuple(range(2, g.ndim))
+        return jnp.sum(lax.population_count(inter).astype(jnp.int32), axis=axes)
+
+    return jax.jit(kernel)
+
+
+def sharded_scorer_counts(mesh: SliceMesh, rows, ids, src, chunk: int = 64):
+    """Per-(slice, candidate) intersection counts for TopN scoring on a
+    slice-sharded row matrix — the multi-host-safe form of the engine row
+    scorer (eagerly indexing ``matrix[si]`` only works when every shard
+    is process-addressable).
+
+    rows: uint32[S, cap, ...] sharded on slice (3D logical or 4D tiled);
+    ids: int32[K] replicated slot ids; src: [S, ...] sharded, same word
+    layout as rows.  Returns int32[S, K] sharded on slice — each rank
+    fetches it with an allgather-aware fetch and feeds its per-fragment
+    heap logic.  The gather transient is bounded by ``chunk`` candidates
+    per dispatch.
+    """
+    import jax.numpy as jnp
+
+    _require_divisible(rows.shape[0], mesh.n_devices)
+    kernel = _sharded_scorer_kernel(mesh.mesh, mesh.AXIS, rows.ndim, src.ndim)
+    k = ids.shape[0]
+    if k > chunk:
+        return jnp.concatenate(
+            [kernel(rows, ids[i : i + chunk], src) for i in range(0, k, chunk)],
+            axis=1,
+        )
+    return kernel(rows, ids, src)
+
+
 def sharded_topn_counts(mesh: SliceMesh, rows, src):
     """Per-row global intersection counts for TopN over a sharded slice axis.
 

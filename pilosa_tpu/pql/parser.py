@@ -266,7 +266,46 @@ def _parse_native(src: str):
     return Query(calls=calls)
 
 
+# Singleton-write fast lane: `SetBit(k=1, frame="f", k2=2)`-shaped
+# sources are the server's hottest parse (one per ingest request), and
+# even the native parser's flat-array rebuild costs ~100 us of Python
+# per call; this regex + split handles the flat no-nesting, no-list,
+# int-or-plain-string argument shape in a few us.  Anything it can't
+# express falls through to the normal parsers, so semantics and error
+# messages are unchanged.
+_SIMPLE_WRITE = re.compile(r"^\s*(SetBit|ClearBit)\s*\(([^()\[\]]*)\)\s*$")
+_SIMPLE_STR = re.compile(r'^"[^"\\]*"$')
+
+
+def _parse_simple_write(src: str):
+    m = _SIMPLE_WRITE.match(src)
+    if m is None:
+        return None
+    name, body = m.group(1), m.group(2)
+    args: dict = {}
+    for part in body.split(","):
+        part = part.strip()
+        if not part:
+            return None
+        k, eq, v = part.partition("=")
+        if not eq:
+            return None
+        k, v = k.strip(), v.strip()
+        if not k.isidentifier():
+            return None
+        if v.isascii() and v.isdigit():
+            args[k] = int(v)
+        elif _SIMPLE_STR.match(v):
+            args[k] = v[1:-1]
+        else:
+            return None  # floats, bools, escapes, lists: slow path
+    return Query(calls=[Call(name=name, args=args)])
+
+
 def parse(src: str) -> Query:
+    q = _parse_simple_write(src)
+    if q is not None:
+        return q
     q = _parse_native(src)
     if q is not None:
         return q

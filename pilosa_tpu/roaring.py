@@ -414,6 +414,16 @@ class Bitmap:
             self._write_op(OP_REMOVE, v)
         return changed
 
+    def add_unlogged(self, v: int) -> bool:
+        """Scalar add WITHOUT the WAL — the tiny-batch ingest fast path
+        (fragment.set_bits): callers apply a handful of scalar adds and
+        then append ONE combined op-log record batch via log_add_ops."""
+        v = int(v)
+        changed = self._container_for(v).add(lowbits(v))
+        if changed and self._snap_dirty is not None:
+            self._snap_dirty.add(highbits(v))
+        return changed
+
     def _bulk_add(self, values: np.ndarray) -> np.ndarray:
         """Shared bulk-add core: apply sorted-unique uint64 values and
         return the (sorted) subset that was newly added.  No WAL."""
@@ -478,6 +488,14 @@ class Bitmap:
         detached).  For callers that apply a batch first and decide on
         durability strategy after seeing what was actually new."""
         if len(added) == 0 or self.op_writer is None:
+            return
+        if len(added) <= 8:
+            # The native encoder costs ~40 us of ctypes marshalling per
+            # call; a handful of records pack faster in pure python.
+            self.op_writer.write(
+                b"".join(encode_op(OP_ADD, int(v)) for v in added)
+            )
+            self.op_n += len(added)
             return
         types = np.zeros(len(added), dtype=np.uint8)  # OP_ADD
         self.op_writer.write(native.oplog_encode(types, added))

@@ -71,6 +71,26 @@ def main() -> int:
         )
         mesh_res = e_mesh.execute("g", q)
         exec_ok = mesh_res == e_np.execute("g", q)
+
+        # TopN(src): the ENGINE scorer must run on a multi-process mesh
+        # (shard_map'd all-slice scoring + allgather), not the host
+        # fallback — round-2 verdict item 7.
+        qt = 'TopN(Bitmap(rowID=0, frame="f"), frame="f", n=3)'
+        topn_parity_ok = e_mesh.execute("g", qt) == e_np.execute("g", qt)
+        frags = [h.fragment("g", "f", "standard", s) for s in range(4)]
+        src_b = [f.row_dense(0) for f in frags]
+        assert e_mesh.engine.row_scorer_all_slices, "expected all-slice scorer"
+        scorer_for = e_mesh._topn_scorer_factory("g", "f", list(range(4)), src_b)
+        sc = scorer_for(1, src_b[1])
+        scorer_engaged = sc is not None
+        topn_scorer_ok = False
+        if scorer_engaged:
+            got = [int(v) for v in sc([0, 1, 2])]
+            want = [
+                int(bw.np_count_and(frags[1].row_dense(r), src_b[1]))
+                for r in range(3)
+            ]
+            topn_scorer_ok = got == want
         h.close()
 
     print(
@@ -85,6 +105,9 @@ def main() -> int:
                 "union_ok": union_ok,
                 "exec_results": [int(v) for v in mesh_res],
                 "exec_ok": bool(exec_ok),
+                "topn_parity_ok": bool(topn_parity_ok),
+                "topn_scorer_engaged": bool(scorer_engaged),
+                "topn_scorer_ok": bool(topn_scorer_ok),
             }
         ),
         flush=True,

@@ -70,6 +70,11 @@ def test_two_process_global_mesh():
         # Full PQL executor in SPMD lockstep over the global mesh agrees
         # with the numpy engine on every process.
         assert o["exec_ok"], o
+        # TopN candidate scoring runs the ENGINE scorer (shard_map'd
+        # all-slice counts) on the 2-process mesh, with host parity.
+        assert o["topn_parity_ok"], o
+        assert o["topn_scorer_engaged"], o
+        assert o["topn_scorer_ok"], o
     # Both processes computed the SAME global count from disjoint shards.
     assert by_pid[0]["count"] == by_pid[1]["count"]
     assert by_pid[0]["exec_results"] == by_pid[1]["exec_results"]
