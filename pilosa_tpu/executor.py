@@ -1107,9 +1107,14 @@ class Executor:
         if bucket == 0:
             return None
         # Unpacked int8 bits are 32 bytes per uint32 word (word count from
-        # either the 3D logical or 4D tiled matrix layout).
+        # either the 3D logical or 4D tiled matrix layout).  The chunked
+        # builder (bitwise.pair_gram) streams slice by slice, so only ONE
+        # slice's bits must fit the transient budget; int32 Gram entries
+        # cap the slice count at 2047 (ops/dispatch.py gate).
+        from pilosa_tpu.ops.dispatch import _GRAM_SLICES_MAX
+
         words = shape[2] if len(shape) == 3 else shape[2] * shape[3]
-        if shape[0] * bucket * words * 32 > self._GRAM_BYTES_BUDGET:
+        if bucket * words * 32 > self._GRAM_BYTES_BUDGET or shape[0] > _GRAM_SLICES_MAX:
             return None
         mu = box.get("mu")
         if mu is None or not mu.acquire(blocking=False):

@@ -195,27 +195,69 @@ def np_gather_count_or_multi(row_matrix: np.ndarray, idx: np.ndarray) -> np.ndar
     return np_gather_count_multi("or", row_matrix, idx)
 
 
+# One-shot Gram unpack budget: past this, the int8 bit matrix streams
+# slice-by-slice through the MXU instead (pair_gram's scan path).
+GRAM_ONESHOT_BYTES = 1536 * 1024 * 1024
+
+
 def pair_gram(row_matrix):
     """All-pairs intersection-count Gram matrix G[i,j] = |row_i & row_j|
-    summed over slices, via ONE int8 matmul on the MXU.
+    summed over slices, on the MXU.
 
-    The MXU strategy for tiny row sets: slices are disjoint bit ranges of
-    the same rows, so the Gram over the concatenated unpacked bit vectors
-    equals the per-slice sum.  int8×int8→int32 accumulation is exact
-    (products are 0/1; counts ≤ 2^31).  G answers every pair op through
-    count identities (see gram_pair_counts), and — being a pure function
-    of the row matrix — XLA hoists it out of query-stream loops, so a
-    stream of fused batches pays for it once.
+    The MXU strategy for small row sets: slices are disjoint bit ranges
+    of the same rows, so the Gram over the concatenated unpacked bit
+    vectors equals the per-slice sum.  int8×int8→int32 accumulation is
+    exact (products are 0/1; per-pair counts are ≤ S * 2^20, so int32
+    holds up to 2047 slices — gate at the caller).  G answers every pair
+    op through count identities (see gram_pair_counts), and — being a
+    pure function of the row matrix — XLA hoists it out of query-stream
+    loops, so a stream of fused batches pays for it once.
+
+    Small matrices unpack once and do ONE matmul; large ones (a 1024-
+    slice x 64-row matrix is 8 GB packed = 64 GB unpacked) scan the
+    slice axis, accumulating ``G += bits_s @ bits_s.T`` with only one
+    slice's int8 bits (R * W * 32 bytes) live per step — billion-column
+    indexes get all-pairs answers for one streamed pass of MXU work.
     """
     if row_matrix.ndim == 4:  # tiled engine form (word order is identical)
-        row_matrix = row_matrix.reshape(*row_matrix.shape[:2], -1)
-    s, r, w = row_matrix.shape
+        s, r = row_matrix.shape[:2]
+        w = row_matrix.shape[2] * row_matrix.shape[3]
+    else:
+        s, r, w = row_matrix.shape
     shifts = jnp.arange(32, dtype=jnp.uint32)
-    flat = row_matrix.transpose(1, 0, 2).reshape(r, s * w)
-    bits = ((flat[..., None] >> shifts) & jnp.uint32(1)).astype(jnp.int8).reshape(r, -1)
-    return lax.dot_general(
-        bits, bits, (((1,), (1,)), ((), ())), preferred_element_type=jnp.int32
-    )
+
+    def unpack2(x):  # [r, ...words] -> int8 [r, words*32]
+        b = ((x[..., None] >> shifts) & jnp.uint32(1)).astype(jnp.int8)
+        return b.reshape(x.shape[0], -1)
+
+    if s * r * w * 32 <= GRAM_ONESHOT_BYTES:
+        if row_matrix.ndim == 4:
+            row_matrix = row_matrix.reshape(s, r, w)
+        flat = row_matrix.transpose(1, 0, 2).reshape(r, s * w)
+        bits = unpack2(flat)
+        return lax.dot_general(
+            bits, bits, (((1,), (1,)), ((), ())), preferred_element_type=jnp.int32
+        )
+
+    def step(acc, i):
+        # One slice per step, fetched by index: scanning rm's leading
+        # axis directly (or reshaping the unpacked bits) made XLA
+        # relayout the whole CARRIED matrix into an MXU-friendly
+        # transposed tiling — an 8 GB HLO-temp copy at the 1024-slice
+        # shape.  Indexed access keeps the matrix in its born layout;
+        # only the per-step 8 MB slice gets copied/transposed.
+        sl = lax.dynamic_index_in_dim(row_matrix, i, 0, keepdims=False)
+        # The barrier stops the MXU's layout preference from propagating
+        # through the slice to the carried matrix (verified: without it
+        # XLA still inserts the full transposed copy).
+        sl = lax.optimization_barrier(sl)
+        b = ((sl[..., None] >> shifts) & jnp.uint32(1)).astype(jnp.int8)
+        dims = tuple(range(1, b.ndim))
+        return acc + lax.dot_general(
+            b, b, ((dims, dims), ((), ())), preferred_element_type=jnp.int32
+        ), None
+
+    return lax.scan(step, jnp.zeros((r, r), jnp.int32), jnp.arange(s))[0]
 
 
 def gram_pair_counts(op: str, gram, pairs):

@@ -97,17 +97,23 @@ def gather_count_and(row_matrix, pairs):
 
 
 # Gram strategy gate: all-pairs count work may exceed the requested batch
-# by this factor before the MXU path stops paying off; the unpacked int8
-# bit matrix must also fit a transient-HBM budget.
+# by this factor before the MXU path stops paying off; one SLICE's
+# unpacked int8 bits must fit a transient-HBM budget (the chunked builder
+# streams slice by slice — see bitwise.pair_gram), and per-pair counts
+# must stay inside int32 (≤ 2047 slices × 2^20 bits).
 _GRAM_FACTOR = 16
 _GRAM_BYTES_BUDGET = 1536 * 1024 * 1024
+_GRAM_SLICES_MAX = 2047
 
 
 def _use_gram(n_slices: int, n_rows: int, w: int, batch: int) -> bool:
     if os.environ.get("PILOSA_TPU_NO_GRAM", "").lower() in ("1", "true", "yes"):
         return False
-    bits_bytes = n_rows * n_slices * w * 32
-    return n_rows * n_rows <= _GRAM_FACTOR * batch and bits_bytes <= _GRAM_BYTES_BUDGET
+    return (
+        n_rows * n_rows <= _GRAM_FACTOR * batch
+        and n_rows * w * 32 <= _GRAM_BYTES_BUDGET
+        and n_slices <= _GRAM_SLICES_MAX
+    )
 
 
 # The Pallas kernels scalar-prefetch the pair ids into SMEM (~1 MiB);

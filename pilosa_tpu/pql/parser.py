@@ -291,8 +291,8 @@ def _parse_simple_write(src: str):
         if not eq:
             return None
         k, v = k.strip(), v.strip()
-        if not k.isidentifier():
-            return None
+        if not k.isidentifier() or k in args:
+            return None  # duplicate keys: the full parsers reject them
         if v.isascii() and v.isdigit():
             args[k] = int(v)
         elif _SIMPLE_STR.match(v):
