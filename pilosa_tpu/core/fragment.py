@@ -527,6 +527,24 @@ class Fragment:
                 os.unlink(tmp)
             raise
         self.storage.op_n = 0
+        # Re-attach zero-copy to the NEW snapshot file (the reference
+        # re-mmaps after every snapshot, fragment.go:1017-1057): the
+        # re-parsed storage is byte-equivalent to the in-memory state just
+        # written, heap containers become file views again, and the old
+        # mapping (pinning the replaced inode) is released.  Readers
+        # holding the old bitmap keep their immutable snapshot.  Costs one
+        # O(containers) parse on top of the O(containers) write this
+        # method just did; skipped when mmap is disabled.
+        old_mm = self._storage_map
+        data, mm = self._map_storage()
+        if mm is not None:
+            self.storage = roaring.Bitmap.from_bytes(data, zero_copy=True)
+            self._storage_map = mm
+            if old_mm is not None:
+                try:
+                    old_mm.close()
+                except BufferError:
+                    pass  # a reader still views it; GC finishes later
         self._attach_wal()
         # duration logging analog (fragment.go:1012-1020); timing() takes
         # seconds (sinks convert to ms themselves).

@@ -413,3 +413,36 @@ f.close()
         assert delta_read > delta_mmap + (100 << 20), (
             f"read {delta_read >> 20} MB vs mmap {delta_mmap >> 20} MB"
         )
+
+
+def test_snapshot_reattaches_mmap(tmp_path):
+    """After a snapshot the storage re-attaches zero-copy to the NEW file
+    (fragment.go:1017-1057 re-mmap): heap containers become views again
+    and the replaced inode's mapping is released."""
+    import numpy as np
+
+    f = Fragment(str(tmp_path / "frag"), "i", "f", "standard", 0)
+    f.open()
+    rng = np.random.default_rng(5)
+    rows = rng.integers(0, 8, size=9000).astype(np.uint64)
+    cols = rng.integers(0, 1 << 20, size=9000).astype(np.uint64)
+    f.import_bits(rows, cols)  # import snapshots at the end
+    assert f._storage_map is not None, "expected re-attached mmap"
+    want = f.storage.count()
+    dense = [c for c in f.storage.containers.values() if c.bitmap is not None]
+    arrays = [c for c in f.storage.containers.values() if c.array is not None]
+    # payloads are views into the new map, not heap copies
+    assert all(not c.bitmap.flags.writeable for c in dense)
+    assert all(not c.array.flags.writeable or len(c.array) == 0 for c in arrays)
+    # the re-attached storage serves reads and writes (COW on top)
+    mm_before = f._storage_map
+    assert f.row_dense(int(rows[0])).any()
+    assert f.set_bit(3, 777) or True
+    assert f.contains(3, 777)
+    # force another snapshot cycle: map swaps again, data stays intact
+    f.snapshot()
+    assert f._storage_map is not None and f._storage_map is not mm_before
+    assert f.storage.count() in (want, want + 1)
+    assert f.contains(3, 777)
+    f.storage.check()
+    f.close()
