@@ -594,22 +594,22 @@ def bench_intersect_stream() -> dict:
     @jax.jit
     def run_stream(chunk, pairs_stream):
         # Outer scan: one step per query batch; inner scan: one step per
-        # logical chunk, accumulating per-query partials (the executor's
-        # streaming accumulation, executor.py streaming regime).
+        # logical chunk.  Per-chunk partials come back as scan OUTPUTS
+        # and the cross-chunk int64 accumulation happens host-side: on
+        # device (no x64) jnp.int64 silently truncates to int32, which
+        # overflows past ~16 chunks of full-density counts (the executor's
+        # streaming regime accumulates per-chunk engine results host-side
+        # the same way).
         def per_batch(carry, prs_chunks):
-            def per_chunk(acc, prs):
-                return acc + fused_resident_count2(
+            def per_chunk(c2, prs):
+                return c2, fused_resident_count2(
                     "and", chunk, prs, interpret=interp
-                ).astype(jnp.int64), None
+                )
 
-            total = lax.scan(
-                per_chunk, jnp.zeros((prs_chunks.shape[1],), jnp.int64),
-                prs_chunks,
-            )[0]
-            return carry, total
+            return carry, lax.scan(per_chunk, 0, prs_chunks)[1]  # [n_chunks, B]
 
-        out = lax.scan(per_batch, 0, pairs_stream)[1]  # [iters, batch]
-        return out, out.sum()
+        out = lax.scan(per_batch, 0, pairs_stream)[1]  # [iters, n_chunks, batch]
+        return out, out.sum()  # digest: sync only (int32 wrap is fine)
 
     out_dev, _ = run_stream(dchunk, dpairs)  # warm + compile
 
@@ -619,7 +619,7 @@ def bench_intersect_stream() -> dict:
         return out_d
 
     dt, out_dev = _best_of_runs(timed, default_runs=3)
-    out = np.asarray(out_dev)
+    out = np.asarray(out_dev).astype(np.int64).sum(axis=1)  # [iters, batch]
     qps = iters * batch / dt
     bytes_read = iters * n_chunks * chunk_slices * n_rows * W * 4
     hbm_gbps = bytes_read / dt / 1e9
