@@ -140,6 +140,10 @@ class NumpyEngine:
             src = src.reshape(*src.shape[:-2], -1)
         return self.count(rows & src)
 
+    # Row-major gather lane: no benefit on host (numpy transposes are
+    # views), so the executor keeps slice-major transients.
+    supports_row_major_gather = False
+
     def update_slices(self, matrix, slice_idxs, planes):
         """Functionally replace whole slice planes of a row matrix
         (incremental refresh of a cached matrix after writes)."""
@@ -268,6 +272,28 @@ class JaxEngine:
             op, self._jnp.asarray(row_matrix), self._jnp.asarray(pairs), allow_gram=False
         )
 
+    # -- row-major gather lane (streaming regime's tall row sets) --------
+
+    @property
+    def supports_row_major_gather(self) -> bool:
+        # Only worth it where the Pallas kernel runs (TPU): elsewhere the
+        # rowmajor dispatch just transposes back per chunk — a pure cost.
+        return self._dispatch.use_pallas()
+
+    def matrix_rows(self, host_matrix: np.ndarray):
+        """Upload a ROW-MAJOR [R, S, W] host block in tiled form — the
+        layout whose per-row bytes are one contiguous DMA descriptor
+        (dispatch.gather_count_rowmajor)."""
+        return self._jnp.asarray(self._tile_host(host_matrix))
+
+    def rowmajor_ok(self, n_slices: int, words: int) -> bool:
+        return self._dispatch.rowmajor_ok(n_slices, words)
+
+    def gather_count_rowmajor_dev(self, op: str, row_major, pairs):
+        return self._dispatch.gather_count_rowmajor(
+            op, self._jnp.asarray(row_major), self._jnp.asarray(pairs)
+        )
+
     def gather_count_multi_dev(self, op: str, row_matrix, idx):
         return self._dispatch.gather_count_multi(
             op, self._jnp.asarray(row_matrix), self._jnp.asarray(idx)
@@ -385,6 +411,10 @@ class MeshEngine(JaxEngine):
     """
 
     name = "mesh"
+
+    # Mesh matrices shard the SLICE axis; a row-major layout would shard
+    # rows instead — keep streaming transients slice-major on meshes.
+    supports_row_major_gather = False
 
     @property
     def supports_row_scorer(self) -> bool:

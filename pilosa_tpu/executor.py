@@ -999,15 +999,29 @@ class Executor:
                     s_chunk = max(
                         1, self._stream_bytes() // max(1, len(want) * _WORDS * 4)
                     )
+                    # Tall row sets hit the GATHER kernels, whose v5e
+                    # throughput is DMA-descriptor-bound: a row-major
+                    # transient gives one contiguous descriptor per
+                    # operand (2-4x the slice-major kernel's rate).  Only
+                    # pair groups dispatch through the row-major lane.
+                    row_major = (
+                        getattr(self.engine, "supports_row_major_gather", False)
+                        and all(kb == 2 for _, kb in groups)
+                        and self.engine.rowmajor_ok(
+                            min(s_chunk, len(slices)), _WORDS
+                        )
+                    )
                     acc: dict[tuple, list] = {}
                     for c0 in range(0, len(slices), s_chunk):
                         matrix = self._transient_matrix(
-                            index, frame, view, slices[c0 : c0 + s_chunk], want
+                            index, frame, view, slices[c0 : c0 + s_chunk], want,
+                            row_major=row_major,
                         )
                         for gk, op_idxs in sorted(groups.items()):
                             acc.setdefault(gk, []).append(
                                 self._group_counts(
-                                    gk, op_idxs, matched, id_pos, matrix, static, None
+                                    gk, op_idxs, matched, id_pos, matrix, static,
+                                    None, row_major=row_major,
                                 )
                             )
                     for gk, op_idxs in sorted(groups.items()):
@@ -1018,7 +1032,9 @@ class Executor:
                             out[i] = int(total[k2])
         return [out[i] for i in idxs]
 
-    def _group_counts(self, gk, op_idxs, matched, id_pos, matrix, static, gram):
+    def _group_counts(
+        self, gk, op_idxs, matched, id_pos, matrix, static, gram, row_major=False
+    ):
         """One fused dispatch for an (op, arity-bucket) call group; returns
         the engine-native count array (fetch deferred to the caller)."""
         op, kb = gk
@@ -1036,6 +1052,8 @@ class Executor:
                 from pilosa_tpu.ops.bitwise import gram_pair_counts
 
                 return gram_pair_counts(op, gram, pairs)
+            if row_major:
+                return self.engine.gather_count_rowmajor_dev(op, matrix, pairs)
             return self.engine.gather_count_dev(op, matrix, pairs)
         # Jitted engines get a padded batch bucket too (pad rows repeat
         # the first call's operands; extra counts discarded) — ragged B
@@ -1054,25 +1072,40 @@ class Executor:
         """Per-chunk byte budget for slice-streaming transient matrices."""
         return int(os.environ.get("PILOSA_TPU_STREAM_BYTES", str(1 << 31)))
 
-    def _densify_block(self, index, frame, view, chunk_slices, rows) -> np.ndarray:
-        """Host block uint32[len(chunk_slices), len(rows), W] of dense rows
-        (the ONE densify loop — pool fetches and transient streaming
-        matrices share it)."""
-        block = np.zeros((len(chunk_slices), len(rows), _WORDS), dtype=np.uint32)
+    def _densify_block(
+        self, index, frame, view, chunk_slices, rows, row_major=False
+    ) -> np.ndarray:
+        """Host block of dense rows: uint32[len(chunk_slices), len(rows), W]
+        (slice-major — pool fetches and transient streaming matrices), or
+        [len(rows), len(chunk_slices), W] with ``row_major=True`` (the
+        streaming gather lane: each row's slices contiguous for one-descriptor
+        DMAs).  Filled directly in target order — no transpose copy."""
+        if row_major:
+            block = np.zeros((len(rows), len(chunk_slices), _WORDS), dtype=np.uint32)
+        else:
+            block = np.zeros((len(chunk_slices), len(rows), _WORDS), dtype=np.uint32)
         for bi, s in enumerate(chunk_slices):
             f = self.holder.fragment(index, frame, view, s)
             if f is not None:
                 for k, r in enumerate(rows):
-                    block[bi, k] = f.row_dense(r)
+                    if row_major:
+                        block[k, bi] = f.row_dense(r)
+                    else:
+                        block[bi, k] = f.row_dense(r)
         return block
 
-    def _transient_matrix(self, index, frame, view, chunk_slices, rows_sorted):
-        """One slice chunk's [len(chunk), len(rows), W] matrix, built
-        host-side and moved in a single transfer; NOT cached — streaming
-        shapes would evict every steady-state pool for nothing."""
-        return self.engine.matrix(
-            self._densify_block(index, frame, view, chunk_slices, rows_sorted)
+    def _transient_matrix(
+        self, index, frame, view, chunk_slices, rows_sorted, row_major=False
+    ):
+        """One slice chunk's transient matrix, built host-side and moved
+        in a single transfer; NOT cached — streaming shapes would evict
+        every steady-state pool for nothing."""
+        block = self._densify_block(
+            index, frame, view, chunk_slices, rows_sorted, row_major=row_major
         )
+        if row_major:
+            return self.engine.matrix_rows(block)
+        return self.engine.matrix(block)
 
     # Transient-HBM budget for the unpacked int8 bit matrix a Gram build
     # streams through the MXU (ops/dispatch.py uses the same bound).

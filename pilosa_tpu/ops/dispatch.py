@@ -161,6 +161,49 @@ def gather_count(op, row_matrix, pairs, allow_gram: bool = True):
     return bitwise.gather_count(op, _rm3(row_matrix), pairs)
 
 
+# Row-major kernel VMEM bound: depth*2 row buffers of S*W*4 bytes each
+# must fit alongside the output tiles (~16 MB VMEM/core).
+_ROWMAJOR_ROW_BYTES_MAX = 2 * 1024 * 1024
+
+
+def rowmajor_ok(n_slices: int, w: int) -> bool:
+    """Whether the pipelined row-major gather kernel can buffer rows of
+    this width (used by callers deciding the transient-matrix layout)."""
+    return n_slices * w * 4 <= _ROWMAJOR_ROW_BYTES_MAX
+
+
+def gather_count_rowmajor(op, row_major, pairs):
+    """Batched pair counts over a ROW-MAJOR matrix [R, S, W] (3D logical)
+    or [R, S, W/128, 128] (tiled): one contiguous DMA descriptor per
+    operand covering every slice — the gather regime's fast path (v5e
+    DMA descriptors process serially, so per-(query, slice) block DMAs
+    cap well below roofline; see fused_gather_count2_rowmajor)."""
+    from pilosa_tpu.ops.pallas_kernels import fused_gather_count2_rowmajor
+
+    n_rows, n_slices = row_major.shape[:2]
+    w = row_major.shape[-1] if row_major.ndim == 3 else row_major.shape[-2] * row_major.shape[-1]
+    if use_pallas() and _tileable(w) and rowmajor_ok(n_slices, w):
+        if row_major.ndim == 3:
+            row_major = row_major.reshape(n_rows, n_slices, w // 128, 128)
+        b = pairs.shape[0]
+        if b > _GATHER_BATCH_MAX:
+            return jnp.concatenate(
+                [
+                    fused_gather_count2_rowmajor(
+                        op, row_major, pairs[i : i + _GATHER_BATCH_MAX]
+                    )
+                    for i in range(0, b, _GATHER_BATCH_MAX)
+                ]
+            )
+        return fused_gather_count2_rowmajor(op, row_major, pairs)
+    # Fallback: logical transpose to slice-major (non-TPU backends and
+    # shapes the kernel can't buffer; engines gate the lane on
+    # use_pallas() so the product path only lands here for oversized
+    # rows).
+    rm = _rm3(row_major) if row_major.ndim == 4 else row_major
+    return bitwise.gather_count(op, jnp.swapaxes(rm, 0, 1), pairs)
+
+
 def gather_count_multi(op, row_matrix, idx):
     """Batched Count over a left-fold of K gathered rows per query —
     N-operand Intersect/Union/Difference trees and the fused Range view

@@ -335,3 +335,31 @@ def test_pair_gram_chunked_matches_oneshot(rng):
     assert np.array_equal(g1, want)
     assert np.array_equal(g2, want)
     assert np.array_equal(g3, want)
+
+
+def test_gather_count_rowmajor_wrapper_parity(rng):
+    """dispatch.gather_count_rowmajor (3D and tiled 4D inputs, including
+    a batch larger than the chunk cap) must match slice-major
+    dispatch.gather_count on the same data."""
+    S, R, W = 3, 48, 1024
+    rm = rng.integers(0, 1 << 32, size=(S, R, W), dtype=np.uint32)
+    rm_t = np.ascontiguousarray(rm.transpose(1, 0, 2))
+    rm_t4 = rm_t.reshape(R, S, W // 128, 128)
+    import pilosa_tpu.ops.dispatch as dispatch_mod
+
+    old = dispatch_mod._GATHER_BATCH_MAX
+    dispatch_mod._GATHER_BATCH_MAX = 8  # force the concat path
+    try:
+        pairs = rng.integers(0, R, size=(21, 2), dtype=np.int32)
+        for op in ("and", "or", "xor", "andnot"):
+            want = np.asarray(
+                dispatch.gather_count(op, jnp.asarray(rm), jnp.asarray(pairs),
+                                      allow_gram=False)
+            )
+            for rmj in (rm_t, rm_t4):
+                got = np.asarray(
+                    dispatch.gather_count_rowmajor(op, jnp.asarray(rmj), jnp.asarray(pairs))
+                )
+                assert np.array_equal(got, want), (op, rmj.ndim)
+    finally:
+        dispatch_mod._GATHER_BATCH_MAX = old
