@@ -286,12 +286,17 @@ class JaxEngine:
         (dispatch.gather_count_rowmajor)."""
         return self._jnp.asarray(self._tile_host(host_matrix))
 
-    def rowmajor_ok(self, n_slices: int, words: int) -> bool:
-        return self._dispatch.rowmajor_ok(n_slices, words)
+    def rowmajor_ok(self, n_slices: int, words: int, k: int = 2) -> bool:
+        return self._dispatch.rowmajor_ok(n_slices, words, k)
 
     def gather_count_rowmajor_dev(self, op: str, row_major, pairs):
         return self._dispatch.gather_count_rowmajor(
             op, self._jnp.asarray(row_major), self._jnp.asarray(pairs)
+        )
+
+    def gather_count_multi_rowmajor_dev(self, op: str, row_major, idx):
+        return self._dispatch.gather_count_multi_rowmajor(
+            op, self._jnp.asarray(row_major), self._jnp.asarray(idx)
         )
 
     def gather_count_multi_dev(self, op: str, row_matrix, idx):

@@ -1002,13 +1002,14 @@ class Executor:
                     # Tall row sets hit the GATHER kernels, whose v5e
                     # throughput is DMA-descriptor-bound: a row-major
                     # transient gives one contiguous descriptor per
-                    # operand (2-4x the slice-major kernel's rate).  Only
-                    # pair groups dispatch through the row-major lane.
+                    # operand (2-4x the slice-major kernel's rate).  The
+                    # widest group's operand count must fit the kernels'
+                    # VMEM row buffers at this chunk's slice width.
                     row_major = (
                         getattr(self.engine, "supports_row_major_gather", False)
-                        and all(kb == 2 for _, kb in groups)
                         and self.engine.rowmajor_ok(
-                            min(s_chunk, len(slices)), _WORDS
+                            min(s_chunk, len(slices)), _WORDS,
+                            max(kb for _, kb in groups),
                         )
                     )
                     acc: dict[tuple, list] = {}
@@ -1066,6 +1067,8 @@ class Executor:
             idx_arr[r, : len(pos)] = pos
             idx_arr[r, len(pos):] = pos[0] if op != "andnot" else pos[1]
         idx_arr[n:] = idx_arr[0]
+        if row_major:
+            return self.engine.gather_count_multi_rowmajor_dev(op, matrix, idx_arr)
         return self.engine.gather_count_multi_dev(op, matrix, idx_arr)
 
     def _stream_bytes(self) -> int:

@@ -161,15 +161,16 @@ def gather_count(op, row_matrix, pairs, allow_gram: bool = True):
     return bitwise.gather_count(op, _rm3(row_matrix), pairs)
 
 
-# Row-major kernel VMEM bound: depth*2 row buffers of S*W*4 bytes each
-# must fit alongside the output tiles (~16 MB VMEM/core).
-_ROWMAJOR_ROW_BYTES_MAX = 2 * 1024 * 1024
+# Row-major kernel VMEM budget: depth(2) * k row buffers of S*W*4 bytes
+# each must fit alongside the output tiles (~16 MB VMEM/core).
+_ROWMAJOR_BUF_BYTES_MAX = 8 * 1024 * 1024
 
 
-def rowmajor_ok(n_slices: int, w: int) -> bool:
-    """Whether the pipelined row-major gather kernel can buffer rows of
-    this width (used by callers deciding the transient-matrix layout)."""
-    return n_slices * w * 4 <= _ROWMAJOR_ROW_BYTES_MAX
+def rowmajor_ok(n_slices: int, w: int, k: int = 2) -> bool:
+    """Whether the pipelined row-major gather kernels can buffer k
+    operand rows of this width per pipeline slot (callers use it to
+    decide the transient-matrix layout)."""
+    return 2 * k * n_slices * w * 4 <= _ROWMAJOR_BUF_BYTES_MAX
 
 
 def gather_count_rowmajor(op, row_major, pairs):
@@ -202,6 +203,32 @@ def gather_count_rowmajor(op, row_major, pairs):
     # rows).
     rm = _rm3(row_major) if row_major.ndim == 4 else row_major
     return bitwise.gather_count(op, jnp.swapaxes(rm, 0, 1), pairs)
+
+
+def gather_count_multi_rowmajor(op, row_major, idx):
+    """K-operand fold counts over a ROW-MAJOR matrix — the multi form of
+    :func:`gather_count_rowmajor` (N-ary trees and Range covers in the
+    streaming gather regime).  Buffers K rows per pipeline slot, so the
+    row-width bound shrinks with K."""
+    from pilosa_tpu.ops.pallas_kernels import fused_gather_count_multi_rowmajor
+
+    n_rows, n_slices = row_major.shape[:2]
+    w = row_major.shape[-1] if row_major.ndim == 3 else row_major.shape[-2] * row_major.shape[-1]
+    b, k = idx.shape
+    if use_pallas() and _tileable(w) and rowmajor_ok(n_slices, w, k):
+        if row_major.ndim == 3:
+            row_major = row_major.reshape(n_rows, n_slices, w // 128, 128)
+        chunk = max(1, (2 * _GATHER_BATCH_MAX) // max(1, k))
+        if b > chunk:
+            return jnp.concatenate(
+                [
+                    fused_gather_count_multi_rowmajor(op, row_major, idx[i : i + chunk])
+                    for i in range(0, b, chunk)
+                ]
+            )
+        return fused_gather_count_multi_rowmajor(op, row_major, idx)
+    rm = _rm3(row_major) if row_major.ndim == 4 else row_major
+    return gather_count_multi(op, jnp.swapaxes(rm, 0, 1), idx)
 
 
 def gather_count_multi(op, row_matrix, idx):

@@ -410,6 +410,69 @@ def fused_gather_count2_rowmajor(
     return out.sum(axis=(1, 2))
 
 
+def _gather_multi_rowmajor_kernel(op, n_ops, depth, idx_ref, rm_ref, out_ref, buf, sems):
+    q = pl.program_id(0)
+    n_q = pl.num_programs(0)
+    fold = _FOLD_OPS[op]
+
+    def dma(i, j):
+        return pltpu.make_async_copy(
+            rm_ref.at[idx_ref[i, j]], buf.at[i % depth, j], sems.at[i % depth, j]
+        )
+
+    @pl.when(q == 0)
+    def _():
+        for d in range(depth - 1):
+            for j in range(n_ops):
+                dma(d, j).start()
+
+    @pl.when(q + depth - 1 < n_q)
+    def _():
+        for j in range(n_ops):
+            dma(q + depth - 1, j).start()
+
+    for j in range(n_ops):
+        dma(q, j).wait()
+    acc = buf[q % depth, 0]
+    for j in range(1, n_ops):
+        acc = fold(acc, buf[q % depth, j])
+    pc = lax.population_count(acc).astype(jnp.int32)
+    s, sub, _ = pc.shape
+    out_ref[0] = pc.reshape(s * sub // 8, 8, _LANES).sum(axis=0)
+
+
+@functools.partial(jax.jit, static_argnames=("op", "depth", "interpret"))
+def fused_gather_count_multi_rowmajor(
+    op: str, row_major, idx, depth: int = 2, interpret: bool = False
+):
+    """Left-fold counts over a ROW-MAJOR matrix [R, S, W/128, 128]: the
+    K-operand form of :func:`fused_gather_count2_rowmajor` (N-ary
+    Intersect/Union/Difference and fused Range view covers in the
+    streaming gather regime).  One contiguous DMA descriptor per
+    (query, operand); idx: int32[B, K] padded with fold-idempotent ids.
+    VMEM: depth*K row buffers — callers bound K * S * W * 4."""
+    n_rows, n_slices, sub = row_major.shape[:3]
+    b, n_ops = idx.shape
+    depth = max(1, min(depth, b))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b,),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec((1, 8, _LANES), lambda q, pr: (q, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((depth, n_ops, n_slices, sub, _LANES), jnp.uint32),
+            pltpu.SemaphoreType.DMA((depth, n_ops)),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_gather_multi_rowmajor_kernel, op, n_ops, depth),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, 8, _LANES), jnp.int32),
+        interpret=interpret,
+    )(idx, row_major)
+    return out.sum(axis=(1, 2))
+
+
 # Left-fold step for the multi-operand gather kernels: how operand j>0
 # combines into the accumulator.  "andnot" folds acc &~ row (Difference's
 # left-associative chain); all are pad-idempotent for the right pad id
