@@ -289,6 +289,21 @@ class JaxEngine:
     def rowmajor_ok(self, n_slices: int, words: int, k: int = 2) -> bool:
         return self._dispatch.rowmajor_ok(n_slices, words, k)
 
+    def prefer_rowmajor(
+        self, n_rows: int, n_slices: int, words: int, n_pairs: int, max_k: int
+    ) -> bool:
+        """Whether a resident working set of ``n_rows`` rows should live
+        in a ROW-MAJOR pool: exactly when dispatch would pick the gather
+        kernels for its pair groups (the resident kernel predicate says
+        no) and the row-major kernels can buffer the widest group's
+        operand rows.  Multi-fold groups always gather, so parts without
+        pair groups prefer row-major whenever the buffer bound allows."""
+        from pilosa_tpu.ops.pallas_kernels import resident_strategy
+
+        return not resident_strategy(n_rows, words, n_pairs) and self.rowmajor_ok(
+            n_slices, words, max_k
+        )
+
     def gather_count_rowmajor_dev(self, op: str, row_major, pairs):
         return self._dispatch.gather_count_rowmajor(
             op, self._jnp.asarray(row_major), self._jnp.asarray(pairs)
@@ -297,6 +312,25 @@ class JaxEngine:
     def gather_count_multi_rowmajor_dev(self, op: str, row_major, idx):
         return self._dispatch.gather_count_multi_rowmajor(
             op, self._jnp.asarray(row_major), self._jnp.asarray(idx)
+        )
+
+    def grow_rows_rm(self, matrix, n: int):
+        """Append n zero SLOTS to a row-major [cap, S, ...] pool matrix."""
+        z = self._jnp.zeros((n,) + matrix.shape[1:], dtype=matrix.dtype)
+        return self._jnp.concatenate([matrix, z], axis=0)
+
+    def set_rows_at_rm(self, matrix, slots, block):
+        """Scatter a row-major miss batch [k, S, W] into slots (axis 0)."""
+        idx = self._jnp.asarray(np.asarray(slots, dtype=np.int32))
+        return matrix.at[idx].set(self._match_block(matrix, block))
+
+    def set_plane_rows_rm(self, matrix, slice_idxs, slots, block):
+        """Refresh (slot, stale-slice) cells of a row-major matrix;
+        block: [len(slots), len(slice_idxs), W]."""
+        sl = self._jnp.asarray(np.asarray(slots, dtype=np.int32))
+        si = self._jnp.asarray(np.asarray(slice_idxs, dtype=np.int32))
+        return matrix.at[sl[:, None], si[None, :]].set(
+            self._match_block(matrix, block)
         )
 
     def gather_count_multi_dev(self, op: str, row_matrix, idx):

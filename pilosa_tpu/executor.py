@@ -507,10 +507,27 @@ class Executor:
                 fmask[qpart] = True
                 fr1, fr2 = r1[fmask], r2[fmask]
                 rows = np.unique(np.concatenate([fr1, fr2]))
-                id_pos, matrix, box = self._frame_matrix(
-                    index, fname, slices, set(rows.tolist())
+                # Tall working sets relative to this chunk's batch hit the
+                # gather kernels — page them through the ROW-MAJOR pool
+                # lane (one contiguous DMA descriptor per operand row;
+                # same choice as the AST fused path).  The Gram never
+                # engages at those row counts.  Effective rows mirror the
+                # slice-major pool's cap (dispatch sees the full matrix).
+                rm_pool = getattr(
+                    self.engine, "supports_row_major_gather", False
+                ) and self.engine.prefer_rowmajor(
+                    max(len(rows), pool.cap), len(slices), _WORDS,
+                    int(fmask.sum()), 2,
                 )
-                gram = self._frame_gram(matrix, box)
+                if rm_pool and len(rows) > self._pool_for(
+                    index, fname, VIEW_STANDARD, slices, lane="rmgather"
+                ).cap_max:
+                    rm_pool = False  # diverged lane caps: stay chunkable
+                id_pos, matrix, box = self._frame_matrix(
+                    index, fname, slices, set(rows.tolist()),
+                    lane="rmgather" if rm_pool else "",
+                )
+                gram = None if rm_pool else self._frame_gram(matrix, box)
                 if gram is not None:  # implies a live box (_frame_gram contract)
                     # Native lane: the gram_lut (sorted id table + positions)
                     # lives and dies with the cache box, like the Gram itself.
@@ -544,6 +561,10 @@ class Executor:
                         from pilosa_tpu.ops.bitwise import gram_pair_counts
 
                         counts = gram_pair_counts(op, gram, pairs)
+                    elif rm_pool:
+                        counts = self.engine.to_numpy(
+                            self.engine.gather_count_rowmajor_dev(op, matrix, pairs)
+                        ).astype(np.int64)
                     else:
                         counts = self.engine.gather_count(op, matrix, pairs)
                     fout[om] = counts
@@ -967,21 +988,51 @@ class Executor:
 
                 if len(want) <= pool.cap_max:
                     # Resident regime: rows live (or page) in the pool.
+                    # Tall working sets relative to the request batch hit
+                    # the GATHER kernels, which on v5e are DMA-descriptor
+                    # -bound: those parts page through a ROW-MAJOR pool
+                    # lane (one contiguous descriptor per operand row)
+                    # instead.  The Gram never engages at these row
+                    # counts (its all-pairs work would dwarf the batch).
+                    n_pairs = sum(
+                        len(v) for (_o, kb), v in groups.items() if kb == 2
+                    )
+                    # Effective row count mirrors what dispatch will see:
+                    # the slice-major pool dispatches over its FULL cap
+                    # (not just this part's rows), so a grown pool forces
+                    # the gather kernels even for small wants.
+                    rm_pool = getattr(
+                        self.engine, "supports_row_major_gather", False
+                    ) and self.engine.prefer_rowmajor(
+                        max(len(want), pool.cap), len(slices), _WORDS, n_pairs,
+                        max(kb for _, kb in groups),
+                    )
+                    if rm_pool:
+                        # Lane caps can diverge when one is overridden;
+                        # never let the lane switch turn a chunkable part
+                        # into an over-capacity error.
+                        rm_p = self._pool_for(
+                            index, frame, view, slices, lane="rmgather"
+                        )
+                        if len(want) > rm_p.cap_max:
+                            rm_pool = False
                     id_pos, matrix, box = self._frame_matrix(
-                        index, frame, slices, set(want), view
+                        index, frame, slices, set(want), view,
+                        lane="rmgather" if rm_pool else "",
                     )
                     # The Gram only answers 2-operand counts — don't
                     # trigger its (expensive, cached) build for requests
                     # without a pair group.
                     gram = (
                         self._frame_gram(matrix, box)
-                        if any(kb == 2 for _, kb in groups)
+                        if not rm_pool and any(kb == 2 for _, kb in groups)
                         else None
                     )
                     for gk, op_idxs in sorted(groups.items()):
                         counts = self.engine.to_numpy(
                             self._group_counts(
-                                gk, op_idxs, matched, id_pos, matrix, static, gram
+                                gk, op_idxs, matched, id_pos, matrix, static,
+                                gram, row_major=rm_pool,
                             )
                         )
                         for k2, i in enumerate(op_idxs):
@@ -1180,22 +1231,30 @@ class Executor:
         in/out on demand (rowpool.DeviceRowPool) — the row-count ceiling
         of the old design is gone.  ``lane`` separates workloads with
         different paging patterns (TopN candidate streams vs fused count
-        working sets) so one can't evict the other's residency.
+        working sets vs the row-major gather lane) so one can't evict
+        another's residency; lanes holding the same frame's rows each
+        carry the per-pool budget (the prefer_rowmajor cap-mirroring
+        keeps a frame's traffic on one lane at steady state, so the
+        duplicate-residency window is the transition, not the norm).
         """
         key = (index, frame, view, tuple(slices), lane)
+        row_major = lane == "rmgather"
         with self._matrix_mu:
             pool = self._matrix_cache.get(key)
             if pool is None:
 
-                def fetch(row_ids, slice_idxs, _key=key):
+                def fetch(row_ids, slice_idxs, _key=key, _rm=row_major):
                     # Re-resolves fragments per fetch (they may be created
                     # by a first write after the pool exists).
                     idx_n, frame_n, view_n, slc, _lane = _key
                     return self._densify_block(
-                        idx_n, frame_n, view_n, [slc[si] for si in slice_idxs], row_ids
+                        idx_n, frame_n, view_n,
+                        [slc[si] for si in slice_idxs], row_ids, row_major=_rm,
                     )
 
-                pool = DeviceRowPool(self.engine, len(slices), _WORDS, fetch)
+                pool = DeviceRowPool(
+                    self.engine, len(slices), _WORDS, fetch, row_major=row_major
+                )
                 self._matrix_cache[key] = pool
             self._matrix_cache.move_to_end(key)
             while len(self._matrix_cache) > self._matrix_cache_entries:
@@ -1203,7 +1262,8 @@ class Executor:
         return pool
 
     def _frame_matrix(
-        self, index: str, frame: str, slices, want: set[int], view: str = VIEW_STANDARD
+        self, index: str, frame: str, slices, want: set[int],
+        view: str = VIEW_STANDARD, lane: str = "",
     ) -> tuple[dict[int, int], object, Optional[dict]]:
         """Device row matrix holding (at least) ``want`` for a frame view.
 
@@ -1216,7 +1276,7 @@ class Executor:
         """
         frags = [self.holder.fragment(index, frame, view, s) for s in slices]
         gens = tuple(-1 if f is None else f.generation for f in frags)
-        pool = self._pool_for(index, frame, view, slices)
+        pool = self._pool_for(index, frame, view, slices, lane=lane)
         return pool.acquire(sorted(want), gens)
 
     # -- call dispatch (executor.go:156-179) ------------------------------

@@ -74,11 +74,19 @@ class DeviceRowPool:
         words: int,
         fetch: Callable[[Sequence[int], Sequence[int]], np.ndarray],
         cap_max: int = 0,
+        row_major: bool = False,
     ):
         self.engine = engine
         self.n_slices = n_slices
         self.words = words
         self.fetch = fetch
+        # Row-major pools store [cap, n_slices, W] (tiled) so the gather
+        # regime's kernels get one contiguous DMA descriptor per operand
+        # row; ``fetch`` must then return [len(row_ids), len(slice_idxs),
+        # W] blocks (the executor's densify fills either order directly).
+        # Slice-major (default) matches mesh sharding and the Gram/TopN
+        # lanes.
+        self.row_major = row_major
         # 0 = budget-driven (re-read per access so a retuned
         # PILOSA_TPU_POOL_BYTES applies to cached pools, keeping this in
         # lockstep with callers that consult pool_capacity() directly).
@@ -126,8 +134,14 @@ class DeviceRowPool:
         if new_cap <= self.cap:
             return
         if self.matrix is None or self.cap == 0:
-            host = np.zeros((self.n_slices, new_cap, self.words), dtype=np.uint32)
-            self.matrix = self.engine.matrix(host)
+            if self.row_major:
+                host = np.zeros((new_cap, self.n_slices, self.words), dtype=np.uint32)
+                self.matrix = self.engine.matrix_rows(host)
+            else:
+                host = np.zeros((self.n_slices, new_cap, self.words), dtype=np.uint32)
+                self.matrix = self.engine.matrix(host)
+        elif self.row_major:
+            self.matrix = self.engine.grow_rows_rm(self.matrix, new_cap - self.cap)
         else:
             # Zero capacity appended device-side (no host transfer).
             self.matrix = self.engine.grow_rows(self.matrix, new_cap - self.cap)
@@ -156,8 +170,13 @@ class DeviceRowPool:
             return
         rows = sorted(self.slot_of, key=self.slot_of.get)
         slots = [self.slot_of[r] for r in rows]
-        block = self.fetch(rows, stale)  # [len(stale), len(rows), W]
-        self.matrix = self.engine.set_plane_rows(self.matrix, stale, slots, block)
+        block = self.fetch(rows, stale)  # layout per self.row_major
+        if self.row_major:  # block: [len(rows), len(stale), W]
+            self.matrix = self.engine.set_plane_rows_rm(
+                self.matrix, stale, slots, block
+            )
+        else:  # block: [len(stale), len(rows), W]
+            self.matrix = self.engine.set_plane_rows(self.matrix, stale, slots, block)
 
     # -- API --------------------------------------------------------------
 
@@ -208,7 +227,12 @@ class DeviceRowPool:
                         self.stat_evictions += 1
                 slots = free[: len(missing)]
                 block = self.fetch(missing, list(range(self.n_slices)))
-                self.matrix = self.engine.set_rows_at(self.matrix, slots, block)
+                if self.row_major:  # block: [len(missing), S, W]
+                    self.matrix = self.engine.set_rows_at_rm(
+                        self.matrix, slots, block
+                    )
+                else:
+                    self.matrix = self.engine.set_rows_at(self.matrix, slots, block)
                 for r, s in zip(missing, slots):
                     self.slot_of[r] = s
                     self.row_at[s] = r

@@ -1166,3 +1166,66 @@ def test_read_coalescing_queue_matches_sequential(tmp_path):
          'Count(Intersect(Bitmap(rowID=2, frame="f"), Bitmap(rowID=3, frame="f")))')
     assert e.execute("i", q) == e_seq.execute("i", q)
     h.close()
+
+
+def test_rowmajor_pool_lane(tmp_path, monkeypatch):
+    """Tall working sets page through the ROW-MAJOR pool lane (one
+    contiguous DMA descriptor per operand row on TPU); forced on here so
+    the CPU suite exercises the row-major fetch/scatter/paging plumbing
+    and its parity with the numpy engine.  Covers miss paging, the
+    write-invalidation (stale plane) refresh, and mixed pair/3-operand
+    groups."""
+    import pilosa_tpu.engine as engine_mod
+
+    h = Holder(str(tmp_path / "data"))
+    h.open()
+    idx = h.create_index("i")
+    idx.create_frame("f", FrameOptions())
+    fr = idx.frame("f")
+    rng = np.random.default_rng(9)
+    n_rows = 160
+    rows = np.repeat(np.arange(n_rows, dtype=np.uint64), 12)
+    for s in range(2):
+        cols = rng.integers(0, SLICE_WIDTH, size=len(rows)).astype(
+            np.uint64
+        ) + np.uint64(s * SLICE_WIDTH)
+        fr.import_bits(rows, cols)
+
+    monkeypatch.setattr(
+        engine_mod.JaxEngine, "supports_row_major_gather", property(lambda self: True)
+    )
+    e = Executor(h, engine="jax")
+    if e.engine.name == "numpy":
+        pytest.skip("jax engine unavailable")
+    e_np = Executor(h, engine="numpy")
+
+    # All-distinct pair operands: want == 2 * n_pairs, exactly the
+    # boundary where the resident-kernel predicate hands over to the
+    # gather kernels (and so the row-major lane).
+    perm = rng.permutation(n_rows)
+    prs = [[int(perm[2 * i]), int(perm[2 * i + 1])] for i in range(64)]
+    tris = rng.integers(0, n_rows, size=(8, 3)).tolist()
+    q = " ".join(
+        f'Count(Intersect(Bitmap(rowID={a}, frame="f"), Bitmap(rowID={b}, frame="f")))'
+        for a, b in prs
+    ) + " " + " ".join(
+        f'Count(Union(Bitmap(rowID={a}, frame="f"), Bitmap(rowID={b}, frame="f"), '
+        f'Bitmap(rowID={c}, frame="f")))'
+        for a, b, c in tris
+    )
+    assert e.execute("i", q) == e_np.execute("i", q)
+    pool = e._pool_for("i", "f", "standard", [0, 1], lane="rmgather")
+    assert pool.row_major and pool.matrix is not None
+    assert pool.matrix.shape[0] >= len({x for p in prs for x in p})
+    # Write invalidation: the stale-plane refresh path in row-major layout.
+    fr.set_bit("standard", int(prs[0][0]), 5)
+    assert e.execute("i", q) == e_np.execute("i", q)
+    # Eviction paging in the row-major pool.  The batch chunker consults
+    # the default lane's capacity, so shrink both pools together (in
+    # production they share the same budget formula).
+    pool.cap_max = 64
+    e._pool_for("i", "f", "standard", [0, 1]).cap_max = 64
+    pool._reset()
+    assert e.execute("i", q) == e_np.execute("i", q)
+    assert pool.stat_evictions > 0 or pool.stat_resets > 0
+    h.close()
