@@ -22,8 +22,11 @@ run() {
   echo "--- exit=$? $(date +%H:%M:%S)" >> $OUT
 }
 # 1) >=1B columns resident on one chip (device-generated; relayout copy
-#    gone since round 3, so 1024 slices x 64 rows = 8 GB fits).
-run BENCH_CONFIG=intersect_count BENCH_SLICES=1024 BENCH_ITERS=128 BENCH_TIMED_RUNS=2
+#    gone since round 3, so 1024 slices x 64 rows = 8 GB fits).  Long
+#    stream for the Gram lane's sustained rate; the NO_GRAM line records
+#    the direct resident kernel's bandwidth on the same shape.
+run BENCH_CONFIG=intersect_count BENCH_SLICES=1024 BENCH_ITERS=65536 BENCH_TIMED_RUNS=3
+run BENCH_CONFIG=intersect_count BENCH_SLICES=1024 PILOSA_TPU_NO_GRAM=1 BENCH_ITERS=128 BENCH_TIMED_RUNS=2
 # 2) TopN p50 @ 1.01B columns (BASELINE.json metric).
 run BENCH_CONFIG=topn_p50 BENCH_ITERS=64
 # 3) Gram-ineligible 4k-row gather headline with bandwidth_util, at the
@@ -33,6 +36,8 @@ run BENCH_CONFIG=intersect_count_4krows BENCH_SLICES=16 BENCH_TIMED_RUNS=3
 # 4) Resident-kernel bandwidth_util at the classic 16-slice shape.
 run BENCH_CONFIG=intersect_count PILOSA_TPU_NO_GRAM=1 BENCH_ITERS=512 BENCH_TIMED_RUNS=3
 # 5) Bigger-than-HBM stream (device-staged chunks; measures the HBM
-#    streaming regime, not the tunnel).
+#    streaming regime, not the tunnel) — at 2.15B and the 10B-column
+#    north-star scale.
 run BENCH_CONFIG=intersect_count_stream BENCH_TIMED_RUNS=2
+run BENCH_CONFIG=intersect_count_stream BENCH_SLICES=10240 BENCH_TIMED_RUNS=2
 echo "ALL DONE $(date +%H:%M:%S)" >> $OUT
