@@ -198,6 +198,12 @@ def test_lockstep_query_service():
                         'SetBit(rowID=0, frame="f", columnID=78, timestamp="2017-03-02T00:00")')
         assert out["results"] == [True, True]
         assert job.query('Count(Bitmap(rowID=0, frame="f"))')["results"] == [10]
+        # TopN with a src bitmap through the SERVICE: candidate scoring
+        # rides the multi-process engine scorer (shard_map + allgather)
+        # on every rank, in lockstep.
+        out = job.query('TopN(Bitmap(rowID=0, frame="f"), frame="f", n=2)')
+        pairs = out["results"][0]
+        assert pairs and pairs[0]["id"] == 0 and pairs[0]["count"] == 10
         # Error path: rank 0 reports, workers stay in lockstep.
         req = urllib.request.Request(
             f"http://127.0.0.1:{job.http}/index/g/query",
