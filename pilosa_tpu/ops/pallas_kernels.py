@@ -148,7 +148,7 @@ def _resident_count_kernel(op, n_pairs, pairs_ref, rows_ref, out_ref):
 
 
 def _resident_chunk_sub(
-    n_rows: int, w: int, batch: int = 0, budget_bytes: int = 4 * 1024 * 1024
+    n_rows: int, w: int, batch: int = 0, budget_bytes: int = 8 * 1024 * 1024
 ) -> int:
     """Largest power-of-two sublane chunk (multiple of 8, dividing w/128)
     whose all-rows block fits the VMEM budget; 0 if even 8 doesn't fit.
@@ -156,7 +156,13 @@ def _resident_chunk_sub(
     The (batch, 8, 128) int32 accumulator block is held fully resident
     across every grid step (constant output index map), so its footprint
     comes out of the same budget — large fused batches must fall back to
-    the per-query gather kernel whose output block is (1, 8, 128)."""
+    the per-query gather kernel whose output block is (1, 8, 128).
+
+    Budget 8 MB: the block is double-buffered across grid steps, so the
+    worst case is 2*(8MB - out) + out <= 16 MB VMEM.  Measured at the
+    1024-slice bench shape: 4 MB blocks (this budget) run at 80% of the
+    HBM roofline vs 53% with the previous 4 MB budget's 2 MB blocks —
+    the v5e DMA descriptor ladder again (BASELINE.md round-3 notes)."""
     out_bytes = batch * 8 * _LANES * 4
     total_sub = w // _LANES
     best = 0
