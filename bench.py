@@ -410,6 +410,86 @@ def bench_executor() -> dict:
     }
 
 
+def bench_executor_gather() -> dict:
+    """Product-path GATHER regime: steady-state PQL pair-count requests
+    whose distinct-row working set forces the gather kernels (Gram- and
+    resident-ineligible), served warm from the executor's row-major pool
+    lane.  vs_baseline compares the same warm requests with the
+    row-major lane disabled (the slice-major kernel) — the recorded form
+    of the lane's product-level win."""
+    n_rows = int(os.environ.get("BENCH_ROWS", "1024"))
+    n_slices = int(os.environ.get("BENCH_SLICES", "4"))
+    batch = int(os.environ.get("BENCH_BATCH", "512"))
+    n_queries = int(os.environ.get("BENCH_ITERS", "8"))
+    bits_per_row = int(os.environ.get("BENCH_BITS_PER_ROW", "20"))
+    repeats = 3
+    import tempfile
+
+    import pilosa_tpu.engine as engine_mod
+    from pilosa_tpu.core.frame import FrameOptions
+    from pilosa_tpu.core.holder import Holder
+    from pilosa_tpu.executor import Executor
+    from pilosa_tpu.pilosa import SLICE_WIDTH
+
+    rng = np.random.default_rng(77)
+    with tempfile.TemporaryDirectory() as d:
+        h = Holder(d)
+        h.open()
+        h.create_index("p").create_frame("f", FrameOptions())
+        fr = h.index("p").frame("f")
+        rows = np.repeat(np.arange(n_rows, dtype=np.uint64), bits_per_row)
+        for s in range(n_slices):
+            cols = rng.integers(0, SLICE_WIDTH, size=len(rows)).astype(
+                np.uint64
+            ) + np.uint64(s * SLICE_WIDTH)
+            fr.import_bits(rows, cols)
+
+        def build_q(seed):
+            # All-distinct operands: want = 2 * pairs, past the resident
+            # kernel's predicate -> the gather/rm lane.
+            perm = np.random.default_rng(seed).permutation(n_rows)
+            return " ".join(
+                f'Count(Intersect(Bitmap(rowID={int(perm[2 * i])}, frame="f"), '
+                f'Bitmap(rowID={int(perm[2 * i + 1])}, frame="f")))'
+                for i in range(batch // 2)
+            )
+
+        qs = [build_q(i) for i in range(n_queries)]
+
+        def steady_rate(ex):
+            for q in qs:  # warm: rows page in, kernels compile
+                ex.execute("p", q)
+            t0 = time.perf_counter()
+            for _ in range(repeats):
+                for q in qs:
+                    ex.execute("p", q)
+            return repeats * n_queries * (batch // 2) / (time.perf_counter() - t0)
+
+        ex = Executor(h)
+        backend = ex.engine.name
+        qps = steady_rate(ex)
+        # Baseline: same engine with the row-major lane disabled.
+        orig = engine_mod.JaxEngine.prefer_rowmajor
+        engine_mod.JaxEngine.prefer_rowmajor = lambda self, *a: False
+        try:
+            base_qps = steady_rate(Executor(h))
+        finally:
+            engine_mod.JaxEngine.prefer_rowmajor = orig
+        # Correctness gate vs numpy on one request.
+        assert ex.execute("p", qs[0]) == Executor(h, engine="numpy").execute("p", qs[0])
+        h.close()
+    return {
+        "metric": "executor_gather_qps",
+        "value": round(qps, 1),
+        "unit": (
+            f"PQL queries/sec end-to-end, gather regime ({n_rows} distinct rows x "
+            f"{n_slices} slices, batch {batch // 2}, row-major pool lane, warm; "
+            f"slice-major lane {base_qps:,.0f} q/s, engine {backend})"
+        ),
+        "vs_baseline": round(qps / base_qps, 2),
+    }
+
+
 def bench_range_executor() -> dict:
     """End-to-end fused Range path: batched PQL Count(Range(...)) requests
     through the Executor — parser -> fused multi-view matrix ->
@@ -990,6 +1070,7 @@ def main() -> None:
             "union64": bench_union64,
             "timerange": bench_timerange,
             "executor": bench_executor,
+            "executor_gather": bench_executor_gather,
             "range_executor": bench_range_executor,
             "intersect_count_stream": bench_intersect_stream,
             "intersect_count_4krows": bench_intersect_4krows,

@@ -114,3 +114,13 @@ def test_bench_lockstep_emits_json():
     )
     result = json.loads(stdout.strip().splitlines()[-1])
     assert result["metric"] == "lockstep_service_qps" and result["value"] > 0
+
+
+def test_bench_executor_gather_smoke():
+    stdout = _run({
+        "BENCH_CONFIG": "executor_gather", "BENCH_ROWS": "32",
+        "BENCH_SLICES": "2", "BENCH_BATCH": "8", "BENCH_ITERS": "2",
+        "BENCH_BITS_PER_ROW": "5",
+    })
+    result = json.loads(stdout.strip().splitlines()[-1])
+    assert result["value"] > 0
