@@ -412,12 +412,22 @@ def bench_executor() -> dict:
 
 def bench_executor_gather() -> dict:
     """Product-path GATHER regime: steady-state PQL pair-count requests
-    whose distinct-row working set forces the gather kernels (Gram- and
-    resident-ineligible), served warm from the executor's row-major pool
+    whose distinct-row working set is past BOTH the Gram budget (4096
+    rows bucket to a >1.5 GB unpacked bit matrix) and the resident
+    kernel's predicate, served warm from the executor's row-major pool
     lane.  vs_baseline compares the same warm requests with the
-    row-major lane disabled (the slice-major kernel) — the recorded form
-    of the lane's product-level win."""
-    n_rows = int(os.environ.get("BENCH_ROWS", "1024"))
+    row-major lane disabled (the slice-major gather kernel).
+
+    CAVEAT (this environment): each request is one eager device
+    dispatch + result fetch, ~100 ms through the remote tunnel, which
+    dominates both lanes' device time (1-15 ms) — so e2e throughput
+    here is RTT-bound and vs_baseline sits near 1.0 regardless of
+    kernel.  The lanes' true difference is the kernel-level record
+    (intersect_count_4krows: row-major 310-395k q/s vs slice-major
+    ~137k on the same shape); on a host-attached TPU the e2e ratio
+    approaches that.  The config still gates parity and proves the
+    lane engages in the product path."""
+    n_rows = int(os.environ.get("BENCH_ROWS", "4096"))
     n_slices = int(os.environ.get("BENCH_SLICES", "4"))
     batch = int(os.environ.get("BENCH_BATCH", "512"))
     n_queries = int(os.environ.get("BENCH_ITERS", "8"))
@@ -484,7 +494,8 @@ def bench_executor_gather() -> dict:
         "unit": (
             f"PQL queries/sec end-to-end, gather regime ({n_rows} distinct rows x "
             f"{n_slices} slices, batch {batch // 2}, row-major pool lane, warm; "
-            f"slice-major lane {base_qps:,.0f} q/s, engine {backend})"
+            f"slice-major lane {base_qps:,.0f} q/s; BOTH tunnel-RTT-bound here — "
+            f"kernel-level lane ratio is in intersect_count_4krows, engine {backend})"
         ),
         "vs_baseline": round(qps / base_qps, 2),
     }

@@ -510,14 +510,18 @@ class Executor:
                 # Tall working sets relative to this chunk's batch hit the
                 # gather kernels — page them through the ROW-MAJOR pool
                 # lane (one contiguous DMA descriptor per operand row;
-                # same choice as the AST fused path).  The Gram never
-                # engages at those row counts.  Effective rows mirror the
-                # slice-major pool's cap (dispatch sees the full matrix).
-                rm_pool = getattr(
-                    self.engine, "supports_row_major_gather", False
-                ) and self.engine.prefer_rowmajor(
-                    max(len(rows), pool.cap), len(slices), _WORDS,
-                    int(fmask.sum()), 2,
+                # same choice as the AST fused path), UNLESS the Gram
+                # could serve this working set (warm Gram lookups beat
+                # any kernel; _gram_could_serve mirrors its gates).
+                # Effective rows mirror the slice-major pool's cap
+                # (dispatch sees the full matrix).
+                rm_pool = (
+                    getattr(self.engine, "supports_row_major_gather", False)
+                    and not self._gram_could_serve(len(rows), len(slices))
+                    and self.engine.prefer_rowmajor(
+                        max(len(rows), pool.cap), len(slices), _WORDS,
+                        int(fmask.sum()), 2,
+                    )
                 )
                 if rm_pool and len(rows) > self._pool_for(
                     index, fname, VIEW_STANDARD, slices, lane="rmgather"
@@ -1000,12 +1004,16 @@ class Executor:
                     # Effective row count mirrors what dispatch will see:
                     # the slice-major pool dispatches over its FULL cap
                     # (not just this part's rows), so a grown pool forces
-                    # the gather kernels even for small wants.
-                    rm_pool = getattr(
-                        self.engine, "supports_row_major_gather", False
-                    ) and self.engine.prefer_rowmajor(
-                        max(len(want), pool.cap), len(slices), _WORDS, n_pairs,
-                        max(kb for _, kb in groups),
+                    # the gather kernels even for small wants.  Never
+                    # displace a Gram-eligible working set — warm Gram
+                    # serving (host lookups) beats any per-query kernel.
+                    rm_pool = (
+                        getattr(self.engine, "supports_row_major_gather", False)
+                        and not self._gram_could_serve(len(want), len(slices))
+                        and self.engine.prefer_rowmajor(
+                            max(len(want), pool.cap), len(slices), _WORDS,
+                            n_pairs, max(kb for _, kb in groups),
+                        )
                     )
                     if rm_pool:
                         # Lane caps can diverge when one is overridden;
@@ -1165,6 +1173,21 @@ class Executor:
     # streams through the MXU (ops/dispatch.py uses the same bound).
     _GRAM_BYTES_BUDGET = 1536 * 1024 * 1024
 
+    def _gram_could_serve(self, n_rows: int, n_slices: int) -> bool:
+        """Whether the cached-Gram strategy is ELIGIBLE for a working set
+        of this size (same gates as _frame_gram, sans warmth): the
+        row-major gather lane must never displace it — warm Gram serving
+        is host-side lookups, strictly faster than any per-query kernel."""
+        if os.environ.get("PILOSA_TPU_NO_GRAM", "").lower() in ("1", "true", "yes"):
+            return False
+        from pilosa_tpu.ops.dispatch import _GRAM_SLICES_MAX
+
+        bucket = 1 << max(0, n_rows - 1).bit_length()
+        return (
+            bucket * _WORDS * 32 <= self._GRAM_BYTES_BUDGET
+            and n_slices <= _GRAM_SLICES_MAX
+        )
+
     def _frame_gram(self, matrix, box: Optional[dict]):
         """Cached all-pairs AND-count Gram for a fused-path row matrix.
 
@@ -1232,10 +1255,12 @@ class Executor:
         of the old design is gone.  ``lane`` separates workloads with
         different paging patterns (TopN candidate streams vs fused count
         working sets vs the row-major gather lane) so one can't evict
-        another's residency; lanes holding the same frame's rows each
-        carry the per-pool budget (the prefer_rowmajor cap-mirroring
-        keeps a frame's traffic on one lane at steady state, so the
-        duplicate-residency window is the transition, not the norm).
+        another's residency.  Lanes holding the same frame's rows each
+        carry the per-pool budget: a frame whose workload mixes
+        Gram-scale and gather-scale requests keeps both lanes warm (up
+        to 2x one pool's budget for that frame), bounded overall by
+        this LRU's entry count — the cost of never paging one workload
+        class's residency out for the other's.
         """
         key = (index, frame, view, tuple(slices), lane)
         row_major = lane == "rmgather"

@@ -1194,6 +1194,9 @@ def test_rowmajor_pool_lane(tmp_path, monkeypatch):
     monkeypatch.setattr(
         engine_mod.JaxEngine, "supports_row_major_gather", property(lambda self: True)
     )
+    # The Gram outranks the rm lane when eligible (it would serve this
+    # 160-row set); disable it so the test drives the rm plumbing.
+    monkeypatch.setenv("PILOSA_TPU_NO_GRAM", "1")
     e = Executor(h, engine="jax")
     if e.engine.name == "numpy":
         pytest.skip("jax engine unavailable")
