@@ -36,7 +36,7 @@ import numpy as np
 from pilosa_tpu import roaring
 from pilosa_tpu.core import cache as cache_mod
 from pilosa_tpu.ops import bitwise as bw
-from pilosa_tpu.pilosa import ErrFragmentLocked, SLICE_WIDTH
+from pilosa_tpu.pilosa import ErrFragmentClosed, ErrFragmentLocked, SLICE_WIDTH
 
 try:
     import fcntl
@@ -217,16 +217,19 @@ class Fragment:
             raise
         self._open = True
 
+    @staticmethod
+    def _mmap_enabled() -> bool:
+        return os.environ.get("PILOSA_TPU_MMAP", "1").lower() not in (
+            "0", "false", "no",
+        )
+
     def _map_storage(self):
         """(buffer, mmap-or-None) for the storage file: an mmap when
         possible (zero-copy attach: open cost is O(container headers),
         payloads page in on demand, the index can exceed host RAM —
         fragment.go:179-234), else the file bytes.  ``PILOSA_TPU_MMAP=0``
         forces the read path."""
-        use_mmap = os.environ.get("PILOSA_TPU_MMAP", "1").lower() not in (
-            "0", "false", "no",
-        )
-        if use_mmap:
+        if self._mmap_enabled():
             import mmap as _mmap
 
             try:
@@ -333,10 +336,11 @@ class Fragment:
         if not data.startswith(_CACHE_MAGIC):
             return
         ids = np.frombuffer(data[len(_CACHE_MAGIC) :], dtype="<u8")
-        for row_id in ids:
-            n = self.row_count(int(row_id))
-            if n:
-                self.cache.bulk_add(int(row_id), n)
+        with self._mu:  # runs inside open(), before _open flips true
+            for row_id in ids:
+                n = self._row_count_locked(int(row_id))
+                if n:
+                    self.cache.bulk_add(int(row_id), n)
         self.cache.recalculate()
 
     def _save_cache(self) -> None:
@@ -371,6 +375,7 @@ class Fragment:
 
     def set_bit(self, row_id: int, column_id: int) -> bool:
         with self._mu:
+            self._assert_open()
             changed = self.storage.add(self.pos(row_id, column_id))
             if changed:
                 # Row bookkeeping (cache invalidation + rank-cache update)
@@ -454,6 +459,7 @@ class Fragment:
 
     def clear_bit(self, row_id: int, column_id: int) -> bool:
         with self._mu:
+            self._assert_open()
             changed = self.storage.remove(self.pos(row_id, column_id))
             if changed:
                 self.generation = next(_generation_counter)
@@ -465,6 +471,7 @@ class Fragment:
 
     def contains(self, row_id: int, column_id: int) -> bool:
         with self._mu:
+            self._assert_open()
             return self.storage.contains(self.pos(row_id, column_id))
 
     def _flush_row_bookkeeping(self) -> None:
@@ -536,7 +543,7 @@ class Fragment:
         # O(containers) parse on top of the O(containers) write this
         # method just did; skipped when mmap is disabled.
         old_mm = self._storage_map
-        data, mm = self._map_storage()
+        data, mm = self._map_storage() if self._mmap_enabled() else (None, None)
         if mm is not None:
             self.storage = roaring.Bitmap.from_bytes(data, zero_copy=True)
             self._storage_map = mm
@@ -552,9 +559,17 @@ class Fragment:
 
     # -- row reads (fragment.go:332-367) --------------------------------
 
+    def _assert_open(self) -> None:
+        """Guard for read paths: close() swaps storage to an empty bitmap
+        (to release the mmap), so a late reader must fail loudly instead
+        of silently observing an empty fragment."""
+        if not self._open:
+            raise ErrFragmentClosed(f"fragment closed: {self.path}")
+
     def row_dense(self, row_id: int) -> np.ndarray:
         """One row of this slice as packed uint32 words (device layout)."""
         with self._mu:
+            self._assert_open()
             self._flush_row_bookkeeping()
             cached = self._row_cache.get(row_id)
             if cached is not None:
@@ -600,12 +615,14 @@ class Fragment:
     def row(self, row_id: int) -> roaring.Bitmap:
         """Row as a roaring bitmap of global column positions for this slice."""
         with self._mu:
+            self._assert_open()
             return self.storage.offset_range(
                 self.slice * SLICE_WIDTH, row_id * SLICE_WIDTH, (row_id + 1) * SLICE_WIDTH
             )
 
     def row_count(self, row_id: int) -> int:
         with self._mu:
+            self._assert_open()
             self._flush_row_bookkeeping()
             return self._row_count_locked(row_id)
 
@@ -738,6 +755,7 @@ class Fragment:
     def import_bits(self, row_ids: Sequence[int], column_ids: Sequence[int]) -> None:
         """Bulk load; WAL detached, one snapshot at the end."""
         with self._mu:
+            self._assert_open()
             self._import_bits(row_ids, column_ids)
 
     def _import_bits(self, row_ids, column_ids) -> None:

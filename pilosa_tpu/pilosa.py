@@ -46,6 +46,12 @@ class ErrFragmentLocked(PilosaError):
     (fragment.go:179-234 flock analog)."""
 
 
+class ErrFragmentClosed(PilosaError):
+    """Read/write against a closed fragment — close() swaps in an empty
+    bitmap to release the mmap, so without this guard a late reader
+    would silently see no data instead of an error."""
+
+
 class ErrQueryRequired(PilosaError):
     pass
 

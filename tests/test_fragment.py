@@ -446,3 +446,44 @@ def test_snapshot_reattaches_mmap(tmp_path):
     assert f.contains(3, 777)
     f.storage.check()
     f.close()
+
+
+def test_post_close_reads_fail_loudly(tmp_path):
+    """close() swaps storage for an empty bitmap to release the mmap; a
+    late reader must get ErrFragmentClosed, not silently-empty rows."""
+    from pilosa_tpu.pilosa import ErrFragmentClosed
+
+    f = Fragment(str(tmp_path / "0"), "i", "f", "standard", 0)
+    f.open()
+    f.set_bit(1, 10)
+    assert f.row_count(1) == 1
+    f.close()
+    for access in (
+        lambda: f.row_dense(1),
+        lambda: f.row(1),
+        lambda: f.row_count(1),
+        lambda: f.contains(1, 10),
+        lambda: f.set_bit(1, 11),
+        lambda: f.clear_bit(1, 10),
+        lambda: f.import_bits([1], [12]),
+    ):
+        with pytest.raises(ErrFragmentClosed):
+            access()
+
+
+def test_snapshot_skips_storage_reread_without_mmap(tmp_path, monkeypatch):
+    """With PILOSA_TPU_MMAP=0 a snapshot must not re-read the file it just
+    wrote (there is no map to re-attach)."""
+    monkeypatch.setenv("PILOSA_TPU_MMAP", "0")
+    f = Fragment(str(tmp_path / "0"), "i", "f", "standard", 0)
+    f.open()
+    f.set_bit(2, 20)
+    calls = []
+    orig = Fragment._map_storage
+    monkeypatch.setattr(
+        Fragment, "_map_storage", lambda self: calls.append(1) or orig(self)
+    )
+    f.snapshot()
+    assert calls == []
+    assert f.contains(2, 20)
+    f.close()
