@@ -35,7 +35,7 @@ from pilosa_tpu.core.fragment import TopOptions
 from pilosa_tpu.core import timequantum as tq
 from pilosa_tpu.core.view import VIEW_INVERSE, VIEW_STANDARD
 from pilosa_tpu.engine import new_engine
-from pilosa_tpu.rowpool import DeviceRowPool, chunk_queries
+from pilosa_tpu.rowpool import DeviceRowPool, chunk_queries, pool_capacity
 from pilosa_tpu.pilosa import (
     ErrFrameInverseDisabled,
     ErrFrameNotFound,
@@ -512,20 +512,26 @@ class Executor:
                 # lane (one contiguous DMA descriptor per operand row;
                 # same choice as the AST fused path), UNLESS the Gram
                 # could serve this working set (warm Gram lookups beat
-                # any kernel; _gram_could_serve mirrors its gates).
-                # Effective rows mirror the slice-major pool's cap
-                # (dispatch sees the full matrix).
+                # any kernel; _gram_could_serve mirrors its gates).  In
+                # the paging regime (multiple qparts) the Gram can never
+                # WARM — each part switch remaps pool slots and kills the
+                # cache box — so only a single-part working set may veto
+                # the row-major lane.  Effective rows mirror the
+                # slice-major pool's cap (dispatch sees the full matrix).
                 rm_pool = (
                     getattr(self.engine, "supports_row_major_gather", False)
-                    and not self._gram_could_serve(len(rows), len(slices))
+                    and (
+                        len(qparts) > 1
+                        or not self._gram_could_serve(len(rows), len(slices))
+                    )
                     and self.engine.prefer_rowmajor(
                         max(len(rows), pool.cap), len(slices), _WORDS,
                         int(fmask.sum()), 2,
                     )
                 )
-                if rm_pool and len(rows) > self._pool_for(
+                if rm_pool and len(rows) > self._peek_pool_cap(
                     index, fname, VIEW_STANDARD, slices, lane="rmgather"
-                ).cap_max:
+                ):
                     rm_pool = False  # diverged lane caps: stay chunkable
                 id_pos, matrix, box = self._frame_matrix(
                     index, fname, slices, set(rows.tolist()),
@@ -975,9 +981,10 @@ class Executor:
             # oversize_ok: one Count over more operands than row_cap has no
             # valid row-chunking — it becomes its own part and the
             # streaming branch below (which handles any row count) runs it.
-            for part in chunk_queries(
+            parts = list(chunk_queries(
                 f_idxs, lambda i: matched[i][3], row_cap, oversize_ok=True
-            ):
+            ))
+            for part in parts:
                 want = sorted({x for i in part for x in matched[i][3]})
                 # Group calls by (op, operand-count bucket): one dispatch
                 # each.  Jitted engines bucket the operand axis to powers
@@ -1006,24 +1013,28 @@ class Executor:
                     # (not just this part's rows), so a grown pool forces
                     # the gather kernels even for small wants.  Never
                     # displace a Gram-eligible working set — warm Gram
-                    # serving (host lookups) beats any per-query kernel.
+                    # serving (host lookups) beats any per-query kernel —
+                    # but only a SINGLE-part working set may veto: in the
+                    # paging regime each part switch remaps pool slots
+                    # and kills the cache box, so the Gram never warms.
                     rm_pool = (
                         getattr(self.engine, "supports_row_major_gather", False)
-                        and not self._gram_could_serve(len(want), len(slices))
+                        and (
+                            len(parts) > 1
+                            or not self._gram_could_serve(len(want), len(slices))
+                        )
                         and self.engine.prefer_rowmajor(
                             max(len(want), pool.cap), len(slices), _WORDS,
                             n_pairs, max(kb for _, kb in groups),
                         )
                     )
-                    if rm_pool:
+                    if rm_pool and len(want) > self._peek_pool_cap(
+                        index, frame, view, slices, lane="rmgather"
+                    ):
                         # Lane caps can diverge when one is overridden;
                         # never let the lane switch turn a chunkable part
                         # into an over-capacity error.
-                        rm_p = self._pool_for(
-                            index, frame, view, slices, lane="rmgather"
-                        )
-                        if len(want) > rm_p.cap_max:
-                            rm_pool = False
+                        rm_pool = False
                     id_pos, matrix, box = self._frame_matrix(
                         index, frame, slices, set(want), view,
                         lane="rmgather" if rm_pool else "",
@@ -1169,9 +1180,15 @@ class Executor:
             return self.engine.matrix_rows(block)
         return self.engine.matrix(block)
 
-    # Transient-HBM budget for the unpacked int8 bit matrix a Gram build
-    # streams through the MXU (ops/dispatch.py uses the same bound).
-    _GRAM_BYTES_BUDGET = 1536 * 1024 * 1024
+    def _gram_rows_max(self) -> int:
+        """Row ceiling for the cached-Gram strategy.  The chunked builder
+        (bitwise.pair_gram) streams (slice, word-chunk) steps, so rows no
+        longer bound the build transient; what remains is the Gram matrix
+        itself — R^2 int32 on device, fetched once to host for the native
+        lookup lane (pn_gram_counts).  4096 rows = a 64 MiB Gram; the
+        pool HBM budget bounds build FLOPs (R * S*R * 2^20 MACs with
+        S*R capped by PILOSA_TPU_POOL_BYTES) to a few MXU-seconds."""
+        return int(os.environ.get("PILOSA_TPU_GRAM_ROWS_MAX", "4096"))
 
     def _gram_could_serve(self, n_rows: int, n_slices: int) -> bool:
         """Whether the cached-Gram strategy is ELIGIBLE for a working set
@@ -1183,10 +1200,7 @@ class Executor:
         from pilosa_tpu.ops.dispatch import _GRAM_SLICES_MAX
 
         bucket = 1 << max(0, n_rows - 1).bit_length()
-        return (
-            bucket * _WORDS * 32 <= self._GRAM_BYTES_BUDGET
-            and n_slices <= _GRAM_SLICES_MAX
-        )
+        return bucket <= self._gram_rows_max() and n_slices <= _GRAM_SLICES_MAX
 
     def _frame_gram(self, matrix, box: Optional[dict]):
         """Cached all-pairs AND-count Gram for a fused-path row matrix.
@@ -1216,15 +1230,13 @@ class Executor:
         bucket = min(shape[1], 1 << max(0, (n_used - 1)).bit_length()) if n_used else 0
         if bucket == 0:
             return None
-        # Unpacked int8 bits are 32 bytes per uint32 word (word count from
-        # either the 3D logical or 4D tiled matrix layout).  The chunked
-        # builder (bitwise.pair_gram) streams slice by slice, so only ONE
-        # slice's bits must fit the transient budget; int32 Gram entries
-        # cap the slice count at 2047 (ops/dispatch.py gate).
+        # The chunked builder (bitwise.pair_gram) streams (slice,
+        # word-chunk) steps, so only GRAM_STEP_BYTES of unpacked bits are
+        # live per step regardless of row count; the gates left are the
+        # Gram matrix size (rows) and the int32 count bound (slices).
         from pilosa_tpu.ops.dispatch import _GRAM_SLICES_MAX
 
-        words = shape[2] if len(shape) == 3 else shape[2] * shape[3]
-        if bucket * words * 32 > self._GRAM_BYTES_BUDGET or shape[0] > _GRAM_SLICES_MAX:
+        if bucket > self._gram_rows_max() or shape[0] > _GRAM_SLICES_MAX:
             return None
         mu = box.get("mu")
         if mu is None or not mu.acquire(blocking=False):
@@ -1243,6 +1255,19 @@ class Executor:
             return gram
         finally:
             mu.release()
+
+    def _peek_pool_cap(
+        self, index: str, frame: str, view: str, slices, lane: str = ""
+    ) -> int:
+        """A lane pool's row capacity WITHOUT instantiating it or touching
+        the LRU order — lane-choice probes must never evict a warm pool
+        (and its cached Gram) for a lane that may not even be taken."""
+        key = (index, frame, view, tuple(slices), lane)
+        with self._matrix_mu:
+            pool = self._matrix_cache.get(key)
+            if pool is not None:
+                return pool.cap_max
+        return max(1, pool_capacity(len(slices), _WORDS))
 
     def _pool_for(
         self, index: str, frame: str, view: str, slices, lane: str = ""

@@ -196,28 +196,40 @@ def np_gather_count_or_multi(row_matrix: np.ndarray, idx: np.ndarray) -> np.ndar
 
 
 # One-shot Gram unpack budget: past this, the int8 bit matrix streams
-# slice-by-slice through the MXU instead (pair_gram's scan path).
+# chunk-by-chunk through the MXU instead (pair_gram's scan path).
 GRAM_ONESHOT_BYTES = 1536 * 1024 * 1024
+
+# Per-step unpack budget for the streamed builder.  A step's live int8
+# bits are R * chunk_words * 32 bytes; tall row sets (4k+ rows, where a
+# single slice's unpack would be 4+ GB) subdivide the word axis until a
+# step fits, so the builder has NO row-count ceiling — only the Gram
+# matrix itself (R^2 ints) and the int32 count bound gate it (callers).
+GRAM_STEP_BYTES = 768 * 1024 * 1024
 
 
 def pair_gram(row_matrix):
     """All-pairs intersection-count Gram matrix G[i,j] = |row_i & row_j|
     summed over slices, on the MXU.
 
-    The MXU strategy for small row sets: slices are disjoint bit ranges
-    of the same rows, so the Gram over the concatenated unpacked bit
-    vectors equals the per-slice sum.  int8×int8→int32 accumulation is
-    exact (products are 0/1; per-pair counts are ≤ S * 2^20, so int32
-    holds up to 2047 slices — gate at the caller).  G answers every pair
-    op through count identities (see gram_pair_counts), and — being a
-    pure function of the row matrix — XLA hoists it out of query-stream
-    loops, so a stream of fused batches pays for it once.
+    The MXU strategy for cacheable working sets: slices are disjoint bit
+    ranges of the same rows, so the Gram over the concatenated unpacked
+    bit vectors equals the per-slice sum — and any word-axis subdivision
+    of a slice splits it further into disjoint bit ranges, so the same
+    identity lets one step carry an arbitrarily small column chunk.
+    int8×int8→int32 accumulation is exact (products are 0/1; per-pair
+    counts are ≤ S * 2^20, so int32 holds up to 2047 slices — gate at
+    the caller).  G answers every pair op through count identities (see
+    gram_pair_counts), and — being a pure function of the row matrix —
+    XLA hoists it out of query-stream loops, so a stream of fused
+    batches pays for it once.
 
     Small matrices unpack once and do ONE matmul; large ones (a 1024-
-    slice x 64-row matrix is 8 GB packed = 64 GB unpacked) scan the
-    slice axis, accumulating ``G += bits_s @ bits_s.T`` with only one
-    slice's int8 bits (R * W * 32 bytes) live per step — billion-column
-    indexes get all-pairs answers for one streamed pass of MXU work.
+    slice x 64-row matrix is 8 GB packed = 64 GB unpacked) scan
+    (slice, word-chunk) steps, accumulating ``G += bits @ bits.T`` with
+    only one chunk's int8 bits (R * chunk_words * 32 bytes, bounded by
+    GRAM_STEP_BYTES) live per step — billion-column indexes AND
+    thousand-row working sets get all-pairs answers for one streamed
+    pass of MXU work.
     """
     if row_matrix.ndim == 4:  # tiled engine form (word order is identical)
         s, r = row_matrix.shape[:2]
@@ -239,14 +251,40 @@ def pair_gram(row_matrix):
             bits, bits, (((1,), (1,)), ((), ())), preferred_element_type=jnp.int32
         )
 
+    # Word-axis subdivision: split each slice into nc equal chunks (nc a
+    # power-of-two divisor of the chunkable axis) until a step's unpack
+    # fits the budget.  nc=1 reproduces the per-slice scan exactly.
+    chunk_axis = row_matrix.shape[2]  # 4D: tile count; 3D: words
+    nc = 1
+    while (
+        r * (w // nc) * 32 > GRAM_STEP_BYTES
+        and nc * 2 <= chunk_axis
+        and chunk_axis % (nc * 2) == 0
+    ):
+        nc *= 2
+
     def step(acc, i):
-        # One slice per step, fetched by index: scanning rm's leading
-        # axis directly (or reshaping the unpacked bits) made XLA
-        # relayout the whole CARRIED matrix into an MXU-friendly
+        # One (slice, chunk) per step, fetched by index: scanning rm's
+        # leading axis directly (or reshaping the unpacked bits) made
+        # XLA relayout the whole CARRIED matrix into an MXU-friendly
         # transposed tiling — an 8 GB HLO-temp copy at the 1024-slice
         # shape.  Indexed access keeps the matrix in its born layout;
-        # only the per-step 8 MB slice gets copied/transposed.
-        sl = lax.dynamic_index_in_dim(row_matrix, i, 0, keepdims=False)
+        # only the per-step chunk gets copied/transposed.
+        if nc == 1:
+            sl = lax.dynamic_index_in_dim(row_matrix, i, 0, keepdims=False)
+        else:
+            si, ci = i // nc, i % nc
+            cw = chunk_axis // nc
+            if row_matrix.ndim == 4:
+                sl = lax.dynamic_slice(
+                    row_matrix,
+                    (si, 0, ci * cw, 0),
+                    (1, r, cw, row_matrix.shape[3]),
+                )[0]
+            else:
+                sl = lax.dynamic_slice(
+                    row_matrix, (si, 0, ci * cw), (1, r, cw)
+                )[0]
         # The barrier stops the MXU's layout preference from propagating
         # through the slice to the carried matrix (verified: without it
         # XLA still inserts the full transposed copy).
@@ -257,7 +295,7 @@ def pair_gram(row_matrix):
             b, b, ((dims, dims), ((), ())), preferred_element_type=jnp.int32
         ), None
 
-    return lax.scan(step, jnp.zeros((r, r), jnp.int32), jnp.arange(s))[0]
+    return lax.scan(step, jnp.zeros((r, r), jnp.int32), jnp.arange(s * nc))[0]
 
 
 def gram_pair_counts(op: str, gram, pairs):

@@ -1232,3 +1232,22 @@ def test_rowmajor_pool_lane(tmp_path, monkeypatch):
     assert e.execute("i", q) == e_np.execute("i", q)
     assert pool.stat_evictions > 0 or pool.stat_resets > 0
     h.close()
+
+
+def test_gram_eligibility_covers_tall_row_sets(env, monkeypatch):
+    """The chunked Gram builder (bitwise.pair_gram word-axis subdivision)
+    removed the per-slice unpack ceiling: eligibility is now a rows gate
+    (PILOSA_TPU_GRAM_ROWS_MAX, default 4096 = a 64 MiB Gram) plus the
+    int32 slice bound — the round-3 gather-regime shapes (1024/4096
+    distinct rows) are Gram-served product paths."""
+    _, e = env
+    monkeypatch.delenv("PILOSA_TPU_NO_GRAM", raising=False)
+    assert e._gram_could_serve(1024, 4)
+    assert e._gram_could_serve(4096, 4)       # round-3 regression shape
+    assert not e._gram_could_serve(4097, 4)   # bucket 8192 > rows max
+    assert e._gram_could_serve(64, 2047)
+    assert not e._gram_could_serve(64, 2048)  # int32 count bound
+    monkeypatch.setenv("PILOSA_TPU_GRAM_ROWS_MAX", "8192")
+    assert e._gram_could_serve(8192, 4)
+    monkeypatch.setenv("PILOSA_TPU_NO_GRAM", "1")
+    assert not e._gram_could_serve(64, 4)

@@ -322,12 +322,19 @@ def test_pair_gram_chunked_matches_oneshot(rng):
     rm = rng.integers(0, 1 << 32, size=(S, R, W), dtype=np.uint32)
     g1 = np.asarray(bw.pair_gram(jnp.asarray(rm)))
     orig = bw.GRAM_ONESHOT_BYTES
+    orig_step = bw.GRAM_STEP_BYTES
     bw.GRAM_ONESHOT_BYTES = 1  # force the scan path
     try:
         g2 = np.asarray(bw.pair_gram(jnp.asarray(rm)))
         g3 = np.asarray(bw.pair_gram(jnp.asarray(rm.reshape(S, R, W // 128, 128))))
+        # Force word-axis subdivision too (tall-row-set regime): a tiny
+        # step budget splits each slice into power-of-two chunks.
+        bw.GRAM_STEP_BYTES = R * (W // 4) * 32
+        g4 = np.asarray(bw.pair_gram(jnp.asarray(rm)))
+        g5 = np.asarray(bw.pair_gram(jnp.asarray(rm.reshape(S, R, W // 128, 128))))
     finally:
         bw.GRAM_ONESHOT_BYTES = orig
+        bw.GRAM_STEP_BYTES = orig_step
     want = np.zeros((R, R), dtype=np.int64)
     for i in range(R):
         for j in range(R):
@@ -335,6 +342,8 @@ def test_pair_gram_chunked_matches_oneshot(rng):
     assert np.array_equal(g1, want)
     assert np.array_equal(g2, want)
     assert np.array_equal(g3, want)
+    assert np.array_equal(g4, want)
+    assert np.array_equal(g5, want)
 
 
 def test_gather_count_rowmajor_wrapper_parity(rng):
