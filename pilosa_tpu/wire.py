@@ -411,6 +411,49 @@ def decode_import_request(data: bytes) -> dict:
 # Private messages (private.proto) — block sync, schema/broadcast, status
 # ---------------------------------------------------------------------------
 
+def encode_bit(row_id: int, column_id: int, timestamp: int = 0) -> bytes:
+    """internal.Bit (public.proto:17-21)."""
+    return Writer().varint(1, row_id).varint(2, column_id).varint(3, timestamp).finish()
+
+
+def decode_bit(data: bytes) -> dict:
+    out = {"rowID": 0, "columnID": 0, "timestamp": 0}
+    for field, wire, v in iter_fields(data):
+        if field == 1:
+            out["rowID"] = v
+        elif field == 2:
+            out["columnID"] = v
+        elif field == 3:
+            out["timestamp"] = _signed64(v)
+    return out
+
+
+def encode_attr_map(attrs: dict) -> bytes:
+    """internal.AttrMap (public.proto:34-36; the reference's attr-store
+    value encoding, attr.go:303-363)."""
+    w = Writer()
+    for a in encode_attrs(attrs):
+        w.message(1, a)
+    return w.finish()
+
+
+def decode_attr_map(data: bytes) -> dict:
+    raws = [v for field, wire, v in iter_fields(data) if field == 1]
+    return decode_attrs(raws)
+
+
+def encode_import_response(err: str = "") -> bytes:
+    """internal.ImportResponse (private.proto:17-19)."""
+    return Writer().string(1, err).finish()
+
+
+def decode_import_response(data: bytes) -> str:
+    for field, wire, v in iter_fields(data):
+        if field == 1:
+            return v.decode()
+    return ""
+
+
 def encode_index_meta(column_label: str, time_quantum: str) -> bytes:
     return Writer().string(1, column_label).string(2, time_quantum).finish()
 
@@ -541,9 +584,10 @@ def decode_cache(data: bytes) -> list[int]:
 
 def encode_max_slices_response(max_slices: dict[str, int]) -> bytes:
     w = Writer()
-    # proto3 map entries: insertion order, value field emitted even when 0.
-    for k, v in max_slices.items():
-        entry = Writer().string(1, k).varint(2, v, force=True).finish()
+    # proto3 map entries: sorted by key (both gogo and google.protobuf
+    # deterministic order), value field emitted even when 0.
+    for k in sorted(max_slices):
+        entry = Writer().string(1, k).varint(2, max_slices[k], force=True).finish()
         w.message(1, entry)
     return w.finish()
 
@@ -574,25 +618,30 @@ def encode_node_status(host: str, state: str, indexes: list[dict]) -> bytes:
     w = Writer().string(1, host).string(2, state)
     for idx in indexes:
         iw = Writer().string(1, idx.get("name", ""))
-        meta = idx.get("meta") or {}
-        iw.message(2, encode_index_meta(meta.get("columnLabel", ""), meta.get("timeQuantum", "")))
+        meta = idx.get("meta")
+        if meta is not None:  # unset submessage is omitted (proto3 presence)
+            iw.message(
+                2, encode_index_meta(meta.get("columnLabel", ""), meta.get("timeQuantum", ""))
+            )
         iw.varint(3, idx.get("maxSlice", 0))
         for fr in idx.get("frames", []):
-            fmeta = fr.get("meta") or {}
+            fmeta = fr.get("meta")
             fw = Writer().string(1, fr.get("name", ""))
-            fw.message(
-                2,
-                encode_frame_meta(
-                    fmeta.get("rowLabel", ""),
-                    fmeta.get("inverseEnabled", False),
-                    fmeta.get("cacheType", ""),
-                    fmeta.get("cacheSize", 0),
-                    fmeta.get("timeQuantum", ""),
-                ),
-            )
+            if fmeta is not None:
+                fw.message(
+                    2,
+                    encode_frame_meta(
+                        fmeta.get("rowLabel", ""),
+                        fmeta.get("inverseEnabled", False),
+                        fmeta.get("cacheType", ""),
+                        fmeta.get("cacheSize", 0),
+                        fmeta.get("timeQuantum", ""),
+                    ),
+                )
             iw.message(4, fw.finish())
-        for s in idx.get("slices", []):
-            iw.varint(5, s, force=True)  # repeated: zero-valued entries must survive
+        # repeated scalar -> packed in proto3 (zero entries survive the
+        # length-prefixed encoding; matches the reference encoder's bytes).
+        iw.packed(5, idx.get("slices", []))
         w.message(3, iw.finish())
     return w.finish()
 
@@ -605,23 +654,46 @@ def decode_node_status(data: bytes) -> dict:
         elif field == 2:
             out["state"] = v.decode()
         elif field == 3:
-            idx: dict = {"name": "", "meta": {}, "maxSlice": 0, "frames": [], "slices": []}
-            for f2, w2, v2 in iter_fields(v):
-                if f2 == 1:
-                    idx["name"] = v2.decode()
-                elif f2 == 2:
-                    idx["meta"] = decode_index_meta(v2)
-                elif f2 == 3:
-                    idx["maxSlice"] = v2
-                elif f2 == 4:
-                    fr: dict = {"name": "", "meta": {}}
-                    for f3, w3, v3 in iter_fields(v2):
-                        if f3 == 1:
-                            fr["name"] = v3.decode()
-                        elif f3 == 2:
-                            fr["meta"] = decode_frame_meta(v3)
-                    idx["frames"].append(fr)
-                elif f2 == 5:
-                    idx["slices"].append(v2)
-            out["indexes"].append(idx)
+            out["indexes"].append(_decode_index_msg(v))
     return out
+
+
+def _decode_index_msg(v: bytes) -> dict:
+    """internal.Index (private.proto Frame/Index); ``meta`` keys appear
+    only when the submessage was present on the wire (re-encode parity)."""
+    idx: dict = {"name": "", "maxSlice": 0, "frames": [], "slices": []}
+    for f2, w2, v2 in iter_fields(v):
+        if f2 == 1:
+            idx["name"] = v2.decode()
+        elif f2 == 2:
+            idx["meta"] = decode_index_meta(v2)
+        elif f2 == 3:
+            idx["maxSlice"] = v2
+        elif f2 == 4:
+            fr: dict = {"name": ""}
+            for f3, w3, v3 in iter_fields(v2):
+                if f3 == 1:
+                    fr["name"] = v3.decode()
+                elif f3 == 2:
+                    fr["meta"] = decode_frame_meta(v3)
+            idx["frames"].append(fr)
+        elif f2 == 5:
+            # packed (reference encoding) or unpacked (also legal proto3)
+            idx["slices"].extend(decode_packed_uint64(v2))
+    return idx
+
+
+def encode_cluster_status(nodes: list[dict]) -> bytes:
+    """internal.ClusterStatus (private.proto:88-90): the gossip
+    LocalState/MergeRemoteState payload.  ``nodes`` items use the
+    encode_node_status dict shape."""
+    w = Writer()
+    for n in nodes:
+        w.message(
+            1, encode_node_status(n.get("host", ""), n.get("state", ""), n.get("indexes", []))
+        )
+    return w.finish()
+
+
+def decode_cluster_status(data: bytes) -> list[dict]:
+    return [decode_node_status(v) for field, wire, v in iter_fields(data) if field == 1]

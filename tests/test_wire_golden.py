@@ -1,0 +1,300 @@
+"""Cross-implementation wire tests: decode official-protobuf golden bytes
+exactly, and re-encode byte-for-byte (tests/wire_golden.py provenance).
+
+This is the reference's asm-vs-Go idiom applied to the codec: the
+hand-rolled proto3 writer/reader vs the official library's output for
+every message in internal/public.proto + internal/private.proto,
+including the silent-divergence corners (packed repeated with zero
+entries, zero-value omission, negative int64, empty messages, map
+entries, unset submessages).
+"""
+
+import pytest
+
+from pilosa_tpu import broadcast, wire
+from pilosa_tpu.core.cache import Pair
+
+from wire_golden import GOLDEN
+
+
+# ---- Attr / AttrMap -------------------------------------------------------
+
+@pytest.mark.parametrize(
+    "name,key,value",
+    [
+        ("attr_string", "name", "alice"),
+        ("attr_int_neg", "x", -3),
+        ("attr_bool_false_zero_omitted", "flag", False),
+        ("attr_float", "f", 1.5),
+    ],
+)
+def test_attr_golden(name, key, value):
+    raw = GOLDEN[name]
+    assert wire.decode_attr(raw) == (key, value)
+    assert wire.encode_attr(key, value) == raw
+
+
+def test_attr_map_golden():
+    raw = GOLDEN["attrmap"]
+    assert wire.decode_attr_map(raw) == {"a": 7, "b": "z"}
+    assert wire.encode_attr_map({"a": 7, "b": "z"}) == raw
+
+
+# ---- Pair / Bit / ColumnAttrSet ------------------------------------------
+
+@pytest.mark.parametrize(
+    "name,key,count",
+    [("pair", 10, 42), ("pair_zero_key", 0, 5), ("pair_zero_count", 9, 0)],
+)
+def test_pair_golden(name, key, count):
+    raw = GOLDEN[name]
+    assert wire.decode_pair(raw) == (key, count)
+    assert wire.encode_pair(key, count) == raw
+
+
+def test_bit_golden():
+    raw = GOLDEN["bit"]
+    assert wire.decode_bit(raw) == {"rowID": 3, "columnID": 1 << 40, "timestamp": -1}
+    assert wire.encode_bit(3, 1 << 40, -1) == raw
+
+
+def test_column_attr_set_golden():
+    raw = GOLDEN["column_attr_set"]
+    assert wire.decode_column_attr_set(raw) == (77, {"n": 1})
+    assert wire.encode_column_attr_set(77, {"n": 1}) == raw
+
+
+# ---- Bitmap ---------------------------------------------------------------
+
+def test_bitmap_golden():
+    raw = GOLDEN["bitmap_packed"]
+    bits, attrs = wire.decode_bitmap(raw)
+    assert bits == [0, 1, 300, 1 << 63] and attrs == {}
+    assert wire.encode_bitmap([0, 1, 300, 1 << 63]) == raw
+    assert GOLDEN["bitmap_empty"] == b""
+    assert wire.encode_bitmap([]) == b""
+    assert wire.decode_bitmap(b"") == ([], {})
+
+
+# ---- QueryRequest / QueryResult / QueryResponse ---------------------------
+
+def test_query_request_golden():
+    raw = GOLDEN["query_request"]
+    assert wire.decode_query_request(raw) == {
+        "query": "Count(Bitmap(rowID=1))",
+        "slices": [0, 1, 5],
+        "column_attrs": True,
+        "quantum": "YMD",
+        "remote": True,
+    }
+    assert (
+        wire.encode_query_request(
+            "Count(Bitmap(rowID=1))", [0, 1, 5], column_attrs=True, quantum="YMD", remote=True
+        )
+        == raw
+    )
+    minimal = GOLDEN["query_request_minimal"]
+    q = 'SetBit(id=1, frame="f", col=2)'
+    assert wire.decode_query_request(minimal)["query"] == q
+    assert wire.encode_query_request(q) == minimal
+
+
+from pilosa_tpu.executor import QueryBitmap
+
+
+class _RawBitmap(QueryBitmap):
+    """QueryBitmap stand-in with explicit global bit positions."""
+
+    def __init__(self, bits, attrs=None):
+        super().__init__({}, attrs or {})
+        self._bits = bits
+
+    def bits(self):
+        return self._bits
+
+
+def test_query_result_golden():
+    assert wire.decode_query_result(GOLDEN["query_result_bitmap"]) == {
+        "bitmap": {"bits": [2, 9], "attrs": {}}
+    }
+    assert wire.decode_query_result(GOLDEN["query_result_n"]) == {"n": 123}
+    assert wire.decode_query_result(GOLDEN["query_result_pairs"]) == {
+        "pairs": [{"id": 1, "count": 2}, {"id": 0, "count": 1}]
+    }
+    assert wire.decode_query_result(GOLDEN["query_result_changed"]) == {"changed": True}
+    # byte-identical re-encode through the executor-result encoder
+    import pilosa_tpu.wire as w
+
+    assert w.encode_query_result(_RawBitmap([2, 9])) == GOLDEN["query_result_bitmap"]
+    assert w.encode_query_result(123) == GOLDEN["query_result_n"]
+    assert (
+        w.encode_query_result([Pair(1, 2), Pair(0, 1)]) == GOLDEN["query_result_pairs"]
+    )
+    assert w.encode_query_result(True) == GOLDEN["query_result_changed"]
+
+
+def test_query_response_golden():
+    raw = GOLDEN["query_response"]
+    got = wire.decode_query_response(raw)
+    assert got["err"] == ""
+    assert len(got["results"]) == 4
+    assert got["columnAttrSets"] == [{"id": 5, "attrs": {"k": "v"}}]
+    assert (
+        wire.encode_query_response(
+            results=[_RawBitmap([2, 9]), 123, [Pair(1, 2), Pair(0, 1)], True],
+            column_attr_sets=[(5, {"k": "v"})],
+        )
+        == raw
+    )
+    err_raw = GOLDEN["query_response_err"]
+    assert wire.decode_query_response(err_raw)["err"] == "index not found"
+    assert wire.encode_query_response(err="index not found") == err_raw
+
+
+# ---- ImportRequest / ImportResponse ---------------------------------------
+
+def test_import_request_golden():
+    raw = GOLDEN["import_request"]
+    assert wire.decode_import_request(raw) == {
+        "index": "i",
+        "frame": "f",
+        "slice": 2,
+        "rowIDs": [1, 0, 2],
+        "columnIDs": [3, 4, 0],
+        "timestamps": [0, -5, 1500000000],
+    }
+    assert (
+        wire.encode_import_request("i", "f", 2, [1, 0, 2], [3, 4, 0], [0, -5, 1500000000])
+        == raw
+    )
+
+
+def test_import_response_golden():
+    assert wire.decode_import_response(GOLDEN["import_response"]) == "nope"
+    assert wire.encode_import_response("nope") == GOLDEN["import_response"]
+    assert GOLDEN["import_response_empty"] == b""
+    assert wire.encode_import_response() == b""
+    assert wire.decode_import_response(b"") == ""
+
+
+# ---- Metas ----------------------------------------------------------------
+
+def test_meta_golden():
+    raw = GOLDEN["index_meta"]
+    assert wire.decode_index_meta(raw) == {"columnLabel": "columnID", "timeQuantum": "YMDH"}
+    assert wire.encode_index_meta("columnID", "YMDH") == raw
+    raw = GOLDEN["frame_meta"]
+    assert wire.decode_frame_meta(raw) == {
+        "rowLabel": "rowID",
+        "inverseEnabled": True,
+        "cacheType": "ranked",
+        "cacheSize": 50000,
+        "timeQuantum": "YMD",
+    }
+    assert wire.encode_frame_meta("rowID", True, "ranked", 50000, "YMD") == raw
+    assert GOLDEN["frame_meta_defaults"] == b""
+    assert wire.encode_frame_meta("", False, "", 0, "") == b""
+
+
+# ---- Block data / Cache / MaxSlices ---------------------------------------
+
+def test_block_data_golden():
+    raw = GOLDEN["block_data_request"]
+    assert wire.decode_block_data_request(raw) == {
+        "index": "i", "frame": "f", "view": "standard", "slice": 3, "block": 7,
+    }
+    assert wire.encode_block_data_request("i", "f", "standard", 3, 7) == raw
+    raw = GOLDEN["block_data_response"]
+    assert wire.decode_block_data_response(raw) == ([0, 1, 1], [5, 0, 9])
+    assert wire.encode_block_data_response([0, 1, 1], [5, 0, 9]) == raw
+
+
+def test_cache_golden():
+    assert wire.decode_cache(GOLDEN["cache"]) == [3, 0, 11]
+    assert wire.encode_cache([3, 0, 11]) == GOLDEN["cache"]
+    assert GOLDEN["cache_empty"] == b""
+    assert wire.encode_cache([]) == b""
+
+
+def test_max_slices_golden():
+    raw = GOLDEN["max_slices"]
+    assert wire.decode_max_slices_response(raw) == {"idx": 4, "a": 0}
+    # zero map values are EMITTED (map entries always carry both fields);
+    # deterministic order = sorted by key.
+    assert wire.encode_max_slices_response({"idx": 4, "a": 0}) == raw
+
+
+# ---- Broadcast envelope messages ------------------------------------------
+
+def test_broadcast_messages_golden():
+    for name, enc, typ, want in [
+        ("create_slice", broadcast.encode_create_slice("i", 9, True),
+         broadcast.MESSAGE_TYPE_CREATE_SLICE, {"index": "i", "slice": 9, "isInverse": True}),
+        ("create_slice_zero", broadcast.encode_create_slice("i", 0),
+         broadcast.MESSAGE_TYPE_CREATE_SLICE, {"index": "i"}),
+        ("delete_index", broadcast.encode_delete_index("i"),
+         broadcast.MESSAGE_TYPE_DELETE_INDEX, {"index": "i"}),
+        ("create_index", broadcast.encode_create_index("i", "c", "Y"),
+         broadcast.MESSAGE_TYPE_CREATE_INDEX,
+         {"index": "i", "meta": {"columnLabel": "c", "timeQuantum": "Y"}}),
+        ("create_frame",
+         broadcast.encode_create_frame("i", "f", {"rowLabel": "r", "cacheType": "lru", "cacheSize": 100}),
+         broadcast.MESSAGE_TYPE_CREATE_FRAME,
+         {"index": "i", "frame": "f",
+          "meta": {"rowLabel": "r", "inverseEnabled": False, "cacheType": "lru",
+                   "cacheSize": 100, "timeQuantum": ""}}),
+        ("delete_frame", broadcast.encode_delete_frame("i", "f"),
+         broadcast.MESSAGE_TYPE_DELETE_FRAME, {"index": "i", "frame": "f"}),
+    ]:
+        assert enc[1:] == GOLDEN[name], name  # payload = official bytes
+        got_typ, got = broadcast.decode_message(enc)
+        assert got_typ == typ, name
+        assert got == want, name
+
+
+# ---- Index / NodeStatus / ClusterStatus -----------------------------------
+
+_IDX1 = {
+    "name": "i1",
+    "meta": {"columnLabel": "col", "timeQuantum": ""},
+    "maxSlice": 3,
+    "frames": [
+        {"name": "f1",
+         "meta": {"rowLabel": "r", "inverseEnabled": False, "cacheType": "ranked",
+                  "cacheSize": 1000, "timeQuantum": ""}}
+    ],
+    "slices": [0, 1, 3],
+}
+
+
+def test_index_golden():
+    assert wire._decode_index_msg(GOLDEN["index_msg"]) == _IDX1
+
+
+def test_node_status_golden():
+    raw = GOLDEN["node_status"]
+    got = wire.decode_node_status(raw)
+    assert got == {
+        "host": "h1:10101",
+        "state": "UP",
+        "indexes": [_IDX1, {"name": "i2", "maxSlice": 0, "frames": [], "slices": []}],
+    }
+    # re-encode byte-for-byte (packed Slices, unset metas omitted)
+    assert (
+        wire.encode_node_status(
+            "h1:10101", "UP", [_IDX1, {"name": "i2"}]
+        )
+        == raw
+    )
+
+
+def test_cluster_status_golden():
+    raw = GOLDEN["cluster_status"]
+    nodes = wire.decode_cluster_status(raw)
+    assert [(n["host"], n["state"]) for n in nodes] == [("a", "UP"), ("b", "DOWN")]
+    assert (
+        wire.encode_cluster_status(
+            [{"host": "a", "state": "UP"}, {"host": "b", "state": "DOWN"}]
+        )
+        == raw
+    )
