@@ -52,6 +52,14 @@ DEFAULT_FRAME = "general"
 
 _WORDS = SLICE_WIDTH // 32
 
+# Device kernels accumulate counts in int32 (TPU jax runs x32; int64 in
+# Pallas/VPU would be emulated): one dispatch may cover at most this many
+# slices, since a full-density count is n_slices * 2^20 per query and
+# 2047 * 2^20 < 2^31.  Wider spans chunk the slice axis and sum the
+# per-chunk partials in int64 HOST-side (same bound as the Gram's
+# _GRAM_SLICES_MAX; BASELINE.md round-3 addendum 3 measured the overflow).
+_INT32_SAFE_SLICES = 2047
+
 
 @dataclass
 class ExecOptions:
@@ -392,9 +400,13 @@ class Executor:
             return None
         from pilosa_tpu.rowpool import pool_capacity
 
-        if pool_capacity(len(std_slices), _WORDS) < 64:
-            # Slice-streaming regime (working set >> HBM pool budget): the
-            # AST fused path owns the slice-chunked accumulation loop; the
+        if (
+            pool_capacity(len(std_slices), _WORDS) < 64
+            or len(std_slices) > _INT32_SAFE_SLICES
+        ):
+            # Slice-streaming regime (working set >> HBM pool budget) or a
+            # slice span past the kernels' int32 count bound: the AST
+            # fused path owns the slice-chunked accumulation loop; the
             # flat lane's whole point (skipping per-call Python) is noise
             # against per-chunk upload costs anyway.
             return None
@@ -672,6 +684,11 @@ class Executor:
         else falls back to the sequential path with identical errors.
         """
         if not slices or len(calls) < 2:
+            return None
+        if len(slices) > _INT32_SAFE_SLICES:
+            # One fused dispatch spans every slice; past the int32 count
+            # bound the sequential per-call path (host-summed python ints)
+            # keeps Range counts exact.
             return None
         matched: dict[int, tuple[str, int, list[str]]] = {}
         for i, c in enumerate(calls):
@@ -997,8 +1014,13 @@ class Executor:
                     kb = 2 if k == 2 else (1 << (k - 1).bit_length()) if static else k
                     groups.setdefault((matched[i][2], kb), []).append(i)
 
-                if len(want) <= pool.cap_max:
+                if len(want) <= pool.cap_max and len(slices) <= _INT32_SAFE_SLICES:
                     # Resident regime: rows live (or page) in the pool.
+                    # (Past _INT32_SAFE_SLICES the single-dispatch count
+                    # could overflow the kernels' int32 accumulators at
+                    # full density — those shapes stream the slice axis
+                    # below, which chunks to the safe bound and sums in
+                    # int64 host-side.)
                     # Tall working sets relative to the request batch hit
                     # the GATHER kernels, which on v5e are DMA-descriptor
                     # -bound: those parts page through a ROW-MAJOR pool
@@ -1066,9 +1088,7 @@ class Executor:
                     # loop (gather_count_dev) so chunk k+1's upload
                     # pipelines behind chunk k's kernel.
                     id_pos = {r: k for k, r in enumerate(want)}
-                    s_chunk = max(
-                        1, self._stream_bytes() // max(1, len(want) * _WORDS * 4)
-                    )
+                    s_chunk = self._slice_chunk(len(want))
                     # Tall row sets hit the GATHER kernels, whose v5e
                     # throughput is DMA-descriptor-bound: a row-major
                     # transient gives one contiguous descriptor per
@@ -1144,6 +1164,19 @@ class Executor:
     def _stream_bytes(self) -> int:
         """Per-chunk byte budget for slice-streaming transient matrices."""
         return int(os.environ.get("PILOSA_TPU_STREAM_BYTES", str(1 << 31)))
+
+    def _slice_chunk(self, n_rows: int) -> int:
+        """Slices per streaming chunk: the byte budget AND the int32
+        count bound — a full-density chunk counts up to s_chunk * 2^20
+        per query inside the kernels' int32 accumulators, so no chunk may
+        span more than _INT32_SAFE_SLICES regardless of budget."""
+        return max(
+            1,
+            min(
+                self._stream_bytes() // max(1, n_rows * _WORDS * 4),
+                _INT32_SAFE_SLICES,
+            ),
+        )
 
     def _densify_block(
         self, index, frame, view, chunk_slices, rows, row_major=False
