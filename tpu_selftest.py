@@ -106,6 +106,22 @@ def main() -> int:
             dispatch.gather_count(op, rm4, dp, allow_gram=False),
             np.asarray(dispatch.gather_count(op, jnp.asarray(rm), dp, allow_gram=False)))
 
+    # Generated differential fuzz: the SAME lane-by-lane random cases the
+    # CI suite runs in interpret mode (tests/test_differential_kernels.py),
+    # here against the real Mosaic lowering.  Case count via
+    # PILOSA_TPU_SELFTEST_CASES (shape buckets bound recompiles).
+    import os
+
+    from pilosa_tpu.ops import diffcheck
+
+    n_cases = int(os.environ.get("PILOSA_TPU_SELFTEST_CASES", "8"))
+    failures = diffcheck.run_lanes(seed=2026, cases_per_lane=n_cases, interpret=False)
+    for f in failures:
+        ok = False
+        print(f"FAIL fuzz {f}", file=sys.stderr)
+    if not failures:
+        print(f"OK   fuzz: {n_cases} generated cases/lane, all lanes match numpy")
+
     print("ALL OK" if ok else "FAILURES", file=sys.stderr)
     return 0 if ok else 1
 
