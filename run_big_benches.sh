@@ -40,6 +40,8 @@ run BENCH_CONFIG=intersect_count PILOSA_TPU_NO_GRAM=1 BENCH_ITERS=512 BENCH_TIME
 #    north-star scale.
 run BENCH_CONFIG=intersect_count_stream BENCH_TIMED_RUNS=2
 run BENCH_CONFIG=intersect_count_stream BENCH_SLICES=10240 BENCH_TIMED_RUNS=2
-# 6) Product-path gather regime (row-major pool lane vs slice-major).
+# 6) Product-path gather-regime shapes (chunked-Gram product lane, with
+#    forced-NO_GRAM row-major/slice-major tiers recorded in the unit).
+run BENCH_CONFIG=executor_gather BENCH_ROWS=1024
 run BENCH_CONFIG=executor_gather
 echo "ALL DONE $(date +%H:%M:%S)" >> $OUT
