@@ -36,6 +36,10 @@ def _run(env_extra, script="bench.py", timeout=240):
     "cfg,extra",
     [
         ("intersect_count", {"BENCH_ITERS": "2", "BENCH_SLICES": "2", "BENCH_ROWS": "4", "BENCH_BATCH": "4"}),
+        # Tier scoreboard forced on (shape env normally disables it so
+        # big-shape runs can't leak into the 4k-row tier shapes).
+        ("intersect_count", {"BENCH_ITERS": "2", "BENCH_SLICES": "2", "BENCH_ROWS": "4",
+                             "BENCH_BATCH": "4", "BENCH_TIERS": "1"}),
         ("setbit", {"BENCH_OPS": "300"}),
         ("topn", {"BENCH_ITERS": "2", "BENCH_TOPN_ROWS": "8"}),
         ("union64", {"BENCH_ITERS": "3", "BENCH_SLICES": "2"}),
@@ -58,6 +62,10 @@ def test_bench_config_emits_json(cfg, extra):
     result = json.loads(line)
     assert {"metric", "value", "unit", "vs_baseline"} <= set(result)
     assert result["value"] > 0
+    if extra.get("BENCH_TIERS") == "1":
+        names = [t["tier"] for t in result["tiers"]]
+        assert len(names) >= 4 and len(set(names)) == len(names)
+        assert all("qps" in t and "bandwidth_util" in t for t in result["tiers"])
 
 
 def test_star_trace_example_runs():
