@@ -16,7 +16,9 @@ from pilosa_tpu.parallel.multihost import (  # noqa: F401
     init_multihost,
 )
 from pilosa_tpu.parallel.sharded import (  # noqa: F401
+    ReplicaMesh,
     SliceMesh,
+    replica_gather_count,
     sharded_count_and,
     sharded_count_call,
     sharded_union_reduce,

@@ -360,3 +360,131 @@ def sharded_topn_counts(mesh: SliceMesh, rows, src):
         return lax.psum(local, mesh.AXIS)
 
     return jax.jit(kernel)(rows, src)
+
+
+# ---------------------------------------------------------------------------
+# Replica groups: 2-D (slice x replica) mesh
+# ---------------------------------------------------------------------------
+
+class ReplicaMesh(SliceMesh):
+    """A 2-D device mesh (slice x replica): the ReplicaN analog.
+
+    The reference assigns each partition to ``ReplicaN`` consecutive
+    ring nodes (cluster.go:220-240) so every slice has replica_n owners.
+    The TPU-native form: devices arranged as a 2-D mesh whose ``slice``
+    axis shards the bitmap stacks and whose ``replica`` axis holds full
+    copies — placement is the sharding annotation, no routing table.
+
+    What the replicas buy, TPU-first:
+    - fault tolerance: either replica group holds the full index; a
+      failed host's job restarts against the surviving group (the
+      in-pod analog of query-time replica failover,
+      executor.go:1147-1159);
+    - READ parallelism: a query batch splits across the replica axis —
+      each replica group answers its sub-batch against its full copy,
+      psum runs over ``slice`` WITHIN each group (XLA emits the
+      all-reduce with replica-group participant lists), and the batch
+      reassembles over the ``replica`` axis.  replica_n groups serve
+      replica_n x the read throughput, the same reason the reference
+      fans reads over any owner node.
+
+    Multi-pod: pass ``hybrid=True`` to lay the replica axis across DCN
+    (``mesh_utils.create_hybrid_device_mesh``) so the slice-axis psum
+    rides ICI inside each pod and only rare cross-replica traffic
+    crosses DCN.  Single-pod/virtual meshes use a plain 2-D reshape.
+    """
+
+    REPLICA_AXIS = "replica"
+
+    def __init__(self, n_replicas: int = 2, devices: Sequence | None = None,
+                 hybrid: bool = False):
+        import jax
+        from jax.sharding import Mesh
+
+        self.jax = jax
+        devices = list(devices if devices is not None else jax.devices())
+        if len(devices) % n_replicas:
+            raise ValueError(
+                f"{len(devices)} devices not divisible into {n_replicas} replica groups"
+            )
+        n_slice = len(devices) // n_replicas
+        if hybrid:
+            from jax.experimental import mesh_utils
+
+            # DCN granules (pods) are the OUTER blocks of the returned
+            # array: flat = [pod0 devices..., pod1 devices...].  Each pod
+            # is one replica group, so pods index the REPLICA axis —
+            # reshape (n_replicas, n_slice) then transpose, keeping the
+            # slice-axis psum on ICI within a pod and only cross-replica
+            # traffic on DCN.
+            dev_array = np.asarray(
+                mesh_utils.create_hybrid_device_mesh(
+                    (n_slice,), (n_replicas,), devices=devices,
+                )
+            ).reshape(n_replicas, n_slice).T
+        else:
+            # Same orientation: consecutive (ICI-adjacent) devices run
+            # along the slice axis within one replica group.
+            dev_array = np.array(devices).reshape(n_replicas, n_slice).T
+        self.mesh = Mesh(dev_array, (self.AXIS, self.REPLICA_AXIS))
+        # SliceMesh API compat: helpers divide the slice axis by this.
+        self.n_devices = n_slice
+        self.n_replicas = n_replicas
+
+
+def replica_gather_count(mesh: ReplicaMesh, op: str, row_matrix, pairs,
+                         interpret: bool = False):
+    """Batched pair counts on a (slice x replica) mesh with the batch
+    SPLIT over the replica axis: each replica group runs the Pallas
+    kernel on its sub-batch against its full slice-sharded copy, psum
+    reduces over ``slice`` within the group (replica-group all-reduce),
+    and the result reassembles along ``replica``.
+
+    pairs: int32[B, 2] with B divisible by n_replicas.  Returns int32[B].
+    """
+    from pilosa_tpu.ops.pallas_kernels import resident_strategy, rm_words
+
+    n_slices, n_rows = row_matrix.shape[:2]
+    _require_divisible(n_slices, mesh.n_devices)
+    b = pairs.shape[0]
+    if b % mesh.n_replicas:
+        raise ValueError(f"batch {b} not divisible by {mesh.n_replicas} replicas")
+    kernel = _replica_pair_kernel(
+        mesh.mesh, mesh.AXIS, mesh.REPLICA_AXIS, op,
+        resident_strategy(n_rows, rm_words(row_matrix), b // mesh.n_replicas),
+        interpret, row_matrix.ndim,
+    )
+    return kernel(row_matrix, pairs)
+
+
+@functools.lru_cache(maxsize=None)
+def _replica_pair_kernel(mesh_obj, slice_axis: str, replica_axis: str, op: str,
+                         resident: bool, interpret: bool, rm_ndim: int):
+    import jax
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from pilosa_tpu.ops.pallas_kernels import (
+        fused_gather_count2,
+        fused_resident_count2,
+    )
+
+    @functools.partial(
+        jax.shard_map,
+        mesh=mesh_obj,
+        # Matrix: sharded over slice, REPLICATED over replica (each
+        # group holds a full copy).  Pairs: split over replica.
+        in_specs=(P(slice_axis, *([None] * (rm_ndim - 1))), P(replica_axis, None)),
+        out_specs=P(replica_axis),
+        check_vma=False,
+    )
+    def kernel(rm_shard, prs_shard):
+        if resident:
+            local = fused_resident_count2(op, rm_shard, prs_shard, interpret=interpret)
+        else:
+            local = fused_gather_count2(op, rm_shard, prs_shard, interpret=interpret)
+        # Replica-group reduce: psum over the slice axis only — XLA emits
+        # the all-reduce with one participant group per replica.
+        return lax.psum(local, slice_axis)
+
+    return jax.jit(kernel)

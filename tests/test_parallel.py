@@ -183,3 +183,37 @@ def test_mesh_engine_picks_interpret_pallas(monkeypatch):
         int(bw.np_popcount(rows[:, int(a)] & rows[:, int(b)]).sum()) for a, b in pairs
     ]
     assert got.tolist() == want
+
+
+def test_replica_mesh_gather_count(rng):
+    """(4, 2) slice x replica mesh: the batch splits over the replica
+    axis, each replica group answers its half against its full
+    slice-sharded copy with a replica-group psum, and the reassembled
+    counts equal numpy (VERDICT r3 item 9; cluster.go:220-240 analog)."""
+    import jax
+
+    from pilosa_tpu.ops import bitwise as bw
+    from pilosa_tpu.parallel import ReplicaMesh, replica_gather_count
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    mesh = ReplicaMesh(n_replicas=2, devices=jax.devices()[:8])
+    assert mesh.n_devices == 4 and mesh.n_replicas == 2
+
+    S, R, W, B = 8, 16, 1024, 12
+    rm = rng.integers(0, 1 << 32, size=(S, R, W), dtype=np.uint32)
+    pairs = rng.integers(0, R, size=(B, 2), dtype=np.int32)
+    drm = mesh.shard_stack(rm)  # sharded over slice, replicated over replica
+    for op in ("and", "or", "xor", "andnot"):
+        got = np.asarray(
+            replica_gather_count(mesh, op, drm, jax.numpy.asarray(pairs), interpret=True)
+        )
+        want = []
+        for p0, p1 in pairs:
+            a, b2 = rm[:, int(p0)], rm[:, int(p1)]
+            v = {"and": a & b2, "or": a | b2, "xor": a ^ b2, "andnot": a & ~b2}[op]
+            want.append(int(bw.np_popcount(v).sum()))
+        assert got.tolist() == want, op
+    # Batch not divisible by replica_n is a loud error, not silent truncation.
+    with pytest.raises(ValueError):
+        replica_gather_count(mesh, "and", drm, jax.numpy.asarray(pairs[:11]), interpret=True)
