@@ -116,7 +116,7 @@ class Handler:
             ("GET", re.compile(r"^/index/(?P<index>[^/]+)/frame/(?P<frame>[^/]+)/views$"), self.get_frame_views),
             ("PATCH", re.compile(r"^/index/(?P<index>[^/]+)/time-quantum$"), self.patch_index_time_quantum),
             ("GET", re.compile(r"^/debug/vars$"), self.get_expvar),
-            ("GET", re.compile(r"^/debug/pprof(?:/.*)?$"), self.get_pprof),
+            ("GET", re.compile(r"^/debug/pprof(?:/(?P<path>.*))?$"), self.get_pprof),
             ("POST", re.compile(r"^/debug/profile/start$"), self.post_profile_start),
             ("POST", re.compile(r"^/debug/profile/stop$"), self.post_profile_stop),
             ("GET", re.compile(r"^/export$"), self.get_export),
@@ -261,16 +261,30 @@ class Handler:
             stats = self.stats.snapshot()
         return self._json(stats)
 
-    def get_pprof(self, **kw):
-        # Python analog of /debug/pprof: live thread stack dump.
-        import sys
+    def get_pprof(self, path="", params=None, **kw):
+        """/debug/pprof with net/http/pprof semantics (handler.go:99):
+        the default payload is a gzipped pprof protobuf Profile that
+        ``go tool pprof`` consumes; ``?debug=1`` returns the text form.
 
-        out = io.StringIO()
-        frames = sys._current_frames()
-        for tid, frame in frames.items():
-            out.write(f"--- thread {tid} ---\n")
-            out.write("".join(traceback.format_stack(frame)))
-        return 200, "text/plain", out.getvalue().encode()
+        Routes: /debug/pprof/goroutine (thread profile — one sample per
+        live thread), /debug/pprof/profile?seconds=N (sampling CPU
+        profile), bare /debug/pprof (thread profile)."""
+        from pilosa_tpu import pprof as pprof_mod
+
+        params = params or {}
+        kind = (path or "").rsplit("/", 1)[-1]
+        if self._param(params, "debug"):
+            return 200, "text/plain", pprof_mod.text_threads().encode()
+        if kind == "profile":
+            try:
+                seconds = float(self._param(params, "seconds") or "5")
+            except ValueError:
+                raise HTTPError(400, "bad seconds")
+            seconds = min(seconds, 120.0)
+            body = pprof_mod.cpu_profile(seconds)
+        else:  # goroutine analog (and the index default)
+            body = pprof_mod.thread_profile()
+        return 200, "application/octet-stream", body
 
     def post_profile_start(self, params=None, **kw):
         """Start a JAX/XLA device trace (the TPU-native analog of the
