@@ -42,8 +42,11 @@ def main() -> int:
         idx.create_frame("f", FrameOptions(time_quantum="YM"))
         fr = idx.frame("f")
         # Identical seed data on every rank (replicated-holder model).
+        # Slice count scales with the job so the global mesh (2 local
+        # devices x nprocs ranks) keeps a divisible slice axis.
+        n_slices = max(4, 2 * nprocs)
         for r in range(4):
-            for s in range(4):
+            for s in range(n_slices):
                 fr.set_bit("standard", r, s * SLICE_WIDTH + 10 + r)
                 fr.set_bit("standard", r, s * SLICE_WIDTH + 500)
 

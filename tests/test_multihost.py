@@ -267,18 +267,20 @@ def test_lockstep_three_ranks():
     """Three-rank lockstep job: two workers ack and replay, reads shard
     over 6 virtual devices, writes replicate everywhere."""
     job = _LockstepJob(3)
+    # Workers seed max(4, 2*nprocs) = 6 slices x 2 bits/row (the slice
+    # axis stays divisible by the 6-device global mesh).
     try:
         job.wait_ready()
-        assert job.query('Count(Bitmap(rowID=0, frame="f"))')["results"] == [8]
+        assert job.query('Count(Bitmap(rowID=0, frame="f"))')["results"] == [12]
         assert job.query('SetBit(rowID=0, frame="f", columnID=321)')["results"] == [True]
-        assert job.query('Count(Bitmap(rowID=0, frame="f"))')["results"] == [9]
+        assert job.query('Count(Bitmap(rowID=0, frame="f"))')["results"] == [13]
         outs = job.shutdown_and_collect()
     finally:
         # finally (not except Exception): pytest.fail raises a
         # BaseException subclass, and ranks blocked on the coordinator
         # barrier must never outlive the test.
         job.cleanup()
-    assert {o["probe"] for o in outs} == {9}  # all three ranks converged
+    assert {o["probe"] for o in outs} == {13}  # all three ranks converged
 
 
 def test_lockstep_pipelined_concurrent_clients():
