@@ -96,9 +96,112 @@ async function runQuery() {
   }
 }
 
-$("run").addEventListener("click", runQuery);
+// Query history: Up/Down recall (persisted), like a shell prompt.
+const HISTORY_KEY = "pilosa-tpu-history";
+let history = [];
+try {
+  history = JSON.parse(localStorage.getItem(HISTORY_KEY) || "[]");
+} catch (e) {
+  history = [];
+}
+let histPos = history.length; // one past the end = "editing a new query"
+let histDraft = "";
+
+function pushHistory(q) {
+  if (!q || history[history.length - 1] === q) {
+    histPos = history.length;
+    return;
+  }
+  history.push(q);
+  if (history.length > 100) history = history.slice(-100);
+  histPos = history.length;
+  try {
+    localStorage.setItem(HISTORY_KEY, JSON.stringify(history));
+  } catch (e) {
+    /* private mode */
+  }
+}
+
+// Keyword autocomplete: Tab completes the word before the caret against
+// the PQL call names and common argument keys; repeated Tab cycles.
+const KEYWORDS = [
+  "Bitmap(", "Count(", "Intersect(", "Union(", "Difference(", "Xor(",
+  "Range(", "TopN(", "SetBit(", "ClearBit(", "SetRowAttrs(",
+  "SetColumnAttrs(",
+  "rowID=", "columnID=", "frame=", "n=", "field=", "filters=",
+  "timestamp=", "start=", "end=", "tanimotoThreshold=", "threshold=",
+  "inverse=",
+];
+let tabMatches = [];
+let tabIndex = 0;
+let tabStart = -1;
+
+function completeAt(el) {
+  const pos = el.selectionStart;
+  // Only cycle when the caret still sits right after the previous
+  // completion; any other caret position starts a fresh completion.
+  const cycling =
+    tabMatches.length &&
+    tabStart >= 0 &&
+    pos === tabStart + tabMatches[tabIndex].length;
+  if (cycling) {
+    // cycle: replace the previous completion with the next candidate
+    tabIndex = (tabIndex + 1) % tabMatches.length;
+  } else {
+    tabMatches = [];
+    tabStart = -1;
+    const before = el.value.slice(0, pos);
+    const m = before.match(/[A-Za-z]+$/);
+    if (!m) return;
+    tabStart = pos - m[0].length;
+    const word = m[0].toLowerCase();
+    tabMatches = KEYWORDS.filter((k) => k.toLowerCase().startsWith(word));
+    tabIndex = 0;
+    if (!tabMatches.length) {
+      tabStart = -1;
+      return;
+    }
+  }
+  const cand = tabMatches[tabIndex];
+  el.value = el.value.slice(0, tabStart) + cand + el.value.slice(el.selectionStart);
+  const caret = tabStart + cand.length;
+  el.setSelectionRange(caret, caret);
+}
+
+$("run").addEventListener("click", () => {
+  pushHistory($("query").value.trim());
+  runQuery();
+});
 $("query").addEventListener("keydown", (ev) => {
-  if ((ev.ctrlKey || ev.metaKey) && ev.key === "Enter") runQuery();
+  const el = ev.target;
+  if ((ev.ctrlKey || ev.metaKey) && ev.key === "Enter") {
+    pushHistory(el.value.trim());
+    runQuery();
+    return;
+  }
+  if (ev.key === "Tab" && !ev.shiftKey) {
+    ev.preventDefault();
+    completeAt(el);
+    return;
+  }
+  tabMatches = [];
+  tabStart = -1;
+  // History only when the caret is on the first/last line (multiline
+  // editing keeps normal cursor movement).
+  if (ev.key === "ArrowUp" && !el.value.slice(0, el.selectionStart).includes("\n")) {
+    if (histPos > 0) {
+      if (histPos === history.length) histDraft = el.value;
+      histPos -= 1;
+      el.value = history[histPos];
+      ev.preventDefault();
+    }
+  } else if (ev.key === "ArrowDown" && !el.value.slice(el.selectionEnd).includes("\n")) {
+    if (histPos < history.length) {
+      histPos += 1;
+      el.value = histPos === history.length ? histDraft : history[histPos];
+      ev.preventDefault();
+    }
+  }
 });
 
 // -- cluster ----------------------------------------------------------------
