@@ -156,6 +156,7 @@ class Fragment:
         # hot path; drained by _flush_row_bookkeeping before cache reads.
         self._pending_rows: dict[int, int] = {}
         self._open = False
+        self._max_opn_scale: Optional[int] = None  # lazy env read
         self._lock_fd: Optional[int] = None
         self._storage_map = None  # live mmap backing zero-copy containers
         # Write generation: refreshed on every mutation from a
@@ -445,7 +446,7 @@ class Fragment:
                 p = self._pending_rows
                 for row_id, cnt in zip(rows_added.tolist(), per_row.tolist()):
                     p[row_id] = p.get(row_id, 0) + cnt
-                if len(added) >= self.max_opn:
+                if len(added) >= self._effective_max_opn():
                     self._snapshot()
                 else:
                     self.storage.log_add_ops(added)
@@ -505,8 +506,38 @@ class Fragment:
             self.cache.add(row_id, rc)
 
     def _increment_opn(self) -> None:
-        if self.storage.op_n >= self.max_opn:
+        if self.storage.op_n >= self._effective_max_opn():
             self.snapshot()
+
+    def _effective_max_opn(self) -> int:
+        """Snapshot trigger, scaled with fragment size for DEFAULT-tuned
+        fragments.
+
+        The reference's fixed MaxOpN=2000 (fragment.go:63-65) is sized
+        for its ~ms C snapshot; here a snapshot serializes+reparses every
+        container in Python/C++ (~7 us/container measured), so at a few
+        thousand containers the fixed trigger makes snapshot amortization
+        THE singleton-write cost (~58 us/op at 16k containers).  Scaling
+        the trigger with container count keeps snapshot work a bounded
+        fraction of write work, and crash recovery stays bounded: WAL
+        replay runs at ~100k ops/s (native decode), so the 200k-op cap
+        bounds re-open at ~2 s.  Only applies when max_opn is the
+        default — an explicitly configured max_opn is honored exactly
+        (reference-identical file-state behavior); set
+        PILOSA_TPU_MAX_OPN_SCALE=0 to disable scaling entirely.
+        """
+        if self.max_opn != DEFAULT_MAX_OPN:
+            return self.max_opn
+        scale = self._max_opn_scale
+        if scale is None:  # read once per fragment (env reads cost ~10us/op)
+            scale = self._max_opn_scale = int(
+                os.environ.get("PILOSA_TPU_MAX_OPN_SCALE", "8")
+            )
+        if scale <= 0:
+            return self.max_opn
+        return max(
+            self.max_opn, min(len(self.storage.containers) * scale, 200_000)
+        )
 
     # -- snapshotting (fragment.go:1017-1057) ---------------------------
 

@@ -21,6 +21,7 @@ SetBit, ClearBit, SetRowAttrs, SetColumnAttrs.
 from __future__ import annotations
 
 import os
+import re
 import threading
 from collections import OrderedDict
 from dataclasses import dataclass, field
@@ -155,6 +156,10 @@ class Executor:
         # fighting over the interpreter per request.
         self._write_queue = None
         self._serve_queue = None
+        # (index, frame) -> (index_obj, frame_obj) for the singleton-write
+        # fast lane; validated by object identity per request (frame
+        # deletion/recreation yields new objects).
+        self._fastwrite_cache: dict[tuple[str, str], tuple] = {}
         if write_queue:
             from pilosa_tpu.ingest import WriteQueue
 
@@ -171,6 +176,9 @@ class Executor:
         opt: Optional[ExecOptions] = None,
     ) -> list[Any]:
         if isinstance(query, str):
+            w = self._singleton_write_fast(index, query, slices, opt)
+            if w is not None:
+                return w
             fast = self._flat_fast_path(index, query, slices, opt)
             if fast is not None:
                 return fast
@@ -358,6 +366,60 @@ class Executor:
         "Difference": "andnot",
         "Xor": "xor",
     }
+    # The canonical singleton-write shape clients emit (and the reference
+    # bench tool generates, ctl/bench.go:71-102): ONE SetBit/ClearBit with
+    # positional-canonical args and no timestamp.  Anything else declines
+    # to the general path.
+    _SINGLETON_WRITE_RX = re.compile(
+        r'^\s*(SetBit|ClearBit)\(\s*([A-Za-z_][A-Za-z0-9_]*)\s*=\s*(\d+)\s*,'
+        r'\s*frame\s*=\s*"([a-z][a-z0-9_-]{0,64})"\s*,'
+        r'\s*([A-Za-z_][A-Za-z0-9_]*)\s*=\s*(\d+)\s*\)\s*$'
+    )
+
+    def _singleton_write_fast(self, index: str, src: str, slices, opt) -> Optional[list]:
+        """Durable singleton SetBit/ClearBit with minimal per-request
+        Python: one regex + cached (index, frame) resolution + the scalar
+        frame write.  The general path costs ~10x more per op in parse +
+        queue + batched-commit machinery that buys nothing for a single
+        bit; under concurrent clients the GIL makes that per-op Python
+        THE write-throughput ceiling (BASELINE.md round-4 waiver note).
+
+        Declines (returns None) for anything beyond the simple local
+        shape: clusters (owner forwarding), inverse-enabled frames (dual
+        writes), non-canonical arg names/order, timestamps, remote opts.
+        """
+        if self.cluster is not None or slices:
+            return None
+        m = self._SINGLETON_WRITE_RX.match(src)
+        if m is None:
+            return None
+        name, k1, v1, fname, k2, v2 = m.groups()
+        cached = self._fastwrite_cache.get((index, fname))
+        if cached is None or self.holder.index(index) is not cached[0]:
+            self._fastwrite_cache.pop((index, fname), None)  # no dead pins
+            idx_obj = self.holder.index(index)
+            if idx_obj is None:
+                return None  # general path raises in canonical order
+            frame = idx_obj.frame(fname)
+            if frame is None:
+                return None
+            cached = (idx_obj, frame)
+            self._fastwrite_cache[(index, fname)] = cached
+        idx_obj, frame = cached
+        if idx_obj.frame(fname) is not frame:
+            self._fastwrite_cache.pop((index, fname), None)
+            return None
+        if (
+            frame.inverse_enabled
+            or k1 != frame.row_label
+            or k2 != idx_obj.column_label
+        ):
+            return None
+        row_id, col_id = int(v1), int(v2)
+        if name == "SetBit":
+            return [frame.set_bit(VIEW_STANDARD, row_id, col_id)]
+        return [frame.clear_bit(VIEW_STANDARD, row_id, col_id)]
+
     def _flat_fast_path(self, index: str, src: str, slices, opt) -> Optional[list]:
         """Compiled-query lane: serve an all-``Count(<op>(Bitmap,Bitmap))``
         request straight from the native matcher's pair arrays — no Token

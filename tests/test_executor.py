@@ -1305,3 +1305,62 @@ def test_count_exact_past_int32_full_density(tmp_path, monkeypatch):
     monkeypatch.setenv("PILOSA_TPU_STREAM_BYTES", str(1 << 62))
     assert e._slice_chunk(2) == _INT32_SAFE_SLICES
     h.close()
+
+
+def test_singleton_write_fast_lane_parity(tmp_path, monkeypatch):
+    """The singleton SetBit/ClearBit fast lane must be observably
+    identical to the general path: changed semantics, label validation
+    (declining non-matching arg names), inverse-frame decline, and
+    interleaving with reads."""
+    h = Holder(str(tmp_path / "data"))
+    h.open()
+    idx = h.create_index("i")
+    idx.create_frame("f", FrameOptions())
+    idx.create_frame("inv", FrameOptions(inverse_enabled=True))
+    e = Executor(h, engine="numpy")
+
+    # fast lane serves the canonical shape
+    assert e.execute("i", 'SetBit(rowID=3, frame="f", columnID=9)') == [True]
+    assert e.execute("i", 'SetBit(rowID=3, frame="f", columnID=9)') == [False]
+    assert e.execute("i", 'Count(Bitmap(rowID=3, frame="f"))') == [1]
+    assert e.execute("i", 'ClearBit(rowID=3, frame="f", columnID=9)') == [True]
+    assert e.execute("i", 'ClearBit(rowID=3, frame="f", columnID=9)') == [False]
+    # wrong arg label: declines to the general path, which raises the
+    # same error as before the lane existed
+    with pytest.raises(PilosaError):
+        e.execute("i", 'SetBit(wrongID=3, frame="f", columnID=9)')
+    # inverse frames decline (dual-view write handled by the general path)
+    assert e.execute("i", 'SetBit(rowID=1, frame="inv", columnID=5)') == [True]
+    assert e.execute("i", 'Count(Bitmap(rowID=5, frame="inv", inverse=true))')[0] >= 0
+    inv_fr = h.frame("i", "inv")
+    assert inv_fr.views.get("inverse") is not None, "inverse view must be written"
+    # frame recreation invalidates the identity cache
+    idx.delete_frame("f")
+    idx.create_frame("f", FrameOptions())
+    assert e.execute("i", 'SetBit(rowID=3, frame="f", columnID=9)') == [True]
+    assert e.execute("i", 'Count(Bitmap(rowID=3, frame="f"))') == [1]
+    h.close()
+
+
+def test_effective_max_opn_scaling(tmp_path, monkeypatch):
+    """Snapshot-trigger scaling: DEFAULT-tuned fragments scale the
+    threshold with container count (bounded); explicit max_opn and the
+    env kill switch keep exact reference behavior."""
+    from pilosa_tpu.core.fragment import DEFAULT_MAX_OPN, Fragment
+
+    f = Fragment(str(tmp_path / "0"), "i", "f", "standard", 0)
+    f.open()
+    assert f._effective_max_opn() >= DEFAULT_MAX_OPN
+    # explicit max_opn: honored exactly
+    g = Fragment(str(tmp_path / "1"), "i", "f", "standard", 1, max_opn=5)
+    g.open()
+    assert g._effective_max_opn() == 5
+    for i in range(7):
+        g.set_bit(0, i)
+    assert g.storage.op_n < 5  # snapshot fired at the explicit threshold
+    # env kill switch restores the fixed default
+    monkeypatch.setenv("PILOSA_TPU_MAX_OPN_SCALE", "0")
+    k = Fragment(str(tmp_path / "2"), "i", "f", "standard", 2)
+    k.open()
+    assert k._effective_max_opn() == DEFAULT_MAX_OPN
+    f.close(); g.close(); k.close()
