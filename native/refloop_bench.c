@@ -1,0 +1,67 @@
+/* Measured CPU stand-in for the reference's IntersectionCount hot loop.
+ *
+ * The Go toolchain is absent in this environment (BASELINE.md), so the
+ * reference's own `go test -bench` cannot run.  This program measures
+ * the SAME inner loop its assembly implements — popcntAndSliceAsm
+ * (Σ popcount(a[i] & b[i]) over []uint64, one POPCNTQ per 8 bytes,
+ * reference roaring/assembly_amd64.s:60-77) — compiled with -mpopcnt
+ * so the compiler emits the same POPCNT instruction the asm uses.  The
+ * result is a measured upper bound for what the reference's kernel
+ * layer sustains per core on THIS host, replacing the literature
+ * estimate in the vs_baseline accounting.
+ *
+ * Build/run: gcc -O2 -mpopcnt -o refloop_bench refloop_bench.c && ./refloop_bench
+ * Prints one JSON line: bytes/s through the AND+POPCNT loop and the
+ * equivalent batch-256 pair-count q/s at the headline shape.
+ */
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <time.h>
+
+static double now_s(void) {
+    struct timespec ts;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    return ts.tv_sec + ts.tv_nsec * 1e-9;
+}
+
+int main(void) {
+    /* One slice-row pair: 2^20 bits = 131072 uint64 words per operand
+     * (the reference's fragment row width, fragment.go:47). */
+    const size_t words = 131072;
+    const int rows = 64;
+    uint64_t *data = malloc(rows * words * 8);
+    uint64_t seed = 0x9E3779B97F4A7C15ull;
+    for (size_t i = 0; i < rows * words; i++) {
+        seed ^= seed << 13; seed ^= seed >> 7; seed ^= seed << 17;
+        data[i] = seed;
+    }
+    /* Warm + pick iteration count for ~1s of work. */
+    const int pairs_per_iter = 256;
+    int iters = 64;
+    uint64_t sink = 0;
+    double best = 1e30;
+    for (int run = 0; run < 5; run++) {
+        double t0 = now_s();
+        for (int it = 0; it < iters; it++) {
+            for (int p = 0; p < pairs_per_iter; p++) {
+                const uint64_t *a = data + ((p * 2 + it) % rows) * words;
+                const uint64_t *b = data + ((p * 2 + 1) % rows) * words;
+                uint64_t acc = 0;
+                for (size_t i = 0; i < words; i++)
+                    acc += (uint64_t)__builtin_popcountll(a[i] & b[i]);
+                sink += acc;
+            }
+        }
+        double dt = now_s() - t0;
+        if (dt < best) best = dt;
+    }
+    double bytes = (double)iters * pairs_per_iter * 2.0 * words * 8.0;
+    double qps = (double)iters * pairs_per_iter / best;
+    printf("{\"metric\": \"ref_and_popcnt_loop\", \"bytes_per_s\": %.3e, "
+           "\"pair_qps_1slice\": %.1f, \"pair_qps_16slices\": %.1f, "
+           "\"sink\": %llu}\n",
+           bytes / best, qps, qps / 16.0, (unsigned long long)(sink & 1));
+    free(data);
+    return 0;
+}

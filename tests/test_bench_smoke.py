@@ -132,3 +132,28 @@ def test_bench_executor_gather_smoke():
     })
     result = json.loads(stdout.strip().splitlines()[-1])
     assert result["value"] > 0
+
+
+def test_refloop_bench_compiles_and_runs(tmp_path):
+    """The measured CPU stand-in for the reference's hot loop
+    (native/refloop_bench.c = popcntAndSliceAsm semantics) must build
+    with the baked toolchain and emit its JSON line."""
+    import shutil
+    import subprocess as sp
+
+    cc = shutil.which("gcc") or shutil.which("cc")
+    if cc is None:
+        pytest.skip("no C compiler")
+    exe = tmp_path / "refloop"
+    built = sp.run(
+        [cc, "-O2", "-mpopcnt", "-o", str(exe),
+         os.path.join(REPO, "native", "refloop_bench.c")],
+        capture_output=True, text=True,
+    )
+    if built.returncode != 0:
+        if "mpopcnt" in built.stderr:  # non-x86 host: capability gap
+            pytest.skip("-mpopcnt unsupported on this arch")
+        raise AssertionError(built.stderr[-1000:])
+    out = sp.run([str(exe)], capture_output=True, text=True, timeout=120, check=True)
+    d = json.loads(out.stdout.strip())
+    assert d["bytes_per_s"] > 1e8 and d["pair_qps_1slice"] > 0
