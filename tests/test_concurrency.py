@@ -237,3 +237,87 @@ def test_soak_two_engines_with_snapshots(tmp_path):
     for r, cols in model.items():
         assert e3.execute("i", f'Count(Bitmap(rowID={r}, frame="f"))') == [len(cols)]
     h2.close()
+
+
+def test_gram_at_scale_reads_stable_under_write_churn(tmp_path):
+    """Round-4 Gram-at-scale lane under concurrent invalidation: reader
+    threads issue fused pair-count batches over rows a writer thread
+    NEVER touches, while the writer churns other rows of the same frame
+    (every write kills the pool's cache box, forcing Gram rebuilds and
+    lane re-decisions mid-stream).  The readers' counts must stay
+    exactly constant throughout — a stale Gram, a torn box, or a lane
+    race would surface as a changed count."""
+    rng = np.random.default_rng(3)
+    h = Holder(str(tmp_path / "data"))
+    h.open()
+    h.create_index("c").create_frame("f", FrameOptions())
+    fr = h.index("c").frame("f")
+    n_read_rows, n_churn_rows = 48, 8
+    rows = np.repeat(np.arange(n_read_rows, dtype=np.uint64), 12)
+    for s in range(2):
+        cols = rng.integers(0, SLICE_WIDTH, size=len(rows)).astype(np.uint64) + np.uint64(
+            s * SLICE_WIDTH
+        )
+        fr.import_bits(rows, cols)
+
+    ex = Executor(h, engine="jax")
+    if not getattr(ex.engine, "wants_static_shapes", False):
+        pytest.skip("jax engine unavailable")
+
+    def build_q(seed):
+        perm = np.random.default_rng(seed).permutation(n_read_rows)
+        return " ".join(
+            f'Count(Intersect(Bitmap(rowID={int(perm[2 * i])}, frame="f"), '
+            f'Bitmap(rowID={int(perm[2 * i + 1])}, frame="f")))'
+            for i in range(8)
+        )
+
+    qs = [build_q(i) for i in range(6)]
+    # Ground truth once, pre-churn, via numpy (the churned rows are
+    # disjoint, so these stay correct throughout).
+    want = {q: Executor(h, engine="numpy").execute("c", q) for q in qs}
+    for q in qs:  # warm: rows resident, Gram builds
+        assert ex.execute("c", q) == want[q]
+
+    stop = threading.Event()
+    failures: list = []
+    writes_done = [0]
+
+    def reader(tid):
+        try:
+            k = tid
+            while not stop.is_set():
+                q = qs[k % len(qs)]
+                got = ex.execute("c", q)
+                if got != want[q]:
+                    failures.append((q, got, want[q]))
+                    return
+                k += 1
+        except BaseException as exc:  # raising IS a failure here
+            failures.append(("reader raised", exc))
+
+    def writer():
+        try:
+            wrng = np.random.default_rng(99)
+            while not stop.is_set():
+                row = n_read_rows + int(wrng.integers(n_churn_rows))
+                col = int(wrng.integers(2 * SLICE_WIDTH))
+                ex.execute("c", f'SetBit(rowID={row}, frame="f", columnID={col})')
+                writes_done[0] += 1
+        except BaseException as exc:
+            failures.append(("writer raised", exc))
+
+    threads = [threading.Thread(target=reader, args=(i,)) for i in range(4)]
+    threads.append(threading.Thread(target=writer))
+    for t in threads:
+        t.start()
+    import time
+
+    time.sleep(6.0)
+    stop.set()
+    for t in threads:
+        t.join(timeout=30)
+        assert not t.is_alive(), "thread hung (deadlock?)"
+    assert not failures, failures[:2]
+    assert writes_done[0] > 0, "writer made no progress: churn never happened"
+    h.close()
