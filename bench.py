@@ -490,10 +490,20 @@ def bench_executor_gather() -> dict:
             thr = repeats * total / (time.perf_counter() - t0)
             return seq, thr
 
-        ex = Executor(h)
+        # write_queue=True is the SERVER's executor configuration; its
+        # serve-queue read coalescing merges concurrent flat-lane
+        # requests into one vectorized evaluation (16-thread Gram
+        # serving measured +76% vs the bare executor).
+        ex = Executor(h, write_queue=True)
         backend = ex.engine.name
         qps, qps_thr = steady_rates(ex)
-        # Forced-NO_GRAM lane tiers: row-major and slice-major gather.
+        # Forced-NO_GRAM lane tiers: row-major and slice-major gather —
+        # measured WITHOUT the serve queue: coalescing serializes all
+        # clients behind one leader's device dispatches, which is right
+        # when serving is host-bound (Gram lookups) but destroys the
+        # concurrent-RTT overlap that is the whole point of the
+        # 16-thread tier on eager device lanes (measured: x16 7.3k
+        # without queue vs 1.0k with, through this tunnel).
         prior_no_gram = os.environ.get("PILOSA_TPU_NO_GRAM")
         os.environ["PILOSA_TPU_NO_GRAM"] = "1"
         orig = engine_mod.JaxEngine.prefer_rowmajor
@@ -516,7 +526,8 @@ def bench_executor_gather() -> dict:
         "unit": (
             f"PQL queries/sec end-to-end, gather-regime shape ({n_rows} distinct "
             f"rows x {n_slices} slices, batch {batch // 2}, warm chunked-Gram "
-            f"product lane, sequential client; {qps_thr:,.0f} q/s 16-thread; "
+            f"product lane, server executor config (serve-queue coalescing), "
+            f"sequential client; {qps_thr:,.0f} q/s 16-thread; "
             f"NO_GRAM tiers: row-major {rm_seq:,.0f} seq / {rm_thr:,.0f} x16, "
             f"slice-major {sm_seq:,.0f} seq / {sm_thr:,.0f} x16 (tunnel-RTT-"
             f"bound; kernel-level lane record in intersect_count_4krows), "

@@ -239,14 +239,17 @@ def test_soak_two_engines_with_snapshots(tmp_path):
     h2.close()
 
 
-def test_gram_at_scale_reads_stable_under_write_churn(tmp_path):
+@pytest.mark.parametrize("write_queue", [False, True])
+def test_gram_at_scale_reads_stable_under_write_churn(tmp_path, write_queue):
     """Round-4 Gram-at-scale lane under concurrent invalidation: reader
     threads issue fused pair-count batches over rows a writer thread
     NEVER touches, while the writer churns other rows of the same frame
     (every write kills the pool's cache box, forcing Gram rebuilds and
     lane re-decisions mid-stream).  The readers' counts must stay
     exactly constant throughout — a stale Gram, a torn box, or a lane
-    race would surface as a changed count."""
+    race would surface as a changed count.  Runs both executor
+    configurations: bare, and the server's serve-queue coalescing
+    (merged cross-client batches racing the same invalidation)."""
     rng = np.random.default_rng(3)
     h = Holder(str(tmp_path / "data"))
     h.open()
@@ -260,7 +263,7 @@ def test_gram_at_scale_reads_stable_under_write_churn(tmp_path):
         )
         fr.import_bits(rows, cols)
 
-    ex = Executor(h, engine="jax")
+    ex = Executor(h, engine="jax", write_queue=write_queue)
     if not getattr(ex.engine, "wants_static_shapes", False):
         pytest.skip("jax engine unavailable")
 
