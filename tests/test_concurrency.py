@@ -207,7 +207,10 @@ def test_soak_two_engines_with_snapshots(tmp_path):
         try:
             while not stop.is_set():
                 h.flush_caches()
-                for frag in list(fr.view("standard").fragments.values()):
+                view = fr.view("standard")  # None until the first write
+                if view is None:
+                    continue
+                for frag in list(view.fragments.values()):
                     frag.snapshot()
         except BaseException as x:  # pragma: no cover
             errors.append(("s", x))
