@@ -460,8 +460,6 @@ class Executor:
         std_slices = list(slices) if slices else list(range(idx_obj.max_slice() + 1))
         if not std_slices:
             return None
-        from pilosa_tpu.rowpool import pool_capacity
-
         if (
             pool_capacity(len(std_slices), _WORDS) < 64
             or len(std_slices) > _INT32_SAFE_SLICES

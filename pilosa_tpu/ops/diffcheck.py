@@ -73,15 +73,15 @@ def _np_pop(x: np.ndarray) -> np.ndarray:
 
 
 def _np_pair(op: str, a: np.ndarray, b: np.ndarray) -> int:
-    if op == "and":
-        v = a & b
-    elif op == "or":
-        v = a | b
-    elif op == "xor":
-        v = a ^ b
-    else:
-        v = a & ~b
-    return int(_np_pop(v).sum())
+    from pilosa_tpu.ops import bitwise as bw
+
+    fn = {
+        "and": bw.np_count_and,
+        "or": bw.np_count_or,
+        "xor": bw.np_count_xor,
+        "andnot": bw.np_count_andnot,
+    }[op]
+    return int(fn(a, b))
 
 
 def np_pair_counts(op: str, rm: np.ndarray, pairs: np.ndarray) -> list[int]:
