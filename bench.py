@@ -1360,17 +1360,23 @@ def main() -> None:
         ) else "1",
     ) not in ("0", "false", "no")
     if tiers_on:
+        # Label by what actually served the headline: the dispatch
+        # strategy predicate mirrors the bandwidth accounting above
+        # (NO_GRAM tall-row shapes run the gather kernel, not resident).
+        if gram_mode:
+            head_tier = "gram"
+            head_note = "all-pairs MXU Gram, host/table lookup serving (no per-query bitmap traffic)"
+        elif n_rows < 2 * batch:
+            head_tier = "resident_nogram"
+            head_note = "direct resident kernel headline (PILOSA_TPU_NO_GRAM)"
+        else:
+            head_tier = "gather_nogram"
+            head_note = "direct gather kernel headline (PILOSA_TPU_NO_GRAM, tall rows)"
         tiers = [{
-            # Label by what actually served the headline (NO_GRAM runs
-            # record the direct kernel here, with its real util).
-            "tier": "gram" if gram_mode else "resident_nogram",
+            "tier": head_tier,
             "qps": result["value"],
             "bandwidth_util": result["bandwidth_util"],
-            "note": (
-                "all-pairs MXU Gram, host/table lookup serving (no per-query bitmap traffic)"
-                if gram_mode
-                else "direct kernel headline (PILOSA_TPU_NO_GRAM)"
-            ),
+            "note": head_note,
         }]
         iters_t = max(1, min(iters, int(os.environ.get("BENCH_TIER_ITERS", "2048"))))
         if gram_mode:

@@ -413,6 +413,7 @@ class Fragment:
         # semantics: one WAL append for the batch, first duplicate wins.
         if len(positions) <= 8:
             with self._mu:
+                self._assert_open()
                 changed = np.zeros(len(positions), dtype=bool)
                 added: list[int] = []
                 for i, v in enumerate(positions.tolist()):
@@ -430,6 +431,7 @@ class Fragment:
                     self._increment_opn()
                 return changed
         with self._mu:
+            self._assert_open()
             # Apply first, then choose durability by how much was actually
             # new: a batch at/over the snapshot threshold goes straight to
             # snapshot (import_bits shape, the op records would be
@@ -544,6 +546,7 @@ class Fragment:
     def snapshot(self) -> None:
         """Rewrite the data file from storage; temp-file + rename."""
         with self._mu:
+            self._assert_open()
             self._snapshot()
 
     def _snapshot(self) -> None:
@@ -677,6 +680,7 @@ class Fragment:
 
     def count(self) -> int:
         with self._mu:
+            self._assert_open()
             return self.storage.count()
 
     # -- TopN (fragment.go:493-659) -------------------------------------
@@ -823,6 +827,7 @@ class Fragment:
     def blocks(self) -> list[tuple[int, bytes]]:
         """(block id, sha1) for each non-empty block of HASH_BLOCK_SIZE rows."""
         with self._mu:
+            self._assert_open()
             self._flush_row_bookkeeping()
             return self._blocks()
 
@@ -848,6 +853,7 @@ class Fragment:
         start = block_id * HASH_BLOCK_SIZE * SLICE_WIDTH
         end = (block_id + 1) * HASH_BLOCK_SIZE * SLICE_WIDTH
         with self._mu:
+            self._assert_open()
             positions = self.storage.slice_values(start, end)
         rows = positions // np.uint64(SLICE_WIDTH)
         cols = positions % np.uint64(SLICE_WIDTH)

@@ -160,6 +160,7 @@ class Executor:
         # fast lane; validated by object identity per request (frame
         # deletion/recreation yields new objects).
         self._fastwrite_cache: dict[tuple[str, str], tuple] = {}
+        self._gram_env_cache: Optional[tuple[bool, int]] = None  # lazy env read
         if write_queue:
             from pilosa_tpu.ingest import WriteQueue
 
@@ -1273,6 +1274,19 @@ class Executor:
             return self.engine.matrix_rows(block)
         return self.engine.matrix(block)
 
+    def _gram_env(self) -> tuple[bool, int]:
+        """(no_gram, rows_max) — read once per Executor: these sit on the
+        per-request serving path and os.environ lookups cost ~10 us each
+        (same lazy-cache pattern as Fragment._max_opn_scale).  Process-
+        lifetime settings; tests that toggle them build fresh Executors."""
+        cached = self._gram_env_cache
+        if cached is None:
+            cached = self._gram_env_cache = (
+                os.environ.get("PILOSA_TPU_NO_GRAM", "").lower() in ("1", "true", "yes"),
+                int(os.environ.get("PILOSA_TPU_GRAM_ROWS_MAX", "4096")),
+            )
+        return cached
+
     def _gram_rows_max(self) -> int:
         """Row ceiling for the cached-Gram strategy.  The chunked builder
         (bitwise.pair_gram) streams (slice, word-chunk) steps, so rows no
@@ -1281,19 +1295,20 @@ class Executor:
         lookup lane (pn_gram_counts).  4096 rows = a 64 MiB Gram; the
         pool HBM budget bounds build FLOPs (R * S*R * 2^20 MACs with
         S*R capped by PILOSA_TPU_POOL_BYTES) to a few MXU-seconds."""
-        return int(os.environ.get("PILOSA_TPU_GRAM_ROWS_MAX", "4096"))
+        return self._gram_env()[1]
 
     def _gram_could_serve(self, n_rows: int, n_slices: int) -> bool:
         """Whether the cached-Gram strategy is ELIGIBLE for a working set
         of this size (same gates as _frame_gram, sans warmth): the
         row-major gather lane must never displace it — warm Gram serving
         is host-side lookups, strictly faster than any per-query kernel."""
-        if os.environ.get("PILOSA_TPU_NO_GRAM", "").lower() in ("1", "true", "yes"):
+        no_gram, rows_max = self._gram_env()
+        if no_gram:
             return False
         from pilosa_tpu.ops.dispatch import _GRAM_SLICES_MAX
 
         bucket = 1 << max(0, n_rows - 1).bit_length()
-        return bucket <= self._gram_rows_max() and n_slices <= _GRAM_SLICES_MAX
+        return bucket <= rows_max and n_slices <= _GRAM_SLICES_MAX
 
     def _frame_gram(self, matrix, box: Optional[dict]):
         """Cached all-pairs AND-count Gram for a fused-path row matrix.
@@ -1307,7 +1322,7 @@ class Executor:
         """
         if box is None or box.get("hits", 0) < 2:
             return None
-        if os.environ.get("PILOSA_TPU_NO_GRAM", "").lower() in ("1", "true", "yes"):
+        if self._gram_env()[0]:  # NO_GRAM
             return None
         gram = box.get("gram")
         if gram is not None:
@@ -1360,7 +1375,7 @@ class Executor:
             pool = self._matrix_cache.get(key)
             if pool is not None:
                 return pool.cap_max
-        return max(1, pool_capacity(len(slices), _WORDS))
+        return DeviceRowPool.default_cap(len(slices), _WORDS)
 
     def _pool_for(
         self, index: str, frame: str, view: str, slices, lane: str = ""

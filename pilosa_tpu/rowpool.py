@@ -104,11 +104,18 @@ class DeviceRowPool:
         self.stat_evictions = 0
         self.stat_resets = 0
 
+    @staticmethod
+    def default_cap(n_slices: int, words: int) -> int:
+        """The budget-driven cap an un-overridden pool would report —
+        shared with callers that must predict a pool's capacity WITHOUT
+        instantiating it (executor lane probes)."""
+        return max(1, pool_capacity(n_slices, words))
+
     @property
     def cap_max(self) -> int:
         if self._cap_override:
             return self._cap_override
-        return max(1, pool_capacity(self.n_slices, self.words))
+        return self.default_cap(self.n_slices, self.words)
 
     @cap_max.setter
     def cap_max(self, v: int) -> None:

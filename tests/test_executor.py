@@ -1242,14 +1242,17 @@ def test_gram_eligibility_covers_tall_row_sets(env, monkeypatch):
     distinct rows) are Gram-served product paths."""
     _, e = env
     monkeypatch.delenv("PILOSA_TPU_NO_GRAM", raising=False)
+    e._gram_env_cache = None  # env settings are cached once per Executor
     assert e._gram_could_serve(1024, 4)
     assert e._gram_could_serve(4096, 4)       # round-3 regression shape
     assert not e._gram_could_serve(4097, 4)   # bucket 8192 > rows max
     assert e._gram_could_serve(64, 2047)
     assert not e._gram_could_serve(64, 2048)  # int32 count bound
     monkeypatch.setenv("PILOSA_TPU_GRAM_ROWS_MAX", "8192")
+    e._gram_env_cache = None
     assert e._gram_could_serve(8192, 4)
     monkeypatch.setenv("PILOSA_TPU_NO_GRAM", "1")
+    e._gram_env_cache = None
     assert not e._gram_could_serve(64, 4)
 
 

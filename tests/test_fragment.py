@@ -466,6 +466,12 @@ def test_post_close_reads_fail_loudly(tmp_path):
         lambda: f.set_bit(1, 11),
         lambda: f.clear_bit(1, 10),
         lambda: f.import_bits([1], [12]),
+        lambda: f.set_bits([1], [13]),
+        lambda: f.set_bits([1] * 9, list(range(9))),  # vectorized branch
+        lambda: f.count(),
+        lambda: f.blocks(),
+        lambda: f.block_data(0),
+        lambda: f.snapshot(),  # would overwrite the file from empty storage
     ):
         with pytest.raises(ErrFragmentClosed):
             access()
