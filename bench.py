@@ -1335,8 +1335,14 @@ def main() -> None:
     # actually MOVES the bitmaps per batch: with the Gram shortcut active
     # each query is a table lookup, so bandwidth_util is reported null
     # (the honest answer — see BASELINE.md's strategy ablation).
+    # The resident-vs-gather split mirrors dispatch's ACTUAL strategy
+    # predicate (resident_strategy includes the VMEM-fit clause, not just
+    # the row/batch ratio) so the traffic formula matches the kernel that
+    # ran.
+    from pilosa_tpu.ops.pallas_kernels import resident_strategy as _resident
+
     if not gram_mode:
-        if n_rows < 2 * batch:  # resident kernel: whole row set per batch
+        if _resident(n_rows, W, batch):  # resident: whole row set per batch
             bytes_moved = iters * n_slices * n_rows * W * 4
         else:  # gather kernel: two operand rows per (query, slice)
             bytes_moved = iters * batch * 2 * n_slices * W * 4
@@ -1366,12 +1372,12 @@ def main() -> None:
         if gram_mode:
             head_tier = "gram"
             head_note = "all-pairs MXU Gram, host/table lookup serving (no per-query bitmap traffic)"
-        elif n_rows < 2 * batch:
+        elif _resident(n_rows, W, batch):
             head_tier = "resident_nogram"
             head_note = "direct resident kernel headline (PILOSA_TPU_NO_GRAM)"
         else:
             head_tier = "gather_nogram"
-            head_note = "direct gather kernel headline (PILOSA_TPU_NO_GRAM, tall rows)"
+            head_note = "direct gather kernel headline (PILOSA_TPU_NO_GRAM)"
         tiers = [{
             "tier": head_tier,
             "qps": result["value"],
@@ -1388,7 +1394,7 @@ def main() -> None:
                 np.asarray(digest)
                 return out_d
             dt_t, out_t = _best_of_runs(timed_t)
-            if n_rows < 2 * batch:
+            if _resident(n_rows, W, batch):
                 moved = iters_t * n_slices * n_rows * W * 4
             else:
                 moved = iters_t * batch * 2 * n_slices * W * 4

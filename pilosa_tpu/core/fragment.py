@@ -253,6 +253,12 @@ class Fragment:
             self._wal = None
         with self._mu:
             self._flush_row_bookkeeping()
+            # Flip _open UNDER the lock, before any storage swap below:
+            # a concurrent guarded caller that acquires _mu after this
+            # point raises ErrFragmentClosed instead of racing the swap
+            # (the TOCTOU would let e.g. snapshot() rewrite the data
+            # file from the swapped-in empty bitmap).
+            self._open = False
         self._save_cache()
         self._release_flock()
         # Drop the storage containers BEFORE closing the map: mmap.close()
@@ -269,7 +275,6 @@ class Fragment:
                 mm.close()
             except BufferError:
                 pass  # a caller still holds a row view; GC will finish it
-        self._open = False
 
     def _acquire_flock(self) -> None:
         """Exclusive inter-process lock for this fragment's files.

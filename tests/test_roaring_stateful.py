@@ -1,0 +1,95 @@
+"""Hypothesis stateful model fuzz for the roaring engine.
+
+The reference's strongest roaring coverage is testing/quick round-trips
+(roaring/roaring_test.go:182-249); this is that idiom upgraded to a
+STATEFUL model: random interleavings of add/remove/add_many/serialize/
+reload/zero-copy-attach/COW-mutate against a python-set oracle, with
+the structural invariants (Bitmap.check) asserted after every
+serialization boundary.  Shrinking gives minimal failing op sequences.
+"""
+
+import io
+
+import numpy as np
+import pytest
+
+pytest.importorskip("hypothesis")  # optional test dep; suite stays runnable
+from hypothesis import settings
+from hypothesis import strategies as st
+from hypothesis.stateful import RuleBasedStateMachine, invariant, rule
+
+from pilosa_tpu import roaring
+
+# Positions concentrated into few containers (values near container
+# boundaries and the array<->bitmap conversion threshold get dense
+# coverage) plus a long tail across container keys.
+_POS = st.one_of(
+    st.integers(0, 1 << 17),
+    st.integers((1 << 16) - 64, (1 << 16) + 64),
+    st.integers(0, (1 << 22) - 1),
+)
+
+
+class RoaringMachine(RuleBasedStateMachine):
+    def __init__(self):
+        super().__init__()
+        self.bm = roaring.Bitmap()
+        self.model: set[int] = set()
+
+    @rule(v=_POS)
+    def add(self, v):
+        assert self.bm.add(v) == (v not in self.model)
+        self.model.add(v)
+
+    @rule(v=_POS)
+    def remove(self, v):
+        assert self.bm.remove(v) == (v in self.model)
+        self.model.discard(v)
+
+    @rule(vs=st.lists(_POS, min_size=1, max_size=300))
+    def add_many(self, vs):
+        arr = np.asarray(sorted(set(vs)), dtype=np.uint64)
+        added = self.bm.add_many_unlogged(arr)
+        assert set(added.tolist()) == (set(vs) - self.model)
+        self.model |= set(vs)
+
+    @rule(v=_POS)
+    def contains(self, v):
+        assert self.bm.contains(v) == (v in self.model)
+
+    @rule()
+    def serialize_reload(self):
+        buf = io.BytesIO()
+        self.bm.write_to(buf)
+        self.bm = roaring.Bitmap.from_bytes(buf.getvalue())
+        self.bm.check()
+        assert self.bm.count() == len(self.model)
+
+    @rule()
+    def zero_copy_attach_then_mutate(self):
+        """Reload zero-copy (read-only views) then mutate: COW promotion
+        must never corrupt neighbouring containers."""
+        buf = io.BytesIO()
+        self.bm.write_to(buf)
+        self.bm = roaring.Bitmap.from_bytes(buf.getvalue(), zero_copy=True)
+        self.bm.check()
+        probe = 12345
+        had = probe in self.model
+        assert self.bm.add(probe) == (not had)
+        self.model.add(probe)
+
+    @rule(lo=_POS, hi=_POS)
+    def count_range(self, lo, hi):
+        lo, hi = min(lo, hi), max(lo, hi)
+        want = sum(1 for v in self.model if lo <= v < hi)
+        assert self.bm.count_range(lo, hi) == want
+
+    @invariant()
+    def count_matches(self):
+        assert self.bm.count() == len(self.model)
+
+
+TestRoaringModel = RoaringMachine.TestCase
+TestRoaringModel.settings = settings(
+    max_examples=40, stateful_step_count=60, deadline=None
+)
