@@ -1,0 +1,111 @@
+"""Hypothesis stateful fuzz for the fragment persistence layer.
+
+Random interleavings of scalar/batched writes, snapshots, clean
+close+reopen, and CRASH reopen (handles dropped without close, WAL
+replayed) against a dict model — the directed crash-safety tests
+(test_crashsafety.py) pin known failure modes; this machine searches
+for unknown interleavings, with shrinking to a minimal op sequence.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+pytest.importorskip("hypothesis")
+from hypothesis import settings
+from hypothesis import strategies as st
+from hypothesis.stateful import RuleBasedStateMachine, invariant, precondition, rule
+
+from pilosa_tpu.core.fragment import Fragment
+from pilosa_tpu.pilosa import SLICE_WIDTH
+
+_ROW = st.integers(0, 7)
+# Columns clustered inside two containers plus the slice tail.
+_COL = st.one_of(
+    st.integers(0, 1 << 17),
+    st.integers(SLICE_WIDTH - 256, SLICE_WIDTH - 1),
+)
+
+
+class FragmentMachine(RuleBasedStateMachine):
+    def __init__(self):
+        super().__init__()
+        import tempfile
+
+        self._dir = tempfile.mkdtemp()
+        self.path = os.path.join(self._dir, "frag")
+        self.f = Fragment(self.path, "i", "f", "standard", 0, max_opn=25)
+        self.f.open()
+        self.model: set[tuple[int, int]] = set()
+
+    def teardown(self):
+        try:
+            self.f.close()
+        except Exception:
+            pass
+        import shutil
+
+        shutil.rmtree(self._dir, ignore_errors=True)
+
+    @rule(r=_ROW, c=_COL)
+    def set_bit(self, r, c):
+        assert self.f.set_bit(r, c) == ((r, c) not in self.model)
+        self.model.add((r, c))
+
+    @rule(r=_ROW, c=_COL)
+    def clear_bit(self, r, c):
+        assert self.f.clear_bit(r, c) == ((r, c) in self.model)
+        self.model.discard((r, c))
+
+    @rule(bits=st.lists(st.tuples(_ROW, _COL), min_size=1, max_size=40))
+    def set_bits(self, bits):
+        rows = np.asarray([b[0] for b in bits], dtype=np.uint64)
+        cols = np.asarray([b[1] for b in bits], dtype=np.uint64)
+        changed = self.f.set_bits(rows, cols)
+        seen = set(self.model)
+        for i, b in enumerate(bits):
+            assert changed[i] == (b not in seen)
+            seen.add(b)
+        self.model |= set(bits)
+
+    @rule()
+    def snapshot(self):
+        self.f.snapshot()
+
+    @rule()
+    def clean_reopen(self):
+        self.f.close()
+        self.f = Fragment(self.path, "i", "f", "standard", 0, max_opn=25)
+        self.f.open()
+
+    @rule()
+    def crash_reopen(self):
+        """Drop handles without close() — reopen must replay the WAL."""
+        f = self.f
+        if f._wal is not None:
+            f._wal.close()
+            f._wal = None
+            f.storage.op_writer = None
+        f._release_flock()
+        f._open = False
+        self.f = Fragment(self.path, "i", "f", "standard", 0, max_opn=25)
+        self.f.open()
+
+    @precondition(lambda self: len(self.model) > 0)
+    @rule()
+    def check_some_row(self):
+        r = next(iter(self.model))[0]
+        want = sum(1 for (rr, _c) in self.model if rr == r)
+        assert self.f.row_count(r) == want
+
+    @invariant()
+    def total_count_matches(self):
+        assert self.f.count() == len(self.model)
+        self.f.storage.check()
+
+
+TestFragmentModel = FragmentMachine.TestCase
+TestFragmentModel.settings = settings(
+    max_examples=25, stateful_step_count=40, deadline=None
+)
