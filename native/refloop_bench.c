@@ -26,18 +26,25 @@ static double now_s(void) {
 }
 
 int main(void) {
-    /* One slice-row pair: 2^20 bits = 131072 uint64 words per operand
-     * (the reference's fragment row width, fragment.go:47). */
-    const size_t words = 131072;
-    const int rows = 64;
+    /* One slice-row pair: 2^20 bits = 16384 uint64 words = 128 KiB per
+     * operand (the reference's fragment row width, fragment.go:47).
+     * Round-4 note: an earlier revision used 131072 words (8x the real
+     * row width), which deflated the derived reference pair rates 8x;
+     * the bytes/s figure was always self-consistent.  Fixed here so the
+     * printed pair_qps fields are the honest per-core reference bound. */
+    const size_t words = 16384;
+    /* 512 rows x 128 KiB = 64 MiB working set: larger than L3 so the
+     * loop is DRAM-bound like the reference's at-scale regime (the same
+     * working-set size the pre-fix revision measured). */
+    const int rows = 512;
     uint64_t *data = malloc(rows * words * 8);
     uint64_t seed = 0x9E3779B97F4A7C15ull;
     for (size_t i = 0; i < rows * words; i++) {
         seed ^= seed << 13; seed ^= seed >> 7; seed ^= seed << 17;
         data[i] = seed;
     }
-    /* Best of 5 runs of a fixed-size stream (~1 GiB of operand reads
-     * per run at these constants). */
+    /* Best of 5 runs of a fixed-size stream (64 iters x 256 pairs x
+     * 2 x 128 KiB = 4 GiB of operand reads per run). */
     const int pairs_per_iter = 256;
     int iters = 64;
     uint64_t sink = 0;
@@ -47,7 +54,7 @@ int main(void) {
         for (int it = 0; it < iters; it++) {
             for (int p = 0; p < pairs_per_iter; p++) {
                 /* Both operands cycle with the iteration so each run
-                 * touches the full 64-row working set from both streams
+                 * touches the full row working set from both streams
                  * and a != b always (a==b would halve real traffic). */
                 int ia = (p * 2 + it) % rows;
                 int ib = (p * 2 + 3 * it + 1) % rows;

@@ -35,18 +35,55 @@ def cmd_server(args) -> int:
     from pilosa_tpu.server.server import Server
 
     cfg = _load_config(args)
+    profiler = None
+    if getattr(args, "profile_cpu", None):
+        # cmd/server.go:100 parity: profile the whole serving lifetime,
+        # written on shutdown (pstats; inspect with `python -m pstats`).
+        # On CPython 3.12+ cProfile rides sys.monitoring, whose events
+        # are process-global, so one enable() here captures the
+        # thread-per-request HTTP handler threads too (goroutine-wide
+        # sampling parity with Go's pprof; verified empirically — a
+        # second per-thread Profile raises "Another profiling tool is
+        # already active").
+        import cProfile
+
+        profiler = cProfile.Profile()
+        profiler.enable()
+
+    def _finish() -> None:
+        server.close()
+        if profiler is not None:
+            profiler.disable()
+            profiler.dump_stats(args.profile_cpu)
+            print(f"cpu profile written to {args.profile_cpu}")
+
     server = Server(cfg)
     server.open()
     print(f"pilosa-tpu serving on http://{server.host} (data: {server.data_dir})")
     if args.test_exit:  # for CLI tests: start, report, stop
-        server.close()
+        _finish()
         return 0
+    # SIGTERM (systemd/docker stop) must flush the profile and close the
+    # holder exactly like Ctrl-C, not die inside time.sleep.  The handler
+    # disarms itself so a second TERM/INT during shutdown cannot abort
+    # close() mid-flush, and _finish runs in a finally for the same
+    # reason.
+    import signal
+
+    def _on_term(signum, frame):
+        signal.signal(signal.SIGTERM, signal.SIG_IGN)
+        raise KeyboardInterrupt
+
+    signal.signal(signal.SIGTERM, _on_term)
     try:
         while True:
             time.sleep(3600)
     except KeyboardInterrupt:
         print("shutting down")
-        server.close()
+    finally:
+        signal.signal(signal.SIGTERM, signal.SIG_IGN)
+        signal.signal(signal.SIGINT, signal.SIG_IGN)
+        _finish()
     return 0
 
 
@@ -281,6 +318,11 @@ def build_parser() -> argparse.ArgumentParser:
     s = sub.add_parser("server", help="run the server")
     s.add_argument("--data-dir", help="data directory")
     s.add_argument("--host", help="host:port to bind")
+    s.add_argument(
+        "--profile.cpu", dest="profile_cpu", metavar="PATH",
+        help="write a CPU profile (pstats format) to PATH on shutdown "
+             "(cmd/server.go:100 parity)",
+    )
     s.add_argument("--test-exit", action="store_true", help=argparse.SUPPRESS)
     s.set_defaults(fn=cmd_server)
 

@@ -2,6 +2,7 @@
 
 import json
 import socket
+import sys
 
 import numpy as np
 import pytest
@@ -39,6 +40,43 @@ def test_config_env_precedence(capsys, monkeypatch):
 def test_server_command(tmp_path, capsys):
     assert main(["server", "--data-dir", str(tmp_path / "d"), "--host", "127.0.0.1:0", "--test-exit"]) == 0
     assert "serving on" in capsys.readouterr().out
+
+
+def test_server_profile_cpu(tmp_path, capsys):
+    """--profile.cpu writes a loadable pstats file (cmd/server.go:100)."""
+    import pstats
+
+    prof = tmp_path / "cpu.prof"
+    assert main([
+        "server", "--data-dir", str(tmp_path / "d"), "--host", "127.0.0.1:0",
+        "--profile.cpu", str(prof), "--test-exit",
+    ]) == 0
+    assert "cpu profile written" in capsys.readouterr().out
+    assert pstats.Stats(str(prof)).total_calls > 0
+
+
+@pytest.mark.skipif(sys.version_info < (3, 12),
+                    reason="process-wide cProfile needs 3.12 sys.monitoring")
+def test_profile_captures_handler_threads():
+    """The flag's pprof parity rests on 3.12 cProfile being process-wide
+    (sys.monitoring): work on OTHER threads must land in the profile."""
+    import cProfile
+    import io
+    import pstats
+    import threading
+
+    def handler_work():
+        return sum(i * i for i in range(10_000))
+
+    p = cProfile.Profile()
+    p.enable()
+    t = threading.Thread(target=handler_work)
+    t.start()
+    t.join()
+    p.disable()
+    buf = io.StringIO()
+    pstats.Stats(p, stream=buf).print_stats("handler_work")
+    assert "handler_work" in buf.getvalue()
 
 
 def test_import_export_sort(tmp_path, srv, capsys):
