@@ -34,6 +34,16 @@ _NP_OPS = {
     "andnot": lambda a, b: a & ~b,
 }
 
+# Tree-fold opcodes by id (ops.bitwise.gather_count_tree encoding);
+# opcode 4 = PASS (take the left child — perfect-tree padding).
+_TREE_NP_OPS = {
+    0: _NP_OPS["and"],
+    1: _NP_OPS["or"],
+    2: _NP_OPS["xor"],
+    3: _NP_OPS["andnot"],
+    4: lambda a, b: a,
+}
+
 
 class NumpyEngine:
     name = "numpy"
@@ -103,6 +113,43 @@ class NumpyEngine:
     def gather_count_or_multi(self, row_matrix, idx) -> np.ndarray:
         return self.gather_count_multi("or", row_matrix, idx)
 
+    def gather_count_tree(self, row_matrix, leaves, opc) -> np.ndarray:
+        """Batched Count over arbitrary nested expression trees (perfect-
+        tree encoding, see ops.bitwise.gather_count_tree).  Chunked over
+        the batch like gather_count_multi (same transient bound).
+
+        Implemented inline (not via ops.bitwise) for two reasons: the
+        numpy engine must work on jax-less hosts (bitwise imports jax at
+        module top), and per-node opcode GROUPING does one bitwise pass
+        per node — the where-select form evaluates all four ops per node,
+        which XLA fuses away but a host loop pays for real.
+        """
+        from pilosa_tpu.pilosa import OR_MULTI_BUDGET_HOST, or_multi_chunk_size
+
+        s, _, w = row_matrix.shape
+        b, k = leaves.shape
+        chunk = or_multi_chunk_size(s, k, w, OR_MULTI_BUDGET_HOST)
+        out = np.empty(b, dtype=np.int64)
+        for i in range(0, b, chunk):
+            g = row_matrix[:, leaves[i : i + chunk], :]  # [S, c, K, W]
+            oc = opc[i : i + chunk]
+            off = 0
+            n = k // 2
+            while n >= 1:
+                a = g[:, :, 0::2]
+                bb = g[:, :, 1::2]
+                nxt = np.empty_like(a)
+                for t in range(n):
+                    col = oc[:, off + t]
+                    for o in np.unique(col):
+                        m = col == o
+                        nxt[:, m, t] = _TREE_NP_OPS[int(o)](a[:, m, t], bb[:, m, t])
+                g = nxt
+                off += n
+                n //= 2
+            out[i : i + chunk] = self.count(g[:, :, 0]).sum(axis=0)
+        return out
+
     def gather_count_dev(self, op: str, row_matrix, pairs):
         """Like gather_count but returns an ENGINE array without forcing a
         host sync — slice-streaming accumulates these so the next chunk's
@@ -111,6 +158,9 @@ class NumpyEngine:
 
     def gather_count_multi_dev(self, op: str, row_matrix, idx):
         return self.gather_count_multi(op, row_matrix, idx)
+
+    def gather_count_tree_dev(self, row_matrix, leaves, opc):
+        return self.gather_count_tree(row_matrix, leaves, opc)
 
     def bit_and(self, a, b):
         return a & b
@@ -338,6 +388,18 @@ class JaxEngine:
             op, self._jnp.asarray(row_matrix), self._jnp.asarray(idx)
         )
 
+    def gather_count_tree(self, row_matrix, leaves, opc) -> np.ndarray:
+        return self.to_numpy(
+            self.gather_count_tree_dev(row_matrix, leaves, opc)
+        ).astype(np.int64)
+
+    def gather_count_tree_dev(self, row_matrix, leaves, opc):
+        return self._dispatch.gather_count_tree(
+            self._jnp.asarray(row_matrix),
+            self._jnp.asarray(leaves),
+            self._jnp.asarray(opc),
+        )
+
     def bit_and(self, a, b):
         return self._jnp.bitwise_and(a, b)
 
@@ -502,6 +564,7 @@ class MeshEngine(JaxEngine):
         # call would re-trace and miss the dispatch cache every time.
         self._gather_jit = jax.jit(_bw.gather_count, static_argnums=0)
         self._gather_multi_jit = jax.jit(_bw.gather_count_multi, static_argnums=0)
+        self._tree_jit = None  # built on first tree batch
 
     def _shard_stack(self, x):
         # Shard only cleanly-divisible leading axes (device_put requires
@@ -646,6 +709,41 @@ class MeshEngine(JaxEngine):
     def gather_count_or_multi(self, row_matrix, idx):
         return self.gather_count_multi("or", row_matrix, idx)
 
+    def gather_count_tree(self, row_matrix, leaves, opc):
+        from pilosa_tpu.ops.pallas_kernels import rm_words
+
+        rm = self._shard_stack(self._jnp.asarray(row_matrix))
+        s, w = rm.shape[0], rm_words(rm)
+        k = leaves.shape[1]
+        mode = self._pallas_mode(s, w)
+        if mode:
+            from pilosa_tpu.parallel.sharded import sharded_gather_count_tree
+
+            return self._fetch(
+                sharded_gather_count_tree(
+                    self.mesh, rm, self._jnp.asarray(leaves),
+                    self._jnp.asarray(opc), interpret=(mode == "interpret"),
+                )
+            ).astype(np.int64)
+        # jnp form materializes the gather per shard: bound the transient
+        # exactly like gather_count_multi's fallback.
+        from pilosa_tpu.ops import bitwise as _bw
+        from pilosa_tpu.pilosa import OR_MULTI_BUDGET_DEVICE, or_multi_chunk_size
+
+        if self._tree_jit is None:
+            self._tree_jit = self._jax.jit(_bw.gather_count_tree)
+        chunk = or_multi_chunk_size(s, k, w, OR_MULTI_BUDGET_DEVICE)
+        outs = [
+            self._fetch(
+                self._tree_jit(
+                    rm, self._jnp.asarray(leaves[i : i + chunk]),
+                    self._jnp.asarray(opc[i : i + chunk]),
+                )
+            )
+            for i in range(0, leaves.shape[0], chunk)
+        ]
+        return np.concatenate(outs).astype(np.int64)
+
     def gather_count_dev(self, op, row_matrix, pairs):
         # Sharded matrices go through the GSPMD-partitioned jnp form (the
         # Pallas dispatch the Jax parent would pick can't lower under
@@ -654,6 +752,9 @@ class MeshEngine(JaxEngine):
 
     def gather_count_multi_dev(self, op, row_matrix, idx):
         return self.gather_count_multi(op, row_matrix, idx)
+
+    def gather_count_tree_dev(self, row_matrix, leaves, opc):
+        return self.gather_count_tree(row_matrix, leaves, opc)
 
 
 def new_engine(name: str = "auto"):

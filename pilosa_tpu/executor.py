@@ -62,6 +62,89 @@ _WORDS = SLICE_WIDTH // 32
 _INT32_SAFE_SLICES = 2047
 
 
+# --- fused tree compilation helpers (executor.go:261-276, fused) -----------
+#
+# An arbitrary nested Count tree compiles to a PERFECT binary tree:
+# ``leaves`` = 2^D gathered row ids in-order, ``opc`` = 2^D - 1 internal
+# node opcodes level-major bottom-up (ops.bitwise.gather_count_tree
+# documents the encoding).  N-ary associative nodes (Intersect/Union/Xor)
+# balance into log-depth subtrees; n-ary Difference rewrites as
+# a &~ (b | c | ...) — identical to the left fold a &~ b &~ c.  PASS
+# nodes (take the left child) pad odd arities and unbalanced nesting.
+
+_TREE_OP_IDS = {"and": 0, "or": 1, "xor": 2, "andnot": 3}
+_TREE_PASS = 4
+# 16 leaves per query; deeper trees take the sequential path (a single
+# PQL call nested past depth 4 is vanishingly rare — dashboards batch
+# WIDE, not deep).
+_TREE_DEPTH_MAX = 4
+
+
+class _TreeUnfusable(Exception):
+    """Tree shape outside the fused lane (not an error — sequential path)."""
+
+
+def _group_sort_key(kv):
+    """Deterministic dispatch order over mixed group keys: plain-op
+    groups key on (op-string, arity); tree groups on ("tree", K)."""
+    op, kb = kv[0]
+    return (str(op[0]) if isinstance(op, tuple) else op, kb)
+
+
+def _tree_depth(node) -> int:
+    if isinstance(node, int):
+        return 0
+    return 1 + max(_tree_depth(node[1]), _tree_depth(node[2]))
+
+
+def _tree_balanced(op_id: int, nodes: list):
+    """Balanced combine under one associative op (the left-fold semantics
+    of n-ary Intersect/Union/Xor are order-independent)."""
+    while len(nodes) > 1:
+        nxt = [
+            (op_id, nodes[i], nodes[i + 1]) for i in range(0, len(nodes) - 1, 2)
+        ]
+        if len(nodes) % 2:
+            nxt.append(nodes[-1])
+        nodes = nxt
+    return nodes[0]
+
+
+def _tree_fill(d: int, fill: int):
+    """A perfect PASS-subtree of depth d over the fill leaf."""
+    if d == 0:
+        return fill
+    sub = _tree_fill(d - 1, fill)
+    return (_TREE_PASS, sub, sub)
+
+
+def _tree_pad(node, d: int, fill: int):
+    """Pad a tree to PERFECT depth d (PASS nodes keep the left value)."""
+    if d == 0:
+        return node
+    if isinstance(node, int):
+        return (_TREE_PASS, _tree_pad(node, d - 1, fill), _tree_fill(d - 1, fill))
+    return (node[0], _tree_pad(node[1], d - 1, fill), _tree_pad(node[2], d - 1, fill))
+
+
+def _tree_flatten(node, d: int) -> tuple[list[int], list[int]]:
+    """(leaves in-order, opcodes level-major bottom-up) of a perfect tree."""
+    leaves: list[int] = []
+    levels: list[list[int]] = [[] for _ in range(d)]
+
+    def walk(n, h):
+        if h == 0:
+            leaves.append(n)
+            return
+        op, l, r = n
+        levels[h - 1].append(op)  # DFS keeps each level left-to-right
+        walk(l, h - 1)
+        walk(r, h - 1)
+
+    walk(node, d)
+    return leaves, [o for lv in levels for o in lv]
+
+
 @dataclass
 class ExecOptions:
     """Execution options (executor.go ExecOptions)."""
@@ -654,6 +737,61 @@ class Executor:
                 out[fmask] = fout
         return out.tolist()
 
+    def _tree_build(self, index: str, c: pql.Call, fv_box: dict):
+        """Recursively compile a bitmap call tree to leaf/op-node form.
+
+        Returns int (a Bitmap leaf's row id) or (op_id, left, right).
+        Raises _TreeUnfusable for shapes outside the lane (Range leaves,
+        <2-child nodes, mixed frame/view) and PilosaError for invalid
+        leaves (callers abort the whole fuse so the sequential path
+        surfaces the identical error)."""
+        if c.name == "Bitmap":
+            frame, view, row = self._resolve_bitmap_leaf(index, c)
+            if fv_box["fv"] is None:
+                fv_box["fv"] = (frame, view)
+            elif fv_box["fv"] != (frame, view):
+                raise _TreeUnfusable()
+            return int(row)
+        op = self._FUSABLE_OPS.get(c.name)
+        if op is None or len(c.children) < 2:
+            raise _TreeUnfusable()
+        subs = [self._tree_build(index, ch, fv_box) for ch in c.children]
+        if op == "andnot":
+            # a &~ b &~ c ... == a & ~(b | c | ...) — the rest joins
+            # under a balanced OR so Difference nests in log depth too.
+            rest = (
+                subs[1]
+                if len(subs) == 2
+                else _tree_balanced(_TREE_OP_IDS["or"], subs[1:])
+            )
+            return (_TREE_OP_IDS["andnot"], subs[0], rest)
+        return _tree_balanced(_TREE_OP_IDS[op], subs)
+
+    def _compile_count_tree(self, index: str, ch: pql.Call):
+        """Compile one Count child tree for the fused tree lane.
+
+        Returns (frame, view, ("tree", 2^D), leaves, opc) or None when the
+        shape stays sequential; propagates PilosaError for invalid leaves.
+        """
+        box = {"fv": None}
+        try:
+            node = self._tree_build(index, ch, box)
+        except _TreeUnfusable:
+            return None
+        if isinstance(node, int):
+            return None
+        d = _tree_depth(node)
+        if d > _TREE_DEPTH_MAX:
+            return None
+        # Pad slots gather the leftmost REAL leaf so the unique-row
+        # working set (pool capacity, Gram eligibility) never grows.
+        fill = node
+        while not isinstance(fill, int):
+            fill = fill[1]
+        leaves, opc = _tree_flatten(_tree_pad(node, d, fill), d)
+        frame, view = box["fv"]
+        return frame, view, ("tree", 1 << d), tuple(leaves), tuple(opc)
+
     def _fuse_count_pair_batch(
         self, index: str, calls, slices, inv_slices, opt: ExecOptions
     ) -> Optional[dict[int, int]]:
@@ -667,16 +805,22 @@ class Executor:
         carrying a batch of count queries costs one kernel launch per
         op/arity group instead of per-call row uploads + reductions.
         Covers Intersect, Union, and Difference over 2+ Bitmap children
-        (2-operand calls keep the Gram-eligible pair lane) and Xor over
-        exactly two.  Only applies to single-node/local execution;
-        distributed requests go through the per-call mapReduce with its
-        node-failure retry.
+        (2-operand calls keep the Gram-eligible pair lane), Xor over
+        exactly two — and, via the TREE lane, ARBITRARY nestings of the
+        four ops (mixed Intersect(Union(...), ...) trees, multi-operand
+        Xor) up to depth 4, compiled to per-query perfect-tree opcode
+        programs and dispatched once per depth bucket
+        (executor.go:261-276's uniform any-depth evaluation, fused).
+        Distributed requests forward ONE batch per remote node and fuse
+        locally per node.
         """
         if not slices:
             return None
 
-        # call idx -> (frame, view, kernel_op, row-id tuple)
-        matched: dict[int, tuple[str, str, str, tuple[int, ...]]] = {}
+        # call idx -> (frame, view, kernel_op, row-id tuple) for flat
+        # calls, or (frame, view, ("tree", 2^D), leaves, opc) for nested
+        # trees / multi-operand Xor (the fused tree lane).
+        matched: dict[int, tuple] = {}
         batch_view: Optional[str] = None
         for i, c in enumerate(calls):
             if c.name != "Count" or len(c.children) != 1:
@@ -685,34 +829,47 @@ class Executor:
             op = self._FUSABLE_OPS.get(ch.name)
             if op is None or len(ch.children) < 2:
                 continue
-            if op == "xor" and len(ch.children) != 2:
-                continue  # xor padding is not idempotent; sequential path
-            leaves = []
-            for leaf in ch.children:
-                if leaf.name != "Bitmap":
-                    break
+            entry = None
+            if op != "xor" or len(ch.children) == 2:
+                # Flat attempt first: the pair lane is Gram-eligible and
+                # the multi-fold lane gathers K rows vs the tree lane's
+                # 2^ceil(log2 K).
+                leaves = []
+                for leaf in ch.children:
+                    if leaf.name != "Bitmap":
+                        break
+                    try:
+                        frame, view, row_id = self._resolve_bitmap_leaf(index, leaf)
+                    except PilosaError:
+                        return None  # surface the error through the normal path
+                    leaves.append((frame, view, row_id))
+                if len(leaves) == len(ch.children) and all(
+                    l[:2] == leaves[0][:2] for l in leaves[1:]
+                ):
+                    entry = (
+                        leaves[0][0],
+                        leaves[0][1],
+                        op,
+                        tuple(l[2] for l in leaves),
+                    )
+            if entry is None:
+                # Nested / multi-Xor shapes: the tree lane (one dispatch
+                # per depth bucket — executor.go:261-276's any-depth
+                # uniformity, fused).
                 try:
-                    frame, view, row_id = self._resolve_bitmap_leaf(index, leaf)
+                    entry = self._compile_count_tree(index, ch)
                 except PilosaError:
                     return None  # surface the error through the normal path
-                leaves.append((frame, view, row_id))
-            if len(leaves) != len(ch.children) or any(
-                l[:2] != leaves[0][:2] for l in leaves[1:]
-            ):
-                continue
+                if entry is None:
+                    continue
             # Uniform view across the batch: the slice domain (standard vs
             # inverse axis) is per-mapReduce, so mixed-view requests take
             # the sequential path.
             if batch_view is None:
-                batch_view = leaves[0][1]
-            elif leaves[0][1] != batch_view:
+                batch_view = entry[1]
+            elif entry[1] != batch_view:
                 return None
-            matched[i] = (
-                leaves[0][0],
-                leaves[0][1],
-                op,
-                tuple(l[2] for l in leaves),
-            )
+            matched[i] = entry
         # Fuse only when the WHOLE request is fusable reads: a write call
         # anywhere in the request must be observed by later Counts
         # (per-call ordering semantics), so mixed requests take the
@@ -1069,11 +1226,15 @@ class Executor:
                 # of two (stable shapes); the numpy engine uses exact
                 # arities — padding there is pure wasted gather/fold work
                 # (same policy as the fused Range lane).
-                groups: dict[tuple[str, int], list[int]] = {}
+                groups: dict[tuple, list[int]] = {}
                 for i in part:
                     k = len(matched[i][3])
                     kb = 2 if k == 2 else (1 << (k - 1).bit_length()) if static else k
                     groups.setdefault((matched[i][2], kb), []).append(i)
+                # Tree groups have no row-major kernel (their matrices
+                # stay slice-major); a part carrying one keeps every
+                # group on the slice-major lanes.
+                has_tree = any(isinstance(g[0], tuple) for g in groups)
 
                 if len(want) <= pool.cap_max and len(slices) <= _INT32_SAFE_SLICES:
                     # Resident regime: rows live (or page) in the pool.
@@ -1101,7 +1262,8 @@ class Executor:
                     # paging regime each part switch remaps pool slots
                     # and kills the cache box, so the Gram never warms.
                     rm_pool = (
-                        getattr(self.engine, "supports_row_major_gather", False)
+                        not has_tree
+                        and getattr(self.engine, "supports_row_major_gather", False)
                         and (
                             len(parts) > 1
                             or not self._gram_could_serve(len(want), len(slices))
@@ -1130,7 +1292,7 @@ class Executor:
                         if not rm_pool and any(kb == 2 for _, kb in groups)
                         else None
                     )
-                    for gk, op_idxs in sorted(groups.items()):
+                    for gk, op_idxs in sorted(groups.items(), key=_group_sort_key):
                         counts = self.engine.to_numpy(
                             self._group_counts(
                                 gk, op_idxs, matched, id_pos, matrix, static,
@@ -1157,7 +1319,8 @@ class Executor:
                     # widest group's operand count must fit the kernels'
                     # VMEM row buffers at this chunk's slice width.
                     row_major = (
-                        getattr(self.engine, "supports_row_major_gather", False)
+                        not has_tree
+                        and getattr(self.engine, "supports_row_major_gather", False)
                         and self.engine.rowmajor_ok(
                             min(s_chunk, len(slices)), _WORDS,
                             max(kb for _, kb in groups),
@@ -1169,14 +1332,14 @@ class Executor:
                             index, frame, view, slices[c0 : c0 + s_chunk], want,
                             row_major=row_major,
                         )
-                        for gk, op_idxs in sorted(groups.items()):
+                        for gk, op_idxs in sorted(groups.items(), key=_group_sort_key):
                             acc.setdefault(gk, []).append(
                                 self._group_counts(
                                     gk, op_idxs, matched, id_pos, matrix, static,
                                     None, row_major=row_major,
                                 )
                             )
-                    for gk, op_idxs in sorted(groups.items()):
+                    for gk, op_idxs in sorted(groups.items(), key=_group_sort_key):
                         total = sum(
                             self.engine.to_numpy(a).astype(np.int64) for a in acc[gk]
                         )
@@ -1190,6 +1353,18 @@ class Executor:
         """One fused dispatch for an (op, arity-bucket) call group; returns
         the engine-native count array (fetch deferred to the caller)."""
         op, kb = gk
+        if isinstance(op, tuple):  # ("tree", K): nested expression trees
+            k = op[1]
+            n = len(op_idxs)
+            bb = (1 << (n - 1).bit_length()) if (static and n > 1) else n
+            leaves = np.zeros((bb, k), dtype=np.int32)
+            opc = np.zeros((bb, k - 1), dtype=np.int32)
+            for r, i in enumerate(op_idxs):
+                leaves[r] = [id_pos[x] for x in matched[i][3]]
+                opc[r] = matched[i][4]
+            leaves[n:] = leaves[0]  # pad rows repeat the first query
+            opc[n:] = opc[0]
+            return self.engine.gather_count_tree_dev(matrix, leaves, opc)
         if kb == 2:
             pairs = np.array(
                 [

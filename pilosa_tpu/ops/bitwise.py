@@ -176,6 +176,78 @@ def gather_count_or_multi(row_matrix, idx):
     return gather_count_multi("or", row_matrix, idx)
 
 
+# ---------------------------------------------------------------------------
+# Tree-fold counts: one dispatch for ARBITRARY nested Count trees
+# (executor.go:261-276's uniform any-depth evaluation, fused)
+# ---------------------------------------------------------------------------
+#
+# A query's boolean expression tree over Bitmap leaves is compiled to a
+# PERFECT binary tree of depth D: ``leaves`` holds the 2^D gathered row
+# ids (in-order), ``opc`` holds the 2^D - 1 internal-node opcodes
+# level-major BOTTOM-UP (the 2^(D-1) leaf-pair nodes first, the root
+# last; nodes left-to-right within a level).  Opcodes 0-3 are the pair
+# ops in PQL_PAIR_OPS order (and/or/xor/andnot); TREE_PASS takes the
+# LEFT child unchanged — the padding op that lets any tree shape (odd
+# arities, unbalanced nesting, multi-operand Xor) fill a perfect tree.
+
+TREE_PASS = 4
+
+
+def tree_select(o, a, b):
+    """Combine one node's children by opcode — elementwise over packed
+    words.  Works on numpy arrays, jnp arrays, AND inside Pallas kernel
+    bodies (o scalar there; array-shaped o broadcasts)."""
+    if isinstance(o, np.ndarray):
+        w = np.where
+    else:
+        w = jnp.where
+    return w(
+        o == 0, a & b,
+        w(o == 1, a | b, w(o == 2, a ^ b, w(o == 3, a & ~b, a))),
+    )
+
+
+def gather_count_tree(row_matrix, leaves, opc):
+    """Batched ``Count(<tree>)`` over all slices in one computation.
+
+    row_matrix: uint32[S, R, W] (or tiled 4D); leaves: int32[B, K] with
+    K = 2^D; opc: int32[B, K-1] level-major bottom-up.  Returns int32[B].
+    XLA form (gather → level folds → popcount); the Pallas version
+    (fused_gather_count_tree) streams one row per grid step instead of
+    materializing the [S, B, K, W] gather.
+    """
+    if row_matrix.ndim == 4:  # tiled engine form: flatten the word axis
+        row_matrix = row_matrix.reshape(*row_matrix.shape[:2], -1)
+    k = leaves.shape[1]
+    vals = jnp.take(row_matrix, leaves, axis=1)  # [S, B, K, W]
+    off = 0
+    n = k // 2
+    while n >= 1:
+        o = opc[None, :, off : off + n, None]  # [1, B, n, 1]
+        vals = tree_select(o, vals[:, :, 0::2], vals[:, :, 1::2])
+        off += n
+        n //= 2
+    acc = vals[:, :, 0]
+    return jnp.sum(lax.population_count(acc).astype(jnp.int32), axis=(0, 2))
+
+
+def np_gather_count_tree(
+    row_matrix: np.ndarray, leaves: np.ndarray, opc: np.ndarray
+) -> np.ndarray:
+    """numpy ground truth for gather_count_tree."""
+    k = leaves.shape[1]
+    vals = row_matrix[:, leaves, :]  # [S, B, K, W]
+    off = 0
+    n = k // 2
+    while n >= 1:
+        o = opc[None, :, off : off + n, None]
+        vals = tree_select(o, vals[:, :, 0::2], vals[:, :, 1::2])
+        off += n
+        n //= 2
+    acc = vals[:, :, 0]
+    return np_popcount(acc).reshape(acc.shape[0], acc.shape[1], -1).sum(axis=(0, 2))
+
+
 def np_gather_count_multi(op: str, row_matrix: np.ndarray, idx: np.ndarray) -> np.ndarray:
     """numpy ground truth for gather_count_multi."""
     g = row_matrix[:, idx, :]  # [S, B, K, W]

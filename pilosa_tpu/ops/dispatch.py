@@ -272,6 +272,45 @@ def gather_count_or_multi(row_matrix, idx):
     return gather_count_multi("or", row_matrix, idx)
 
 
+def gather_count_tree(row_matrix, leaves, opc):
+    """Batched Count over ARBITRARY nested expression trees — one
+    dispatch per batch (executor.go:261-276 fused).  leaves: int32[B, K]
+    (K = 2^D perfect-tree row ids); opc: int32[B, K-1] level-major
+    bottom-up opcodes (see bitwise.gather_count_tree)."""
+    from pilosa_tpu.ops.pallas_kernels import fused_gather_count_tree
+
+    b, k = leaves.shape
+    if use_pallas() and _tileable(rm_words(row_matrix)):
+        # Prefetched ids per query: K leaves + K-1 opcodes ~ 2K — bound
+        # by the same SMEM id budget as the pair/multi kernels.
+        chunk = max(1, (2 * _GATHER_BATCH_MAX) // max(1, 2 * k - 1))
+        if b > chunk:
+            return jnp.concatenate(
+                [
+                    fused_gather_count_tree(
+                        row_matrix, leaves[i : i + chunk], opc[i : i + chunk]
+                    )
+                    for i in range(0, b, chunk)
+                ]
+            )
+        return fused_gather_count_tree(row_matrix, leaves, opc)
+    # XLA fallback materializes the [S, chunk, K, W] gather: bound the
+    # transient like the multi fallback does.
+    from pilosa_tpu.pilosa import OR_MULTI_BUDGET_DEVICE, or_multi_chunk_size
+
+    s, _, w = _rm_dims(row_matrix)
+    rm = _rm3(row_matrix)
+    chunk = or_multi_chunk_size(s, k, w, OR_MULTI_BUDGET_DEVICE)
+    if b > chunk:
+        return jnp.concatenate(
+            [
+                bitwise.gather_count_tree(rm, leaves[i : i + chunk], opc[i : i + chunk])
+                for i in range(0, b, chunk)
+            ]
+        )
+    return bitwise.gather_count_tree(rm, leaves, opc)
+
+
 def batch_intersection_count(rows, src, tiled: bool = False):
     """|rows[k] & src| for a stack of rows — TopN's exact-count hot loop.
 

@@ -555,6 +555,77 @@ def fused_gather_count_or(row_matrix, idx, interpret: bool = False):
     return fused_gather_count_multi("or", row_matrix, idx, interpret=interpret)
 
 
+def _gather_tree_kernel(k, leaves_ref, opc_ref, row_ref, out_ref, buf_ref):
+    from pilosa_tpu.ops.bitwise import tree_select
+
+    q, s, j = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+    buf_ref[j] = row_ref[0, 0]
+
+    def fold():
+        vals = [buf_ref[t] for t in range(k)]
+        off = 0
+        n = k // 2
+        while n >= 1:
+            vals = [
+                tree_select(opc_ref[q, off + t], vals[2 * t], vals[2 * t + 1])
+                for t in range(n)
+            ]
+            off += n
+            n //= 2
+        return _partial_tile(vals[0][None])
+
+    @pl.when((j == k - 1) & (s == 0))
+    def _():
+        out_ref[0] = fold()
+
+    @pl.when((j == k - 1) & (s != 0))
+    def _():
+        out_ref[0] = out_ref[0] + fold()
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def fused_gather_count_tree(row_matrix, leaves, opc, interpret: bool = False):
+    """Per-query ``sum_s popcount(tree(rows))`` for an ARBITRARY nested
+    expression tree per query — the fused form of Count over any nesting
+    of Intersect/Union/Xor/Difference (executor.go:261-276's uniform
+    call-tree evaluation, one kernel launch for the whole batch).
+
+    row_matrix: uint32[n_slices, n_rows, W] (or tiled 4D);
+    leaves: int32[B, K] row ids of a PERFECT binary tree (K = 2^D);
+    opc: int32[B, K-1] node opcodes level-major bottom-up
+    (bitwise.gather_count_tree documents the encoding; TREE_PASS pads).
+    Returns int32[B].
+
+    One row DMA per (query, slice, leaf) grid step lands in a VMEM leaf
+    buffer; at the last leaf the whole fold (statically unrolled — K is
+    small) runs in VMEM and accumulates into the per-query output tile,
+    which stays resident across the slice axis.  Per-node opcodes are
+    scalar-prefetched, so one compiled kernel serves every tree shape of
+    the same depth bucket.
+    """
+    rm4 = _rm4(row_matrix)
+    n_slices, n_rows, sub = rm4.shape[:3]
+    b, k = leaves.shape
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, n_slices, k),
+        in_specs=[
+            pl.BlockSpec(
+                (1, 1, sub, _LANES), lambda q, s, j, lv, oc: (s, lv[q, j], 0, 0)
+            ),
+        ],
+        out_specs=pl.BlockSpec((1, 8, _LANES), lambda q, s, j, lv, oc: (q, 0, 0)),
+        scratch_shapes=[pltpu.VMEM((k, sub, _LANES), jnp.uint32)],
+    )
+    out = pl.pallas_call(
+        functools.partial(_gather_tree_kernel, k),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, 8, _LANES), jnp.int32),
+        interpret=interpret,
+    )(leaves, opc, rm4)
+    return out.sum(axis=(1, 2))
+
+
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def fused_count1(a, interpret: bool = False):
     """sum(popcount(a)) over the last axis via a Pallas kernel."""

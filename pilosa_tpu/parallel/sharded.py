@@ -280,6 +280,55 @@ def sharded_gather_count_multi(
 
 
 @functools.lru_cache(maxsize=None)
+def _sharded_tree_kernel(mesh_obj, axis: str, interpret: bool, rm_ndim: int = 3):
+    import jax
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from pilosa_tpu.ops.pallas_kernels import fused_gather_count_tree
+
+    @functools.partial(
+        jax.shard_map,
+        mesh=mesh_obj,
+        in_specs=(P(axis, *([None] * (rm_ndim - 1))), P(None, None), P(None, None)),
+        out_specs=P(),
+        check_vma=False,
+    )
+    def kernel(rm_shard, leaves, opc):
+        local = fused_gather_count_tree(rm_shard, leaves, opc, interpret=interpret)
+        return lax.psum(local, axis)
+
+    return jax.jit(kernel)
+
+
+def sharded_gather_count_tree(
+    mesh: SliceMesh, row_matrix, leaves, opc, interpret: bool = False
+):
+    """Arbitrary nested tree counts through the Pallas tree kernel per
+    shard + psum (the multi-chip form of dispatch.gather_count_tree —
+    executor.go:261-276 fused over the mesh).  Chunks the batch so the
+    prefetched leaf ids + opcodes stay inside the SMEM budget."""
+    import jax.numpy as jnp
+
+    n_slices = row_matrix.shape[0]
+    _require_divisible(n_slices, mesh.n_devices)
+    b, k = leaves.shape
+    chunk = max(1, (2 * _SHARDED_BATCH_MAX) // max(1, 2 * k - 1))
+    if b > chunk:
+        return jnp.concatenate(
+            [
+                sharded_gather_count_tree(
+                    mesh, row_matrix, leaves[i : i + chunk], opc[i : i + chunk],
+                    interpret,
+                )
+                for i in range(0, b, chunk)
+            ]
+        )
+    kernel = _sharded_tree_kernel(mesh.mesh, mesh.AXIS, interpret, row_matrix.ndim)
+    return kernel(row_matrix, leaves, opc)
+
+
+@functools.lru_cache(maxsize=None)
 def _sharded_scorer_kernel(mesh_obj, axis: str, rm_ndim: int, src_ndim: int):
     """Jitted shard_map'd scorer kernel, cached per (mesh, layouts) — a
     fresh closure per call would retrace + recompile every candidate

@@ -1367,3 +1367,64 @@ def test_effective_max_opn_scaling(tmp_path, monkeypatch):
     k.open()
     assert k._effective_max_opn() == DEFAULT_MAX_OPN
     f.close(); g.close(); k.close()
+
+
+@pytest.mark.parametrize("engine", ["numpy", "jax"])
+def test_fused_tree_lane_matches_sequential(tmp_path, engine):
+    """Nested mixed trees and multi-operand Xor fuse into the tree lane
+    and agree exactly with the sequential path (executor.go:261-276's
+    uniform any-depth evaluation, fused)."""
+    h = Holder(str(tmp_path / "data"))
+    h.open()
+    idx = h.create_index("i")
+    idx.create_frame("f", FrameOptions())
+    fr = idx.frame("f")
+    rng = np.random.default_rng(5)
+    fr.import_bits(rng.integers(0, 10, 600), rng.integers(0, 3 * SLICE_WIDTH, 600))
+    e = Executor(h, engine=engine)
+    qs = [
+        'Count(Intersect(Union(Bitmap(rowID=0, frame="f"), Bitmap(rowID=1, frame="f")), Bitmap(rowID=2, frame="f")))',
+        'Count(Xor(Bitmap(rowID=0, frame="f"), Bitmap(rowID=1, frame="f"), Bitmap(rowID=2, frame="f")))',
+        'Count(Difference(Union(Bitmap(rowID=3, frame="f"), Bitmap(rowID=4, frame="f")), Bitmap(rowID=5, frame="f"), Bitmap(rowID=6, frame="f")))',
+        'Count(Union(Intersect(Bitmap(rowID=1, frame="f"), Bitmap(rowID=2, frame="f")), Intersect(Bitmap(rowID=3, frame="f"), Bitmap(rowID=4, frame="f"))))',
+        'Count(Xor(Union(Bitmap(rowID=0, frame="f"), Bitmap(rowID=7, frame="f")), Bitmap(rowID=8, frame="f"), Bitmap(rowID=9, frame="f"), Bitmap(rowID=1, frame="f")))',
+        # flat shapes mixed in: pair + multi lanes coexist with tree groups
+        'Count(Intersect(Bitmap(rowID=1, frame="f"), Bitmap(rowID=2, frame="f")))',
+        'Count(Union(Bitmap(rowID=3, frame="f"), Bitmap(rowID=4, frame="f"), Bitmap(rowID=5, frame="f")))',
+    ]
+    seq = [e.execute("i", q)[0] for q in qs]
+    # The batch must actually take the fused lane.
+    from pilosa_tpu.pql.parser import parse
+
+    fused = e._fuse_count_pair_batch(
+        "i", parse(" ".join(qs)).calls, list(range(3)), None, ExecOptions()
+    )
+    assert fused is not None and len(fused) == len(qs)
+    assert [fused[i] for i in range(len(qs))] == seq
+    assert e.execute("i", " ".join(qs)) == seq
+    h.close()
+
+
+def test_fused_tree_lane_depth_cap_falls_back(tmp_path):
+    """Trees past _TREE_DEPTH_MAX decline the fused lane but still
+    answer correctly through the sequential path."""
+    h = Holder(str(tmp_path / "data"))
+    h.open()
+    idx = h.create_index("i")
+    idx.create_frame("f", FrameOptions())
+    fr = idx.frame("f")
+    fr.import_bits(np.arange(6) % 3, np.arange(6) * 1000)
+    e = Executor(h, engine="numpy")
+    deep = 'Bitmap(rowID=0, frame="f")'
+    for _ in range(6):  # depth 6 > _TREE_DEPTH_MAX
+        deep = f'Union({deep}, Bitmap(rowID=1, frame="f"))'
+    q = f"Count({deep})"
+    assert e._compile_count_tree("i", parse_query(q).calls[0].children[0]) is None
+    assert e.execute("i", f"{q} {q}") == [e.execute("i", q)[0]] * 2
+    h.close()
+
+
+def parse_query(src):
+    from pilosa_tpu.pql.parser import parse
+
+    return parse(src)

@@ -163,6 +163,15 @@ def test_sharded_pallas_kernels_interpret(mesh):
             acc |= rows[:, idx[q, j]]
         want.append(int(bw.np_popcount(acc).sum()))
     assert got.tolist() == want
+    # Tree kernel under the mesh: random perfect-tree programs vs numpy.
+    from pilosa_tpu.parallel.sharded import sharded_gather_count_tree
+
+    leaves = rng.integers(0, n_rows, size=(4, 8), dtype=np.int32)
+    opc = rng.integers(0, 5, size=(4, 7), dtype=np.int32)
+    got_t = np.asarray(
+        sharded_gather_count_tree(mesh, drows, leaves, opc, interpret=True)
+    )
+    assert got_t.tolist() == bw.np_gather_count_tree(rows, leaves, opc).tolist()
 
 
 def test_mesh_engine_picks_interpret_pallas(monkeypatch):
