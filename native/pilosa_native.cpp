@@ -10,9 +10,11 @@
 //
 // Build: make -C native   (produces libpilosa_native.so)
 
+#include <cerrno>
 #include <cstddef>
 #include <cstdint>
 #include <cstring>
+#include <unistd.h>
 
 extern "C" {
 
@@ -68,6 +70,49 @@ int64_t pn_array_insert_u32(uint32_t* arr, int64_t n, uint32_t v) {
         if (arr[mid] < v) lo = mid + 1; else hi = mid;
     }
     if (lo < n && arr[lo] == v) return -1;
+    memmove(arr + lo + 1, arr + lo, (size_t)(n - lo) * sizeof(uint32_t));
+    arr[lo] = v;
+    return n + 1;
+}
+
+// ---------------------------------------------------------------------------
+// Fused singleton-write core (fragment.go:371-459's compiled hot path):
+// container binary-search + duplicate check + memmove insert + WAL record
+// encode + write(2), all in ONE ctypes crossing.  The Python side keeps
+// owning the numpy buffers and the container directory; this call only
+// executes the common-case mutation (array container with capacity slack)
+// and returns a structural-fallback code for everything else.
+// ---------------------------------------------------------------------------
+
+// Returns the new element count (>= 1) on success, with the 13-byte WAL
+// record written to wal_fd (when wal_fd >= 0); -2 when the value is
+// already present (no mutation, no WAL); -3 when the WAL write failed
+// (the insert is NOT applied — durability-first, caller raises).
+// Caller guarantees capacity > n (the Python side checks the slack).
+int64_t pn_array_add_logged(uint32_t* arr, int64_t n, uint32_t v,
+                            uint64_t pos, int32_t wal_fd) {
+    int64_t lo = 0, hi = n;
+    while (lo < hi) {
+        int64_t mid = (lo + hi) >> 1;
+        if (arr[mid] < v) lo = mid + 1; else hi = mid;
+    }
+    if (lo < n && arr[lo] == v) return -2;
+    if (wal_fd >= 0) {
+        uint8_t rec[13];
+        rec[0] = 0;  // OP_ADD
+        for (int j = 0; j < 8; j++) rec[1 + j] = (pos >> (8 * j)) & 0xFF;
+        uint32_t chk = pn_fnv1a32(rec, 9);
+        for (int j = 0; j < 4; j++) rec[9 + j] = (chk >> (8 * j)) & 0xFF;
+        size_t off = 0;
+        while (off < sizeof(rec)) {
+            ssize_t w = write(wal_fd, rec + off, sizeof(rec) - off);
+            if (w < 0) {
+                if (errno == EINTR) continue;
+                return -3;
+            }
+            off += (size_t)w;
+        }
+    }
     memmove(arr + lo + 1, arr + lo, (size_t)(n - lo) * sizeof(uint32_t));
     arr[lo] = v;
     return n + 1;

@@ -157,6 +157,7 @@ class Fragment:
         self._pending_rows: dict[int, int] = {}
         self._open = False
         self._max_opn_scale: Optional[int] = None  # lazy env read
+        self._opn_trigger = 0  # cached snapshot trigger (_increment_opn)
         self._lock_fd: Optional[int] = None
         self._storage_map = None  # live mmap backing zero-copy containers
         # Write generation: refreshed on every mutation from a
@@ -249,6 +250,12 @@ class Fragment:
 
     def close(self) -> None:
         if self._wal is not None:
+            # Detach BEFORE closing: the fused native add caches the raw
+            # fd from op_writer — a closed fd number can be reused by any
+            # later open(), and a stale cached fd would write(2) op
+            # records into that unrelated file.  Detaching resets the
+            # Bitmap's fd cache (op_writer setter).
+            self.storage.op_writer = None
             self._wal.close()
             self._wal = None
         with self._mu:
@@ -328,6 +335,7 @@ class Fragment:
         # ops on crash).
         self._wal = open(self.path, "ab", buffering=0)
         self.storage.op_writer = self._wal
+        self._opn_trigger = 0  # storage swap: recompute on next op
 
     @property
     def cache_path(self) -> str:
@@ -513,8 +521,19 @@ class Fragment:
             self.cache.add(row_id, rc)
 
     def _increment_opn(self) -> None:
-        if self.storage.op_n >= self._effective_max_opn():
+        # One comparison on the hot path: the full trigger computation
+        # (env cache + container count scaling) runs only when op_n
+        # crosses the cached value.  The cache may lag the true trigger
+        # (container churn between crossings); the recompute at crossing
+        # time makes the final snapshot decision, so the deviation is
+        # only WHEN the check happens, never whether.
+        if self.storage.op_n < self._opn_trigger:
+            return
+        t = self._effective_max_opn()
+        if self.storage.op_n >= t:
             self.snapshot()
+            t = self._effective_max_opn()
+        self._opn_trigger = t
 
     def _effective_max_opn(self) -> int:
         """Snapshot trigger, scaled with fragment size for DEFAULT-tuned

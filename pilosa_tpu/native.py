@@ -82,6 +82,11 @@ def load() -> Optional[ctypes.CDLL]:
         # the SetBit hot path (data_as() allocates a pointer object).
         lib.pn_array_insert_u32.restype = ctypes.c_int64
         lib.pn_array_insert_u32.argtypes = [ctypes.c_void_p, ctypes.c_int64, ctypes.c_uint32]
+        lib.pn_array_add_logged.restype = ctypes.c_int64
+        lib.pn_array_add_logged.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_uint32,
+            ctypes.c_uint64, ctypes.c_int32,
+        ]
         lib.pn_gram_counts.restype = ctypes.c_int64
         lib.pn_gram_counts.argtypes = [
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,

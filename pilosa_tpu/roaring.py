@@ -192,6 +192,20 @@ class Container:
             bm = self.bitmap = bm.copy()
         return bm
 
+    def _ensure_slack(self, n: int) -> np.ndarray:
+        """The capacity-slack insert buffer, (re)built so capacity > n.
+
+        Invariant shared by every native insert path: ``array`` is
+        ``_buf[:n]`` and ``_buf_addr`` caches the buffer's base address.
+        """
+        buf = self._buf
+        if buf is None or n >= len(buf):
+            buf = np.empty(max(8, 2 * n), dtype=np.uint32)
+            buf[:n] = self.array
+            self._buf = buf
+            self._buf_addr = buf.ctypes.data
+        return buf
+
     def add(self, v: int) -> bool:
         """Insert lowbits value; True if it was newly added."""
         arr = self.array
@@ -203,13 +217,7 @@ class Container:
                     # Native in-place insert over a capacity-slack buffer:
                     # one C call does the binary search, duplicate check,
                     # and memmove — no per-op numpy dispatch or allocation.
-                    buf = self._buf
-                    if buf is None or n >= len(buf):
-                        cap = max(8, 2 * n)
-                        buf = np.empty(cap, dtype=np.uint32)
-                        buf[:n] = arr
-                        self._buf = buf
-                        self._buf_addr = buf.ctypes.data
+                    buf = self._ensure_slack(n)
                     newn = lib.pn_array_insert_u32(self._buf_addr, n, v)
                     if newn < 0:
                         return False
@@ -376,7 +384,11 @@ class Bitmap:
 
     def __init__(self, values: Optional[Iterable[int]] = None):
         self.containers: dict[int, Container] = {}
-        self.op_writer = None  # file-like; WAL hook
+        self._op_writer = None  # file-like; WAL hook
+        # Raw fd of the WAL writer for the fused native add (insert + WAL
+        # record + write(2) in one C call): >= 0 usable, -1 unresolved,
+        # -2 writer has no fileno (BytesIO tests — python write path).
+        self._op_fd = -1
         self.op_n = 0
         # C++ incremental-snapshot mirror (see write_to): handle into the
         # native encoder + the container keys mutated since the last sync.
@@ -387,10 +399,76 @@ class Bitmap:
         if values is not None:
             self.add_many(np.fromiter(values, dtype=np.uint64))
 
+    @property
+    def op_writer(self):
+        return self._op_writer
+
+    @op_writer.setter
+    def op_writer(self, w) -> None:
+        self._op_writer = w
+        self._op_fd = -1  # re-resolve on next fused add
+
+    def _wal_fd(self) -> int:
+        """fd of the WAL writer, or -2 when the fused C write(2) path may
+        not use it.  Only UNBUFFERED raw writers qualify: a buffered
+        writer's fileno() is real, but bypassing its userspace buffer
+        would let a fused ADD hit disk ahead of an unflushed earlier
+        record — out-of-order replay after a crash."""
+        fd = self._op_fd
+        if fd == -1:
+            w = self._op_writer
+            if isinstance(w, io.RawIOBase):
+                try:
+                    fd = w.fileno()
+                except (OSError, ValueError):
+                    fd = -2
+            else:
+                fd = -2
+            self._op_fd = fd
+        return fd
+
     # -- mutation -----------------------------------------------------
 
     def add(self, v: int) -> bool:
         v = int(v)
+        # Fused native lane (the reference's compiled SetBit chain,
+        # fragment.go:371-459): container search + duplicate check +
+        # memmove insert + WAL record + write(2) in ONE ctypes call.
+        # Declines to the general path on any structural case: new or
+        # bitmap container, no capacity slack, array at the conversion
+        # threshold, or a WAL writer without a real fd.
+        key = v >> 16
+        c = self.containers.get(key)
+        if c is None or (c.array is not None and len(c.array) < ARRAY_MAX_SIZE):
+            lib = native.load()
+            if lib is not None:
+                if self._op_writer is None:
+                    fd = -1
+                else:
+                    fd = self._wal_fd()
+                if fd != -2:
+                    if c is None:  # first touch: container + slack buffer
+                        c = Container()
+                        self.containers[key] = c
+                        n = 0
+                    else:
+                        n = len(c.array)
+                    buf = c._ensure_slack(n)
+                    r = lib.pn_array_add_logged(c._buf_addr, n, v & 0xFFFF, v, fd)
+                    if r == -2:
+                        return False
+                    if r == -3:
+                        if n == 0:  # don't leave an empty first-touch shell
+                            del self.containers[key]
+                        raise OSError("WAL write failed")
+                    c._ser = None
+                    c.array = buf[:r]
+                    d = self._snap_dirty
+                    if d is not None:
+                        d.add(key)
+                    if fd >= 0:
+                        self.op_n += 1
+                    return True
         changed = self._container_for(v).add(lowbits(v))
         if changed:
             d = self._snap_dirty

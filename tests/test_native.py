@@ -1,6 +1,8 @@
 """C++ native kernel tests: native results must equal the Python fallbacks
 (the asm-vs-Go equivalence idiom, roaring/assembly_test.go analog)."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -185,3 +187,73 @@ def test_gram_counts_native():
     r1_bad = r1.copy()
     r1_bad[5] = 999
     assert native.gram_counts(op_ids, r1_bad, r2, rows_sorted, pos, gram) is None
+
+
+def test_array_add_logged(tmp_path):
+    """Fused singleton add: insert + WAL record + write(2) in one call;
+    the record bytes must match encode_op exactly (replay compatible)."""
+    lib = native.load()
+    wal = tmp_path / "wal"
+    fd = os.open(str(wal), os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+    buf = np.zeros(8, dtype=np.uint32)
+    addr = buf.ctypes.data
+    # Insert 3 values (one duplicate) with WAL.
+    assert lib.pn_array_add_logged(addr, 0, 7, (5 << 16) | 7, fd) == 1
+    assert lib.pn_array_add_logged(addr, 1, 3, (5 << 16) | 3, fd) == 2
+    assert lib.pn_array_add_logged(addr, 2, 7, (5 << 16) | 7, fd) == -2  # dup
+    assert buf[:2].tolist() == [3, 7]
+    os.close(fd)
+    want = encode_op(OP_ADD, (5 << 16) | 7) + encode_op(OP_ADD, (5 << 16) | 3)
+    assert wal.read_bytes() == want
+    # fd = -1: mutation without WAL (unlogged callers).
+    assert lib.pn_array_add_logged(addr, 2, 1, 1, -1) == 3
+    assert buf[:3].tolist() == [1, 3, 7]
+    # Bad fd: declined atomically — no insert, no partial record.
+    assert lib.pn_array_add_logged(addr, 3, 9, 9, 12345) == -3
+    assert buf[:3].tolist() == [1, 3, 7]
+
+
+def test_bitmap_add_fused_lane_matches_slow_path(tmp_path):
+    """Bitmap.add through the fused lane equals the PILOSA_TPU_NO_NATIVE
+    slow path: same container contents, same WAL bytes, same op_n."""
+    from pilosa_tpu import roaring
+
+    rng = np.random.default_rng(11)
+    vals = rng.integers(0, 1 << 22, size=400).tolist()
+
+    def run(native_on: bool):
+        bm = roaring.Bitmap()
+        path = tmp_path / ("fast" if native_on else "slow")
+        w = open(path, "ab", buffering=0)
+        bm.op_writer = w
+        if not native_on:
+            bm._op_fd = -2  # force the python slow path
+        changed = [bm.add(v) for v in vals]
+        w.close()
+        return changed, sorted(bm.to_array().tolist()), bm.op_n, path.read_bytes()
+
+    c1, v1, n1, wal1 = run(True)
+    c2, v2, n2, wal2 = run(False)
+    assert c1 == c2
+    assert v1 == v2
+    assert n1 == n2
+    assert wal1 == wal2
+
+
+def test_fused_lane_declines_buffered_writers(tmp_path):
+    """A BUFFERED op_writer must keep every record in the Python write
+    path: mixing the fused lane's raw write(2) with unflushed buffered
+    records would reorder the WAL (replay corruption)."""
+    from pilosa_tpu import roaring
+
+    bm = roaring.Bitmap()
+    path = tmp_path / "wal"
+    w = open(path, "wb")  # buffered
+    bm.op_writer = w
+    assert bm.add(5)
+    assert bm.remove(5)
+    assert bm.add(5)
+    w.close()
+    recs = path.read_bytes()
+    assert len(recs) == 39  # 3 records, in operation order
+    assert [recs[i] for i in (0, 13, 26)] == [roaring.OP_ADD, roaring.OP_REMOVE, roaring.OP_ADD]
