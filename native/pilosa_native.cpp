@@ -695,6 +695,59 @@ int64_t pn_pql_match_pairs(const char* src, int64_t len,
 
 extern "C" {
 
+// ---------------------------------------------------------------------------
+// One-call serving lane (server.go:150 + executor.go:1209-1244 analog):
+// parse + validate + Gram-evaluate an entire batched pair-count request
+// in a single GIL-released crossing.  The Python side caches the serve
+// state (expected frame/row-label bytes, the sorted row-id table and
+// Gram snapshot) and revalidates it per request with generation checks;
+// THIS call does everything else.  Returns the call count with counts
+// in out[], or PN_PQL_FALLBACK for anything outside the exact shape
+// (other frames, wrong row-key label, unknown rows, parse mismatch) —
+// the caller then runs the general path, which also refreshes the
+// cached state.
+// ---------------------------------------------------------------------------
+
+int64_t pn_serve_pairs(const char* src, int64_t len,
+                       const char* frame, int64_t flen, int64_t allow_default,
+                       const char* rowkey, int64_t klen,
+                       const int64_t* rows_sorted, const int32_t* pos,
+                       int64_t n_rows, const int64_t* gram, int64_t gram_dim,
+                       int64_t* out, int64_t cap) {
+    enum { MAXC = 4096, TAB = 16 };
+    static thread_local uint8_t op_ids[MAXC];
+    static thread_local int32_t frame_ids[MAXC], key_ids[MAXC];
+    static thread_local int64_t r1[MAXC], r2[MAXC];
+    int32_t uf_s[TAB], uf_e[TAB], uk_s[TAB], uk_e[TAB];
+    int32_t n_frames = 0, n_keys = 0;
+    int64_t n = pn_pql_match_pairs(src, len, op_ids, frame_ids, key_ids, r1, r2,
+                                   cap < MAXC ? cap : MAXC,
+                                   uf_s, uf_e, &n_frames, uk_s, uk_e, &n_keys,
+                                   TAB);
+    if (n < 0) return PN_PQL_FALLBACK;
+    // Every frame reference must be the cached frame (an absent frame=
+    // arg is the default frame, allowed only when the cached frame IS
+    // the default); every row-key label must be the frame's row label.
+    for (int32_t t = 0; t < n_frames; t++) {
+        int32_t l = uf_e[t] - uf_s[t];
+        if (l != flen || memcmp(src + uf_s[t], frame, (size_t)l) != 0)
+            return PN_PQL_FALLBACK;
+    }
+    for (int32_t t = 0; t < n_keys; t++) {
+        int32_t l = uk_e[t] - uk_s[t];
+        if (l != klen || memcmp(src + uk_s[t], rowkey, (size_t)l) != 0)
+            return PN_PQL_FALLBACK;
+    }
+    if (!allow_default) {
+        for (int64_t i = 0; i < n; i++)
+            if (frame_ids[i] < 0) return PN_PQL_FALLBACK;
+    }
+    if (pn_gram_counts(op_ids, r1, r2, n, rows_sorted, pos, n_rows, gram,
+                       gram_dim, out) != 0)
+        return PN_PQL_FALLBACK;
+    return n;
+}
+
 // Returns the number of calls parsed (preorder), or PN_PQL_FALLBACK when
 // the source needs the full Python parser.  n_args_out gets the total
 // arg-slot count on success.

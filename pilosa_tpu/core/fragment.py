@@ -249,15 +249,17 @@ class Fragment:
         return (data if data else None), None
 
     def close(self) -> None:
-        if self._wal is not None:
-            # Detach BEFORE closing: the fused native add caches the raw
-            # fd from op_writer — a closed fd number can be reused by any
-            # later open(), and a stale cached fd would write(2) op
-            # records into that unrelated file.  Detaching resets the
-            # Bitmap's fd cache (op_writer setter).
-            self.storage.op_writer = None
-            self._wal.close()
-            self._wal = None
+        with self._mu:
+            if self._wal is not None:
+                # Detach + close UNDER the write lock: the fused native
+                # add caches the raw fd from op_writer and write(2)s to
+                # it with the GIL released — closing outside _mu could
+                # free the fd (reusable by any later open()) while an
+                # in-flight add still writes to it.  Detaching first
+                # also resets the Bitmap's fd cache (op_writer setter).
+                self.storage.op_writer = None
+                self._wal.close()
+                self._wal = None
         with self._mu:
             self._flush_row_bookkeeping()
             # Flip _open UNDER the lock, before any storage swap below:

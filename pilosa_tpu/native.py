@@ -93,6 +93,15 @@ def load() -> Optional[ctypes.CDLL]:
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
             ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
         ]
+        lib.pn_serve_pairs.restype = ctypes.c_int64
+        lib.pn_serve_pairs.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64,
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_char_p, ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_int64,
+        ]
         lib.pn_oplog_decode.restype = ctypes.c_int64
         lib.pn_oplog_decode.argtypes = [u8p, ctypes.c_size_t, u8p, u64p]
         lib.pn_parse_csv.restype = ctypes.c_int64
@@ -472,6 +481,32 @@ def gram_counts(op_ids, r1, r2, rows_sorted, pos, gram):
     if rc != 0:
         return None
     return out
+
+
+def serve_pairs(raw, frame_b, allow_default, rowkey_b, rows_sorted, pos, gram):
+    """One-call serving lane: parse + validate + Gram-evaluate a whole
+    batched pair-count request in a single GIL-released native call
+    (the executor's cached-state steady-state loop; server.go:150 +
+    executor.go:1209-1244 analog).
+
+    raw: utf-8 request bytes; frame_b/rowkey_b: expected frame name and
+    row-key label bytes; allow_default: the frame may be referenced
+    implicitly (it IS the index default).  Table args as gram_counts.
+    Returns i64[N] counts or None (caller runs the general path).
+    """
+    lib = load()
+    if lib is None:
+        return None
+    out = np.empty(4096, dtype=np.int64)
+    n = lib.pn_serve_pairs(
+        raw, len(raw), frame_b, len(frame_b), 1 if allow_default else 0,
+        rowkey_b, len(rowkey_b),
+        rows_sorted.ctypes.data, pos.ctypes.data, len(rows_sorted),
+        gram.ctypes.data, gram.shape[0], out.ctypes.data, len(out),
+    )
+    if n < 0:
+        return None
+    return out[:n]
 
 
 def fnv1a64(data: bytes) -> int:

@@ -248,6 +248,13 @@ class DeviceRowPool:
                 self.lru.move_to_end(r)
             if changed:
                 self.box = self._new_box()
+            # The generations THIS box's matrix content was validated
+            # against: consumers deriving cached state from the box (the
+            # executor's serve-state capture) must use these as validity
+            # tokens, not generations re-read later — a write landing
+            # between acquire and capture would otherwise stamp post-
+            # write tokens onto pre-write data (permanent stale serves).
+            self.box["gens"] = gens
             self.box["hits"] += 1
             return self.box["id_pos"], self.matrix, self.box
 
