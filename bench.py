@@ -882,10 +882,16 @@ def bench_intersect_4krows() -> dict:
     rows hits.  Uses the row-major pipelined kernel (one contiguous DMA
     descriptor per operand covering every slice): on v5e the DMA engine
     processes descriptors serially at ~1 us each, so achievable bandwidth
-    is descriptor-size-bound — 512 KB rows (4 slices) reach ~40% of
-    roofline, 2 MB rows (16 slices) ~76% (BASELINE.md round-3 note).
-    Reports HBM bandwidth utilization vs the v5e roofline (true traffic:
-    two operand rows per query)."""
+    is descriptor-size-bound.  Round-5 ceiling measurement at 4 slices:
+    2 descriptors/query (the gather minimum — operand rows are random,
+    so no descriptor can carry more than one row) x the measured
+    ~1.3 us issue rate = 2.6 us/query = util ~0.49-0.51, which this
+    kernel hits exactly; deeper pipelines (depth 4/8) and multi-query
+    grid steps both measured SLOWER (VMEM pressure; issue stays serial).
+    Past this rung the lane needs bigger rows, not more overlap: 16
+    slices (2 MB descriptors) measures 0.64-0.76.  Reports HBM bandwidth
+    utilization vs the v5e roofline (true traffic: two operand rows per
+    query)."""
     n_slices = int(os.environ.get("BENCH_SLICES", "4"))
     n_rows = int(os.environ.get("BENCH_ROWS", "4096"))
     batch = int(os.environ.get("BENCH_BATCH", "256"))
