@@ -2048,11 +2048,41 @@ class Executor:
         n_dev = getattr(getattr(self.engine, "mesh", None), "n_devices", 1)
         if len(all_slices) % n_dev:
             return lambda si, src_dense: None
-        src_stack = np.stack(
-            [np.asarray(src_batch[i]) for i in range(len(all_slices))]
-        )
-        src_dev = self.engine.prepare_topn_src(src_stack)  # one upload per query
-        memo: dict = {}
+        # Single-slice dispatches are legal whenever every shard is
+        # process-addressable (single-chip jax engines, single-process
+        # meshes); multi-process meshes must always go through the SPMD
+        # all-slice dispatch.
+        single_ok = bool(getattr(self.engine, "supports_single_slice_score", True))
+        state: dict = {"src_dev": None, "src_si": {}}
+
+        def all_src_dev():
+            if state["src_dev"] is None:
+                src_stack = np.stack(
+                    [np.asarray(src_batch[i]) for i in range(len(all_slices))]
+                )
+                state["src_dev"] = self.engine.prepare_topn_src(src_stack)
+            return state["src_dev"]
+
+        memo: dict = {}  # ids -> int[S, K] all-slice counts
+        seen: dict = {}  # ids -> first slice position that scored them
+
+        def acquire_pos(ids):
+            frags = [
+                self.holder.fragment(index, frame_name, VIEW_STANDARD, s)
+                for s in all_slices
+            ]
+            gens = tuple(-1 if f is None else f.generation for f in frags)
+            id_pos, matrix, _ = pool.acquire(sorted(set(ids)), gens)
+            n = len(ids)
+            padded = (
+                list(ids) + [ids[0]] * (TOPN_SCORE_CHUNK - n)
+                if n < TOPN_SCORE_CHUNK
+                else list(ids)
+            )
+            pos = np.fromiter(
+                (id_pos[i] for i in padded), dtype=np.int32, count=len(padded)
+            )
+            return matrix, pos
 
         def scorer_for(si: int, src_dense):
             if src_dense is None:
@@ -2061,25 +2091,30 @@ class Executor:
             def score(ids):
                 key = tuple(ids)
                 counts = memo.get(key)
-                if counts is None:
-                    frags = [
-                        self.holder.fragment(index, frame_name, VIEW_STANDARD, s)
-                        for s in all_slices
-                    ]
-                    gens = tuple(-1 if f is None else f.generation for f in frags)
-                    id_pos, matrix, _ = pool.acquire(sorted(set(ids)), gens)
-                    n = len(ids)
-                    padded = (
-                        list(ids) + [ids[0]] * (TOPN_SCORE_CHUNK - n)
-                        if n < TOPN_SCORE_CHUNK
-                        else list(ids)
+                if counts is not None:
+                    return counts[si, : len(ids)]
+                if single_ok and seen.setdefault(key, si) == si:
+                    # First sight of this candidate set (phase 1: each
+                    # fragment scores its OWN rank-cache candidates):
+                    # dispatch just this slice — the all-slice launch
+                    # would do S x the compute for one consumed row.
+                    matrix, pos = acquire_pos(ids)
+                    tile = getattr(self.engine, "tile_src", self.engine.asarray)
+                    src_dev = state["src_si"].get(si)
+                    if src_dev is None:
+                        src_dev = state["src_si"][si] = tile(src_dense)
+                    rows = matrix[si][pos]
+                    c = self.engine.batch_intersection_count(
+                        rows, src_dev, tiled=getattr(matrix, "ndim", 3) == 4
                     )
-                    pos = np.fromiter(
-                        (id_pos[i] for i in padded), dtype=np.int32, count=len(padded)
-                    )
-                    counts = memo[key] = self.engine.topn_scorer_counts(
-                        matrix, pos, src_dev
-                    )
+                    return c[: len(ids)]
+                # A SECOND slice asking for the same ids (phase 2's
+                # merged-id refetch re-queries every slice): one
+                # all-slice dispatch, memoized for the rest.
+                matrix, pos = acquire_pos(ids)
+                counts = memo[key] = self.engine.topn_scorer_counts(
+                    matrix, pos, all_src_dev()
+                )
                 return counts[si, : len(ids)]
 
             return score

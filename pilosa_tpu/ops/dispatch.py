@@ -311,6 +311,52 @@ def gather_count_tree(row_matrix, leaves, opc):
     return bitwise.gather_count_tree(rm, leaves, opc)
 
 
+def topn_scorer_counts(row_matrix, pos, src_stack):
+    """Per-(slice, candidate) intersection counts |rm[s, pos[k]] & src[s]|
+    in one dispatch (int32[S, K]) — TopN candidate scoring across every
+    slice at once.  Pallas on TPU; jnp per-slice fallback elsewhere (the
+    fallback's whole-gather transient is bounded by looping slices)."""
+    from pilosa_tpu.ops.pallas_kernels import fused_gather_src_counts
+    from pilosa_tpu.pilosa import OR_MULTI_BUDGET_DEVICE
+
+    n_slices, _, w = _rm_dims(row_matrix)
+    if use_pallas() and _tileable(w):
+        k = pos.shape[0]
+        # The kernel's HBM partial-tile output is k * S * 4096 bytes
+        # (summed on the XLA side), so the per-dispatch candidate chunk
+        # must shrink with the slice count — a fixed k-chunk at
+        # thousand-slice shapes would materialize a multi-GB transient
+        # (the round-2 OOM class).
+        chunk = max(1, min(
+            _GATHER_BATCH_MAX,
+            OR_MULTI_BUDGET_DEVICE // max(1, n_slices * 8 * 128 * 4),
+        ))
+        if k > chunk:
+            return jnp.concatenate(
+                [
+                    fused_gather_src_counts(
+                        row_matrix, pos[i : i + chunk], src_stack
+                    )
+                    for i in range(0, k, chunk)
+                ],
+                axis=1,
+            )
+        return fused_gather_src_counts(row_matrix, pos, src_stack)
+    rm = _rm3(row_matrix)
+    if src_stack.ndim == 3:
+        src_stack = src_stack.reshape(n_slices, -1)
+    outs = [
+        jnp.sum(
+            jax.lax.population_count(
+                jnp.take(rm[s], pos, axis=0) & src_stack[s][None]
+            ).astype(jnp.int32),
+            axis=-1,
+        )
+        for s in range(n_slices)
+    ]
+    return jnp.stack(outs)
+
+
 def batch_intersection_count(rows, src, tiled: bool = False):
     """|rows[k] & src| for a stack of rows — TopN's exact-count hot loop.
 

@@ -335,6 +335,45 @@ def fused_topn_counts(row_matrix, src, interpret: bool = False):
     return out.sum(axis=(1, 2))
 
 
+def _gather_src_counts_kernel(pos_ref, row_ref, src_ref, out_ref):
+    out_ref[0, 0] = _partial_tile((row_ref[0, 0] & src_ref[0])[None])
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def fused_gather_src_counts(row_matrix, pos, src_stack, interpret: bool = False):
+    """Per-(slice, candidate) ``|rm[s, pos[k]] & src[s]|`` in ONE launch —
+    TopN's candidate scoring across every slice at once
+    (fragment.go:493-625's Src.IntersectionCount phase, cross-slice
+    fused; the per-(slice, chunk) dispatch this replaces paid one tunnel
+    round trip per slice).
+
+    row_matrix: uint32[S, R, W] (or tiled 4D); pos: int32[K] candidate
+    row slots; src_stack: uint32[S, W] (or tiled [S, W/128, 128]).
+    Returns int32[S, K].
+    """
+    rm4 = _rm4(row_matrix)
+    n_slices, n_rows, sub = rm4.shape[:3]
+    if src_stack.ndim == 2:
+        src_stack = src_stack.reshape(n_slices, sub, _LANES)
+    k = pos.shape[0]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(k, n_slices),
+        in_specs=[
+            pl.BlockSpec((1, 1, sub, _LANES), lambda q, s, pr: (s, pr[q], 0, 0)),
+            pl.BlockSpec((1, sub, _LANES), lambda q, s, pr: (s, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, 8, _LANES), lambda q, s, pr: (q, s, 0, 0)),
+    )
+    out = pl.pallas_call(
+        _gather_src_counts_kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((k, n_slices, 8, _LANES), jnp.int32),
+        interpret=interpret,
+    )(pos, rm4, src_stack)
+    return out.sum(axis=(2, 3)).T  # [S, K]
+
+
 def _gather_rowmajor_kernel(op, depth, pairs_ref, rm_ref, out_ref, buf, sems):
     q = pl.program_id(0)
     n_q = pl.num_programs(0)

@@ -490,3 +490,27 @@ def test_numpy_engine_tree_matches_ground_truth(rng):
     leaves, opc = _rand_tree_arrays(rng, R, B, D)
     got = NumpyEngine().gather_count_tree(rm, leaves, opc)
     assert got.tolist() == bw.np_gather_count_tree(rm, leaves, opc).tolist()
+
+
+def test_fused_gather_src_counts_interpret(rng):
+    """All-slice TopN scorer kernel vs numpy ground truth."""
+    from pilosa_tpu.ops.pallas_kernels import fused_gather_src_counts
+
+    S, R, K = 3, 10, 7
+    rm = rng.integers(0, 1 << 32, size=(S, R, W), dtype=np.uint32)
+    src = rng.integers(0, 1 << 32, size=(S, W), dtype=np.uint32)
+    pos = rng.integers(0, R, size=(K,), dtype=np.int32)
+    got = np.asarray(
+        fused_gather_src_counts(
+            jnp.asarray(rm), jnp.asarray(pos), jnp.asarray(src), interpret=True
+        )
+    )
+    want = np.stack([
+        np.array([bw.np_count(rm[s, p] & src[s]) for p in pos]) for s in range(S)
+    ])
+    assert np.array_equal(got, want)
+    # dispatch fallback parity (jnp path on CPU)
+    got_d = np.asarray(
+        dispatch.topn_scorer_counts(jnp.asarray(rm), jnp.asarray(pos), jnp.asarray(src))
+    )
+    assert np.array_equal(got_d, want)
