@@ -559,12 +559,12 @@ class MeshEngine(JaxEngine):
 
     @property
     def row_scorer_all_slices(self) -> bool:
-        """Whether TopN candidate scoring must go through the all-slice
-        sharded dispatch (multi-process: per-slice eager indexing would
-        touch non-addressable shards)."""
-        import jax
-
-        return jax.process_count() > 1
+        """Meshes always route through the hybrid scorer factory; the
+        single-vs-all-slice dispatch decision lives there, gated by
+        supports_single_slice_score (multi-process meshes must stay
+        SPMD — eager matrix[si] indexing would touch non-addressable
+        shards)."""
+        return True
 
     @property
     def supports_single_slice_score(self) -> bool:

@@ -2003,20 +2003,8 @@ class Executor:
                 return None
 
             def score(ids):
-                frags = [
-                    self.holder.fragment(index, frame_name, VIEW_STANDARD, s)
-                    for s in all_slices
-                ]
-                gens = tuple(-1 if f is None else f.generation for f in frags)
-                id_pos, matrix, _ = pool.acquire(sorted(set(ids)), gens)
-                n = len(ids)
-                padded = (
-                    list(ids) + [ids[0]] * (TOPN_SCORE_CHUNK - n)
-                    if n < TOPN_SCORE_CHUNK
-                    else list(ids)
-                )
-                pos = np.fromiter(
-                    (id_pos[i] for i in padded), dtype=np.int64, count=len(padded)
+                matrix, pos = self._topn_acquire_pos(
+                    index, frame_name, all_slices, pool, ids
                 )
                 src_dev = state["src_dev"].get(si)
                 if src_dev is None:
@@ -2027,24 +2015,47 @@ class Executor:
                 counts = self.engine.batch_intersection_count(
                     rows, src_dev, tiled=getattr(matrix, "ndim", 3) == 4
                 )
-                return counts[:n]
+                return counts[: len(ids)]
 
             return score
 
         return scorer_for
 
+    def _topn_acquire_pos(self, index, frame_name, all_slices, pool, ids):
+        """Shared scorer helper: page the candidate rows into the pool
+        and map ids to matrix slots, padded to TOPN_SCORE_CHUNK so the
+        jitted scorer shapes never vary (pad scores are discarded)."""
+        from pilosa_tpu.core.fragment import TOPN_SCORE_CHUNK
+
+        frags = [
+            self.holder.fragment(index, frame_name, VIEW_STANDARD, s)
+            for s in all_slices
+        ]
+        gens = tuple(-1 if f is None else f.generation for f in frags)
+        id_pos, matrix, _ = pool.acquire(sorted(set(ids)), gens)
+        n = len(ids)
+        padded = (
+            list(ids) + [ids[0]] * (TOPN_SCORE_CHUNK - n)
+            if n < TOPN_SCORE_CHUNK
+            else list(ids)
+        )
+        pos = np.fromiter(
+            (id_pos[i] for i in padded), dtype=np.int32, count=len(padded)
+        )
+        return matrix, pos
+
     def _topn_scorer_factory_all_slices(
         self, index, frame_name, all_slices, src_batch, pool
     ):
-        """Multi-process mesh scorer: ONE shard_map'd SPMD dispatch scores
-        a candidate chunk against EVERY slice (engine.topn_scorer_counts:
-        local gather per shard + allgathered [S, K] result), memoized per
-        candidate set so the per-fragment loop reuses it.  Eagerly
-        indexing ``matrix[si]`` (the single-process scorer) would touch
-        shards owned by other processes.  Falls back to the host loop for
-        slice counts the mesh can't shard evenly."""
-        from pilosa_tpu.core.fragment import TOPN_SCORE_CHUNK
-
+        """Hybrid memoizing scorer (round 5): phase-1 candidate chunks
+        (each fragment's own rank-cache candidates, one consuming slice)
+        dispatch just their slice; a candidate set re-asked by a SECOND
+        slice (phase 2's merged-id refetch across every slice) upgrades
+        to ONE all-slice launch (engine.topn_scorer_counts) memoized for
+        the rest.  Multi-process meshes always use the SPMD all-slice
+        dispatch (eager ``matrix[si]`` indexing would touch shards owned
+        by other processes).  Falls back to the host loop for slice
+        counts a mesh can't shard evenly."""
         n_dev = getattr(getattr(self.engine, "mesh", None), "n_devices", 1)
         if len(all_slices) % n_dev:
             return lambda si, src_dense: None
@@ -2067,22 +2078,7 @@ class Executor:
         seen: dict = {}  # ids -> first slice position that scored them
 
         def acquire_pos(ids):
-            frags = [
-                self.holder.fragment(index, frame_name, VIEW_STANDARD, s)
-                for s in all_slices
-            ]
-            gens = tuple(-1 if f is None else f.generation for f in frags)
-            id_pos, matrix, _ = pool.acquire(sorted(set(ids)), gens)
-            n = len(ids)
-            padded = (
-                list(ids) + [ids[0]] * (TOPN_SCORE_CHUNK - n)
-                if n < TOPN_SCORE_CHUNK
-                else list(ids)
-            )
-            pos = np.fromiter(
-                (id_pos[i] for i in padded), dtype=np.int32, count=len(padded)
-            )
-            return matrix, pos
+            return self._topn_acquire_pos(index, frame_name, all_slices, pool, ids)
 
         def scorer_for(si: int, src_dense):
             if src_dense is None:

@@ -332,15 +332,21 @@ def topn_scorer_counts(row_matrix, pos, src_stack):
             OR_MULTI_BUDGET_DEVICE // max(1, n_slices * 8 * 128 * 4),
         ))
         if k > chunk:
-            return jnp.concatenate(
+            # Pad the ragged tail to the chunk size (pad scores are
+            # sliced off) so every dispatch shares ONE jitted shape.
+            if k % chunk:
+                pad = chunk - (k % chunk)
+                pos = jnp.concatenate([pos, jnp.broadcast_to(pos[:1], (pad,))])
+            out = jnp.concatenate(
                 [
                     fused_gather_src_counts(
                         row_matrix, pos[i : i + chunk], src_stack
                     )
-                    for i in range(0, k, chunk)
+                    for i in range(0, pos.shape[0], chunk)
                 ],
                 axis=1,
             )
+            return out[:, :k]
         return fused_gather_src_counts(row_matrix, pos, src_stack)
     rm = _rm3(row_matrix)
     if src_stack.ndim == 3:

@@ -79,9 +79,65 @@ def main() -> int:
             "g",
             'Count(Range(rowID=0, frame="f", start="2017-01-01T00:00", end="2018-01-01T00:00"))',
         )
+
+        # Collective ReplicaMesh probe over the GLOBAL job mesh: with 4
+        # ranks x 2 local devices this is the (4, 2) slice x replica
+        # layout (cluster.go:220-240's ReplicaN, TPU-first) — the batch
+        # splits over the replica axis, each group psums over its slice
+        # shards, and the counts must equal every rank's LOCAL numpy
+        # ground truth (i.e. the replicated holders really converged).
+        replica_probe = -1
+        import jax
+
+        n_dev = jax.device_count()
+        if n_dev >= 4 and n_dev % 2 == 0 and n_slices % (n_dev // 2) == 0:
+            import numpy as np
+
+            from pilosa_tpu.parallel import ReplicaMesh, replica_gather_count
+
+            frags = [
+                h.fragment("g", "f", "standard", s) for s in range(n_slices)
+            ]
+            mat = np.stack(
+                [
+                    np.stack([f.row_dense(r) for r in range(4)])
+                    for f in frags
+                ]
+            )
+            rmesh = ReplicaMesh(n_replicas=2)
+            pairs = np.array(
+                [[a, b] for a in range(4) for b in range(2)], dtype=np.int32
+            )
+            out = replica_gather_count(
+                rmesh, "and", rmesh.shard_stack(mat), jax.numpy.asarray(pairs),
+                interpret=jax.default_backend() != "tpu",
+            )
+            if not getattr(out, "is_fully_addressable", True):
+                from jax.experimental import multihost_utils
+
+                got = np.asarray(multihost_utils.process_allgather(out, tiled=True))
+            else:
+                got = np.asarray(out)
+            from pilosa_tpu.ops.bitwise import np_popcount
+
+            want = [
+                int(np_popcount(mat[:, a] & mat[:, b]).sum()) for a, b in pairs
+            ]
+            assert got.tolist() == want, f"replica probe mismatch: {got} != {want}"
+            replica_probe = int(got.sum())
         h.close()
 
-    print(json.dumps({"pid": pid, "probe": int(probe), "range_probe": int(rprobe)}), flush=True)
+    print(
+        json.dumps(
+            {
+                "pid": pid,
+                "probe": int(probe),
+                "range_probe": int(rprobe),
+                "replica_probe": replica_probe,
+            }
+        ),
+        flush=True,
+    )
     return 0
 
 

@@ -314,3 +314,64 @@ def test_lockstep_pipelined_concurrent_clients():
         assert outs[0]["probe"] == outs[1]["probe"] == after
     finally:
         svc.cleanup()
+
+
+def test_lockstep_four_ranks_replica_mesh():
+    """Four-rank lockstep job (8 global devices): reads and replicated
+    writes converge on every rank, and the post-run collective probe
+    runs a (4, 2) slice x replica ReplicaMesh computation over the
+    GLOBAL mesh whose counts must equal each rank's local ground truth
+    (cluster.go:220-240 ReplicaN analog at job scale)."""
+    job = _LockstepJob(4)
+    # Workers seed max(4, 2*nprocs) = 8 slices x 2 bits/row.
+    try:
+        job.wait_ready(timeout=240)
+        assert job.query('Count(Bitmap(rowID=0, frame="f"))')["results"] == [16]
+        assert job.query('SetBit(rowID=0, frame="f", columnID=444)')["results"] == [True]
+        assert job.query('Count(Bitmap(rowID=0, frame="f"))')["results"] == [17]
+        outs = job.shutdown_and_collect()
+    finally:
+        job.cleanup()
+    assert {o["probe"] for o in outs} == {17}  # all four ranks converged
+    # The (4,2) replica-mesh collective ran on every rank and agreed.
+    rp = {o["replica_probe"] for o in outs}
+    assert len(rp) == 1 and rp.pop() > 0
+
+
+def test_lockstep_worker_death_mid_stream():
+    """A worker rank SIGKILLed MID-REQUEST-STREAM: the in-flight or next
+    request errors, every subsequent request is refused (the service
+    cannot guarantee replica convergence anymore — fail-stop,
+    executor.go:1147-1159's failure handling at the lockstep layer), and
+    rank 0 itself stays alive and responsive to the refusal."""
+    import urllib.error
+
+    job = _LockstepJob(2)
+    try:
+        job.wait_ready()
+        q = 'Count(Bitmap(rowID=0, frame="f"))'
+        base = job.query(q)["results"][0]
+        assert base > 0
+        # Kill the worker rank mid-stream (CPU gloo job — no TPU grant
+        # to leak), then keep issuing requests until the degrade bites.
+        job.procs[1].kill()
+        failed = False
+        for _ in range(20):
+            try:
+                job.query(
+                    f'SetBit(rowID=0, frame="f", columnID={900 + _})', timeout=30
+                )
+            except (urllib.error.HTTPError, urllib.error.URLError, OSError):
+                failed = True
+                break
+        assert failed, "service kept acking writes after a replica died"
+        # Fail-stop: every subsequent request is refused.
+        for _ in range(3):
+            try:
+                job.query(q, timeout=30)
+                assert False, "degraded service answered a read"
+            except (urllib.error.HTTPError, urllib.error.URLError, OSError):
+                pass
+        assert job.procs[0].poll() is None, "rank 0 died with the worker"
+    finally:
+        job.cleanup()
