@@ -205,3 +205,70 @@ def test_random_nested_trees_through_fused_lane(tmp_path, seed):
             )
     assert fused_batches >= 4  # the lane actually exercised, not all-declines
     h.close()
+
+
+@pytest.mark.parametrize("seed", [21, 22])
+def test_serve_lane_interleaved_writes_fuzz(tmp_path, seed):
+    """Stateful fuzz for the single-call native serve lane: random
+    interleavings of singleton writes and flat Count batches through the
+    jax executor must match a numpy executor on the same holder at every
+    step (the serve state must invalidate on every write, never serve a
+    pre-write Gram)."""
+    rng = random.Random(seed)
+    nprng = np.random.default_rng(seed)
+    h = Holder(str(tmp_path / "data"))
+    h.open()
+    idx = h.create_index("d")
+    idx.create_frame("f", FrameOptions())
+    fr = idx.frame("f")
+    fr.import_bits(
+        nprng.integers(0, 16, size=300), nprng.integers(0, 2 * SLICE_WIDTH, size=300)
+    )
+    import os as _os
+
+    e_jx = Executor(h, engine="jax")
+    e_np = Executor(h, engine="numpy")
+
+    def oracle(q):
+        # The oracle must NOT share the native fast lanes with the code
+        # under test (the serve lane is engine-independent — the numpy
+        # executor would arm its own serve state and mask a staleness
+        # bug); NO_FASTLANE is read per request, so toggling it forces
+        # the full-parse sequential path for the oracle only.
+        _os.environ["PILOSA_TPU_NO_FASTLANE"] = "1"
+        try:
+            return e_np.execute("d", q)
+        finally:
+            del _os.environ["PILOSA_TPU_NO_FASTLANE"]
+
+    def batch():
+        ops = ["Intersect", "Union", "Xor", "Difference"]
+        return " ".join(
+            f'Count({rng.choice(ops)}(Bitmap(rowID={rng.randrange(16)}, frame="f"), '
+            f'Bitmap(rowID={rng.randrange(16)}, frame="f")))'
+            for _ in range(rng.randrange(2, 20))
+        )
+
+    wrote = False
+    served_after_write = 0
+    for step in range(60):
+        roll = rng.random()
+        if roll < 0.3:
+            q = (
+                f'SetBit(rowID={rng.randrange(16)}, frame="f", '
+                f'columnID={rng.randrange(2 * SLICE_WIDTH)})'
+            )
+            e_jx.execute("d", q)
+            # Write visibility: the oracle's re-issue must observe it.
+            assert oracle(q) == [False]
+            wrote = True
+        else:
+            q = batch()
+            got = e_jx.execute("d", q)
+            want = oracle(q)
+            assert got == want, f"step {step}: {q}"
+            if wrote and e_jx._serve_state is not None:
+                served_after_write += 1
+    # The lane re-armed and served AFTER invalidating writes.
+    assert served_after_write > 5
+    h.close()

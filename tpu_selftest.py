@@ -92,6 +92,35 @@ def main() -> int:
         fused_topn_counts(rm4, jnp.asarray(src.reshape(S, W // 128, 128))),
         bw.np_popcount(rm & src[:, None, :]).reshape(S, R, -1).sum(axis=(0, 2)))
 
+    # Round-5 kernels: perfect-tree fold + all-slice TopN candidate scorer.
+    from pilosa_tpu.ops.pallas_kernels import (
+        fused_gather_count_tree,
+        fused_gather_src_counts,
+    )
+
+    for D in (2, 3, 4):
+        Kt = 1 << D
+        leaves = rng.integers(0, R, size=(B, Kt), dtype=np.int32)
+        opc = rng.integers(0, 5, size=(B, Kt - 1), dtype=np.int32)
+        # Chunked reference: one-shot np gather at D=4 materializes
+        # ~2 GB (+ popcount temporaries) — chunk the batch instead.
+        want_t = np.concatenate([
+            bw.np_gather_count_tree(rm, leaves[i : i + 8], opc[i : i + 8])
+            for i in range(0, B, 8)
+        ])
+        chk(f"tree D={D}",
+            fused_gather_count_tree(rm4, jnp.asarray(leaves), jnp.asarray(opc)),
+            want_t)
+    cand = rng.integers(0, R, size=(17,), dtype=np.int32)
+    chk("gather_src_counts",
+        fused_gather_src_counts(
+            rm4, jnp.asarray(cand), jnp.asarray(src.reshape(S, W // 128, 128))
+        ),
+        np.stack([
+            np.array([int(bw.np_popcount(rm[s, p] & src[s]).sum()) for p in cand])
+            for s in range(S)
+        ]))
+
     g1 = np.asarray(bw.pair_gram(jnp.asarray(rm)))
     orig = bw.GRAM_ONESHOT_BYTES
     bw.GRAM_ONESHOT_BYTES = 1
