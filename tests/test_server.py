@@ -650,3 +650,74 @@ def test_pprof_proto_endpoints(srv):
     finally:
         stop.set()
         t.join(timeout=5)
+
+
+def test_serve_lane_through_http_server(tmp_path):
+    """The single-call native serve lane must engage through the REAL
+    threaded HTTP server: after the Gram warms, concurrent clients'
+    batched Count requests are answered by pn_serve_pairs (executor
+    serve state armed) with results identical to a cold numpy oracle."""
+    import os
+    from concurrent.futures import ThreadPoolExecutor
+
+    from pilosa_tpu import native
+    from pilosa_tpu.executor import Executor
+    from pilosa_tpu.server.server import Server
+
+    cfg = Config(data_dir=str(tmp_path / "d"), host="127.0.0.1:0", engine="jax")
+    s = Server(cfg)
+    s.open()
+    try:
+        base = f"http://{s.host}"
+
+        def post(path, data):
+            req = urllib.request.Request(
+                base + path, data=data.encode(), method="POST"
+            )
+            return json.loads(urllib.request.urlopen(req, timeout=60).read())
+
+        post("/index/i", "{}")
+        post("/index/i/frame/f", "{}")
+        rng = np.random.default_rng(4)
+        s.holder.frame("i", "f").import_bits(
+            rng.integers(0, 24, 800), rng.integers(0, 2 * (1 << 20), 800)
+        )
+        batch = " ".join(
+            f'Count(Intersect(Bitmap(rowID={a}, frame="f"), Bitmap(rowID={b}, frame="f")))'
+            for a, b in rng.integers(0, 24, size=(32, 2))
+        )
+        first = post("/index/i/query", batch)["results"]
+        post("/index/i/query", batch)  # second request arms the Gram/state
+        assert s.executor._serve_state is not None, "serve lane did not arm over HTTP"
+        # Count actual native serve calls: the concurrent requests must
+        # ride pn_serve_pairs, not silently fall to the general lane.
+        calls = {"n": 0}
+        orig = native.serve_pairs
+
+        def counting(*a, **kw):
+            r = orig(*a, **kw)
+            if r is not None:
+                calls["n"] += 1
+            return r
+
+        native.serve_pairs = counting
+        try:
+            with ThreadPoolExecutor(6) as pool:
+                outs = list(
+                    pool.map(
+                        lambda _: post("/index/i/query", batch)["results"], range(12)
+                    )
+                )
+        finally:
+            native.serve_pairs = orig
+        assert calls["n"] == 12, f"only {calls['n']}/12 requests served natively"
+        oracle = Executor(s.holder, engine="numpy")
+        os.environ["PILOSA_TPU_NO_FASTLANE"] = "1"
+        try:
+            want = oracle.execute("i", batch)
+        finally:
+            del os.environ["PILOSA_TPU_NO_FASTLANE"]
+        assert first == want
+        assert all(o == want for o in outs)
+    finally:
+        s.close()
