@@ -84,6 +84,15 @@ class _TreeUnfusable(Exception):
     """Tree shape outside the fused lane (not an error — sequential path)."""
 
 
+# First frame reference in a request (double-quoted, single-quoted, or
+# bare identifier) — picks the serve-state candidate in the fast lane.
+_FRAME_SNIFF_RX = re.compile(
+    r'frame\s*=\s*(?:"([a-z][a-z0-9_-]{0,64})"'
+    r"|'([a-z][a-z0-9_-]{0,64})'"
+    r"|([a-z][a-z0-9_-]{0,64}))"
+)
+
+
 def _group_sort_key(kv):
     """Deterministic dispatch order over mixed group keys: plain-op
     groups key on (op-string, arity); tree groups on ("tree", K)."""
@@ -243,11 +252,14 @@ class Executor:
         # fast lane; validated by object identity per request (frame
         # deletion/recreation yields new objects).
         self._fastwrite_cache: dict[tuple[str, str], tuple] = {}
-        # One-entry cached serve state for the single-call native read
-        # lane (_flat_fast_path): captured when a warm Gram answers a
-        # single-frame flat batch, revalidated per request by fragment
-        # generations + max_slice, dropped on any mismatch.
-        self._serve_state: Optional[dict] = None
+        # Cached serve states for the single-call native read lane
+        # (_flat_fast_path), keyed (index, frame) in a small LRU so a
+        # workload alternating between a few frames' dashboards doesn't
+        # thrash one slot.  Each entry is captured when a warm Gram
+        # answers a single-frame flat batch, revalidated per request by
+        # fragment generations + max_slice, dropped on any mismatch.
+        self._serve_states: "OrderedDict[tuple[str, str], dict]" = OrderedDict()
+        self._serve_states_max = 4
         self._gram_env_cache: Optional[tuple[bool, int]] = None  # lazy env read
         if write_queue:
             from pilosa_tpu.ingest import WriteQueue
@@ -528,7 +540,6 @@ class Executor:
             return None
         opt = opt or ExecOptions()
         local = slices is None and not self._is_distributed(opt)
-        st = self._serve_state
         # Single-call serving lane: with a valid cached serve state the
         # WHOLE request — parse, frame/row-label validation, Gram count
         # identities — runs inside one GIL-released native call
@@ -542,16 +553,31 @@ class Executor:
         # decline falls through to the general lane, which refreshes the
         # state.  The serve QUEUE below only coalesces the cold/unarmed
         # path, where per-request Python still dominates.
-        if st is not None and local:
-            if st["index"] == index and self._serve_state_valid(st):
-                counts = native.serve_pairs(
-                    raw, st["frame_b"], st["allow_default"], st["rowkey_b"],
-                    st["rs"], st["ps"], st["gram"],
-                )
-                if counts is not None:
-                    return counts.tolist()
-            elif st["index"] == index:
-                self._serve_state = None
+        if local and self._serve_states:
+            # Pick the candidate state by SNIFFING the first frame
+            # reference (cheap regex over the request head) instead of
+            # trying every armed state — each native attempt re-parses
+            # the whole batch, so a decline ladder would tax alternating
+            # multi-frame dashboards with a full wasted parse per
+            # request.  A servable request is single-frame anyway (the C
+            # validator enforces it), so the first reference decides.
+            sn = _FRAME_SNIFF_RX.search(src, 0, 512)
+            fname = sn.group(1) or sn.group(2) or sn.group(3) if sn else DEFAULT_FRAME
+            st = self._serve_states.get((index, fname))
+            if st is not None:
+                if self._serve_state_valid(st):
+                    counts = native.serve_pairs(
+                        raw, st["frame_b"], st["allow_default"], st["rowkey_b"],
+                        st["rs"], st["ps"], st["gram"],
+                    )
+                    if counts is not None:
+                        # Guard: a concurrent invalidation/eviction during
+                        # the GIL-released call may have removed the key.
+                        if (index, fname) in self._serve_states:
+                            self._serve_states.move_to_end((index, fname))
+                        return counts.tolist()
+                else:
+                    self._serve_states.pop((index, fname), None)
         m = native.pql_match_pairs(raw)
         if m is None:
             return None
@@ -674,7 +700,7 @@ class Executor:
         for s, g in zip(slices, gens):
             f = self.holder.fragment(index, fname, VIEW_STANDARD, s)
             slots.append((s, f, g))
-        self._serve_state = {
+        self._serve_states[(index, fname)] = {
             "index": index,
             "fname": fname,
             "idx_obj": idx_obj,
@@ -688,6 +714,9 @@ class Executor:
             "gram": glut[1],
             "ps": glut[2],
         }
+        self._serve_states.move_to_end((index, fname))
+        while len(self._serve_states) > self._serve_states_max:
+            self._serve_states.popitem(last=False)
 
     def _apply_queued_reads(self, items) -> list:
         """Evaluate one drained serve-queue batch of flat-lane requests.
@@ -814,7 +843,7 @@ class Executor:
                         # subsequent requests can skip straight to
                         # pn_serve_pairs.  Single-frame full batches
                         # only; re-capture only when the glut changed.
-                        st = self._serve_state
+                        st = self._serve_states.get((index, fname))
                         if (
                             len(qparts) == 1
                             and bool(fmask0.all())

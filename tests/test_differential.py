@@ -267,7 +267,7 @@ def test_serve_lane_interleaved_writes_fuzz(tmp_path, seed):
             got = e_jx.execute("d", q)
             want = oracle(q)
             assert got == want, f"step {step}: {q}"
-            if wrote and e_jx._serve_state is not None:
+            if wrote and e_jx._serve_states:
                 served_after_write += 1
     # The lane re-armed and served AFTER invalidating writes.
     assert served_after_write > 5

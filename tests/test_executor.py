@@ -1454,7 +1454,7 @@ class TestServeLane:
     def _arm(self, ex, batch):
         ex.execute("p", batch)
         ex.execute("p", batch)  # Gram arms on the second request
-        assert ex._serve_state is not None, "serve state did not arm"
+        assert ex._serve_states, "serve state did not arm"
 
     def test_parity_and_all_ops(self, tmp_path):
         h, ex, batch = self._setup(tmp_path)
@@ -1537,3 +1537,51 @@ class TestServeLane:
             t.join()
         assert not errs, errs[:3]
         h.close()
+
+
+def test_serve_lane_multi_frame_alternation(tmp_path):
+    """Two frames' dashboards alternating must BOTH stay armed (the
+    serve-state LRU holds one entry per (index, frame)) and keep serving
+    natively without thrash."""
+    from pilosa_tpu import native
+
+    h = Holder(str(tmp_path / "data"))
+    h.open()
+    idx = h.create_index("p")
+    rng = np.random.default_rng(9)
+    for fname in ("f", "g"):
+        idx.create_frame(fname, FrameOptions())
+        idx.frame(fname).import_bits(
+            rng.integers(0, 16, 400), rng.integers(0, 2 * SLICE_WIDTH, 400)
+        )
+    ex = Executor(h, engine="jax")
+
+    def batch(fname):
+        return " ".join(
+            f'Count(Intersect(Bitmap(rowID={a}, frame="{fname}"), Bitmap(rowID={b}, frame="{fname}")))'
+            for a, b in np.random.default_rng(1).integers(0, 16, size=(16, 2))
+        )
+
+    qf, qg = batch("f"), batch("g")
+    want_f, want_g = ex.execute("p", qf), ex.execute("p", qg)
+    for q, w in ((qf, want_f), (qg, want_g)):  # arm both (Gram on 2nd hit)
+        assert ex.execute("p", q) == w
+    assert set(ex._serve_states) == {("p", "f"), ("p", "g")}
+    calls = {"n": 0}
+    orig = native.serve_pairs
+
+    def counting(*a, **kw):
+        r = orig(*a, **kw)
+        if r is not None:
+            calls["n"] += 1
+        return r
+
+    native.serve_pairs = counting
+    try:
+        for _ in range(5):  # alternate: both frames keep serving natively
+            assert ex.execute("p", qf) == want_f
+            assert ex.execute("p", qg) == want_g
+    finally:
+        native.serve_pairs = orig
+    assert calls["n"] == 10, f"only {calls['n']}/10 alternating requests served natively"
+    h.close()

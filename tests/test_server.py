@@ -688,7 +688,7 @@ def test_serve_lane_through_http_server(tmp_path):
         )
         first = post("/index/i/query", batch)["results"]
         post("/index/i/query", batch)  # second request arms the Gram/state
-        assert s.executor._serve_state is not None, "serve lane did not arm over HTTP"
+        assert s.executor._serve_states, "serve lane did not arm over HTTP"
         # Count actual native serve calls: the concurrent requests must
         # ride pn_serve_pairs, not silently fall to the general lane.
         calls = {"n": 0}
