@@ -618,15 +618,21 @@ int64_t pn_pql_match_pairs(const char* src, int64_t len,
         if (!p.ws() || !p.ch('(')) return PN_PQL_FALLBACK;
         if (!p.ws()) return PN_PQL_FALLBACK;
         uint8_t op;
+        int n_leaves = 2;
         if (p.lit("Intersect", 9)) op = 0;
         else if (p.lit("Union", 5)) op = 1;
         else if (p.lit("Xor", 3)) op = 2;
         else if (p.lit("Difference", 10)) op = 3;
-        else return PN_PQL_FALLBACK;
-        if (!p.ws() || !p.ch('(')) return PN_PQL_FALLBACK;
+        else if (p.i + 6 <= p.len && memcmp(src + p.i, "Bitmap", 6) == 0) {
+            // Plain row count Count(Bitmap(...)): |r| == |r & r| — emit
+            // the pair (r, r) with op AND so it rides the same lanes.
+            op = 0;
+            n_leaves = 1;
+        } else return PN_PQL_FALLBACK;
+        if (n_leaves == 2 && (!p.ws() || !p.ch('('))) return PN_PQL_FALLBACK;
         int32_t fid[2], kid[2];
         int64_t row[2];
-        for (int leaf = 0; leaf < 2; leaf++) {
+        for (int leaf = 0; leaf < n_leaves; leaf++) {
             if (!p.ws() || !p.lit("Bitmap", 6)) return PN_PQL_FALLBACK;
             if (!p.ws() || !p.ch('(')) return PN_PQL_FALLBACK;
             int32_t f_s = -1, f_e = -1, k_s = -1, k_e = -1;
@@ -674,11 +680,17 @@ int64_t pn_pql_match_pairs(const char* src, int64_t len,
             kid[leaf] = intern_span(src, k_s, k_e, uk_s, uk_e, n_keys, tab_cap);
             if (fid[leaf] == -2 || kid[leaf] == -2) return PN_PQL_FALLBACK;
             row[leaf] = rv;
-            if (leaf == 0) {
+            if (leaf == 0 && n_leaves == 2) {
                 if (!p.ws() || !p.ch(',')) return PN_PQL_FALLBACK;
             }
         }
-        if (!p.ws() || !p.ch(')')) return PN_PQL_FALLBACK;  // close op
+        if (n_leaves == 1) {  // Count(Bitmap(...)): the leaf IS the op body
+            fid[1] = fid[0];
+            kid[1] = kid[0];
+            row[1] = row[0];
+        } else {
+            if (!p.ws() || !p.ch(')')) return PN_PQL_FALLBACK;  // close op
+        }
         if (!p.ws() || !p.ch(')')) return PN_PQL_FALLBACK;  // close Count
         if (fid[0] != fid[1] || kid[0] != kid[1]) return PN_PQL_FALLBACK;
         op_ids[n] = op;

@@ -965,6 +965,20 @@ class Executor:
             if c.name != "Count" or len(c.children) != 1:
                 continue
             ch = c.children[0]
+            if ch.name == "Bitmap":
+                # Plain row count: |r| == |r & r| — rides the pair lane
+                # (Gram diagonal) so a dashboard mixing row counts with
+                # pair counts keeps the whole batch fused.
+                try:
+                    frame, view, row_id = self._resolve_bitmap_leaf(index, ch)
+                except PilosaError:
+                    return None  # surface the error through the normal path
+                if batch_view is None:
+                    batch_view = view
+                elif view != batch_view:
+                    return None
+                matched[i] = (frame, view, "and", (row_id, row_id))
+                continue
             op = self._FUSABLE_OPS.get(ch.name)
             if op is None or len(ch.children) < 2:
                 continue

@@ -1585,3 +1585,32 @@ def test_serve_lane_multi_frame_alternation(tmp_path):
         native.serve_pairs = orig
     assert calls["n"] == 10, f"only {calls['n']}/10 alternating requests served natively"
     h.close()
+
+
+@pytest.mark.parametrize("engine", ["numpy", "jax"])
+def test_count_bitmap_singles_fuse_with_pairs(tmp_path, engine):
+    """Plain Count(Bitmap(r)) calls ride the pair lane as (r, r): a
+    dashboard mixing row counts, pair counts, and nested trees stays ONE
+    fused batch instead of falling to sequential per-call evaluation."""
+    from pilosa_tpu.pql.parser import parse
+
+    h = Holder(str(tmp_path / "data"))
+    h.open()
+    h.create_index("i").create_frame("f", FrameOptions())
+    fr = h.index("i").frame("f")
+    rng = np.random.default_rng(6)
+    fr.import_bits(rng.integers(0, 12, 500), rng.integers(0, 3 * SLICE_WIDTH, 500))
+    e = Executor(h, engine=engine)
+    qs = [
+        'Count(Bitmap(rowID=3, frame="f"))',
+        'Count(Intersect(Bitmap(rowID=1, frame="f"), Bitmap(rowID=2, frame="f")))',
+        'Count(Bitmap(rowID=7, frame="f"))',
+        'Count(Union(Intersect(Bitmap(rowID=1, frame="f"), Bitmap(rowID=2, frame="f")), Bitmap(rowID=3, frame="f")))',
+    ]
+    seq = [e.execute("i", q)[0] for q in qs]
+    fused = e._fuse_count_pair_batch(
+        "i", parse(" ".join(qs)).calls, list(range(3)), None, ExecOptions()
+    )
+    assert fused is not None and [fused[i] for i in range(4)] == seq
+    assert e.execute("i", " ".join(qs)) == seq
+    h.close()

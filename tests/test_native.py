@@ -257,3 +257,18 @@ def test_fused_lane_declines_buffered_writers(tmp_path):
     recs = path.read_bytes()
     assert len(recs) == 39  # 3 records, in operation order
     assert [recs[i] for i in (0, 13, 26)] == [roaring.OP_ADD, roaring.OP_REMOVE, roaring.OP_ADD]
+
+
+def test_match_pairs_accepts_count_bitmap_singles():
+    """Count(Bitmap(...)) matches as the (r, r) AND pair — the C matcher
+    and serve lane cover plain row counts in batched requests."""
+    q = ('Count(Bitmap(rowID=3, frame="f")) '
+         'Count(Intersect(Bitmap(rowID=1, frame="f"), Bitmap(rowID=2, frame="f")))')
+    m = native.pql_match_pairs(q.encode())
+    assert m is not None
+    op_ids, frame_ids, key_ids, r1, r2 = m[0], m[1], m[2], m[3], m[4]
+    assert op_ids.tolist() == [0, 0]
+    assert list(zip(r1.tolist(), r2.tolist())) == [(3, 3), (1, 2)]
+    # malformed single-leaf shapes still fall back
+    assert native.pql_match_pairs(b'Count(Bitmap(rowID=3, frame="f") ') is None
+    assert native.pql_match_pairs(b'Count(Bitmap(frame="f"))  Count(Bitmap(rowID=1))') is None
