@@ -731,6 +731,149 @@ def bench_range_executor() -> dict:
     }
 
 
+def bench_mixed() -> dict:
+    """Mixed read/write serving tier: warm-Gram pair-count batches with
+    single-bit SetBit writes interleaved, at 95/5 and 50/50 request
+    mixes.  Measures the warm-state REPAIR lane (delta-patched row
+    matrices + rank-k Gram updates) against forced
+    invalidate-and-rebuild (PILOSA_TPU_REPAIR_ROWS_MAX=0) on the same
+    request stream; per-mix steady qps, the latency of the read
+    immediately following a write (the repair-vs-rebuild split), and the
+    pool repair count land in the ``tiers`` list.  Every write targets a
+    column range the import never touches, so each one really mutates
+    storage and really invalidates (or patches) the warm state.
+    BENCH_SMOKE=1 shrinks every shape to run under CI tier-1 time
+    budgets on CPU, exercising the patch lane end to end."""
+    smoke = os.environ.get("BENCH_SMOKE", "").lower() in ("1", "true", "yes")
+    n_slices = int(os.environ.get("BENCH_SLICES", "2" if smoke else "4"))
+    n_rows = int(os.environ.get("BENCH_ROWS", "16" if smoke else "64"))
+    batch = int(os.environ.get("BENCH_BATCH", "8" if smoke else "128"))
+    n_requests = int(os.environ.get("BENCH_ITERS", "30" if smoke else "400"))
+    bits_per_row = int(
+        os.environ.get("BENCH_BITS_PER_ROW", "50" if smoke else "20000")
+    )
+    import tempfile
+
+    from pilosa_tpu.core.frame import FrameOptions
+    from pilosa_tpu.core.holder import Holder
+    from pilosa_tpu.executor import Executor
+    from pilosa_tpu.pilosa import SLICE_WIDTH
+
+    rng = np.random.default_rng(23)
+    reserve = 4096  # import keeps these top columns free for the writes
+
+    def build_read(seed):
+        prs = np.random.default_rng(seed).integers(0, n_rows, size=(batch, 2))
+        return " ".join(
+            f'Count(Intersect(Bitmap(rowID={a}, frame="f"), Bitmap(rowID={b}, frame="f")))'
+            for a, b in prs.tolist()
+        )
+
+    read_qs = [build_read(s) for s in range(4)]
+    state = {"engine": "?"}
+
+    def run_mix(write_every: int, repair_on: bool) -> dict:
+        prior = os.environ.get("PILOSA_TPU_REPAIR_ROWS_MAX")
+        if not repair_on:
+            os.environ["PILOSA_TPU_REPAIR_ROWS_MAX"] = "0"
+        try:
+            with tempfile.TemporaryDirectory() as d:
+                h = Holder(d)
+                h.open()
+                h.create_index("m").create_frame("f", FrameOptions())
+                fr = h.index("m").frame("f")
+                rows = np.repeat(np.arange(n_rows, dtype=np.uint64), bits_per_row)
+                for s in range(n_slices):
+                    cols = rng.integers(
+                        0, SLICE_WIDTH - reserve, size=len(rows)
+                    ).astype(np.uint64) + np.uint64(s * SLICE_WIDTH)
+                    fr.import_bits(rows, cols)
+                ex = Executor(h)
+                state["engine"] = ex.engine.name
+                for q in read_qs:  # pass 1: matrices page in, jit compiles
+                    ex.execute("m", q)
+                for q in read_qs:  # pass 2: the Gram (and serve lane) arm
+                    ex.execute("m", q)
+                wcount = 0
+                calls = 0
+                lat_post_write: list = []
+                lat_other: list = []
+                last_was_write = False
+                t0 = time.perf_counter()
+                for i in range(n_requests):
+                    if write_every and i % write_every == write_every - 1:
+                        r = wcount % n_rows
+                        c = (SLICE_WIDTH - reserve) + (wcount // n_rows) % reserve
+                        ex.execute("m", f'SetBit(rowID={r}, frame="f", columnID={c})')
+                        wcount += 1
+                        calls += 1
+                        last_was_write = True
+                    else:
+                        t1 = time.perf_counter()
+                        ex.execute("m", read_qs[i % len(read_qs)])
+                        dt1 = time.perf_counter() - t1
+                        (lat_post_write if last_was_write else lat_other).append(dt1)
+                        calls += batch
+                        last_was_write = False
+                dt = time.perf_counter() - t0
+                # Correctness gate: warm-lane counts must match the numpy
+                # sequential path AFTER the interleaved writes (the
+                # read-your-writes contract the repair must not break).
+                want = Executor(h, engine="numpy").execute("m", read_qs[0])
+                got = ex.execute("m", read_qs[0])
+                assert got == want, "mixed-lane counts diverged from numpy"
+                repairs = sum(
+                    p.stat_repairs for p in ex._matrix_cache.values()
+                )
+                h.close()
+            return {
+                "qps": calls / dt,
+                "post_write_ms": (
+                    1e3 * float(np.mean(lat_post_write)) if lat_post_write else None
+                ),
+                "steady_ms": 1e3 * float(np.mean(lat_other)) if lat_other else None,
+                "repairs": repairs,
+            }
+        finally:
+            if prior is None:
+                os.environ.pop("PILOSA_TPU_REPAIR_ROWS_MAX", None)
+            else:
+                os.environ["PILOSA_TPU_REPAIR_ROWS_MAX"] = prior
+
+    tiers = []
+    for name, write_every in (("mixed_95_5", 20), ("mixed_50_50", 2)):
+        rep = run_mix(write_every, True)
+        reb = run_mix(write_every, False)
+        tiers.append({
+            "tier": name,
+            "qps": round(rep["qps"], 1),
+            "rebuild_qps": round(reb["qps"], 1),
+            "speedup": round(rep["qps"] / reb["qps"], 2),
+            "repair_post_write_ms": (
+                round(rep["post_write_ms"], 3) if rep["post_write_ms"] else None
+            ),
+            "rebuild_post_write_ms": (
+                round(reb["post_write_ms"], 3) if reb["post_write_ms"] else None
+            ),
+            "steady_ms": round(rep["steady_ms"], 3) if rep["steady_ms"] else None,
+            "repairs": rep["repairs"],
+        })
+    head = tiers[0]
+    return {
+        "metric": "mixed_rw_qps",
+        "value": head["qps"],
+        "unit": (
+            f"PQL calls/sec, 95/5 read/write mix ({n_slices} slices x "
+            f"{n_rows} rows, batch {batch}, warm-state repair lane vs "
+            f"invalidate-and-rebuild x{head['speedup']}; 50/50 mix "
+            f"{tiers[1]['qps']:,.0f} calls/s (x{tiers[1]['speedup']} vs "
+            f"rebuild), engine {state['engine']})"
+        ),
+        "vs_baseline": head["speedup"],
+        "tiers": tiers,
+    }
+
+
 # v5e single-chip HBM bandwidth roofline (bytes/sec) for bandwidth_util
 # accounting; override for other parts (v4: ~1.2e12, v5p: ~2.8e12).
 HBM_ROOFLINE = float(os.environ.get("BENCH_HBM_ROOFLINE", str(819e9)))
@@ -1201,6 +1344,7 @@ def main() -> None:
             "executor": bench_executor,
             "executor_gather": bench_executor_gather,
             "range_executor": bench_range_executor,
+            "mixed": bench_mixed,
             "intersect_count_stream": bench_intersect_stream,
             "intersect_count_4krows": bench_intersect_4krows,
             "topn_p50": bench_topn_p50,

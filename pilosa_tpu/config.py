@@ -11,7 +11,11 @@ seed), anti-entropy interval, max-writes-per-request, log path
 from __future__ import annotations
 
 import os
-import tomllib
+
+try:
+    import tomllib
+except ImportError:  # Python < 3.11: the API-compatible backport
+    import tomli as tomllib
 from dataclasses import dataclass, field
 
 DEFAULT_HOST = "localhost:10101"
@@ -48,6 +52,10 @@ class Config:
     # "expvar" (default; served at /debug/vars), "statsd[:host[:port]]"
     # (datadog-compatible UDP), "nop" to disable (stats.go:33-54 analog).
     stats: str = "expvar"
+    # Executor serve-state LRU capacity: one entry per (index, frame)
+    # dashboard kept armed for the single-call native serve lane.  Size
+    # for the number of frames a workload alternates between.
+    serve_state_cache: int = 4
 
     @classmethod
     def from_toml(cls, path: str) -> "Config":
@@ -69,6 +77,9 @@ class Config:
         cfg.log_path = raw.get("log-path", cfg.log_path)
         cfg.engine = raw.get("engine", cfg.engine)
         cfg.stats = raw.get("stats", cfg.stats)
+        cfg.serve_state_cache = int(
+            raw.get("serve-state-cache", cfg.serve_state_cache)
+        )
         cl = raw.get("cluster", {})
         cfg.cluster.replica_n = cl.get("replicas", cfg.cluster.replica_n)
         cfg.cluster.type = cl.get("type", cfg.cluster.type)
@@ -96,6 +107,8 @@ class Config:
             self.engine = env["PILOSA_ENGINE"]
         if "PILOSA_STATS" in env:
             self.stats = env["PILOSA_STATS"]
+        if "PILOSA_SERVE_STATE_CACHE" in env:
+            self.serve_state_cache = int(env["PILOSA_SERVE_STATE_CACHE"])
         return self
 
     def to_toml(self) -> str:

@@ -60,6 +60,13 @@ _WORDS = SLICE_WIDTH // 32
 # Process-global write-generation source (see Fragment.generation).
 _generation_counter = itertools.count(1)
 
+# Dirty-row journal length (entries, one per generation bump).  Past this
+# the oldest entries are dropped and deltas reaching back that far become
+# unenumerable (rows_dirty_since returns None -> callers rebuild), which
+# is exactly the right degradation: a warm cache that fell thousands of
+# writes behind is not worth patching row by row anyway.
+_DIRTY_LOG_MAX = int(os.environ.get("PILOSA_TPU_DIRTY_LOG_MAX", "512"))
+
 # Magic header for the sidecar .cache file (row-id list persisted so ranked
 # caches can be rebuilt by recount on open; fragment.go:236-274, 1073-1093).
 _CACHE_MAGIC = b"PTPC\x01"
@@ -167,6 +174,14 @@ class Fragment:
         # fragment can never repeat an old fragment's generation and
         # revive its cache entries.
         self.generation = next(_generation_counter)
+        # Dirty-row journal: one (generation, rows) entry per generation
+        # bump, so warm device state (executor serve states, row-pool
+        # matrices, Grams) can be PATCHED after small writes instead of
+        # rebuilt (rows None = unenumerable bulk change).  The floor is
+        # the creation generation: a consumer holding an older fragment's
+        # generation can never enumerate a delta against this one.
+        self._dirty_log: "list[tuple[int, Optional[tuple[int, ...]]]]" = []
+        self._dirty_floor = self.generation
 
     # -- lifecycle (fragment.go:151-274) --------------------------------
 
@@ -387,6 +402,42 @@ class Fragment:
         """Linear bit position (fragment.go:1512-1514)."""
         return row_id * SLICE_WIDTH + (column_id % SLICE_WIDTH)
 
+    # -- dirty-row journal (warm-state repair) ---------------------------
+
+    def _log_dirty(self, rows) -> None:
+        """Record one generation bump's touched rows (call with the lock
+        held, AFTER self.generation was advanced).  ``rows`` None marks
+        an unenumerable change (bulk import / restore): any delta
+        spanning it forces a full rebuild downstream."""
+        self._dirty_log.append(
+            (self.generation, None if rows is None else tuple(rows))
+        )
+        if len(self._dirty_log) > _DIRTY_LOG_MAX:
+            drop = len(self._dirty_log) - _DIRTY_LOG_MAX
+            self._dirty_floor = self._dirty_log[drop - 1][0]
+            del self._dirty_log[:drop]
+
+    def rows_dirty_since(self, gen0: int) -> Optional[set]:
+        """Rows written since generation ``gen0``, or None when the delta
+        cannot be enumerated: the journal was evicted past gen0, a bulk
+        import/restore landed in the span, or this fragment was created
+        after gen0 (a recreated fragment's floor is its creation
+        generation, so stale consumers of a deleted namesake always get
+        None, never a partial delta)."""
+        with self._mu:
+            if gen0 == self.generation:
+                return set()
+            if gen0 < self._dirty_floor:
+                return None
+            out: set = set()
+            for g, rows in reversed(self._dirty_log):
+                if g <= gen0:
+                    break
+                if rows is None:
+                    return None
+                out.update(rows)
+            return out
+
     # -- bit ops (fragment.go:371-459) ----------------------------------
 
     def set_bit(self, row_id: int, column_id: int) -> bool:
@@ -401,6 +452,7 @@ class Fragment:
                 # current, and the write generation bumps eagerly so
                 # engine-side matrices never serve stale hits.
                 self.generation = next(_generation_counter)
+                self._log_dirty((row_id,))
                 p = self._pending_rows
                 p[row_id] = p.get(row_id, 0) + 1
                 self._increment_opn()
@@ -438,6 +490,7 @@ class Fragment:
                 if added:
                     self.stats.count("setN", len(added))
                     self.generation = next(_generation_counter)
+                    self._log_dirty({v // SLICE_WIDTH for v in added})
                     p = self._pending_rows
                     for v in added:
                         r = v // SLICE_WIDTH
@@ -460,6 +513,7 @@ class Fragment:
                 rows_added, per_row = np.unique(
                     added // np.uint64(SLICE_WIDTH), return_counts=True
                 )
+                self._log_dirty(rows_added.tolist())
                 p = self._pending_rows
                 for row_id, cnt in zip(rows_added.tolist(), per_row.tolist()):
                     p[row_id] = p.get(row_id, 0) + cnt
@@ -481,6 +535,7 @@ class Fragment:
             changed = self.storage.remove(self.pos(row_id, column_id))
             if changed:
                 self.generation = next(_generation_counter)
+                self._log_dirty((row_id,))
                 p = self._pending_rows
                 p[row_id] = p.get(row_id, 0) - 1
                 self._increment_opn()
@@ -831,6 +886,7 @@ class Fragment:
         finally:
             self.storage.op_writer = self._wal
         self.generation = next(_generation_counter)
+        self._log_dirty(None)  # bulk load: delta unenumerable by design
         self._row_cache.clear()
         self._row_dev_cache.clear()
         self._row_dev_cache_arrays = 0
@@ -942,6 +998,7 @@ class Fragment:
         self.storage = roaring.Bitmap.from_bytes(data)
         self.storage.op_n = 0
         self.generation = next(_generation_counter)
+        self._log_dirty(None)  # wholesale restore: delta unenumerable
         self._row_cache.clear()
         self._row_dev_cache.clear()
         self._row_dev_cache_arrays = 0

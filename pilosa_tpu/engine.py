@@ -240,6 +240,29 @@ class NumpyEngine:
         all-pairs popcount would dwarf the direct path)."""
         return None
 
+    def gram_update_rows(self, matrix, gram, slots):
+        """Rank-k repair of a host AND-count Gram after in-place row
+        rewrites: recompute ONLY the dirty rows/columns with one batched
+        pair-count pass against the (already patched) resident matrix —
+        O(K*R*W) instead of the O(R^2*W) full rebuild.  Returns a NEW
+        array (copy-on-write: readers holding the old Gram keep a
+        consistent pre-write snapshot; AND is symmetric, so one K x R
+        count block fills both the rows and the columns)."""
+        slots = np.asarray(sorted({int(s) for s in slots}), dtype=np.int64)
+        n = gram.shape[0]
+        pairs = np.empty((len(slots) * n, 2), dtype=np.int32)
+        pairs[:, 0] = np.repeat(slots.astype(np.int32), n)
+        pairs[:, 1] = np.tile(np.arange(n, dtype=np.int32), len(slots))
+        block = (
+            np.asarray(self.gather_count("and", matrix, pairs))
+            .reshape(len(slots), n)
+            .astype(gram.dtype)
+        )
+        out = np.array(gram, copy=True)
+        out[slots, :] = block
+        out[:, slots] = block.T
+        return out
+
     def to_numpy(self, x) -> np.ndarray:
         return np.asarray(x)
 
@@ -522,6 +545,31 @@ class JaxEngine:
 
             self._gram_jit = jax.jit(pair_gram)
         return self.to_numpy(self._gram_jit(self._jnp.asarray(matrix))).astype(np.int64)
+
+    def gram_update_rows(self, matrix, gram, slots):
+        """Rank-k Gram repair (see NumpyEngine.gram_update_rows): one
+        batched gather-count dispatch recomputes the dirty rows/columns.
+        The dirty-slot axis pads to a power-of-two bucket (recomputing a
+        row twice is idempotent) so the jitted dispatch shape stays
+        stable across repairs of 1..K rows."""
+        slots = sorted({int(s) for s in slots})
+        k = len(slots)
+        kb = 1 << (k - 1).bit_length() if k > 1 else 1
+        padded = np.asarray(slots + [slots[0]] * (kb - k), dtype=np.int32)
+        n = gram.shape[0]
+        pairs = np.empty((kb * n, 2), dtype=np.int32)
+        pairs[:, 0] = np.repeat(padded, n)
+        pairs[:, 1] = np.tile(np.arange(n, dtype=np.int32), kb)
+        block = (
+            np.asarray(self.gather_count("and", matrix, pairs))
+            .reshape(kb, n)[:k]
+            .astype(gram.dtype)
+        )
+        idx = np.asarray(slots, dtype=np.int64)
+        out = np.array(gram, copy=True)
+        out[idx, :] = block
+        out[:, idx] = block.T
+        return out
 
     def to_numpy(self, x) -> np.ndarray:
         return np.asarray(x)

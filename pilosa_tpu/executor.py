@@ -217,6 +217,7 @@ class Executor:
         host: str = "",
         max_writes_per_request: int = 0,
         write_queue: bool = False,
+        serve_state_cache: int = 0,
     ):
         self.holder = holder
         self.engine = new_engine(engine) if isinstance(engine, str) else engine
@@ -259,7 +260,29 @@ class Executor:
         # answers a single-frame flat batch, revalidated per request by
         # fragment generations + max_slice, dropped on any mismatch.
         self._serve_states: "OrderedDict[tuple[str, str], dict]" = OrderedDict()
-        self._serve_states_max = 4
+        # LRU capacity: constructor arg (server passes Config.serve_state_cache)
+        # > PILOSA_SERVE_STATE_CACHE env > default 4 entries.  One entry per
+        # (index, frame) dashboard; size for the number of frames a workload
+        # alternates between.
+        if serve_state_cache <= 0:
+            serve_state_cache = int(os.environ.get("PILOSA_SERVE_STATE_CACHE", "4"))
+        self._serve_states_max = max(1, serve_state_cache)
+        # Warm-state repair budget: a write burst touching at most this many
+        # distinct rows gets the PATCH lane (in-place matrix row rewrite +
+        # rank-k Gram repair); bigger deltas fall back to the full
+        # invalidate-and-rebuild.  0 disables repair entirely (A/B lever;
+        # bench_mixed uses it for the rebuild baseline).
+        self._repair_rows_max = int(
+            os.environ.get("PILOSA_TPU_REPAIR_ROWS_MAX", "64")
+        )
+        # Per-(index, frame) dirty-row ledger fed by the write paths: the
+        # serve-state patch lane's cheap budget precheck (the exact
+        # generation-anchored delta comes from the fragment dirty-row
+        # journals, which also cover non-executor writers).  Value None =
+        # saturated (a burst blew past the budget; rebuild, don't walk
+        # journals).
+        self._dirty_rows: dict[tuple[str, str], Optional[set]] = {}
+        self._dirty_mu = threading.Lock()
         self._gram_env_cache: Optional[tuple[bool, int]] = None  # lazy env read
         if write_queue:
             from pilosa_tpu.ingest import WriteQueue
@@ -402,6 +425,8 @@ class Executor:
             cols = np.array([parsed[i][2] for i in idxs], dtype=np.uint64)
             stamps = [parsed[i][3] for i in idxs]
             ch = frame.set_bits(VIEW_STANDARD, rows, cols, stamps)
+            if ch.any():
+                self._note_dirty_rows(index, frame.name, rows[ch].tolist())
             if frame.inverse_enabled:
                 ch |= frame.set_bits(VIEW_INVERSE, cols, rows, stamps)
             for k, i in enumerate(idxs):
@@ -518,8 +543,12 @@ class Executor:
             return None
         row_id, col_id = int(v1), int(v2)
         if name == "SetBit":
-            return [frame.set_bit(VIEW_STANDARD, row_id, col_id)]
-        return [frame.clear_bit(VIEW_STANDARD, row_id, col_id)]
+            ch = frame.set_bit(VIEW_STANDARD, row_id, col_id)
+        else:
+            ch = frame.clear_bit(VIEW_STANDARD, row_id, col_id)
+        if ch:
+            self._note_dirty_rows(index, fname, (row_id,))
+        return [ch]
 
     def _flat_fast_path(self, index: str, src: str, slices, opt) -> Optional[list]:
         """Compiled-query lane: serve an all-``Count(<op>(Bitmap,Bitmap))``
@@ -564,20 +593,25 @@ class Executor:
             sn = _FRAME_SNIFF_RX.search(src, 0, 512)
             fname = sn.group(1) or sn.group(2) or sn.group(3) if sn else DEFAULT_FRAME
             st = self._serve_states.get((index, fname))
-            if st is not None:
-                if self._serve_state_valid(st):
-                    counts = native.serve_pairs(
-                        raw, st["frame_b"], st["allow_default"], st["rowkey_b"],
-                        st["rs"], st["ps"], st["gram"],
-                    )
-                    if counts is not None:
-                        # Guard: a concurrent invalidation/eviction during
-                        # the GIL-released call may have removed the key.
-                        if (index, fname) in self._serve_states:
-                            self._serve_states.move_to_end((index, fname))
-                        return counts.tolist()
-                else:
+            if st is not None and not self._serve_state_valid(st):
+                # Patch lane: a small write repairs the warm state in
+                # place (matrix rows + rank-k Gram + glut) and re-arms;
+                # only structural or over-budget deltas pop the entry
+                # and pay the full rebuild through the general lane.
+                st = self._serve_state_repair((index, fname), st)
+                if st is None:
                     self._serve_states.pop((index, fname), None)
+            if st is not None:
+                counts = native.serve_pairs(
+                    raw, st["frame_b"], st["allow_default"], st["rowkey_b"],
+                    st["rs"], st["ps"], st["gram"],
+                )
+                if counts is not None:
+                    # Guard: a concurrent invalidation/eviction during
+                    # the GIL-released call may have removed the key.
+                    if (index, fname) in self._serve_states:
+                        self._serve_states.move_to_end((index, fname))
+                    return counts.tolist()
         m = native.pql_match_pairs(raw)
         if m is None:
             return None
@@ -664,6 +698,136 @@ class Executor:
                 return False
         return True
 
+    # -- warm-state repair (delta patch instead of invalidate) ------------
+
+    def _note_dirty_rows(self, index: str, fname: str, rows) -> None:
+        """Accumulate the per-(index, frame) dirty-row ledger feeding the
+        serve-state patch lane's budget precheck.  Saturates (value None)
+        past 4x the repair budget so a write burst can't grow it
+        unbounded — saturation just means 'rebuild, don't walk journals'.
+        Skipped entirely while nothing is warm (pure-ingest workloads pay
+        zero here)."""
+        if not self._serve_states and not self._matrix_cache:
+            return
+        key = (index, fname)
+        cap = 4 * self._repair_rows_max + 16
+        with self._dirty_mu:
+            cur = self._dirty_rows.get(key, ())
+            if cur is None:
+                return  # already saturated
+            if cur == ():
+                cur = self._dirty_rows[key] = set()
+            cur.update(int(r) for r in rows)
+            if len(cur) > cap:
+                self._dirty_rows[key] = None
+
+    def _journal_dirty_rows(self, frags, old_gens, new_gens) -> Optional[set]:
+        """The EXACT set of rows written between two generation vectors,
+        from the fragment dirty-row journals — or None when the delta is
+        unenumerable (bulk import/restore, journal evicted, fragment
+        deleted/recreated) or over the repair budget; callers then take
+        the full rebuild path.  Journals are maintained inside the
+        fragment's own locked mutation methods, so this covers every
+        writer — not just this executor's write paths."""
+        budget = self._repair_rows_max
+        if budget <= 0:
+            return None
+        dirty: set = set()
+        for f, g0, g1 in zip(frags, old_gens, new_gens):
+            if g0 == g1:
+                continue
+            if f is None:
+                return None  # fragment deleted since the state was recorded
+            rows = f.rows_dirty_since(g0)
+            if rows is None:
+                return None
+            dirty |= rows
+            if len(dirty) > budget:
+                return None
+        return dirty if dirty else None
+
+    def _serve_state_repair(self, key: tuple, st: dict) -> Optional[dict]:
+        """The serve-state PATCH lane (the Roaring repair principle one
+        level up): a state invalidated by a small write is repaired —
+        the pool matrix's dirty rows rewritten in place, the Gram
+        rank-k-updated, the glut re-derived — and re-captured with fresh
+        validity tokens, instead of being popped and rebuilt from
+        scratch.  Returns the re-captured state (read-your-writes: it
+        serves post-write counts), or None when the delta is over the
+        repair budget, unenumerable, or structural (index/frame/slice
+        growth) — the caller pops and the general lane re-arms.
+        """
+        index, fname = key
+        idx_obj = st["idx_obj"]
+        if self.holder.index(index) is not idx_obj:
+            return None
+        if idx_obj.max_slice() != st["max_slice"]:
+            return None  # slice/row-count growth: the state's span is wrong
+        with self._dirty_mu:
+            noted = self._dirty_rows.get(key, ())
+        if noted is None or (noted and len(noted) > self._repair_rows_max):
+            return None  # ledger precheck: saturated or clearly over budget
+        slices: list[int] = []
+        frags: list = []
+        old_gens: list[int] = []
+        new_gens: list[int] = []
+        for s, frag, gen in st["slots"]:
+            f = self.holder.fragment(index, fname, VIEW_STANDARD, s)
+            if f is not frag:
+                return None  # fragment created/replaced since capture
+            slices.append(s)
+            frags.append(f)
+            old_gens.append(gen)
+            new_gens.append(-1 if f is None else f.generation)
+        dirty = self._journal_dirty_rows(frags, old_gens, new_gens)
+        if dirty is None:
+            return None
+        # Drive the pool's patch lane: the dirty set is complete for the
+        # (old -> new) span, so acquire repairs the matrix rows + Gram in
+        # place and the box (with its glut) survives.
+        pool = self._pool_for(index, fname, VIEW_STANDARD, slices)
+        _, _, box = pool.acquire([], tuple(new_gens), dirty_rows=dirty)
+        glut = box.get("gram_lut")
+        if glut is None:
+            return None  # box didn't survive (evicted/reset elsewhere)
+        self._capture_serve_state(index, fname, slices, glut, box)
+        return self._serve_states.get(key)
+
+    def drop_frame_state(self, index: str, frame: str) -> None:
+        """Drop every cached serving artifact for one (index, frame):
+        serve states, device row pools (and their Grams), multi-view
+        Range matrices, the fast-write pin, and the dirty ledger.  Called
+        on frame deletion so a recreated namesake can never be served
+        from (or pin the memory of) the old frame's device state; the
+        generation/identity validity checks already guarantee
+        correctness — this hook reclaims the memory eagerly."""
+        with self._matrix_mu:
+            for k in [k for k in self._matrix_cache if k[0] == index and k[1] == frame]:
+                del self._matrix_cache[k]
+            for k in [
+                k for k in self._multi_matrix_cache if k[0] == index and k[1] == frame
+            ]:
+                del self._multi_matrix_cache[k]
+        self._serve_states.pop((index, frame), None)
+        self._fastwrite_cache.pop((index, frame), None)
+        with self._dirty_mu:
+            self._dirty_rows.pop((index, frame), None)
+
+    def drop_index_state(self, index: str) -> None:
+        """Index-deletion analog of drop_frame_state (every frame)."""
+        with self._matrix_mu:
+            for k in [k for k in self._matrix_cache if k[0] == index]:
+                del self._matrix_cache[k]
+            for k in [k for k in self._multi_matrix_cache if k[0] == index]:
+                del self._multi_matrix_cache[k]
+        for k in [k for k in list(self._serve_states) if k[0] == index]:
+            self._serve_states.pop(k, None)
+        for k in [k for k in list(self._fastwrite_cache) if k[0] == index]:
+            self._fastwrite_cache.pop(k, None)
+        with self._dirty_mu:
+            for k in [k for k in self._dirty_rows if k[0] == index]:
+                del self._dirty_rows[k]
+
     def _capture_serve_state(self, index: str, fname: str, slices, glut, box) -> None:
         """Snapshot the single-call serve lane's state after a warm-Gram
         single-frame batch: the glut arrays (sorted row ids, positions,
@@ -717,6 +881,10 @@ class Executor:
         self._serve_states.move_to_end((index, fname))
         while len(self._serve_states) > self._serve_states_max:
             self._serve_states.popitem(last=False)
+        # The fresh tokens make older ledger entries moot for THIS frame's
+        # precheck; the journals stay authoritative for any other state.
+        with self._dirty_mu:
+            self._dirty_rows.pop((index, fname), None)
 
     def _apply_queued_reads(self, items) -> list:
         """Evaluate one drained serve-queue batch of flat-lane requests.
@@ -1763,7 +1931,18 @@ class Executor:
         frags = [self.holder.fragment(index, frame, view, s) for s in slices]
         gens = tuple(-1 if f is None else f.generation for f in frags)
         pool = self._pool_for(index, frame, view, slices, lane=lane)
-        return pool.acquire(sorted(want), gens)
+        # Dirty-row delta for the pool's PATCH lane: when the fragment
+        # journals can enumerate everything written since the pool's
+        # recorded generations (and it fits the repair budget), acquire
+        # rewrites just those rows and rank-k-repairs the Gram instead of
+        # refreshing whole planes and resetting the box.  The unlocked
+        # pool.gens read is benign: a stale (older) base only widens the
+        # delta — a superset patch is still correct.
+        dirty = None
+        pool_gens = pool.gens
+        if pool_gens is not None and pool_gens != gens:
+            dirty = self._journal_dirty_rows(frags, pool_gens, gens)
+        return pool.acquire(sorted(want), gens, dirty_rows=dirty)
 
     # -- call dispatch (executor.go:156-179) ------------------------------
 
@@ -2205,6 +2384,8 @@ class Executor:
                 changed = frame.set_bit(VIEW_STANDARD, row_id, col_id, timestamp)
                 if frame.inverse_enabled and frame.set_bit(VIEW_INVERSE, col_id, row_id, timestamp):
                     changed = True
+            if changed:
+                self._note_dirty_rows(index, frame.name, (row_id,))
             return changed
 
         if opt.remote or self.cluster is None or self.client_factory is None:

@@ -123,7 +123,10 @@ def _frame_stack(frame) -> list[tuple[str, str, int]]:
     out = []
     f = frame
     while f is not None:
-        out.append((f.f_code.co_qualname, f.f_code.co_filename, f.f_lineno))
+        # co_qualname is 3.11+; co_name keeps 3.10 serving (just less
+        # qualified frame names in the profile).
+        name = getattr(f.f_code, "co_qualname", f.f_code.co_name)
+        out.append((name, f.f_code.co_filename, f.f_lineno))
         f = f.f_back
     return out
 

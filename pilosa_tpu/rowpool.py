@@ -103,6 +103,7 @@ class DeviceRowPool:
         self.stat_misses = 0
         self.stat_evictions = 0
         self.stat_resets = 0
+        self.stat_repairs = 0
 
     @staticmethod
     def default_cap(n_slices: int, words: int) -> int:
@@ -185,9 +186,47 @@ class DeviceRowPool:
         else:  # block: [len(stale), len(rows), W]
             self.matrix = self.engine.set_plane_rows(self.matrix, stale, slots, block)
 
+    def _repair_dirty(self, stale: list[int], dirty_rows) -> bool:
+        """Patch ONLY the written rows' planes and rank-k-repair the box
+        Gram, instead of the blind whole-plane refresh + box reset: the
+        box (and with it the Gram, its glut, and the id_pos snapshot)
+        SURVIVES the write, so a small write costs O(dirty) row fetches
+        plus one dirty x resident pair-count dispatch — not an O(R^2)
+        Gram rebuild.  The caller (executor) guarantees ``dirty_rows``
+        covers every row whose storage changed across the stale slices
+        (fragment dirty-row journals); rows not resident in the pool
+        need no patch at all.  Returns False (nothing mutated) when the
+        dirty slots fall outside the Gram's slot range — an invariant
+        breach that the conservative full refresh handles."""
+        resident = sorted(r for r in set(dirty_rows) if r in self.slot_of)
+        if not resident:
+            return True  # writes only touched rows the pool doesn't hold
+        slots = [self.slot_of[r] for r in resident]
+        gram = self.box.get("gram")
+        if gram is not None and any(s >= gram.shape[0] for s in slots):
+            return False  # defensive: slot outside the Gram bucket
+        block = self.fetch(resident, stale)  # layout per self.row_major
+        if self.row_major:
+            self.matrix = self.engine.set_plane_rows_rm(
+                self.matrix, stale, slots, block
+            )
+        else:
+            self.matrix = self.engine.set_plane_rows(self.matrix, stale, slots, block)
+        if gram is not None:
+            d = gram.shape[0]
+            m = self.matrix if d == self.cap else self.matrix[:, :d]
+            gram = self.engine.gram_update_rows(m, gram, slots)
+            self.box["gram"] = gram
+            glut = self.box.get("gram_lut")
+            if glut is not None:
+                # rs/ps are membership-keyed and membership didn't change;
+                # only the count table is new.
+                self.box["gram_lut"] = (glut[0], np.ascontiguousarray(gram), glut[2])
+        return True
+
     # -- API --------------------------------------------------------------
 
-    def acquire(self, want: Sequence[int], gens: tuple):
+    def acquire(self, want: Sequence[int], gens: tuple, dirty_rows=None):
         """Ensure ``want`` rows are resident; returns (id_pos, matrix, box).
 
         ``id_pos`` maps every RESIDENT row id to its slot (a stable
@@ -195,6 +234,12 @@ class DeviceRowPool:
         array snapshot those slots refer to.  Raises ValueError when
         ``want`` alone exceeds the pool capacity — callers chunk their
         query batch by unique-row count first (``chunk_queries``).
+
+        ``dirty_rows``: the complete set of row ids written since this
+        pool's recorded generations (from the fragment dirty-row
+        journals), or None when unknown.  When provided, a generation
+        mismatch takes the PATCH lane (_repair_dirty) and the cache box
+        — including a warm Gram — survives the write.
         """
         want = list(dict.fromkeys(want))  # de-dup, keep order
         if len(want) > self.cap_max:
@@ -209,8 +254,13 @@ class DeviceRowPool:
                         si for si in range(self.n_slices) if self.gens[si] != gens[si]
                     ]
                     if stale:
-                        self._refresh_stale(stale)
-                        changed = True
+                        if dirty_rows is not None and self._repair_dirty(
+                            stale, dirty_rows
+                        ):
+                            self.stat_repairs += 1
+                        else:
+                            self._refresh_stale(stale)
+                            changed = True
                 self.gens = gens
             missing = [r for r in want if r not in self.slot_of]
             if missing:

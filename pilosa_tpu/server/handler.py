@@ -343,6 +343,12 @@ class Handler:
 
     def delete_index(self, index=None, **kw):
         self.holder.delete_index(index)
+        if self.executor is not None:
+            # Reclaim warm device state eagerly (serve states, row pools,
+            # Grams): validity tokens already prevent stale serving for a
+            # recreated namesake, but the old state would otherwise pin
+            # HBM until LRU churn evicts it.
+            self.executor.drop_index_state(index)
         if self.broadcaster is not None:
             self.broadcaster.delete_index(index)
         return self._json({})
@@ -383,6 +389,8 @@ class Handler:
         if idx is None:
             raise errors.ErrIndexNotFound(index)
         idx.delete_frame(frame)
+        if self.executor is not None:
+            self.executor.drop_frame_state(index, frame)
         if self.broadcaster is not None:
             self.broadcaster.delete_frame(index, frame)
         return self._json({})

@@ -62,6 +62,7 @@ class Server:
             client_factory=self.client_factory,
             host=self.host,
             max_writes_per_request=self.config.max_writes_per_request,
+            serve_state_cache=self.config.serve_state_cache,
             # Server ingest routes singleton SetBits through the
             # group-commit queue (concurrent clients batch into one
             # fragment pass + WAL append); opt out via env for A/B runs.

@@ -44,4 +44,9 @@ run BENCH_CONFIG=intersect_count_stream BENCH_SLICES=10240 BENCH_TIMED_RUNS=2
 #    forced-NO_GRAM row-major/slice-major tiers recorded in the unit).
 run BENCH_CONFIG=executor_gather BENCH_ROWS=1024
 run BENCH_CONFIG=executor_gather
+# 7) Mixed read/write serving: warm-state repair lane vs forced
+#    invalidate-and-rebuild, at 95/5 and 50/50 mixes (tiers in the JSON);
+#    the second line stresses a wider Gram (more rows) per repair.
+run BENCH_CONFIG=mixed
+run BENCH_CONFIG=mixed BENCH_ROWS=256 BENCH_SLICES=8
 echo "ALL DONE $(date +%H:%M:%S)" >> $OUT

@@ -52,6 +52,9 @@ def _run(env_extra, script="bench.py", timeout=240):
                       "BENCH_BATCH": "4", "BENCH_BITS_PER_ROW": "50", "BENCH_THREADS": "2"}),
         ("range_executor", {"BENCH_ITERS": "3", "BENCH_SLICES": "2",
                             "BENCH_BATCH": "4", "BENCH_BITS": "200"}),
+        # Mixed read/write tier: BENCH_SMOKE exercises the warm-state
+        # REPAIR lane end-to-end (patch + rebuild A/B) on CPU.
+        ("mixed", {"BENCH_SMOKE": "1"}),
         ("intersect_count_stream", {"BENCH_ITERS": "2", "BENCH_SLICES": "4",
                                     "BENCH_ROWS": "4", "BENCH_BATCH": "4",
                                     "BENCH_CHUNK_SLICES": "2"}),
@@ -70,6 +73,15 @@ def test_bench_config_emits_json(cfg, extra):
         names = [t["tier"] for t in result["tiers"]]
         assert len(names) >= 4 and len(set(names)) == len(names)
         assert all("qps" in t and "bandwidth_util" in t for t in result["tiers"])
+    if cfg == "mixed":
+        names = [t["tier"] for t in result["tiers"]]
+        assert names == ["mixed_95_5", "mixed_50_50"]
+        assert all(
+            t["qps"] > 0 and t["rebuild_qps"] > 0 and "speedup" in t
+            for t in result["tiers"]
+        )
+        # The smoke path must actually exercise the patch lane.
+        assert result["tiers"][1]["repairs"] > 0
 
 
 def test_star_trace_example_runs():

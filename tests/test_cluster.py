@@ -102,6 +102,9 @@ def test_config_toml_env_precedence(tmp_path, monkeypatch):
     cfg.apply_env({"PILOSA_HOST": "env:9", "PILOSA_CLUSTER_REPLICAS": "3"})
     assert cfg.host == "env:9"
     assert cfg.cluster.replica_n == 3
-    # round-trip through to_toml parses again
-    cfg2 = Config.from_dict(__import__("tomllib").loads(cfg.to_toml()))
+    # round-trip through to_toml parses again (config's tomllib alias
+    # falls back to the tomli backport on Python < 3.11)
+    from pilosa_tpu.config import tomllib
+
+    cfg2 = Config.from_dict(tomllib.loads(cfg.to_toml()))
     assert cfg2.cluster.replica_n == 3

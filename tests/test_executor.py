@@ -1538,6 +1538,120 @@ class TestServeLane:
         assert not errs, errs[:3]
         h.close()
 
+    def test_single_bit_write_repairs_warm_state(self, tmp_path):
+        """Read-your-writes through the PATCH lane: a single-bit write
+        below the repair budget must be served with updated counts by a
+        REPAIRED warm state (matrix row rewrite + rank-k Gram update),
+        not by dropping the state and rebuilding."""
+        from pilosa_tpu.core.view import VIEW_STANDARD
+
+        h, ex, batch = self._setup(tmp_path)
+        self._arm(ex, batch)
+        key = ("p", "f")
+        st0 = ex._serve_states[key]
+        pool = ex._matrix_cache[("p", "f", VIEW_STANDARD, (0, 1, 2), "")]
+        # Deterministic delta: clear then set the same bit, counting the
+        # row-3 diagonal through the warm lane around each write.
+        q = 'Count(Intersect(Bitmap(rowID=3, frame="f"), Bitmap(rowID=3, frame="f")))'
+        col = 2 * SLICE_WIDTH + 99
+        ex.execute("p", f'ClearBit(rowID=3, frame="f", columnID={col})')
+        before = ex.execute("p", q)[0]
+        ex.execute("p", f'SetBit(rowID=3, frame="f", columnID={col})')
+        after = ex.execute("p", q)[0]
+        assert after == before + 1
+        # The state was re-captured (patched), never dropped, and the
+        # pool took the repair lane — no reset, no blind plane refresh.
+        # (The ClearBit is usually a no-op on the random import — no
+        # generation bump — so only the SetBit is guaranteed to repair.)
+        st1 = ex._serve_states.get(key)
+        assert st1 is not None and st1 is not st0
+        assert pool.stat_repairs >= 1 and pool.stat_resets == 0
+        # Full-batch parity with the sequential numpy path after repair.
+        assert ex.execute("p", batch) == Executor(h, engine="numpy").execute("p", batch)
+        h.close()
+
+    def test_write_burst_over_budget_falls_back_to_rebuild(
+        self, tmp_path, monkeypatch
+    ):
+        """A burst touching more rows than the repair budget must take
+        the full invalidate-and-rebuild path — and still satisfy
+        read-your-writes, then re-arm."""
+        from pilosa_tpu.core.view import VIEW_STANDARD
+
+        monkeypatch.setenv("PILOSA_TPU_REPAIR_ROWS_MAX", "4")
+        h, ex, batch = self._setup(tmp_path)  # Executor reads the env at init
+        self._arm(ex, batch)
+        pool = ex._matrix_cache[("p", "f", VIEW_STANDARD, (0, 1, 2), "")]
+        burst = " ".join(
+            f'SetBit(rowID={r}, frame="f", columnID={SLICE_WIDTH + 777 + r})'
+            for r in range(10)  # 10 distinct rows > budget 4
+        )
+        ex.execute("p", burst)
+        want = Executor(h, engine="numpy").execute("p", batch)
+        assert ex.execute("p", batch) == want
+        assert pool.stat_repairs == 0  # over budget: no patch attempted
+        # The lane re-arms and keeps serving correct counts.
+        assert ex.execute("p", batch) == want
+        assert ex._serve_states, "serve lane did not re-arm after rebuild"
+        h.close()
+
+    def test_repair_disabled_env_forces_rebuild(self, tmp_path, monkeypatch):
+        """PILOSA_TPU_REPAIR_ROWS_MAX=0 is the A/B lever bench_mixed
+        uses: every write must invalidate, none may patch."""
+        from pilosa_tpu.core.view import VIEW_STANDARD
+
+        monkeypatch.setenv("PILOSA_TPU_REPAIR_ROWS_MAX", "0")
+        h, ex, batch = self._setup(tmp_path)
+        self._arm(ex, batch)
+        pool = ex._matrix_cache[("p", "f", VIEW_STANDARD, (0, 1, 2), "")]
+        ex.execute("p", 'SetBit(rowID=3, frame="f", columnID=98765)')
+        assert ex.execute("p", batch) == Executor(h, engine="numpy").execute("p", batch)
+        assert pool.stat_repairs == 0
+        h.close()
+
+    def test_frame_recreate_never_serves_stale(self, tmp_path):
+        """Deleting and recreating a frame of the same name must drop the
+        old warm state (identity/generation tokens) — counts come from
+        the NEW frame's bits."""
+        h, ex, batch = self._setup(tmp_path)
+        self._arm(ex, batch)
+        h.index("p").delete_frame("f")
+        h.index("p").create_frame("f", FrameOptions())
+        fr = h.index("p").frame("f")
+        fr.import_bits(np.array([3, 9], dtype=np.uint64), np.array([5, 5], dtype=np.uint64))
+        got = ex.execute("p", batch)
+        assert got == Executor(h, engine="numpy").execute("p", batch)
+        h.close()
+
+    def test_drop_frame_state_hook(self, tmp_path):
+        """The deletion hook reclaims every cached artifact for the
+        frame: serve states, row pools, fast-write pins, dirty ledger."""
+        h, ex, batch = self._setup(tmp_path)
+        self._arm(ex, batch)
+        ex.execute("p", 'SetBit(rowID=1, frame="f", columnID=424242)')
+        assert any(k[:2] == ("p", "f") for k in ex._matrix_cache)
+        ex.drop_frame_state("p", "f")
+        assert ("p", "f") not in ex._serve_states
+        assert not any(k[:2] == ("p", "f") for k in ex._matrix_cache)
+        assert ("p", "f") not in ex._fastwrite_cache
+        assert ("p", "f") not in ex._dirty_rows
+        # Still serves correctly from scratch afterwards.
+        assert ex.execute("p", batch) == Executor(h, engine="numpy").execute("p", batch)
+        # Index-level drop clears every frame's artifacts too.
+        ex.execute("p", batch)
+        ex.drop_index_state("p")
+        assert not ex._serve_states
+        assert not any(k[0] == "p" for k in ex._matrix_cache)
+        h.close()
+
+    def test_serve_state_cache_size_configurable(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("PILOSA_SERVE_STATE_CACHE", "2")
+        h, ex, _ = self._setup(tmp_path)
+        assert ex._serve_states_max == 2
+        ex2 = Executor(h, serve_state_cache=7)  # explicit arg wins
+        assert ex2._serve_states_max == 7
+        h.close()
+
 
 def test_serve_lane_multi_frame_alternation(tmp_path):
     """Two frames' dashboards alternating must BOTH stay armed (the

@@ -493,3 +493,72 @@ def test_snapshot_skips_storage_reread_without_mmap(tmp_path, monkeypatch):
     assert calls == []
     assert f.contains(2, 20)
     f.close()
+
+
+class TestDirtyRowJournal:
+    """The dirty-row journal behind warm-state repair: exact deltas for
+    small writes, None (unenumerable) for bulk changes, eviction, and
+    recreated fragments."""
+
+    def test_exact_delta_set_clear(self, frag):
+        g0 = frag.generation
+        assert frag.rows_dirty_since(g0) == set()
+        frag.set_bit(1, 10)
+        frag.set_bit(2, 20)
+        frag.clear_bit(1, 10)
+        assert frag.rows_dirty_since(g0) == {1, 2}
+        g1 = frag.generation
+        frag.set_bits([5, 6, 5], [1, 2, 3])
+        assert frag.rows_dirty_since(g1) == {5, 6}
+        assert frag.rows_dirty_since(g0) == {1, 2, 5, 6}
+
+    def test_batched_set_bits_large_path(self, frag):
+        # >8 positions takes the vectorized branch; same journal contract.
+        g0 = frag.generation
+        rows = list(range(12))
+        frag.set_bits(rows, [100 + r for r in rows])
+        assert frag.rows_dirty_since(g0) == set(rows)
+
+    def test_noop_writes_do_not_log(self, frag):
+        frag.set_bit(3, 30)
+        g = frag.generation
+        frag.set_bit(3, 30)  # duplicate: no change, no generation bump
+        frag.clear_bit(9, 90)  # absent: no change
+        assert frag.generation == g
+        assert frag.rows_dirty_since(g) == set()
+
+    def test_bulk_import_unenumerable(self, frag):
+        g0 = frag.generation
+        frag.import_bits([7], [3])
+        assert frag.rows_dirty_since(g0) is None
+        # After the import, new small writes are enumerable again.
+        g1 = frag.generation
+        frag.set_bit(8, 80)
+        assert frag.rows_dirty_since(g1) == {8}
+
+    def test_journal_eviction_floors(self, frag, monkeypatch):
+        from pilosa_tpu.core import fragment as frag_mod
+
+        monkeypatch.setattr(frag_mod, "_DIRTY_LOG_MAX", 8)
+        g0 = frag.generation
+        for i in range(12):  # 12 distinct bits > log max 8
+            frag.set_bit(i, 1000 + i)
+        assert frag.rows_dirty_since(g0) is None  # evicted past g0
+        g1 = frag.generation
+        frag.set_bit(50, 5000)
+        assert frag.rows_dirty_since(g1) == {50}  # recent span still exact
+
+    def test_recreated_fragment_floor(self, tmp_path):
+        from pilosa_tpu.core.fragment import Fragment
+
+        f1 = Fragment(str(tmp_path / "a"), "i", "f", "standard", 0)
+        f1.open()
+        f1.set_bit(1, 1)
+        g_old = f1.generation
+        f1.close()
+        f2 = Fragment(str(tmp_path / "b"), "i", "f", "standard", 0)
+        f2.open()
+        # A consumer anchored on the OLD fragment's generation can never
+        # enumerate a delta against the new one.
+        assert f2.rows_dirty_since(g_old) is None
+        f2.close()

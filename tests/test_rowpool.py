@@ -190,3 +190,76 @@ def test_chunk_queries_oversize_ok():
     qs = [(0, 1), tuple(range(10)), (2, 3)]
     chunks = chunk_queries(qs, lambda q: q, 4, oversize_ok=True)
     assert chunks == [[(0, 1)], [tuple(range(10))], [(2, 3)]]
+
+
+def _np_gram(pool, rows, resident, n):
+    """Ground-truth AND-count Gram over the pool's slot assignment."""
+    from pilosa_tpu.roaring import _popcount_words
+
+    g = np.zeros((n, n), dtype=np.int64)
+    slot = {r: pool.slot_of[r] for r in resident}
+    for a in resident:
+        for b in resident:
+            c = 0
+            for si in range(pool.n_slices):
+                wa = rows.get((si, a), np.zeros(W, np.uint32))
+                wb = rows.get((si, b), np.zeros(W, np.uint32))
+                c += _popcount_words(wa & wb)
+            g[slot[a], slot[b]] = c
+    return g
+
+
+def test_acquire_dirty_rows_repairs_in_place():
+    """The PATCH lane: a generation bump with a known dirty-row set
+    rewrites only those rows' planes, keeps the box (and its Gram/glut)
+    alive, and rank-k-updates the Gram to exact counts."""
+    rng = np.random.default_rng(7)
+    rows = fill_rows(rng, 2, range(4))
+    pool, live = make_pool(n_slices=2, rows=rows, cap_max=8)
+    id_pos, _, box1 = pool.acquire([0, 1, 2, 3], (1, 1))
+    # Seed a warm Gram + glut the way the executor does (bucket = pow2(4)).
+    gram = _np_gram(pool, live, [0, 1, 2, 3], 4)
+    box1["gram"] = gram
+    rs = np.array(sorted(id_pos), dtype=np.int64)
+    ps = np.fromiter((id_pos[int(v)] for v in rs), dtype=np.int32, count=len(rs))
+    box1["gram_lut"] = (rs, np.ascontiguousarray(gram), ps)
+    # Mutate row 2 on slice 1 only; bump slice 1's generation.
+    live[(1, 2)] = rng.integers(0, 1 << 32, size=W, dtype=np.uint32)
+    id_pos2, matrix, box2 = pool.acquire([0, 1], (1, 2), dirty_rows={2})
+    assert box2 is box1, "box must survive the patch lane"
+    assert pool.stat_repairs == 1 and pool.stat_resets == 0
+    # Matrix reflects the new row data; untouched rows kept their planes.
+    np.testing.assert_array_equal(matrix[1, id_pos2[2]], live[(1, 2)])
+    np.testing.assert_array_equal(matrix[0, id_pos2[0]], live[(0, 0)])
+    # The repaired Gram matches a from-scratch recount, and the glut's
+    # count table was swapped to it (copy-on-write: the old array is not
+    # mutated).
+    want = _np_gram(pool, live, [0, 1, 2, 3], 4)
+    np.testing.assert_array_equal(box2["gram"], want)
+    np.testing.assert_array_equal(box2["gram_lut"][1], want)
+    assert box2["gram"] is not gram
+
+
+def test_acquire_dirty_rows_nonresident_keeps_box():
+    """Writes to rows the pool does not hold need no matrix or Gram work
+    at all — the box survives untouched."""
+    rng = np.random.default_rng(8)
+    rows = fill_rows(rng, 2, range(6))
+    pool, live = make_pool(n_slices=2, rows=rows, cap_max=4)
+    _, _, box1 = pool.acquire([0, 1], (1, 1))
+    live[(0, 5)] = rng.integers(0, 1 << 32, size=W, dtype=np.uint32)
+    _, _, box2 = pool.acquire([0, 1], (2, 1), dirty_rows={5})
+    assert box2 is box1 and pool.stat_repairs == 1
+
+
+def test_acquire_without_dirty_rows_still_resets_box():
+    """No delta information -> the conservative full refresh + box reset
+    (the pre-repair behavior) is unchanged."""
+    rng = np.random.default_rng(9)
+    rows = fill_rows(rng, 2, range(4))
+    pool, live = make_pool(n_slices=2, rows=rows, cap_max=8)
+    _, _, box1 = pool.acquire([0, 1], (1, 1))
+    live[(0, 0)] = rng.integers(0, 1 << 32, size=W, dtype=np.uint32)
+    id_pos, matrix, box2 = pool.acquire([0, 1], (2, 1))
+    assert box2 is not box1 and pool.stat_repairs == 0
+    np.testing.assert_array_equal(matrix[0, id_pos[0]], live[(0, 0)])
