@@ -772,10 +772,19 @@ def bench_mixed() -> dict:
     read_qs = [build_read(s) for s in range(4)]
     state = {"engine": "?"}
 
-    def run_mix(write_every: int, repair_on: bool) -> dict:
+    def run_mix(write_every: int, repair_on: bool, burst: int = 1,
+                n_req: int = 0) -> dict:
+        """One mixed-traffic run.  ``burst > 1`` switches the 50/50
+        schedule from strict alternation to coalescing bursts: ``burst``
+        back-to-back writes followed by ``burst`` reads — the whole
+        burst's dirty rows accumulate in the ledger/journals and the
+        FIRST read dispatches ONE deferred repair for the union (one
+        pool rewrite + one rank-k Gram update per burst, not per
+        write)."""
         prior = os.environ.get("PILOSA_TPU_REPAIR_ROWS_MAX")
         if not repair_on:
             os.environ["PILOSA_TPU_REPAIR_ROWS_MAX"] = "0"
+        n_req = n_req or n_requests
         try:
             with tempfile.TemporaryDirectory() as d:
                 h = Holder(d)
@@ -800,8 +809,12 @@ def bench_mixed() -> dict:
                 lat_other: list = []
                 last_was_write = False
                 t0 = time.perf_counter()
-                for i in range(n_requests):
-                    if write_every and i % write_every == write_every - 1:
+                for i in range(n_req):
+                    if burst > 1:
+                        is_write = i % (2 * burst) < burst  # W^b R^b cycles
+                    else:
+                        is_write = write_every and i % write_every == write_every - 1
+                    if is_write:
                         r = wcount % n_rows
                         c = (SLICE_WIDTH - reserve) + (wcount // n_rows) % reserve
                         ex.execute("m", f'SetBit(rowID={r}, frame="f", columnID={c})')
@@ -825,6 +838,9 @@ def bench_mixed() -> dict:
                 repairs = sum(
                     p.stat_repairs for p in ex._matrix_cache.values()
                 )
+                patch_planes = sum(
+                    p.stat_patch_planes for p in ex._matrix_cache.values()
+                )
                 h.close()
             return {
                 "qps": calls / dt,
@@ -833,6 +849,7 @@ def bench_mixed() -> dict:
                 ),
                 "steady_ms": 1e3 * float(np.mean(lat_other)) if lat_other else None,
                 "repairs": repairs,
+                "patch_planes": patch_planes,
             }
         finally:
             if prior is None:
@@ -840,10 +857,20 @@ def bench_mixed() -> dict:
             else:
                 os.environ["PILOSA_TPU_REPAIR_ROWS_MAX"] = prior
 
+    # Coalescing tiers: 50/50 at write-burst sizes 8 and 64 — each
+    # burst's writes batch into ONE deferred repair dispatch, so
+    # qps/repairs scale with the burst (requests scale so every tier
+    # sees several full cycles).
     tiers = []
-    for name, write_every in (("mixed_95_5", 20), ("mixed_50_50", 2)):
-        rep = run_mix(write_every, True)
-        reb = run_mix(write_every, False)
+    plan = [
+        ("mixed_95_5", 20, 1, 0),
+        ("mixed_50_50", 2, 1, 0),
+        ("mixed_50_50_b8", 2, 8, max(n_requests, 8 * 8)),
+        ("mixed_50_50_b64", 2, 64, max(n_requests, 8 * 64)),
+    ]
+    for name, write_every, burst, n_req in plan:
+        rep = run_mix(write_every, True, burst=burst, n_req=n_req)
+        reb = run_mix(write_every, False, burst=burst, n_req=n_req)
         tiers.append({
             "tier": name,
             "qps": round(rep["qps"], 1),
@@ -857,6 +884,7 @@ def bench_mixed() -> dict:
             ),
             "steady_ms": round(rep["steady_ms"], 3) if rep["steady_ms"] else None,
             "repairs": rep["repairs"],
+            "patch_planes": rep["patch_planes"],
         })
     head = tiers[0]
     return {
@@ -1208,24 +1236,19 @@ def bench_topn_p50() -> dict:
     }
 
 
-def bench_lockstep() -> dict:
-    """Lockstep-service throughput: a 2-rank SPMD job (CPU gloo mesh —
-    the shape this box can spawn; on a pod the same path rides ICI)
-    serving batched PQL over HTTP with concurrent clients, vs the SAME
-    requests through a single in-process executor.  Exercises the
-    pipelined total order: N requests in flight on the control plane,
-    execution in sequence order on both ranks."""
-    import re
+def _run_lockstep_job(queries, n_clients: int, n_ranks: int, env_extra=None,
+                      warm: int = 6):
+    """Spawn an n-rank lockstep job (tests/lockstep_worker.py), POST
+    ``queries`` from ``n_clients`` concurrent clients, tear the job
+    down, and return (wall_seconds, responses).  Shared by the lockstep
+    throughput bench and the request-coalescing bench (which runs the
+    SAME job twice with different coalescing env)."""
     import subprocess
     import sys
     import tempfile
     import urllib.request
     from concurrent.futures import ThreadPoolExecutor
 
-    batch = int(os.environ.get("BENCH_BATCH", "64"))
-    iters = int(os.environ.get("BENCH_ITERS", "60"))
-    n_clients = int(os.environ.get("BENCH_THREADS", "6"))
-    n_ranks = int(os.environ.get("BENCH_RANKS", "2"))
     repo = os.path.dirname(os.path.abspath(__file__))
 
     def free_port():
@@ -1242,6 +1265,7 @@ def bench_lockstep() -> dict:
     env.pop("JAX_PLATFORMS", None)
     env["PYTHONPATH"] = repo
     env["XLA_FLAGS"] = ""
+    env.update(env_extra or {})
     worker = os.path.join(repo, "tests", "lockstep_worker.py")
     errs = [tempfile.NamedTemporaryFile("w+", delete=False) for _ in range(n_ranks)]
     procs = [
@@ -1256,32 +1280,29 @@ def bench_lockstep() -> dict:
         line = procs[0].stdout.readline()
         assert json.loads(line).get("ready"), line
 
-        rng = np.random.default_rng(17)
-        def mk_query():
-            pairs = rng.integers(0, 4, size=(batch, 2))
-            return " ".join(
-                f'Count(Intersect(Bitmap(rowID={a}, frame="f"), Bitmap(rowID={b}, frame="f")))'
-                for a, b in pairs
-            )
-        queries = [mk_query() for _ in range(iters)]
-
         def post(q):
             req = urllib.request.Request(
                 f"http://127.0.0.1:{http}/index/g/query", data=q.encode(), method="POST")
             return json.loads(urllib.request.urlopen(req, timeout=120).read())
 
-        for q in queries[:6]:
+        for q in queries[:warm]:
             post(q)  # warm: matrices, jit, memo
         t0 = time.perf_counter()
         with ThreadPoolExecutor(n_clients) as pool:
             outs = list(pool.map(post, queries))
         dt = time.perf_counter() - t0
-        qps = iters * batch / dt
-        assert all("results" in o and len(o["results"]) == batch for o in outs)
     finally:
         try:
             procs[0].stdin.write("\n")
             procs[0].stdin.flush()
+        except Exception:
+            pass
+        stats = {}
+        try:  # rank 0's final JSON line carries coalescing telemetry
+            for line in procs[0].stdout:
+                line = line.strip()
+                if line:
+                    stats = json.loads(line)
         except Exception:
             pass
         for p in procs:
@@ -1292,6 +1313,35 @@ def bench_lockstep() -> dict:
         for f in errs:
             f.close()
             os.unlink(f.name)
+    return dt, outs, stats
+
+
+def bench_lockstep() -> dict:
+    """Lockstep-service throughput: a 2-rank SPMD job (CPU gloo mesh —
+    the shape this box can spawn; on a pod the same path rides ICI)
+    serving batched PQL over HTTP with concurrent clients, vs the SAME
+    requests through a single in-process executor.  Exercises the
+    pipelined total order: N requests in flight on the control plane,
+    execution in sequence order on both ranks."""
+
+    batch = int(os.environ.get("BENCH_BATCH", "64"))
+    iters = int(os.environ.get("BENCH_ITERS", "60"))
+    n_clients = int(os.environ.get("BENCH_THREADS", "6"))
+    n_ranks = int(os.environ.get("BENCH_RANKS", "2"))
+
+    rng = np.random.default_rng(17)
+
+    def mk_query():
+        pairs = rng.integers(0, 4, size=(batch, 2))
+        return " ".join(
+            f'Count(Intersect(Bitmap(rowID={a}, frame="f"), Bitmap(rowID={b}, frame="f")))'
+            for a, b in pairs
+        )
+
+    queries = [mk_query() for _ in range(iters)]
+    dt, outs, _stats = _run_lockstep_job(queries, n_clients, n_ranks)
+    qps = iters * batch / dt
+    assert all("results" in o and len(o["results"]) == batch for o in outs)
 
     # Single-rank baseline: same queries through one in-process executor.
     import tempfile as _tf
@@ -1332,12 +1382,64 @@ def bench_lockstep() -> dict:
     }
 
 
+def bench_lockstep_coalesce() -> dict:
+    """Lockstep request-coalescing tier: SMALL single-call requests from
+    many concurrent clients — the shape where the per-request fixed cost
+    (HTTP + one control-plane entry + one ack round per request,
+    BACKLOG's ~1.9 ms/request) dominates — with coalescing ON (rank 0
+    drains its queue into one batch replay entry; the default) vs
+    forced OFF (``PILOSA_TPU_LOCKSTEP_COALESCE=1``: one entry per
+    request, the PR-1 behavior).  Per-request overhead must DROP with
+    batch size; both phases run the same request stream on a fresh
+    2-rank job.  BENCH_SMOKE=1 shrinks the stream for CI."""
+    smoke = os.environ.get("BENCH_SMOKE", "").lower() in ("1", "true", "yes")
+    iters = int(os.environ.get("BENCH_ITERS", "24" if smoke else "400"))
+    n_clients = int(os.environ.get("BENCH_THREADS", "4" if smoke else "16"))
+    n_ranks = int(os.environ.get("BENCH_RANKS", "2"))
+
+    rng = np.random.default_rng(29)
+    queries = [
+        f'Count(Intersect(Bitmap(rowID={a}, frame="f"), Bitmap(rowID={b}, frame="f")))'
+        for a, b in rng.integers(0, 4, size=(iters, 2)).tolist()
+    ]
+    tiers = []
+    for name, env_extra in (
+        ("coalesce_on", {}),
+        ("coalesce_off", {"PILOSA_TPU_LOCKSTEP_COALESCE": "1"}),
+    ):
+        dt, outs, stats = _run_lockstep_job(queries, n_clients, n_ranks, env_extra)
+        assert all("results" in o and len(o["results"]) == 1 for o in outs)
+        n_b = stats.get("batches") or 0
+        tiers.append({
+            "tier": name,
+            "rps": round(iters / dt, 1),
+            "per_request_ms": round(1e3 * dt / iters, 3),
+            "batches": n_b,
+            "mean_batch": (
+                round(stats.get("requests", 0) / n_b, 2) if n_b else None
+            ),
+        })
+    on, off = tiers[0], tiers[1]
+    return {
+        "metric": "lockstep_coalesce_rps",
+        "value": on["rps"],
+        "unit": (
+            f"single-call PQL requests/sec via {n_ranks}-rank lockstep HTTP "
+            f"({n_clients} clients; coalesced {on['per_request_ms']} ms/req vs "
+            f"uncoalesced {off['per_request_ms']} ms/req)"
+        ),
+        "vs_baseline": round(on["rps"] / off["rps"], 3),
+        "tiers": tiers,
+    }
+
+
 def main() -> None:
     cfg = os.environ.get("BENCH_CONFIG", "intersect_count")
     if cfg != "intersect_count":
         result = {
             "setbit": bench_setbit,
             "lockstep": bench_lockstep,
+            "lockstep_coalesce": bench_lockstep_coalesce,
             "topn": bench_topn,
             "union64": bench_union64,
             "timerange": bench_timerange,

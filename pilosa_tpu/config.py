@@ -56,6 +56,14 @@ class Config:
     # dashboard kept armed for the single-call native serve lane.  Size
     # for the number of frames a workload alternates between.
     serve_state_cache: int = 4
+    # Warm-state repair budget in dirty rows: write bursts touching at
+    # most this many distinct rows PATCH the warm serving state (pool
+    # row rewrite + rank-k Gram repair) instead of rebuilding it; 0
+    # disables repair outright (the bench A/B lever).
+    repair_rows_max: int = 64
+    # Row ceiling for the cached all-pairs Gram strategy (4096 rows = a
+    # 64 MiB Gram; raise on host-attached hardware).
+    gram_rows_max: int = 4096
 
     @classmethod
     def from_toml(cls, path: str) -> "Config":
@@ -80,6 +88,8 @@ class Config:
         cfg.serve_state_cache = int(
             raw.get("serve-state-cache", cfg.serve_state_cache)
         )
+        cfg.repair_rows_max = int(raw.get("repair-rows-max", cfg.repair_rows_max))
+        cfg.gram_rows_max = int(raw.get("gram-rows-max", cfg.gram_rows_max))
         cl = raw.get("cluster", {})
         cfg.cluster.replica_n = cl.get("replicas", cfg.cluster.replica_n)
         cfg.cluster.type = cl.get("type", cfg.cluster.type)
@@ -109,6 +119,10 @@ class Config:
             self.stats = env["PILOSA_STATS"]
         if "PILOSA_SERVE_STATE_CACHE" in env:
             self.serve_state_cache = int(env["PILOSA_SERVE_STATE_CACHE"])
+        if "PILOSA_TPU_REPAIR_ROWS_MAX" in env:
+            self.repair_rows_max = int(env["PILOSA_TPU_REPAIR_ROWS_MAX"])
+        if "PILOSA_TPU_GRAM_ROWS_MAX" in env:
+            self.gram_rows_max = int(env["PILOSA_TPU_GRAM_ROWS_MAX"])
         return self
 
     def to_toml(self) -> str:

@@ -240,24 +240,42 @@ class NumpyEngine:
         all-pairs popcount would dwarf the direct path)."""
         return None
 
-    def gram_update_rows(self, matrix, gram, slots):
+    def gram_update_rows(self, matrix, gram, slots, old_matrix=None, slice_idxs=None):
         """Rank-k repair of a host AND-count Gram after in-place row
         rewrites: recompute ONLY the dirty rows/columns with one batched
         pair-count pass against the (already patched) resident matrix —
         O(K*R*W) instead of the O(R^2*W) full rebuild.  Returns a NEW
         array (copy-on-write: readers holding the old Gram keep a
         consistent pre-write snapshot; AND is symmetric, so one K x R
-        count block fills both the rows and the columns)."""
+        count block fills both the rows and the columns).
+
+        Per-(row, slice) delta mode: with ``old_matrix`` (the pre-patch
+        snapshot) and ``slice_idxs`` (the slice planes actually written),
+        the dirty rows' counts are ADJUSTED by (new - old) restricted to
+        those slices instead of recomputed over the whole span —
+        unchanged slices cancel out of the difference, so the dispatch
+        covers K x R x |dirty slices| instead of K x R x S.  Falls back
+        to the full recompute when the restriction wouldn't pay
+        (>= half the slices dirty)."""
         slots = np.asarray(sorted({int(s) for s in slots}), dtype=np.int64)
         n = gram.shape[0]
         pairs = np.empty((len(slots) * n, 2), dtype=np.int32)
         pairs[:, 0] = np.repeat(slots.astype(np.int32), n)
         pairs[:, 1] = np.tile(np.arange(n, dtype=np.int32), len(slots))
-        block = (
-            np.asarray(self.gather_count("and", matrix, pairs))
-            .reshape(len(slots), n)
-            .astype(gram.dtype)
-        )
+        si = sorted({int(s) for s in slice_idxs}) if slice_idxs is not None else None
+        if old_matrix is not None and si and 2 * len(si) < matrix.shape[0]:
+            new_c = np.asarray(self.gather_count("and", matrix[si], pairs))
+            old_c = np.asarray(self.gather_count("and", old_matrix[si], pairs))
+            delta = (new_c.astype(np.int64) - old_c.astype(np.int64)).reshape(
+                len(slots), n
+            )
+            block = (np.asarray(gram)[slots, :] + delta).astype(gram.dtype)
+        else:
+            block = (
+                np.asarray(self.gather_count("and", matrix, pairs))
+                .reshape(len(slots), n)
+                .astype(gram.dtype)
+            )
         out = np.array(gram, copy=True)
         out[slots, :] = block
         out[:, slots] = block.T
@@ -546,12 +564,23 @@ class JaxEngine:
             self._gram_jit = jax.jit(pair_gram)
         return self.to_numpy(self._gram_jit(self._jnp.asarray(matrix))).astype(np.int64)
 
-    def gram_update_rows(self, matrix, gram, slots):
+    def gram_update_rows(self, matrix, gram, slots, old_matrix=None, slice_idxs=None):
         """Rank-k Gram repair (see NumpyEngine.gram_update_rows): one
         batched gather-count dispatch recomputes the dirty rows/columns.
         The dirty-slot axis pads to a power-of-two bucket (recomputing a
         row twice is idempotent) so the jitted dispatch shape stays
-        stable across repairs of 1..K rows."""
+        stable across repairs of 1..K rows.
+
+        Per-(row, slice) delta mode (old_matrix + slice_idxs): two
+        dispatches restricted to the written slice planes adjust the
+        dirty rows by (new - old) — unchanged slices cancel, so a
+        single-slice write repairs in O(K*R) counts regardless of the
+        state's span.  The restricted slice axis pads to a power-of-two
+        bucket with a CLEAN (unwritten) slice so jitted shapes stay
+        stable: a clean slice's old and new planes are identical, so its
+        padded contribution cancels exactly.  Falls back to the full
+        recompute when no clean pad slice exists or the restriction
+        wouldn't pay (>= half the slices dirty after padding)."""
         slots = sorted({int(s) for s in slots})
         k = len(slots)
         kb = 1 << (k - 1).bit_length() if k > 1 else 1
@@ -560,12 +589,47 @@ class JaxEngine:
         pairs = np.empty((kb * n, 2), dtype=np.int32)
         pairs[:, 0] = np.repeat(padded, n)
         pairs[:, 1] = np.tile(np.arange(n, dtype=np.int32), kb)
+        idx = np.asarray(slots, dtype=np.int64)
+        n_slices = matrix.shape[0]
+        si = sorted({int(s) for s in slice_idxs}) if slice_idxs is not None else None
+        if old_matrix is not None and si:
+            sb = 1 << (len(si) - 1).bit_length() if len(si) > 1 else 1
+            clean = next((s for s in range(n_slices) if s not in set(si)), None)
+            if clean is not None and 2 * sb < n_slices:
+                sel = self._jnp.asarray(
+                    np.asarray(si + [clean] * (sb - len(si)), dtype=np.int32)
+                )
+                if 2 * k >= n:
+                    # Wide repairs (a coalesced burst dirtying most of the
+                    # matrix): k*R direct pair counts approach the cost of
+                    # the whole Gram — two restricted-slice pair_gram
+                    # builds (MXU matmul shape; fixed R^2 cost) beat the
+                    # gather dispatch past k ~ R/2 (measured on the CPU
+                    # build host; the MXU makes them cheaper still), and
+                    # the FULL-gram delta is exact (pairs with no dirty
+                    # row have identical planes in old and new, so their
+                    # delta is zero).
+                    pg_new = self.pair_gram(matrix[sel])
+                    pg_old = None if pg_new is None else self.pair_gram(old_matrix[sel])
+                    if pg_old is not None:
+                        return (
+                            np.asarray(gram) + (pg_new - pg_old)
+                        ).astype(gram.dtype)
+                new_c = np.asarray(self.gather_count("and", matrix[sel], pairs))
+                old_c = np.asarray(self.gather_count("and", old_matrix[sel], pairs))
+                delta = (new_c.astype(np.int64) - old_c.astype(np.int64)).reshape(
+                    kb, n
+                )[:k]
+                block = (np.asarray(gram)[idx, :] + delta).astype(gram.dtype)
+                out = np.array(gram, copy=True)
+                out[idx, :] = block
+                out[:, idx] = block.T
+                return out
         block = (
             np.asarray(self.gather_count("and", matrix, pairs))
             .reshape(kb, n)[:k]
             .astype(gram.dtype)
         )
-        idx = np.asarray(slots, dtype=np.int64)
         out = np.array(gram, copy=True)
         out[idx, :] = block
         out[:, idx] = block.T
@@ -701,6 +765,14 @@ class MeshEngine(JaxEngine):
 
     def set_plane_rows(self, matrix, slice_idxs, slots, block):
         return self._repin(super().set_plane_rows(matrix, slice_idxs, slots, block), matrix)
+
+    def gram_update_rows(self, matrix, gram, slots, old_matrix=None, slice_idxs=None):
+        # No restricted-slice delta on meshes: indexing a subset of the
+        # sharded slice axis breaks the shard_map divisibility the
+        # kernels need (and touches non-addressable shards on
+        # multi-process jobs).  The full rank-k recompute stays
+        # SPMD-safe on every rank.
+        return super().gram_update_rows(matrix, gram, slots)
 
     def _pallas_mode(self, n_slices: int, w: int) -> str:
         """How to run kernels under the mesh: "pallas" (shard_map'd

@@ -218,6 +218,8 @@ class Executor:
         max_writes_per_request: int = 0,
         write_queue: bool = False,
         serve_state_cache: int = 0,
+        repair_rows_max: Optional[int] = None,
+        gram_rows_max: int = 0,
     ):
         self.holder = holder
         self.engine = new_engine(engine) if isinstance(engine, str) else engine
@@ -271,10 +273,16 @@ class Executor:
         # distinct rows gets the PATCH lane (in-place matrix row rewrite +
         # rank-k Gram repair); bigger deltas fall back to the full
         # invalidate-and-rebuild.  0 disables repair entirely (A/B lever;
-        # bench_mixed uses it for the rebuild baseline).
-        self._repair_rows_max = int(
-            os.environ.get("PILOSA_TPU_REPAIR_ROWS_MAX", "64")
-        )
+        # bench_mixed uses it for the rebuild baseline).  Precedence
+        # matches serve_state_cache: constructor arg (server passes
+        # Config.repair_rows_max) > PILOSA_TPU_REPAIR_ROWS_MAX env >
+        # default 64 (None = not configured; 0 is meaningful).
+        if repair_rows_max is None:
+            repair_rows_max = int(os.environ.get("PILOSA_TPU_REPAIR_ROWS_MAX", "64"))
+        self._repair_rows_max = repair_rows_max
+        # Gram row ceiling override (same precedence; 0 = env/default,
+        # resolved lazily in _gram_env alongside the NO_GRAM switch).
+        self._gram_rows_max_cfg = gram_rows_max
         # Per-(index, frame) dirty-row ledger fed by the write paths: the
         # serve-state patch lane's cheap budget precheck (the exact
         # generation-anchored delta comes from the fragment dirty-row
@@ -702,11 +710,18 @@ class Executor:
 
     def _note_dirty_rows(self, index: str, fname: str, rows) -> None:
         """Accumulate the per-(index, frame) dirty-row ledger feeding the
-        serve-state patch lane's budget precheck.  Saturates (value None)
-        past 4x the repair budget so a write burst can't grow it
-        unbounded — saturation just means 'rebuild, don't walk journals'.
-        Skipped entirely while nothing is warm (pure-ingest workloads pay
-        zero here)."""
+        serve-state patch lane's budget precheck.  This is the ONLY
+        per-write bookkeeping the coalescing pipeline does: the repair
+        itself is deferred until a read needs the warm state, so a write
+        burst costs one batched patch dispatch, not one per write.
+        Saturates (value None) past 4x the repair budget so a burst
+        can't grow it unbounded — saturation just means 'rebuild, don't
+        walk journals'.  Skipped entirely while nothing is warm
+        (pure-ingest workloads pay zero here) and when repair is
+        disabled (the ledger's only consumer, _serve_state_repair, can
+        never use it with a zero budget)."""
+        if self._repair_rows_max <= 0:
+            return
         if not self._serve_states and not self._matrix_cache:
             return
         key = (index, fname)
@@ -721,19 +736,25 @@ class Executor:
             if len(cur) > cap:
                 self._dirty_rows[key] = None
 
-    def _journal_dirty_rows(self, frags, old_gens, new_gens) -> Optional[set]:
-        """The EXACT set of rows written between two generation vectors,
-        from the fragment dirty-row journals — or None when the delta is
-        unenumerable (bulk import/restore, journal evicted, fragment
-        deleted/recreated) or over the repair budget; callers then take
-        the full rebuild path.  Journals are maintained inside the
-        fragment's own locked mutation methods, so this covers every
-        writer — not just this executor's write paths."""
+    def _journal_dirty_rows(self, frags, old_gens, new_gens) -> Optional[dict]:
+        """The EXACT per-(row, slice) delta written between two generation
+        vectors, from the fragment dirty-row journals, as a
+        ``{slice_position: rows}`` mapping (positions index the ``frags``
+        order, which is the pool's slice order) — or None when the delta
+        is unenumerable (bulk import/restore, journal evicted, fragment
+        deleted/recreated) or its row UNION is over the repair budget;
+        callers then take the full rebuild path.  Keeping each
+        fragment's rows separate (instead of the old flat union) is what
+        lets the patch lane re-fetch and rank-k-update only the planes
+        actually written.  Journals are maintained inside the fragment's
+        own locked mutation methods, so this covers every writer — not
+        just this executor's write paths."""
         budget = self._repair_rows_max
         if budget <= 0:
             return None
-        dirty: set = set()
-        for f, g0, g1 in zip(frags, old_gens, new_gens):
+        dirty: dict[int, set] = {}
+        union: set = set()
+        for si, (f, g0, g1) in enumerate(zip(frags, old_gens, new_gens)):
             if g0 == g1:
                 continue
             if f is None:
@@ -741,9 +762,11 @@ class Executor:
             rows = f.rows_dirty_since(g0)
             if rows is None:
                 return None
-            dirty |= rows
-            if len(dirty) > budget:
-                return None
+            if rows:
+                dirty[si] = rows
+                union |= rows
+                if len(union) > budget:
+                    return None
         return dirty if dirty else None
 
     def _serve_state_repair(self, key: tuple, st: dict) -> Optional[dict]:
@@ -782,9 +805,12 @@ class Executor:
         dirty = self._journal_dirty_rows(frags, old_gens, new_gens)
         if dirty is None:
             return None
-        # Drive the pool's patch lane: the dirty set is complete for the
-        # (old -> new) span, so acquire repairs the matrix rows + Gram in
-        # place and the box (with its glut) survives.
+        # Drive the pool's patch lane: the per-(row, slice) delta is
+        # complete for the (old -> new) span — the whole write burst
+        # since capture coalesces into THIS one acquire (one pool
+        # rewrite + one rank-k Gram dispatch), and only the planes
+        # actually written are re-gathered.  The box (with its glut)
+        # survives.
         pool = self._pool_for(index, fname, VIEW_STANDARD, slices)
         _, _, box = pool.acquire([], tuple(new_gens), dirty_rows=dirty)
         glut = box.get("gram_lut")
@@ -1779,7 +1805,8 @@ class Executor:
         if cached is None:
             cached = self._gram_env_cache = (
                 os.environ.get("PILOSA_TPU_NO_GRAM", "").lower() in ("1", "true", "yes"),
-                int(os.environ.get("PILOSA_TPU_GRAM_ROWS_MAX", "4096")),
+                self._gram_rows_max_cfg
+                or int(os.environ.get("PILOSA_TPU_GRAM_ROWS_MAX", "4096")),
             )
         return cached
 

@@ -56,8 +56,20 @@ def init_multihost(
         # tests/conftest.py — backends are created lazily).
         os.environ["JAX_PLATFORMS"] = "cpu"
         jax.config.update("jax_platforms", "cpu")
-        jax.config.update("jax_num_cpu_devices", local_device_count)
-        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        try:
+            jax.config.update("jax_num_cpu_devices", local_device_count)
+        except AttributeError:
+            # Older jax: the option predates jax_num_cpu_devices — the
+            # XLA flag does the same thing and is read at backend init
+            # (which hasn't happened yet by this function's contract).
+            os.environ["XLA_FLAGS"] = (
+                os.environ.get("XLA_FLAGS", "")
+                + f" --xla_force_host_platform_device_count={local_device_count}"
+            ).strip()
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except AttributeError:
+            pass  # older jax: gloo is the only distributed CPU choice anyway
     if coordinator is None:
         jax.distributed.initialize()
     else:

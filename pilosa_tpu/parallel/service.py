@@ -31,6 +31,20 @@ identically on every rank, keeping the replicated holders convergent.
 Errors raised before device work (parse errors, unknown frames) raise
 identically everywhere — rank 0 reports them to the client, workers log
 and continue.
+
+COALESCING: concurrent requests drain into ONE control-plane batch
+entry (``{"op": "batch", "seq": n, "reqs": [{"index", "query"}, ...]}``)
+through the same rotating-leader group commit the ingest queue uses —
+one sequence number, one fan-out send, and one ack round per batch
+instead of per request, amortizing the fixed replay overhead across the
+batch.  Every rank executes the batch's requests in list order inside
+the batch's slot in the total order, so the lockstep invariant is
+untouched; per-request errors are ISOLATED (a deterministic PilosaError
+is returned to its own client and skipped identically on every rank —
+it never poisons sibling requests or desynchronizes ranks).
+``PILOSA_TPU_LOCKSTEP_COALESCE`` caps the batch size (default 32;
+1 disables coalescing).  An idle service adds no latency: the first
+request leads immediately and ships a batch of one.
 """
 
 from __future__ import annotations
@@ -119,6 +133,29 @@ class LockstepService:
         self._degraded = False
         self._httpd = None
         self._stop = threading.Event()
+        # Request coalescing: concurrent _execute callers drain into one
+        # control-plane batch entry via a rotating shipper (the ingest
+        # WriteQueue's leaderless group commit, SPLIT so shipping and
+        # execution pipeline: the shipper releases its role right after
+        # the ack round, letting the next batch's forward/ack network
+        # time overlap this batch's device execution).  No dedicated
+        # thread, no idle timer — a lone request ships immediately as a
+        # batch of one.
+        self.coalesce_max = max(
+            1, int(os.environ.get("PILOSA_TPU_LOCKSTEP_COALESCE", "32"))
+        )
+        self._q_cv = threading.Condition()
+        self._q: list = []  # [((index, query), slot)]
+        self._shipping = False
+        # Ship-ahead pipeline depth: while batch n executes, at most ONE
+        # further batch may ship (its forward/ack overlaps n's device
+        # time).  Deeper shipping would drain arrivals into batches of
+        # one — requests must ACCUMULATE during execution for the
+        # coalescing to form real batches.
+        self._inflight = 0
+        # Telemetry (bench + tests): batches shipped / requests carried.
+        self.stat_batches = 0
+        self.stat_requests = 0
 
     # -- rank 0 ----------------------------------------------------------
 
@@ -164,20 +201,68 @@ class LockstepService:
                     self._acked[i] += 1
 
     def _execute(self, index: str, query: str):
-        """Forward to every worker, then run locally in sequence order.
+        """Serve one request through the coalescing queue.
 
-        PIPELINED: the total order is a sequence number assigned under a
-        short send-lock, so several requests can be in flight — request
-        n+1's parse/forward/ack network time overlaps request n's device
-        execution; local execution (and each worker's replay, by socket
-        order) still happens in exactly one total order, which is the
-        invariant the collectives require.
+        Whoever finds the queue shipper-less drains every waiting
+        request (up to ``coalesce_max``) into ONE control-plane batch
+        entry, ships it (sequence number + worker fan-out + ack round),
+        hands the shipper role to the next thread, and only then
+        executes the batch in its slot of the total order — so batch
+        n+1's forward/ack network time overlaps batch n's device
+        execution exactly like the old per-request pipeline, with the
+        fixed replay overhead now amortized over the whole batch.
+        Per-request results — including a request's own deterministic
+        PilosaError — come back through per-item slots, so one bad
+        request never poisons its batch siblings.
+        """
+        slot = [False, None]  # done, result (exception instance = raise)
+        with self._q_cv:
+            self._q.append(((index, query), slot))
+            while not slot[0]:
+                if not self._shipping and self._q and self._inflight < 2:
+                    self._shipping = True
+                    self._inflight += 1
+                    batch = self._q[: self.coalesce_max]
+                    del self._q[: len(batch)]
+                    self.stat_batches += 1
+                    self.stat_requests += len(batch)
+                    self._q_cv.release()
+                    seq = None
+                    try:
+                        seq = self._ship_batch([it for it, _ in batch])
+                    except BaseException as e:  # noqa: BLE001 — degrade
+                        for _, s in batch:
+                            s[1] = e
+                            s[0] = True
+                    finally:
+                        self._q_cv.acquire()
+                        self._shipping = False
+                        self._q_cv.notify_all()
+                    if seq is not None:
+                        self._q_cv.release()
+                        try:
+                            self._run_batch(seq, batch)
+                        finally:
+                            self._q_cv.acquire()
+                    self._inflight -= 1
+                    self._q_cv.notify_all()
+                    continue
+                self._q_cv.wait()
+        if isinstance(slot[1], BaseException):
+            raise slot[1]
+        return slot[1]
+
+    def _ship_batch(self, items) -> int:
+        """Assign the batch's slot in the total order and replicate it:
+        one control-plane send per worker plus one ack round for the
+        WHOLE batch (the per-request fixed cost this coalescing
+        amortizes).
 
         FAIL-STOP on a broken control plane: once any forward or ack
         fails, the ranks can no longer be guaranteed identical (a partial
         fan-out may have replayed a write on some ranks only), so the
         whole service degrades: new queries are refused, and in-flight
-        requests behind the failed sequence error out WITHOUT executing
+        batches behind the failed sequence error out WITHOUT executing
         locally even though live workers may replay them — after a
         degrade the replicas are presumed diverged and nothing more is
         served from any of them, so rank 0 skipping those requests is
@@ -185,6 +270,7 @@ class LockstepService:
         idempotent).  A dead rank forces a restart exactly like the
         collective hang it would otherwise cause.
         """
+        reqs = [{"index": index, "query": query} for index, query in items]
         with self._order_mu:
             if self._degraded:
                 raise PilosaError(
@@ -195,36 +281,149 @@ class LockstepService:
             try:
                 for w in self._workers:
                     w.settimeout(self.ack_timeout)
-                    _send_msg(w, {"op": "query", "index": index, "query": query, "seq": seq})
+                    _send_msg(w, {"op": "batch", "seq": seq, "reqs": reqs})
             except (OSError, socket.timeout) as e:
                 raise self._degrade(e)
         try:
             self._await_acks(seq)
         except (OSError, socket.timeout) as e:
             raise self._degrade(e)
+        return seq
+
+    def _batch_units(self, items):
+        """Split one replay batch into execution units.
+
+        Maximal runs of ADJACENT same-index READ-ONLY requests fuse into
+        one joined PQL execution — one parse, one fused dispatch, and
+        one collective round instead of N (the per-request device
+        barrier is the coalescing bench's dominant cost; the control
+        plane was already amortized by the batch entry).  Writes, mixed
+        requests, and unparseable requests execute alone, preserving
+        their exact semantics.  The split is a pure function of the
+        request strings, so every rank derives identical units — the
+        lockstep invariant holds through the fusion."""
+        from pilosa_tpu import pql
+
+        units: list = []  # ("run", index, [(pos, query, n_calls)]) | ("solo", pos, index, query)
+        cur: list = []
+        cur_idx = None
+
+        def flush():
+            nonlocal cur, cur_idx
+            if cur:
+                units.append(("run", cur_idx, cur))
+                cur, cur_idx = [], None
+
+        for pos, (index, query) in enumerate(items):
+            n_calls = 0
+            read_only = False
+            try:
+                q = pql.parse_cached(query)
+                n_calls = len(q.calls)
+                read_only = n_calls > 0 and q.write_call_n() == 0
+            except Exception:  # noqa: BLE001 — parse error: solo raises it
+                pass
+            if read_only:
+                if cur and cur_idx != index:
+                    flush()
+                cur_idx = index
+                cur.append((pos, query, n_calls))
+            else:
+                flush()
+                units.append(("solo", pos, index, query))
+        flush()
+        return units
+
+    def _exec_batch_units(self, items, deliver) -> None:
+        """Execute one batch's units in order, reporting each request's
+        result (or isolated PilosaError) through ``deliver(pos, r)``.
+
+        ERROR ISOLATION: a PilosaError is deterministic (replicated
+        holders, same total order), so every rank resolves it
+        identically — it becomes that request's result only.  A fused
+        read run that errors falls back to per-request execution: reads
+        are side-effect-free, so the partial re-execution is safe and
+        every rank repeats the same fallback.  Any OTHER exception
+        propagates to the caller (rank-local failure — fail-stop).
+        """
+        for unit in self._batch_units(items):
+            if unit[0] == "solo":
+                _, pos, index, query = unit
+                try:
+                    deliver(pos, self.executor.execute(index, query))
+                except PilosaError as e:
+                    deliver(pos, e)  # isolated: every rank resolved it too
+                continue
+            _, index, run = unit
+            if len(run) > 1:
+                joined = " ".join(q for _, q, _ in run)
+                try:
+                    res = self.executor.execute(index, joined)
+                except PilosaError:
+                    pass  # per-request fallback pins the error to its owner
+                else:
+                    off = 0
+                    for pos, _q, n in run:
+                        deliver(pos, res[off : off + n])
+                        off += n
+                    continue
+            for pos, query, _n in run:
+                try:
+                    deliver(pos, self.executor.execute(index, query))
+                except PilosaError as e:
+                    deliver(pos, e)
+
+    def _run_batch(self, seq: int, batch) -> None:
+        """Execute one shipped batch in its slot of the total order and
+        fill every submitter's result slot; never raises (siblings would
+        hang on an unfilled slot otherwise).
+
+        Requests execute through the batch units (_exec_batch_units):
+        adjacent read-only requests fuse into one executor pass,
+        per-request errors stay isolated.  Any non-PilosaError failure
+        means this rank may have diverged from the workers that replayed
+        the batch — fail-stop: the service degrades and the batch's
+        unresolved requests error out.
+        """
+        err = None
         with self._exec_cv:
             while self._exec_next != seq:
                 if self._degraded:
-                    # An earlier in-flight request hit a lost rank: its
+                    # An earlier in-flight batch hit a lost rank: its
                     # seq will never execute here, so waiting would
-                    # deadlock — every later request reports degraded.
-                    raise PilosaError(
+                    # deadlock — every later batch reports degraded.
+                    err = PilosaError(
                         "lockstep service degraded mid-flight; restart the job"
                     )
+                    break
                 self._exec_cv.wait(timeout=1.0)
+        owned = err is None  # the wait loop exited at our slot
         try:
-            return self.executor.execute(index, query)
-        except PilosaError:
-            raise  # deterministic; every rank raised it identically
-        except Exception:
-            # Workers replayed this request but rank 0 failed it:
-            # the replicas may have diverged — fail-stop.
-            self._degraded = True
-            raise
+            if err is None and self._degraded:
+                err = PilosaError(
+                    "lockstep service degraded mid-batch; restart the job"
+                )
+            if err is None:
+                def deliver(pos, result):
+                    slot = batch[pos][1]
+                    slot[1] = result
+                    slot[0] = True
+
+                try:
+                    self._exec_batch_units([it for it, _ in batch], deliver)
+                except Exception as e:  # noqa: BLE001 — rank-local failure
+                    self._degraded = True
+                    err = e
+            if err is not None:
+                for _, slot in batch:
+                    if not slot[0]:
+                        slot[1] = err
+                        slot[0] = True
         finally:
-            with self._exec_cv:
-                self._exec_next = seq + 1
-                self._exec_cv.notify_all()
+            if owned:
+                with self._exec_cv:
+                    self._exec_next = seq + 1
+                    self._exec_cv.notify_all()
 
     class _Handler(BaseHTTPRequestHandler):
         service: "LockstepService"
@@ -303,27 +502,37 @@ class LockstepService:
 
         rt = threading.Thread(target=reader, daemon=True)
         rt.start()
-        while not self._stop.is_set():
+        dead = False
+        while not self._stop.is_set() and not dead:
             msg = jobs.get()
             if msg is None:
                 break
+            # A batch entry replays N requests in list order; a legacy
+            # "query" entry is a batch of one.  Replay goes through the
+            # SAME batch units as rank 0 (_exec_batch_units): adjacent
+            # read-only requests fuse into one executor pass, and
+            # per-request PilosaErrors are deterministic (rank 0
+            # returned the same error to that request's client) and
+            # resolve identically on every rank — the batch, and the
+            # lockstep, continue with the next request.
+            if msg.get("op") == "batch":
+                reqs = msg["reqs"]
+            else:
+                reqs = [{"index": msg["index"], "query": msg["query"]}]
+            items = [(r["index"], r["query"]) for r in reqs]
             try:
-                self.executor.execute(msg["index"], msg["query"])
-            except PilosaError:
-                # Deterministic: rank 0 raised the same error before any
-                # device work and reported it to the client; stay in
-                # lockstep.
-                continue
+                self._exec_batch_units(items, lambda pos, result: None)
             except Exception:  # noqa: BLE001
                 # Rank-LOCAL failure (disk full, engine fault): this
-                # replica may have diverged from its peers, so fail-stop —
-                # closing the socket trips rank 0's ack check on the next
-                # request and degrades the whole service, rather than
-                # silently serving collectives over diverged data.
+                # replica may have diverged from its peers, so
+                # fail-stop — closing the socket trips rank 0's ack
+                # check on the next request and degrades the whole
+                # service, rather than silently serving collectives
+                # over diverged data.
                 import traceback
 
                 traceback.print_exc()
-                break
+                dead = True
         sock.close()
 
     # -- lifecycle -------------------------------------------------------

@@ -27,6 +27,26 @@ from typing import Sequence
 import numpy as np
 
 
+def _shard_map(jax):
+    """Compat shim: ``jax.shard_map`` (with ``check_vma``) is the
+    current API; older releases only have
+    ``jax.experimental.shard_map.shard_map`` (with ``check_rep``).
+    Returns a callable with the CURRENT keyword surface either way, so
+    every kernel below writes modern code once."""
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        return sm
+    from jax.experimental.shard_map import shard_map as legacy
+
+    def adapted(f, *, mesh, in_specs, out_specs, check_vma=True):
+        return legacy(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_rep=check_vma,
+        )
+
+    return adapted
+
+
 class SliceMesh:
     """A 1-D device mesh over the ``slice`` axis.
 
@@ -83,7 +103,7 @@ def sharded_count_and(mesh: SliceMesh, a, b):
     from jax.sharding import PartitionSpec as P
 
     @functools.partial(
-        jax.shard_map,
+        _shard_map(jax),
         mesh=mesh.mesh,
         in_specs=(P(mesh.AXIS, None), P(mesh.AXIS, None)),
         out_specs=P(),
@@ -138,7 +158,7 @@ def sharded_count_call(mesh: SliceMesh, op: str, a, b):
         raise ValueError(op)
 
     @functools.partial(
-        jax.shard_map,
+        _shard_map(jax),
         mesh=mesh.mesh,
         in_specs=(P(mesh.AXIS, None), P(mesh.AXIS, None)),
         out_specs=P(),
@@ -169,7 +189,7 @@ def _sharded_pair_kernel(
     )
 
     @functools.partial(
-        jax.shard_map,
+        _shard_map(jax),
         mesh=mesh_obj,
         in_specs=(P(axis, *([None] * (rm_ndim - 1))), P(None, None)),
         out_specs=P(),
@@ -194,7 +214,7 @@ def _sharded_multi_kernel(mesh_obj, axis: str, op: str, interpret: bool, rm_ndim
     from pilosa_tpu.ops.pallas_kernels import fused_gather_count_multi
 
     @functools.partial(
-        jax.shard_map,
+        _shard_map(jax),
         mesh=mesh_obj,
         in_specs=(P(axis, *([None] * (rm_ndim - 1))), P(None, None)),
         out_specs=P(),
@@ -288,7 +308,7 @@ def _sharded_tree_kernel(mesh_obj, axis: str, interpret: bool, rm_ndim: int = 3)
     from pilosa_tpu.ops.pallas_kernels import fused_gather_count_tree
 
     @functools.partial(
-        jax.shard_map,
+        _shard_map(jax),
         mesh=mesh_obj,
         in_specs=(P(axis, *([None] * (rm_ndim - 1))), P(None, None), P(None, None)),
         out_specs=P(),
@@ -339,7 +359,7 @@ def _sharded_scorer_kernel(mesh_obj, axis: str, rm_ndim: int, src_ndim: int):
     from jax.sharding import PartitionSpec as P
 
     @functools.partial(
-        jax.shard_map,
+        _shard_map(jax),
         mesh=mesh_obj,
         in_specs=(
             P(axis, *([None] * (rm_ndim - 1))),
@@ -397,7 +417,7 @@ def sharded_topn_counts(mesh: SliceMesh, rows, src):
     from jax.sharding import PartitionSpec as P
 
     @functools.partial(
-        jax.shard_map,
+        _shard_map(jax),
         mesh=mesh.mesh,
         in_specs=(P(mesh.AXIS, None, None), P(mesh.AXIS, None)),
         out_specs=P(),
@@ -519,7 +539,7 @@ def _replica_pair_kernel(mesh_obj, slice_axis: str, replica_axis: str, op: str,
     )
 
     @functools.partial(
-        jax.shard_map,
+        _shard_map(jax),
         mesh=mesh_obj,
         # Matrix: sharded over slice, REPLICATED over replica (each
         # group holds a full copy).  Pairs: split over replica.

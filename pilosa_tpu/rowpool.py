@@ -104,6 +104,9 @@ class DeviceRowPool:
         self.stat_evictions = 0
         self.stat_resets = 0
         self.stat_repairs = 0
+        # (row, slice) planes actually fetched by the patch lane — the
+        # per-(row, slice) granularity benches/tests assert on this.
+        self.stat_patch_planes = 0
 
     @staticmethod
     def default_cap(n_slices: int, words: int) -> int:
@@ -187,35 +190,63 @@ class DeviceRowPool:
             self.matrix = self.engine.set_plane_rows(self.matrix, stale, slots, block)
 
     def _repair_dirty(self, stale: list[int], dirty_rows) -> bool:
-        """Patch ONLY the written rows' planes and rank-k-repair the box
-        Gram, instead of the blind whole-plane refresh + box reset: the
-        box (and with it the Gram, its glut, and the id_pos snapshot)
-        SURVIVES the write, so a small write costs O(dirty) row fetches
-        plus one dirty x resident pair-count dispatch — not an O(R^2)
-        Gram rebuild.  The caller (executor) guarantees ``dirty_rows``
+        """Patch ONLY the written (row, slice) planes and rank-k-repair
+        the box Gram, instead of the blind whole-plane refresh + box
+        reset: the box (and with it the Gram, its glut, and the id_pos
+        snapshot) SURVIVES the write, so a small write costs O(dirty
+        planes) row fetches plus one dirty x resident pair-count
+        dispatch — not an O(R^2) Gram rebuild.  ``dirty_rows`` is either
+        a ``{slice_index: rows}`` mapping (per-(row, slice) granularity:
+        each stale slice re-fetches only the rows written IN that slice)
+        or a flat row iterable (legacy: every dirty row re-fetched
+        across every stale slice).  The caller (executor) guarantees it
         covers every row whose storage changed across the stale slices
         (fragment dirty-row journals); rows not resident in the pool
         need no patch at all.  Returns False (nothing mutated) when the
         dirty slots fall outside the Gram's slot range — an invariant
         breach that the conservative full refresh handles."""
-        resident = sorted(r for r in set(dirty_rows) if r in self.slot_of)
-        if not resident:
-            return True  # writes only touched rows the pool doesn't hold
-        slots = [self.slot_of[r] for r in resident]
-        gram = self.box.get("gram")
-        if gram is not None and any(s >= gram.shape[0] for s in slots):
-            return False  # defensive: slot outside the Gram bucket
-        block = self.fetch(resident, stale)  # layout per self.row_major
-        if self.row_major:
-            self.matrix = self.engine.set_plane_rows_rm(
-                self.matrix, stale, slots, block
-            )
+        if isinstance(dirty_rows, dict):
+            per_slice = {
+                si: sorted(r for r in set(dirty_rows.get(si, ())) if r in self.slot_of)
+                for si in stale
+            }
         else:
-            self.matrix = self.engine.set_plane_rows(self.matrix, stale, slots, block)
+            flat = sorted(r for r in set(dirty_rows) if r in self.slot_of)
+            per_slice = {si: flat for si in stale}
+        patched = [si for si in stale if per_slice[si]]
+        if not patched:
+            return True  # writes only touched rows the pool doesn't hold
+        all_slots = sorted({self.slot_of[r] for si in patched for r in per_slice[si]})
+        gram = self.box.get("gram")
+        if gram is not None and any(s >= gram.shape[0] for s in all_slots):
+            return False  # defensive: slot outside the Gram bucket
+        old_matrix = self.matrix  # pre-patch snapshot (functional updates)
+        # One fetch + one scatter per distinct row set: slices written
+        # with the same rows batch into a single transfer, and a slice
+        # whose dirty rows aren't resident costs nothing at all.
+        by_rows: dict[tuple, list[int]] = {}
+        for si in patched:
+            by_rows.setdefault(tuple(per_slice[si]), []).append(si)
+        for rows_t, group in by_rows.items():
+            rows = list(rows_t)
+            slots = [self.slot_of[r] for r in rows]
+            block = self.fetch(rows, group)  # layout per self.row_major
+            self.stat_patch_planes += len(rows) * len(group)
+            if self.row_major:
+                self.matrix = self.engine.set_plane_rows_rm(
+                    self.matrix, group, slots, block
+                )
+            else:
+                self.matrix = self.engine.set_plane_rows(
+                    self.matrix, group, slots, block
+                )
         if gram is not None:
             d = gram.shape[0]
             m = self.matrix if d == self.cap else self.matrix[:, :d]
-            gram = self.engine.gram_update_rows(m, gram, slots)
+            m_old = old_matrix if d == self.cap else old_matrix[:, :d]
+            gram = self.engine.gram_update_rows(
+                m, gram, all_slots, old_matrix=m_old, slice_idxs=patched
+            )
             self.box["gram"] = gram
             glut = self.box.get("gram_lut")
             if glut is not None:
@@ -235,9 +266,11 @@ class DeviceRowPool:
         ``want`` alone exceeds the pool capacity — callers chunk their
         query batch by unique-row count first (``chunk_queries``).
 
-        ``dirty_rows``: the complete set of row ids written since this
-        pool's recorded generations (from the fragment dirty-row
-        journals), or None when unknown.  When provided, a generation
+        ``dirty_rows``: the complete delta written since this pool's
+        recorded generations (from the fragment dirty-row journals) —
+        either a ``{slice_index: rows}`` mapping (per-(row, slice)
+        granularity) or a flat row set (every row dirty in every stale
+        slice) — or None when unknown.  When provided, a generation
         mismatch takes the PATCH lane (_repair_dirty) and the cache box
         — including a warm Gram — survives the write.
         """

@@ -63,6 +63,8 @@ class Server:
             host=self.host,
             max_writes_per_request=self.config.max_writes_per_request,
             serve_state_cache=self.config.serve_state_cache,
+            repair_rows_max=self.config.repair_rows_max,
+            gram_rows_max=self.config.gram_rows_max,
             # Server ingest routes singleton SetBits through the
             # group-commit queue (concurrent clients batch into one
             # fragment pass + WAL append); opt out via env for A/B runs.

@@ -45,8 +45,14 @@ run BENCH_CONFIG=intersect_count_stream BENCH_SLICES=10240 BENCH_TIMED_RUNS=2
 run BENCH_CONFIG=executor_gather BENCH_ROWS=1024
 run BENCH_CONFIG=executor_gather
 # 7) Mixed read/write serving: warm-state repair lane vs forced
-#    invalidate-and-rebuild, at 95/5 and 50/50 mixes (tiers in the JSON);
-#    the second line stresses a wider Gram (more rows) per repair.
+#    invalidate-and-rebuild, at 95/5, 50/50, and write-burst coalescing
+#    tiers (b8/b64 — one deferred repair per burst; tiers in the JSON);
+#    the second line stresses a wider Gram (more rows) per repair and a
+#    wider slice span (where per-(row, slice) patch granularity pays).
 run BENCH_CONFIG=mixed
 run BENCH_CONFIG=mixed BENCH_ROWS=256 BENCH_SLICES=8
+# 8) Lockstep request coalescing: single-call requests, coalesced batch
+#    replay vs one control-plane entry per request.
+run BENCH_CONFIG=lockstep_coalesce
+run BENCH_CONFIG=lockstep_coalesce BENCH_THREADS=32
 echo "ALL DONE $(date +%H:%M:%S)" >> $OUT

@@ -134,6 +134,10 @@ def main() -> int:
                 "probe": int(probe),
                 "range_probe": int(rprobe),
                 "replica_probe": replica_probe,
+                # Coalescing telemetry (rank 0 only counts ships):
+                # control-plane batch entries vs requests carried.
+                "batches": svc.stat_batches,
+                "requests": svc.stat_requests,
             }
         ),
         flush=True,

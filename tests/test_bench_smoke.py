@@ -75,13 +75,23 @@ def test_bench_config_emits_json(cfg, extra):
         assert all("qps" in t and "bandwidth_util" in t for t in result["tiers"])
     if cfg == "mixed":
         names = [t["tier"] for t in result["tiers"]]
-        assert names == ["mixed_95_5", "mixed_50_50"]
+        assert names == [
+            "mixed_95_5", "mixed_50_50", "mixed_50_50_b8", "mixed_50_50_b64"
+        ]
         assert all(
             t["qps"] > 0 and t["rebuild_qps"] > 0 and "speedup" in t
             for t in result["tiers"]
         )
-        # The smoke path must actually exercise the patch lane.
-        assert result["tiers"][1]["repairs"] > 0
+        # The smoke path must actually exercise the patch lane, and the
+        # burst tiers must COALESCE: one deferred repair per write burst,
+        # so repairs never grow with burst size.
+        by = {t["tier"]: t for t in result["tiers"]}
+        assert by["mixed_50_50"]["repairs"] > 0
+        assert 0 < by["mixed_50_50_b8"]["repairs"] <= by["mixed_50_50"]["repairs"]
+        assert 0 < by["mixed_50_50_b64"]["repairs"] <= by["mixed_50_50_b8"]["repairs"]
+        # Per-(row, slice) granularity is live: the patch lane fetched
+        # planes, bounded by rows x slices per repair.
+        assert by["mixed_50_50"]["patch_planes"] > 0
 
 
 def test_star_trace_example_runs():
@@ -97,6 +107,11 @@ def test_graft_entry_dryrun_smoke():
     env = dict(os.environ)
     env.pop("PYTHONPATH", None)
     env.pop("JAX_PLATFORMS", None)  # the script pins its own CPU mesh
+    # The suite's conftest exports XLA_FLAGS for the in-process tests; if
+    # it leaks into the subprocess the script skips its own CPU pin
+    # (device count pre-set) and a remote-TPU sitecustomize hook can hang
+    # the run looking for an accelerator.
+    env.pop("XLA_FLAGS", None)
     out = subprocess.run(
         [sys.executable, os.path.join(REPO, "__graft_entry__.py"), "4"],
         capture_output=True,
@@ -138,6 +153,20 @@ def test_bench_lockstep_emits_json():
     )
     result = json.loads(stdout.strip().splitlines()[-1])
     assert result["metric"] == "lockstep_service_qps" and result["value"] > 0
+
+
+def test_bench_lockstep_coalesce_emits_json():
+    """The request-coalescing bench path must keep working: both tiers
+    (coalesced batch replay vs one entry per request) run a real 2-rank
+    job and emit per-request overhead."""
+    stdout = _run({"BENCH_CONFIG": "lockstep_coalesce", "BENCH_SMOKE": "1",
+                   "BENCH_ITERS": "8", "BENCH_THREADS": "2"},
+                  timeout=360)
+    result = json.loads(stdout.strip().splitlines()[-1])
+    assert result["metric"] == "lockstep_coalesce_rps" and result["value"] > 0
+    names = [t["tier"] for t in result["tiers"]]
+    assert names == ["coalesce_on", "coalesce_off"]
+    assert all(t["rps"] > 0 and t["per_request_ms"] > 0 for t in result["tiers"])
 
 
 def test_bench_executor_gather_smoke():

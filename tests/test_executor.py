@@ -1652,6 +1652,145 @@ class TestServeLane:
         assert ex2._serve_states_max == 7
         h.close()
 
+    def test_repair_and_gram_budgets_configurable(self, tmp_path, monkeypatch):
+        """config.py plumbing for the repair/Gram budgets: constructor
+        arg (server passes Config values) > PILOSA_TPU_* env > default,
+        with 0 meaning 'disabled' for repair (so None is the
+        not-configured sentinel)."""
+        from pilosa_tpu.config import Config
+
+        monkeypatch.setenv("PILOSA_TPU_REPAIR_ROWS_MAX", "9")
+        monkeypatch.setenv("PILOSA_TPU_GRAM_ROWS_MAX", "512")
+        h, ex, _ = self._setup(tmp_path)
+        assert ex._repair_rows_max == 9
+        assert ex._gram_rows_max() == 512
+        ex2 = Executor(h, repair_rows_max=0, gram_rows_max=128)  # args win
+        assert ex2._repair_rows_max == 0
+        assert ex2._gram_rows_max() == 128
+        # TOML -> Config -> env precedence mirrors serve-state-cache.
+        cfg = Config.from_dict({"repair-rows-max": 5, "gram-rows-max": 2048})
+        assert cfg.repair_rows_max == 5 and cfg.gram_rows_max == 2048
+        cfg.apply_env({"PILOSA_TPU_REPAIR_ROWS_MAX": "0",
+                       "PILOSA_TPU_GRAM_ROWS_MAX": "64"})
+        assert cfg.repair_rows_max == 0 and cfg.gram_rows_max == 64
+        h.close()
+
+    def test_ledger_skipped_when_repair_disabled(self, tmp_path, monkeypatch):
+        """With PILOSA_TPU_REPAIR_ROWS_MAX=0 the dirty-row ledger must
+        stay empty even while serve state is warm — its only consumer
+        (the repair precheck) can never use it."""
+        monkeypatch.setenv("PILOSA_TPU_REPAIR_ROWS_MAX", "0")
+        h, ex, batch = self._setup(tmp_path)
+        self._arm(ex, batch)
+        ex.execute("p", 'SetBit(rowID=3, frame="f", columnID=424242)')
+        assert not ex._dirty_rows
+        h.close()
+
+    def test_ledger_saturation_forces_rebuild(self, tmp_path, monkeypatch):
+        """A burst past 4x the budget saturates the ledger (value None);
+        the repair lane must refuse without walking journals, the state
+        rebuilds, and counts stay read-your-writes correct."""
+        from pilosa_tpu.core.view import VIEW_STANDARD
+
+        monkeypatch.setenv("PILOSA_TPU_REPAIR_ROWS_MAX", "2")  # cap = 24
+        h, ex, batch = self._setup(tmp_path)
+        self._arm(ex, batch)
+        pool = ex._matrix_cache[("p", "f", VIEW_STANDARD, (0, 1, 2), "")]
+        burst = " ".join(
+            f'SetBit(rowID={r}, frame="f", columnID={2 * SLICE_WIDTH + 600 + r})'
+            for r in range(30)  # 30 distinct rows > 4*2+16
+        )
+        ex.execute("p", burst)
+        assert ex._dirty_rows[("p", "f")] is None  # saturated
+        walks = {"n": 0}
+        orig = ex._journal_dirty_rows
+
+        def counting(*a, **kw):
+            walks["n"] += 1
+            return orig(*a, **kw)
+
+        ex._journal_dirty_rows = counting
+        want = Executor(h, engine="numpy").execute("p", batch)
+        assert ex.execute("p", batch) == want
+        assert pool.stat_repairs == 0
+        # The serve-lane repair precheck declined BEFORE the journal
+        # walk; the only walks come from the pool acquire path (which
+        # rebuilds because the delta is over budget anyway).  The lane
+        # re-arms on the second post-write read (Gram warms on hit 2).
+        assert ex.execute("p", batch) == want
+        assert ex._serve_states, "lane did not re-arm"
+        h.close()
+
+    def test_over_budget_precheck_declines_without_journal_walk(
+        self, tmp_path, monkeypatch
+    ):
+        """A ledger clearly over budget (but not saturated) must make
+        _serve_state_repair decline before touching the fragment
+        journals."""
+        monkeypatch.setenv("PILOSA_TPU_REPAIR_ROWS_MAX", "4")
+        h, ex, batch = self._setup(tmp_path)
+        self._arm(ex, batch)
+        st = ex._serve_states[("p", "f")]
+        with ex._dirty_mu:
+            ex._dirty_rows[("p", "f")] = {1, 2, 3, 4, 5, 6}  # 6 > budget 4
+
+        def boom(*a, **kw):
+            raise AssertionError("journal walk after precheck decline")
+
+        ex._journal_dirty_rows = boom
+        assert ex._serve_state_repair(("p", "f"), st) is None
+        h.close()
+
+    def test_repair_bails_on_replaced_fragment(self, tmp_path):
+        """A fragment deleted/recreated since capture fails the identity
+        check: the repair lane returns None (rebuild path)."""
+        h, ex, batch = self._setup(tmp_path)
+        self._arm(ex, batch)
+        st = ex._serve_states[("p", "f")]
+        h.index("p").delete_frame("f")
+        h.index("p").create_frame("f", FrameOptions())
+        h.index("p").frame("f").import_bits(
+            np.array([1], dtype=np.uint64),
+            np.array([2 * SLICE_WIDTH + 5], dtype=np.uint64),
+        )
+        assert ex._serve_state_repair(("p", "f"), st) is None
+        h.close()
+
+    def test_repair_bails_on_slice_growth(self, tmp_path):
+        """A write extending max_slice makes the state's span wrong: the
+        repair lane must decline (the general lane rebuilds wider)."""
+        h, ex, batch = self._setup(tmp_path)
+        self._arm(ex, batch)
+        st = ex._serve_states[("p", "f")]
+        ex.execute("p", f'SetBit(rowID=3, frame="f", columnID={7 * SLICE_WIDTH + 1})')
+        assert ex._serve_state_repair(("p", "f"), st) is None
+        # And the general path still serves correct post-growth counts.
+        assert ex.execute("p", batch) == Executor(h, engine="numpy").execute("p", batch)
+        h.close()
+
+    def test_write_burst_coalesces_into_one_repair(self, tmp_path):
+        """Batched write->repair dispatch: a burst of N singleton writes
+        with no interleaved reads must be repaired by ONE deferred patch
+        dispatch on the next read (not one per write), touching only the
+        written slice's planes."""
+        from pilosa_tpu.core.view import VIEW_STANDARD
+
+        h, ex, batch = self._setup(tmp_path)
+        self._arm(ex, batch)
+        pool = ex._matrix_cache[("p", "f", VIEW_STANDARD, (0, 1, 2), "")]
+        repairs0 = pool.stat_repairs
+        # 8 writes to distinct rows, all landing in slice 1.
+        for r in range(8):
+            ex.execute(
+                "p", f'SetBit(rowID={r}, frame="f", columnID={SLICE_WIDTH + 4000 + r})'
+            )
+        want = Executor(h, engine="numpy").execute("p", batch)
+        assert ex.execute("p", batch) == want
+        assert pool.stat_repairs == repairs0 + 1  # one repair for the burst
+        # Per-(row, slice) granularity: 8 rows x ONE slice, not x3.
+        assert pool.stat_patch_planes == 8
+        h.close()
+
 
 def test_serve_lane_multi_frame_alternation(tmp_path):
     """Two frames' dashboards alternating must BOTH stay armed (the

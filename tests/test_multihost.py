@@ -87,7 +87,7 @@ class _LockstepJob:
     tests/lockstep_worker.py, drains stdout, keeps stderr in temp files
     surfaced on failure, and collects the final per-rank JSON."""
 
-    def __init__(self, n_ranks: int):
+    def __init__(self, n_ranks: int, env_extra=None):
         import tempfile
         import threading
 
@@ -97,6 +97,7 @@ class _LockstepJob:
         env.pop("JAX_PLATFORMS", None)
         env["PYTHONPATH"] = REPO
         env["XLA_FLAGS"] = ""
+        env.update(env_extra or {})
         worker = os.path.join(REPO, "tests", "lockstep_worker.py")
         self.errfiles = [tempfile.NamedTemporaryFile("w+", delete=False) for _ in range(n_ranks)]
         self.procs = [
@@ -336,6 +337,70 @@ def test_lockstep_four_ranks_replica_mesh():
     # The (4,2) replica-mesh collective ran on every rank and agreed.
     rp = {o["replica_probe"] for o in outs}
     assert len(rp) == 1 and rp.pop() > 0
+
+
+def test_lockstep_batch_error_isolation():
+    """Request coalescing must ISOLATE per-request errors: a stream of
+    interleaved bad requests (unknown frame — a deterministic
+    PilosaError) and good reads/writes from concurrent clients gets
+    coalesced into batch replay entries, and every bad request errors on
+    its own while its batch siblings succeed, ranks stay in lockstep,
+    and the service keeps serving afterwards."""
+    import urllib.error
+    from concurrent.futures import ThreadPoolExecutor
+
+    job = _LockstepJob(2)
+    try:
+        job.wait_ready()
+        q_read = 'Count(Bitmap(rowID=0, frame="f"))'
+        base = job.query(q_read)["results"][0]
+
+        def run(q):
+            try:
+                return ("ok", job.query(q)["results"])
+            except urllib.error.HTTPError as e:
+                return ("err", e.code)
+
+        wcols = list(range(800, 810))
+        jobs = (
+            [q_read] * 10
+            + ['Bitmap(rowID=1, frame="nope")'] * 10
+            + [f'SetBit(rowID=0, frame="f", columnID={c})' for c in wcols]
+        )
+        import random
+
+        random.Random(7).shuffle(jobs)
+        with ThreadPoolExecutor(8) as pool:
+            outs = list(pool.map(run, jobs))
+        # Every bad request got ITS OWN 400; every good one succeeded.
+        by_q = list(zip(jobs, outs))
+        assert all(o == ("err", 400) for q, o in by_q if "nope" in q)
+        assert all(o[0] == "ok" for q, o in by_q if "nope" not in q), by_q
+        # The service is still healthy and the writes all landed once.
+        after = job.query(q_read)["results"][0]
+        assert after == base + len(wcols)
+        outs = job.shutdown_and_collect()
+    finally:
+        job.cleanup()
+    # Both ranks skipped the bad requests identically and converged.
+    assert outs[0]["probe"] == outs[1]["probe"] == after
+
+
+def test_lockstep_coalescing_batches_requests():
+    """With coalescing forced to batches of one
+    (PILOSA_TPU_LOCKSTEP_COALESCE=1) the service must behave exactly like
+    the per-request replay — the env knob is the A/B lever the
+    lockstep_coalesce bench uses."""
+    job = _LockstepJob(2, env_extra={"PILOSA_TPU_LOCKSTEP_COALESCE": "1"})
+    try:
+        job.wait_ready()
+        assert job.query('Count(Bitmap(rowID=0, frame="f"))')["results"] == [8]
+        assert job.query('SetBit(rowID=0, frame="f", columnID=345)')["results"] == [True]
+        assert job.query('Count(Bitmap(rowID=0, frame="f"))')["results"] == [9]
+        outs = job.shutdown_and_collect()
+    finally:
+        job.cleanup()
+    assert {o["probe"] for o in outs} == {9}
 
 
 def test_lockstep_worker_death_mid_stream():

@@ -240,6 +240,134 @@ def test_acquire_dirty_rows_repairs_in_place():
     assert box2["gram"] is not gram
 
 
+def test_acquire_dirty_rows_per_slice_granularity():
+    """Per-(row, slice) patch: a {slice: rows} dirty mapping re-fetches
+    ONLY the planes actually written — row 2 for slice 1 and row 3 for
+    slice 2, not the cross product — and the rank-k Gram repair still
+    lands on exact counts."""
+    rng = np.random.default_rng(11)
+    rows = fill_rows(rng, 3, range(4))
+    log: list = []
+    pool, live = make_pool(n_slices=3, rows=rows, cap_max=8, fetch_log=log)
+    id_pos, _, box1 = pool.acquire([0, 1, 2, 3], (1, 1, 1))
+    gram = _np_gram(pool, live, [0, 1, 2, 3], 4)
+    box1["gram"] = gram
+    rs = np.array(sorted(id_pos), dtype=np.int64)
+    ps = np.fromiter((id_pos[int(v)] for v in rs), dtype=np.int32, count=len(rs))
+    box1["gram_lut"] = (rs, np.ascontiguousarray(gram), ps)
+    # Row 2 written in slice 1; row 3 written in slice 2.
+    live[(1, 2)] = rng.integers(0, 1 << 32, size=W, dtype=np.uint32)
+    live[(2, 3)] = rng.integers(0, 1 << 32, size=W, dtype=np.uint32)
+    log.clear()
+    id_pos2, matrix, box2 = pool.acquire(
+        [0, 1], (1, 2, 2), dirty_rows={1: {2}, 2: {3}}
+    )
+    assert box2 is box1 and pool.stat_repairs == 1 and pool.stat_resets == 0
+    # Exactly the two written planes were fetched (in either group order).
+    assert sorted(log) == [((2,), (1,)), ((3,), (2,))]
+    assert pool.stat_patch_planes == 2
+    np.testing.assert_array_equal(matrix[1, id_pos2[2]], live[(1, 2)])
+    np.testing.assert_array_equal(matrix[2, id_pos2[3]], live[(2, 3)])
+    # Unwritten planes of the dirty rows are untouched.
+    np.testing.assert_array_equal(matrix[0, id_pos2[2]], live[(0, 2)])
+    want = _np_gram(pool, live, [0, 1, 2, 3], 4)
+    np.testing.assert_array_equal(box2["gram"], want)
+    np.testing.assert_array_equal(box2["gram_lut"][1], want)
+
+
+def test_acquire_dirty_dict_slices_share_fetch():
+    """Stale slices dirtied with the SAME row set batch into one fetch
+    (one transfer per distinct row group, not per slice)."""
+    rng = np.random.default_rng(12)
+    rows = fill_rows(rng, 3, range(4))
+    log: list = []
+    pool, live = make_pool(n_slices=3, rows=rows, cap_max=8, fetch_log=log)
+    pool.acquire([0, 1, 2], (1, 1, 1))
+    live[(0, 1)] = rng.integers(0, 1 << 32, size=W, dtype=np.uint32)
+    live[(2, 1)] = rng.integers(0, 1 << 32, size=W, dtype=np.uint32)
+    log.clear()
+    id_pos, matrix, _ = pool.acquire([0, 1], (2, 1, 2), dirty_rows={0: {1}, 2: {1}})
+    assert log == [((1,), (0, 2))]  # one grouped fetch for both slices
+    np.testing.assert_array_equal(matrix[0, id_pos[1]], live[(0, 1)])
+    np.testing.assert_array_equal(matrix[2, id_pos[1]], live[(2, 1)])
+
+
+def test_gram_update_rows_delta_matches_full_recompute():
+    """The per-(row, slice) delta form of gram_update_rows (old matrix +
+    written slice planes) must agree exactly with the full recompute, on
+    the numpy engine and on jax (which pads the restricted slice axis
+    with a clean slice)."""
+    rng = np.random.default_rng(13)
+    S, R = 8, 4
+    old = rng.integers(0, 1 << 32, size=(S, R, W), dtype=np.uint32)
+    new = old.copy()
+    dirty_slots = [1, 3]
+    dirty_slices = [2, 5]
+    for sl in dirty_slots:
+        for si in dirty_slices:
+            new[si, sl] = rng.integers(0, 1 << 32, size=W, dtype=np.uint32)
+
+    from pilosa_tpu.roaring import _popcount_words
+
+    def np_gram(m):
+        g = np.zeros((R, R), dtype=np.int64)
+        for a in range(R):
+            for b in range(R):
+                g[a, b] = sum(
+                    _popcount_words(m[si, a] & m[si, b]) for si in range(S)
+                )
+        return g
+
+    gram_old = np_gram(old)
+    want = np_gram(new)
+    eng = NumpyEngine()
+    got = eng.gram_update_rows(
+        new, gram_old, dirty_slots, old_matrix=old, slice_idxs=dirty_slices
+    )
+    np.testing.assert_array_equal(got, want)
+    # Full-recompute form agrees too (no delta args).
+    np.testing.assert_array_equal(
+        eng.gram_update_rows(new, gram_old, dirty_slots), want
+    )
+
+    from pilosa_tpu.engine import JaxEngine
+
+    jeng = JaxEngine()
+    got_j = jeng.gram_update_rows(
+        jeng.matrix(new), gram_old, dirty_slots,
+        old_matrix=jeng.matrix(old), slice_idxs=dirty_slices,
+    )
+    np.testing.assert_array_equal(got_j, want)
+
+
+def test_gram_update_rows_delta_all_slices_dirty_falls_back():
+    """Every slice dirty -> no clean pad slice / no restriction win: both
+    engines take the full-recompute path and stay exact."""
+    rng = np.random.default_rng(14)
+    S, R = 2, 3
+    old = rng.integers(0, 1 << 32, size=(S, R, W), dtype=np.uint32)
+    new = old.copy()
+    new[:, 1] = rng.integers(0, 1 << 32, size=(S, W), dtype=np.uint32)
+
+    from pilosa_tpu.roaring import _popcount_words
+
+    def np_gram(m):
+        g = np.zeros((R, R), dtype=np.int64)
+        for a in range(R):
+            for b in range(R):
+                g[a, b] = sum(
+                    _popcount_words(m[si, a] & m[si, b]) for si in range(S)
+                )
+        return g
+
+    want = np_gram(new)
+    eng = NumpyEngine()
+    got = eng.gram_update_rows(
+        new, np_gram(old), [1], old_matrix=old, slice_idxs=[0, 1]
+    )
+    np.testing.assert_array_equal(got, want)
+
+
 def test_acquire_dirty_rows_nonresident_keeps_box():
     """Writes to rows the pool does not hold need no matrix or Gram work
     at all — the box survives untouched."""
