@@ -1433,6 +1433,180 @@ def bench_lockstep_coalesce() -> dict:
     }
 
 
+def bench_overload() -> dict:
+    """Request-lifecycle QoS tier: a REAL HTTP server (numpy engine)
+    driven past saturation by closed-loop clients, with the QoS door ON
+    (bounded per-class admission + per-request deadlines; overflow
+    sheds 429 + Retry-After at the door) vs OFF (unbounded admission,
+    no deadline — the pre-QoS behavior).
+
+    Three phases: ``presat`` measures the pre-saturation peak (clients
+    == read depth), then the overload phases run 2x the door capacity
+    (depth admitted + depth waiting).  Non-collapse contract: with QoS
+    on the shed rate is > 0, the SERVED requests' p99 stays near the
+    pre-saturation p99, and goodput stays within ~20% of peak; with QoS
+    off every request is admitted and the served p99 degrades with the
+    queue depth instead.  BENCH_SMOKE=1 shrinks the shapes for CI."""
+    import tempfile
+    import urllib.error
+    import urllib.request
+    from concurrent.futures import ThreadPoolExecutor
+
+    from pilosa_tpu.config import Config
+    from pilosa_tpu.server.server import Server
+
+    smoke = os.environ.get("BENCH_SMOKE", "").lower() in ("1", "true", "yes")
+    depth = int(os.environ.get("BENCH_QOS_DEPTH", "2" if smoke else "4"))
+    # 2x the DOOR capacity (depth active + depth waiting) = 4x depth.
+    overload_clients = int(os.environ.get("BENCH_THREADS", str(4 * depth)))
+    phase_s = float(os.environ.get("BENCH_OVERLOAD_SECS", "1.5" if smoke else "8"))
+    deadline_ms = float(os.environ.get("BENCH_DEADLINE_MS", "500" if smoke else "2000"))
+    n_slices = int(os.environ.get("BENCH_SLICES", "2" if smoke else "4"))
+    n_rows = int(os.environ.get("BENCH_ROWS", "8" if smoke else "16"))
+    batch = int(os.environ.get("BENCH_BATCH", "8" if smoke else "32"))
+
+    from pilosa_tpu.pilosa import SLICE_WIDTH
+
+    rng = np.random.default_rng(31)
+    queries = []
+    for seed in range(8):
+        prs = np.random.default_rng(seed).integers(0, n_rows, size=(batch, 2))
+        queries.append(" ".join(
+            f'Count(Intersect(Bitmap(rowID={a}, frame="f"), Bitmap(rowID={b}, frame="f")))'
+            for a, b in prs.tolist()
+        ))
+
+    def mk_server(d, qos_on: bool) -> Server:
+        cfg = Config(data_dir=d, host="127.0.0.1:0", engine="numpy", stats="expvar")
+        if qos_on:
+            cfg.qos_read_depth = depth
+            cfg.qos_write_depth = depth
+            cfg.qos_queue_wait_ms = 25.0
+            cfg.qos_retry_after_ms = 50.0
+            cfg.default_deadline_ms = deadline_ms
+        else:
+            cfg.qos_read_depth = cfg.qos_write_depth = cfg.qos_admin_depth = 0
+            cfg.default_deadline_ms = 0.0
+        srv = Server(cfg)
+        srv.open()
+        idx = srv.holder.create_index("o")
+        from pilosa_tpu.core.frame import FrameOptions
+
+        idx.create_frame("f", FrameOptions())
+        fr = idx.frame("f")
+        rows = np.repeat(np.arange(n_rows, dtype=np.uint64), 2000)
+        for s in range(n_slices):
+            cols = rng.integers(0, SLICE_WIDTH, size=len(rows)).astype(
+                np.uint64
+            ) + np.uint64(s * SLICE_WIDTH)
+            fr.import_bits(rows, cols)
+        return srv
+
+    def run_phase(host: str, n_clients: int, dur_s: float) -> dict:
+        """Closed-loop load: each client posts back-to-back until the
+        phase ends; sheds honor the server's Retry-After."""
+        t_end = time.perf_counter() + dur_s
+
+        def client(i: int) -> dict:
+            lat: list = []
+            out = {"served": 0, "shed": 0, "expired": 0, "timeouts": 0, "errors": 0}
+            k = i
+            while time.perf_counter() < t_end:
+                q = queries[k % len(queries)]
+                k += 1
+                req = urllib.request.Request(
+                    f"http://{host}/index/o/query", data=q.encode(), method="POST")
+                t1 = time.perf_counter()
+                try:
+                    with urllib.request.urlopen(req, timeout=30) as resp:
+                        resp.read()
+                    lat.append(time.perf_counter() - t1)
+                    out["served"] += 1
+                except urllib.error.HTTPError as e:
+                    e.read()
+                    if e.code == 429 or e.code == 503:
+                        out["shed"] += 1
+                        try:
+                            wait = float(e.headers.get("Retry-After", "0.05"))
+                        except (TypeError, ValueError):
+                            wait = 0.05
+                        time.sleep(min(wait, 0.25))
+                    elif e.code == 504:
+                        out["expired"] += 1
+                    else:
+                        out["errors"] += 1
+                except OSError:
+                    out["timeouts"] += 1
+            out["lat"] = lat
+            return out
+
+        t0 = time.perf_counter()
+        with ThreadPoolExecutor(n_clients) as pool:
+            outs = list(pool.map(client, range(n_clients)))
+        dt = time.perf_counter() - t0
+        lat = sorted(x for o in outs for x in o["lat"])
+        total = {k: sum(o[k] for o in outs)
+                 for k in ("served", "shed", "expired", "timeouts", "errors")}
+        offered = sum(total.values())
+        return {
+            "goodput_qps": round(total["served"] / dt, 1),
+            "p50_ms": round(1e3 * lat[len(lat) // 2], 2) if lat else None,
+            "p99_ms": (
+                round(1e3 * lat[min(len(lat) - 1, int(len(lat) * 0.99))], 2)
+                if lat else None
+            ),
+            "shed_rate": round(total["shed"] / offered, 3) if offered else 0.0,
+            **total,
+        }
+
+    tiers = []
+    with tempfile.TemporaryDirectory() as d:
+        srv = mk_server(d, qos_on=True)
+        try:
+            for q in queries:  # warm: matrices + serve lane
+                run = urllib.request.Request(
+                    f"http://{srv.host}/index/o/query", data=q.encode(), method="POST")
+                urllib.request.urlopen(run, timeout=60).read()
+            presat = run_phase(srv.host, depth, phase_s)
+            tiers.append({"tier": "presat", "clients": depth, **presat})
+            on = run_phase(srv.host, overload_clients, phase_s)
+            tiers.append({"tier": "overload_qos_on", "clients": overload_clients, **on})
+        finally:
+            srv.close()
+    with tempfile.TemporaryDirectory() as d:
+        srv = mk_server(d, qos_on=False)
+        try:
+            for q in queries:
+                run = urllib.request.Request(
+                    f"http://{srv.host}/index/o/query", data=q.encode(), method="POST")
+                urllib.request.urlopen(run, timeout=60).read()
+            off = run_phase(srv.host, overload_clients, phase_s)
+            tiers.append({"tier": "overload_qos_off", "clients": overload_clients, **off})
+        finally:
+            srv.close()
+
+    on["goodput_vs_peak"] = round(
+        on["goodput_qps"] / presat["goodput_qps"], 3
+    ) if presat["goodput_qps"] else None
+    tiers[1]["goodput_vs_peak"] = on["goodput_vs_peak"]
+    p99_ratio = (
+        round(off["p99_ms"] / on["p99_ms"], 2)
+        if on.get("p99_ms") and off.get("p99_ms") else None
+    )
+    return {
+        "metric": "overload_goodput_qps",
+        "value": on["goodput_qps"],
+        "unit": (
+            f"served requests/sec at 2x door capacity ({overload_clients} clients, "
+            f"read depth {depth}; shed rate {on['shed_rate']}, served p99 "
+            f"{on['p99_ms']} ms vs presat {presat['p99_ms']} ms; QoS-off p99 "
+            f"{off['p99_ms']} ms = {p99_ratio}x worse)"
+        ),
+        "vs_baseline": p99_ratio,
+        "tiers": tiers,
+    }
+
+
 def main() -> None:
     cfg = os.environ.get("BENCH_CONFIG", "intersect_count")
     if cfg != "intersect_count":
@@ -1447,6 +1621,7 @@ def main() -> None:
             "executor_gather": bench_executor_gather,
             "range_executor": bench_range_executor,
             "mixed": bench_mixed,
+            "overload": bench_overload,
             "intersect_count_stream": bench_intersect_stream,
             "intersect_count_4krows": bench_intersect_4krows,
             "topn_p50": bench_topn_p50,

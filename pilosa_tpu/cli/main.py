@@ -116,6 +116,10 @@ def cmd_lockstep(args) -> int:
         holder,
         control_addr=(ctrl_host or "127.0.0.1", int(ctrl_port)),
         http_addr=(host or "127.0.0.1", int(port or 10101)),
+        ack_timeout=cfg.lockstep_ack_timeout,
+        connect_timeout=cfg.lockstep_connect_timeout,
+        queue_depth=cfg.lockstep_queue_depth,
+        default_deadline_ms=cfg.default_deadline_ms,
     )
     if svc.rank == 0:
         print(

@@ -64,6 +64,28 @@ class Config:
     # Row ceiling for the cached all-pairs Gram strategy (4096 rows = a
     # 64 MiB Gram; raise on host-attached hardware).
     gram_rows_max: int = 4096
+    # -- request-lifecycle QoS ([qos] TOML section) ----------------------
+    # Default per-request time budget in ms when the client sends no
+    # X-Pilosa-Deadline-Ms header; 0 = unbounded (pre-QoS behavior).
+    default_deadline_ms: float = 0.0
+    # Per-class admission depths (max concurrently executing requests;
+    # an equal number may wait briefly at the door).  0 = unbounded.
+    qos_read_depth: int = 64
+    qos_write_depth: int = 32
+    qos_admin_depth: int = 16
+    # How long a request may wait at a full door before shedding, and
+    # the Retry-After hint returned with a 429/503.
+    qos_queue_wait_ms: float = 100.0
+    qos_retry_after_ms: float = 250.0
+    # -- lockstep service ([lockstep] TOML section) ----------------------
+    # Rank-0 wait for a worker's receipt ack (control-plane latency +
+    # scheduling, not execution) and a worker's connect retry window at
+    # startup — both previously hard-coded in parallel/service.py.
+    lockstep_ack_timeout: float = 120.0
+    lockstep_connect_timeout: float = 60.0
+    # Bound on rank 0's arrival queue: requests beyond this shed with
+    # 429 instead of growing the coalescing queue without limit.
+    lockstep_queue_depth: int = 256
 
     @classmethod
     def from_toml(cls, path: str) -> "Config":
@@ -90,6 +112,29 @@ class Config:
         )
         cfg.repair_rows_max = int(raw.get("repair-rows-max", cfg.repair_rows_max))
         cfg.gram_rows_max = int(raw.get("gram-rows-max", cfg.gram_rows_max))
+        qos = raw.get("qos", {})
+        cfg.default_deadline_ms = 1000.0 * _interval(
+            qos.get("default-deadline"), cfg.default_deadline_ms / 1000.0
+        )
+        cfg.qos_read_depth = int(qos.get("read-depth", cfg.qos_read_depth))
+        cfg.qos_write_depth = int(qos.get("write-depth", cfg.qos_write_depth))
+        cfg.qos_admin_depth = int(qos.get("admin-depth", cfg.qos_admin_depth))
+        cfg.qos_queue_wait_ms = 1000.0 * _interval(
+            qos.get("queue-wait"), cfg.qos_queue_wait_ms / 1000.0
+        )
+        cfg.qos_retry_after_ms = 1000.0 * _interval(
+            qos.get("retry-after"), cfg.qos_retry_after_ms / 1000.0
+        )
+        ls = raw.get("lockstep", {})
+        cfg.lockstep_ack_timeout = _interval(
+            ls.get("ack-timeout"), cfg.lockstep_ack_timeout
+        )
+        cfg.lockstep_connect_timeout = _interval(
+            ls.get("connect-timeout"), cfg.lockstep_connect_timeout
+        )
+        cfg.lockstep_queue_depth = int(
+            ls.get("queue-depth", cfg.lockstep_queue_depth)
+        )
         cl = raw.get("cluster", {})
         cfg.cluster.replica_n = cl.get("replicas", cfg.cluster.replica_n)
         cfg.cluster.type = cl.get("type", cfg.cluster.type)
@@ -123,6 +168,26 @@ class Config:
             self.repair_rows_max = int(env["PILOSA_TPU_REPAIR_ROWS_MAX"])
         if "PILOSA_TPU_GRAM_ROWS_MAX" in env:
             self.gram_rows_max = int(env["PILOSA_TPU_GRAM_ROWS_MAX"])
+        if "PILOSA_TPU_DEADLINE_MS" in env:
+            self.default_deadline_ms = float(env["PILOSA_TPU_DEADLINE_MS"])
+        if "PILOSA_TPU_QOS_READ_DEPTH" in env:
+            self.qos_read_depth = int(env["PILOSA_TPU_QOS_READ_DEPTH"])
+        if "PILOSA_TPU_QOS_WRITE_DEPTH" in env:
+            self.qos_write_depth = int(env["PILOSA_TPU_QOS_WRITE_DEPTH"])
+        if "PILOSA_TPU_QOS_ADMIN_DEPTH" in env:
+            self.qos_admin_depth = int(env["PILOSA_TPU_QOS_ADMIN_DEPTH"])
+        if "PILOSA_TPU_QOS_QUEUE_WAIT_MS" in env:
+            self.qos_queue_wait_ms = float(env["PILOSA_TPU_QOS_QUEUE_WAIT_MS"])
+        if "PILOSA_TPU_QOS_RETRY_AFTER_MS" in env:
+            self.qos_retry_after_ms = float(env["PILOSA_TPU_QOS_RETRY_AFTER_MS"])
+        if "PILOSA_TPU_LOCKSTEP_ACK_TIMEOUT" in env:
+            self.lockstep_ack_timeout = float(env["PILOSA_TPU_LOCKSTEP_ACK_TIMEOUT"])
+        if "PILOSA_TPU_LOCKSTEP_CONNECT_TIMEOUT" in env:
+            self.lockstep_connect_timeout = float(
+                env["PILOSA_TPU_LOCKSTEP_CONNECT_TIMEOUT"]
+            )
+        if "PILOSA_TPU_LOCKSTEP_QUEUE_DEPTH" in env:
+            self.lockstep_queue_depth = int(env["PILOSA_TPU_LOCKSTEP_QUEUE_DEPTH"])
         return self
 
     def to_toml(self) -> str:

@@ -160,6 +160,10 @@ class ExecOptions:
 
     remote: bool = False
     exclude_attrs: bool = False
+    # Request deadline (qos.Deadline): checked at cheap checkpoints
+    # between calls and between fan-out slice chunks, and forwarded to
+    # remote nodes as the remaining budget.  None = unbounded.
+    deadline: Any = None
 
 
 class QueryBitmap:
@@ -307,6 +311,10 @@ class Executor:
         slices: Optional[Sequence[int]] = None,
         opt: Optional[ExecOptions] = None,
     ) -> list[Any]:
+        if opt is not None and opt.deadline is not None:
+            # Door checkpoint: an already-expired request never touches
+            # the serve lane (fast paths included).
+            opt.deadline.check("pre-execution")
         if isinstance(query, str):
             w = self._singleton_write_fast(index, query, slices, opt)
             if w is not None:
@@ -359,6 +367,10 @@ class Executor:
 
         results = []
         for i, call in enumerate(query.calls):
+            if opt.deadline is not None and i:
+                # Cancellation checkpoint between calls: an expired
+                # request stops here instead of finishing the batch.
+                opt.deadline.check("between calls")
             if fused is not None and i in fused:
                 results.append(fused[i])
                 continue
@@ -443,7 +455,11 @@ class Executor:
 
         for host, idxs in by_node.items():
             client = self.client_factory(host)
-            res = client.execute_remote(index, pql.Query(calls=[calls[i] for i in idxs]))
+            q = pql.Query(calls=[calls[i] for i in idxs])
+            if opt.deadline is not None:
+                res = client.execute_remote(index, q, deadline=opt.deadline)
+            else:
+                res = client.execute_remote(index, q)
             for k, i in enumerate(idxs):
                 if res and res[k]:
                     changed[i] = True
@@ -1514,7 +1530,12 @@ class Executor:
             return local_fn(node_slices)
 
         def remote_map(client, node_slices):
-            res = client.execute_remote(index, batch_query, node_slices)
+            if opt.deadline is not None:
+                res = client.execute_remote(
+                    index, batch_query, node_slices, deadline=opt.deadline
+                )
+            else:
+                res = client.execute_remote(index, batch_query, node_slices)
             if len(res) != len(idxs):
                 raise PilosaError(
                     f"fused batch: peer returned {len(res)} results for {len(idxs)} calls"
@@ -2426,7 +2447,12 @@ class Executor:
                     changed = True
             else:
                 client = self.client_factory(node.host)
-                res = client.execute_remote(index, pql.Query(calls=[c]))
+                if opt.deadline is not None:
+                    res = client.execute_remote(
+                        index, pql.Query(calls=[c]), deadline=opt.deadline
+                    )
+                else:
+                    res = client.execute_remote(index, pql.Query(calls=[c]))
                 if res and res[0]:
                     changed = True
         return changed
@@ -2505,6 +2531,11 @@ class Executor:
                 return local_map(node_slices)
             result = zero
             for i in range(0, len(node_slices), chunk):
+                if opt.deadline is not None and i:
+                    # Cancellation checkpoint between slice chunks: a
+                    # bigger-than-memory scan stops streaming once the
+                    # request's budget is gone.
+                    opt.deadline.check("between slice chunks")
                 result = reduce_fn(result, local_map(node_slices[i : i + chunk]))
             return result
 
@@ -2519,6 +2550,12 @@ class Executor:
             client = self.client_factory(node.host)
             if remote_map is not None:
                 return remote_map(client, node_slices)
+            # deadline= only when set: custom client factories (tests,
+            # embedders) need not know the QoS kwargs.
+            if opt.deadline is not None:
+                return client.execute_remote_call(
+                    index, c, node_slices, deadline=opt.deadline
+                )
             return client.execute_remote_call(index, c, node_slices)
 
         # Mid-query node-failure retry (executor.go:1147-1159): when a

@@ -45,6 +45,17 @@ it never poisons sibling requests or desynchronizes ranks).
 ``PILOSA_TPU_LOCKSTEP_COALESCE`` caps the batch size (default 32;
 1 disables coalescing).  An idle service adds no latency: the first
 request leads immediately and ships a batch of one.
+
+QoS: each request may carry a deadline (``X-Pilosa-Deadline-Ms``
+header, or the service's ``default_deadline_ms``).  Expiry is decided
+ONCE — on rank 0, at ship time — and rides the batch entry as a
+per-request ``expired`` flag (plus ``deadline_ms`` remaining, for
+observability): every rank drops the same expired requests before
+execution from the flag alone, so no clock sync is assumed and the
+lockstep invariant holds (the client gets a 504).  The arrival queue
+is bounded (``queue_depth``, default 256): a request landing on a full
+queue gets 429 + Retry-After at the door, and a degraded control plane
+answers 503 + Retry-After instead of 400.
 """
 
 from __future__ import annotations
@@ -60,9 +71,19 @@ from typing import Optional
 from pilosa_tpu.engine import MeshEngine
 from pilosa_tpu.executor import Executor
 from pilosa_tpu.pilosa import PilosaError
+from pilosa_tpu.qos import DeadlineExceeded, ShedError, deadline_from_headers
 from pilosa_tpu.server.handler import result_to_json
 
 _LEN = struct.Struct("<I")
+
+
+class DegradedError(PilosaError):
+    """The lockstep control plane lost a rank — the replicas can no
+    longer be guaranteed identical, so the whole service refuses work
+    (HTTP 503 + Retry-After: clients should come back to a RESTARTED
+    job, not hammer a dead one)."""
+
+    retry_after = 5.0
 
 
 def _send_msg(sock: socket.socket, obj: dict) -> None:
@@ -102,6 +123,10 @@ class LockstepService:
         control_addr: tuple[str, int],
         http_addr: Optional[tuple[str, int]] = None,
         devices=None,
+        ack_timeout: Optional[float] = None,
+        connect_timeout: Optional[float] = None,
+        queue_depth: Optional[int] = None,
+        default_deadline_ms: Optional[float] = None,
     ):
         import jax
 
@@ -116,8 +141,32 @@ class LockstepService:
         # Bound on how long rank 0 waits for a worker's receipt ack (and
         # for the send buffer to drain).  Acks come from the workers'
         # reader threads (receipt, not completion), so this only needs to
-        # cover control-plane latency plus scheduling hiccups.
-        self.ack_timeout = float(os.environ.get("PILOSA_TPU_LOCKSTEP_ACK_TIMEOUT", "120"))
+        # cover control-plane latency plus scheduling hiccups.  Config
+        # precedence (PR-2 style): ctor arg (the CLI passes
+        # Config.lockstep_ack_timeout) > env > default.
+        if ack_timeout is None:
+            ack_timeout = float(os.environ.get("PILOSA_TPU_LOCKSTEP_ACK_TIMEOUT", "120"))
+        self.ack_timeout = ack_timeout
+        # Worker startup: how long a worker retries connecting to rank
+        # 0's control listener (the gossip seed-join startup race).
+        if connect_timeout is None:
+            connect_timeout = float(
+                os.environ.get("PILOSA_TPU_LOCKSTEP_CONNECT_TIMEOUT", "60")
+            )
+        self.connect_timeout = connect_timeout
+        # Admission bound on rank 0's arrival queue: requests beyond
+        # this shed with 429 + Retry-After instead of growing the
+        # coalescing queue without limit (coalesced batches stay sized,
+        # and waiting clients aren't promised work the job can't do).
+        # 0 = unbounded.
+        if queue_depth is None:
+            queue_depth = int(os.environ.get("PILOSA_TPU_LOCKSTEP_QUEUE_DEPTH", "256"))
+        self.queue_depth = queue_depth
+        # Default per-request budget when no X-Pilosa-Deadline-Ms header
+        # arrives; 0 = unbounded.
+        if default_deadline_ms is None:
+            default_deadline_ms = float(os.environ.get("PILOSA_TPU_DEADLINE_MS", "0"))
+        self.default_deadline_ms = default_deadline_ms
         # PIPELINED total order: _order_mu only covers sequence assignment
         # + the worker sends (cheap), so N requests can be in flight on
         # the control plane at once; local execution is serialized in
@@ -153,9 +202,12 @@ class LockstepService:
         # one — requests must ACCUMULATE during execution for the
         # coalescing to form real batches.
         self._inflight = 0
-        # Telemetry (bench + tests): batches shipped / requests carried.
+        # Telemetry (bench + tests): batches shipped / requests carried,
+        # plus QoS outcomes (shed at the arrival queue, dropped expired).
         self.stat_batches = 0
         self.stat_requests = 0
+        self.stat_shed = 0
+        self.stat_expired = 0
 
     # -- rank 0 ----------------------------------------------------------
 
@@ -173,11 +225,11 @@ class LockstepService:
             self._ack_mu.append(threading.Lock())
             self._acked.append(0)
 
-    def _degrade(self, e) -> "PilosaError":
+    def _degrade(self, e) -> "DegradedError":
         self._degraded = True
         with self._exec_cv:
             self._exec_cv.notify_all()
-        return PilosaError(
+        return DegradedError(
             f"lockstep control plane lost a rank ({e}); "
             "service degraded — restart the job"
         )
@@ -200,8 +252,14 @@ class LockstepService:
                         raise OSError("worker closed control connection")
                     self._acked[i] += 1
 
-    def _execute(self, index: str, query: str):
+    def _execute(self, index: str, query: str, deadline=None):
         """Serve one request through the coalescing queue.
+
+        ADMISSION: the arrival queue is bounded (``queue_depth``) — a
+        request landing on a full queue sheds with :class:`ShedError`
+        (HTTP 429 + Retry-After) instead of queuing into collapse, so
+        coalesced batches stay sized and every admitted request is one
+        the job can actually serve.
 
         Whoever finds the queue shipper-less drains every waiting
         request (up to ``coalesce_max``) into ONE control-plane batch
@@ -217,7 +275,13 @@ class LockstepService:
         """
         slot = [False, None]  # done, result (exception instance = raise)
         with self._q_cv:
-            self._q.append(((index, query), slot))
+            if self.queue_depth > 0 and len(self._q) >= self.queue_depth:
+                self.stat_shed += 1
+                raise ShedError(
+                    f"lockstep arrival queue full ({self.queue_depth}); retry",
+                    retry_after=0.25,
+                )
+            self._q.append(((index, query, deadline), slot))
             while not slot[0]:
                 if not self._shipping and self._q and self._inflight < 2:
                     self._shipping = True
@@ -227,9 +291,9 @@ class LockstepService:
                     self.stat_batches += 1
                     self.stat_requests += len(batch)
                     self._q_cv.release()
-                    seq = None
+                    shipped = None
                     try:
-                        seq = self._ship_batch([it for it, _ in batch])
+                        shipped = self._ship_batch([it for it, _ in batch])
                     except BaseException as e:  # noqa: BLE001 — degrade
                         for _, s in batch:
                             s[1] = e
@@ -238,10 +302,10 @@ class LockstepService:
                         self._q_cv.acquire()
                         self._shipping = False
                         self._q_cv.notify_all()
-                    if seq is not None:
+                    if shipped is not None:
                         self._q_cv.release()
                         try:
-                            self._run_batch(seq, batch)
+                            self._run_batch(shipped[0], batch, shipped[1])
                         finally:
                             self._q_cv.acquire()
                     self._inflight -= 1
@@ -252,11 +316,19 @@ class LockstepService:
             raise slot[1]
         return slot[1]
 
-    def _ship_batch(self, items) -> int:
+    def _ship_batch(self, items) -> tuple[int, list[bool]]:
         """Assign the batch's slot in the total order and replicate it:
         one control-plane send per worker plus one ack round for the
         WHOLE batch (the per-request fixed cost this coalescing
-        amortizes).
+        amortizes).  Returns (seq, expired flags).
+
+        DEADLINES ride the wire entry: expiry is decided ONCE, here on
+        rank 0 at ship time, and the per-request ``expired`` flag (plus
+        the remaining budget, for observability) is part of the batch
+        entry — every rank drops the same expired requests before
+        execution from the flag alone, never from its own clock, so the
+        lockstep invariant holds without any clock sync (the same
+        determinism rule as PR 2's error isolation).
 
         FAIL-STOP on a broken control plane: once any forward or ack
         fails, the ranks can no longer be guaranteed identical (a partial
@@ -270,10 +342,18 @@ class LockstepService:
         idempotent).  A dead rank forces a restart exactly like the
         collective hang it would otherwise cause.
         """
-        reqs = [{"index": index, "query": query} for index, query in items]
+        reqs = []
+        expired: list[bool] = []
+        for index, query, d in items:
+            exp = bool(d is not None and d.expired())
+            expired.append(exp)
+            entry = {"index": index, "query": query, "expired": exp}
+            if d is not None:
+                entry["deadline_ms"] = max(0, int(d.remaining_ms()))
+            reqs.append(entry)
         with self._order_mu:
             if self._degraded:
-                raise PilosaError(
+                raise DegradedError(
                     "lockstep service degraded: control plane lost a rank; restart the job"
                 )
             seq = self._next_seq
@@ -288,7 +368,28 @@ class LockstepService:
             self._await_acks(seq)
         except (OSError, socket.timeout) as e:
             raise self._degrade(e)
-        return seq
+        return seq, expired
+
+    def _exec_batch_entries(self, entries, deliver) -> None:
+        """Drop expired entries (the flag decided at ship time — every
+        rank sees the same flags, so every rank drops the same entries
+        before execution), then run the remaining requests through the
+        fused batch units.  The expired requests resolve to
+        DeadlineExceeded — deterministic, so it is safe as a
+        per-request result on every rank (batch siblings unaffected).
+        """
+        live: list = []  # (original position, (index, query))
+        for pos, e in enumerate(entries):
+            if e.get("expired"):
+                self.stat_expired += 1
+                deliver(pos, DeadlineExceeded("dropped at lockstep replay"))
+            else:
+                live.append((pos, (e["index"], e["query"])))
+        if live:
+            self._exec_batch_units(
+                [it for _, it in live],
+                lambda i, result: deliver(live[i][0], result),
+            )
 
     def _batch_units(self, items):
         """Split one replay batch into execution units.
@@ -373,10 +474,12 @@ class LockstepService:
                 except PilosaError as e:
                     deliver(pos, e)
 
-    def _run_batch(self, seq: int, batch) -> None:
+    def _run_batch(self, seq: int, batch, expired=None) -> None:
         """Execute one shipped batch in its slot of the total order and
         fill every submitter's result slot; never raises (siblings would
-        hang on an unfilled slot otherwise).
+        hang on an unfilled slot otherwise).  ``expired`` carries the
+        ship-time per-request expiry flags — the SAME flags the workers
+        read off the wire, so the drop is identical on every rank.
 
         Requests execute through the batch units (_exec_batch_units):
         adjacent read-only requests fuse into one executor pass,
@@ -409,8 +512,13 @@ class LockstepService:
                     slot[1] = result
                     slot[0] = True
 
+                flags = expired or [False] * len(batch)
+                entries = [
+                    {"index": it[0], "query": it[1], "expired": flags[i]}
+                    for i, (it, _) in enumerate(batch)
+                ]
                 try:
-                    self._exec_batch_units([it for it, _ in batch], deliver)
+                    self._exec_batch_entries(entries, deliver)
                 except Exception as e:  # noqa: BLE001 — rank-local failure
                     self._degraded = True
                     err = e
@@ -439,12 +547,28 @@ class LockstepService:
             index = parts[1]
             n = int(self.headers.get("Content-Length", 0))
             query = self.rfile.read(n).decode("utf-8")
+            headers = {k.lower(): v for k, v in self.headers.items()}
+            deadline = deadline_from_headers(
+                headers, self.service.default_deadline_ms
+            )
+            retry_after = None
             try:
-                results = self.service._execute(index, query)
+                results = self.service._execute(index, query, deadline=deadline)
                 body = json.dumps(
                     {"results": [result_to_json(r) for r in results]}
                 ).encode()
                 status = 200
+            except DeadlineExceeded as e:
+                body = json.dumps({"error": str(e)}).encode()
+                status = 504
+            except ShedError as e:  # arrival queue full: back off and retry
+                body = json.dumps({"error": str(e)}).encode()
+                status = e.status
+                retry_after = e.retry_after
+            except DegradedError as e:  # control plane down: 503, not 400
+                body = json.dumps({"error": str(e)}).encode()
+                status = 503
+                retry_after = e.retry_after
             except PilosaError as e:
                 body = json.dumps({"error": str(e)}).encode()
                 status = 400
@@ -456,6 +580,8 @@ class LockstepService:
             self.send_response(status)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            if retry_after is not None:
+                self.send_header("Retry-After", f"{retry_after:.3f}")
             self.end_headers()
             self.wfile.write(body)
 
@@ -466,7 +592,7 @@ class LockstepService:
 
         # Rank 0 may still be binding its control listener; retry briefly
         # (the same startup race the gossip seed-join retries handle).
-        deadline = time.monotonic() + 60
+        deadline = time.monotonic() + self.connect_timeout
         while True:
             try:
                 sock = socket.create_connection(self.control_addr, timeout=5)
@@ -519,9 +645,11 @@ class LockstepService:
                 reqs = msg["reqs"]
             else:
                 reqs = [{"index": msg["index"], "query": msg["query"]}]
-            items = [(r["index"], r["query"]) for r in reqs]
             try:
-                self._exec_batch_units(items, lambda pos, result: None)
+                # Entries marked expired at ship time are dropped HERE
+                # exactly as on rank 0 — by the wire flag, never this
+                # rank's clock — before any device work.
+                self._exec_batch_entries(reqs, lambda pos, result: None)
             except Exception:  # noqa: BLE001
                 # Rank-LOCAL failure (disk full, engine fault): this
                 # replica may have diverged from its peers, so

@@ -1,0 +1,151 @@
+"""Admission control: per-class bounded doors with shed-on-full.
+
+Requests are classified into three classes — ``read`` (data-plane
+queries, exports, fragment reads), ``write`` (imports, mutating PQL,
+fragment restores), ``admin`` (schema, status, debug) — and each class
+has a bounded door: at most ``depth`` requests executing, at most
+``depth`` more waiting briefly (``queue-wait-ms``) for a slot.  Beyond
+that the request is REJECTED AT THE DOOR with :class:`ShedError`
+(HTTP 429 + ``Retry-After``) instead of queuing into collapse — under
+overload the server keeps serving ``depth`` requests at pre-saturation
+latency and sheds the excess, rather than serving everyone a timeout.
+
+``depth <= 0`` disables the bound for that class (the pre-QoS
+behavior, and the bench's QoS-off baseline).
+"""
+
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+from typing import Optional
+
+from pilosa_tpu.pilosa import PilosaError
+from pilosa_tpu.pql.ast import WRITE_CALL_NAMES
+from pilosa_tpu.stats import NOP_STATS
+
+CLASS_READ = "read"
+CLASS_WRITE = "write"
+CLASS_ADMIN = "admin"
+CLASSES = (CLASS_READ, CLASS_WRITE, CLASS_ADMIN)
+
+# Mutating-call markers, matched as raw bytes so one scan classifies
+# both JSON bodies (the PQL string itself) and protobuf QueryRequests
+# (the PQL string is embedded verbatim as a length-delimited field).
+_WRITE_MARKERS = tuple(f"{name}(".encode() for name in WRITE_CALL_NAMES)
+
+
+class ShedError(PilosaError):
+    """Request rejected at the door (HTTP 429, or 503 when the serving
+    plane itself is down); ``retry_after`` is the client hint in
+    seconds for the ``Retry-After`` header."""
+
+    def __init__(self, message: str, retry_after: float = 0.25, status: int = 429):
+        super().__init__(message)
+        self.retry_after = retry_after
+        self.status = status
+
+
+def classify_request(method: str, path: str, body: bytes = b"") -> str:
+    """Map (method, path, body) to an admission class.
+
+    The query route is split by content: a request whose body carries a
+    mutating call (SetBit & co.) is a write, everything else a read —
+    a cheap substring scan, not a parse, so classification never fails
+    a request and costs O(len(body)) at the door.
+    """
+    if path.startswith("/index/") and path.endswith("/query"):
+        if any(m in body for m in _WRITE_MARKERS):
+            return CLASS_WRITE
+        return CLASS_READ
+    if path == "/import" or (
+        method == "POST"
+        and (path in ("/fragment/data", "/fragment/block/diff") or path.endswith("/restore"))
+    ):
+        return CLASS_WRITE
+    if path == "/export" or path.startswith("/fragment/") or path.endswith("/attr/diff"):
+        return CLASS_READ
+    return CLASS_ADMIN
+
+
+class AdmissionController:
+    """Per-class bounded admission with a short in-door wait.
+
+    A request ACQUIRES a slot for its class before executing and
+    releases it after.  When all ``depth`` slots are busy the request
+    waits at most ``queue_wait_ms`` (never past its deadline) for a
+    release; when the wait lane itself is full (``depth`` waiters) it
+    sheds immediately — the two bounds together cap the work the
+    server ever holds to 2x depth per class.
+    """
+
+    def __init__(
+        self,
+        depths: Optional[dict[str, int]] = None,
+        queue_wait_ms: float = 100.0,
+        retry_after_ms: float = 250.0,
+        stats=None,
+    ):
+        self.depths = dict(depths or {})
+        self.queue_wait_ms = queue_wait_ms
+        self.retry_after = max(0.001, retry_after_ms / 1000.0)
+        self.stats = stats if stats is not None else NOP_STATS
+        self._cv = threading.Condition()
+        self._active = {c: 0 for c in CLASSES}
+        self._waiting = {c: 0 for c in CLASSES}
+        # Totals (also mirrored into stats counters for /debug/vars).
+        self.stat_admitted = 0
+        self.stat_shed = 0
+
+    def _shed(self, cls: str) -> ShedError:
+        self.stat_shed += 1
+        self.stats.count(f"qos.shed.{cls}")
+        return ShedError(
+            f"{cls} admission queue full; retry after {self.retry_after:.3f}s",
+            retry_after=self.retry_after,
+        )
+
+    def acquire(self, cls: str, deadline=None) -> None:
+        depth = self.depths.get(cls, 0)
+        with self._cv:
+            if depth <= 0 or self._active[cls] < depth:
+                self._active[cls] += 1
+                self.stat_admitted += 1
+                self.stats.gauge(f"qos.inflight.{cls}", self._active[cls])
+                return
+            if self._waiting[cls] >= depth:
+                raise self._shed(cls)
+            self._waiting[cls] += 1
+            self.stats.gauge(f"qos.queue_depth.{cls}", self._waiting[cls])
+            try:
+                budget = self.queue_wait_ms / 1000.0
+                if deadline is not None:
+                    budget = min(budget, max(0.0, deadline.remaining_ms() / 1000.0))
+                import time as _time
+
+                end = _time.monotonic() + budget
+                while self._active[cls] >= depth:
+                    left = end - _time.monotonic()
+                    if left <= 0:
+                        raise self._shed(cls)
+                    self._cv.wait(left)
+            finally:
+                self._waiting[cls] -= 1
+                self.stats.gauge(f"qos.queue_depth.{cls}", self._waiting[cls])
+            self._active[cls] += 1
+            self.stat_admitted += 1
+            self.stats.gauge(f"qos.inflight.{cls}", self._active[cls])
+
+    def release(self, cls: str) -> None:
+        with self._cv:
+            self._active[cls] -= 1
+            self.stats.gauge(f"qos.inflight.{cls}", self._active[cls])
+            self._cv.notify()
+
+    @contextmanager
+    def admit(self, cls: str, deadline=None):
+        self.acquire(cls, deadline)
+        try:
+            yield
+        finally:
+            self.release(cls)

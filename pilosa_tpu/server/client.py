@@ -9,6 +9,7 @@ attr-diff sync, and the ctl tools.
 from __future__ import annotations
 
 import json
+import time
 import urllib.error
 import urllib.request
 from typing import Any, Optional, Sequence
@@ -20,8 +21,14 @@ from pilosa_tpu.core.cache import Pair
 from pilosa_tpu.executor import QueryBitmap
 from pilosa_tpu.ops.bitwise import pack_positions
 from pilosa_tpu.pilosa import SLICE_WIDTH, PilosaError
+from pilosa_tpu.qos import DEADLINE_HEADER
 
 PROTOBUF = "application/x-protobuf"
+
+# Backoff cap when honoring a peer's Retry-After on 429/503 in the
+# cluster fan-out: a peer advertising a long recovery must not stall a
+# forwarded sub-request longer than this per attempt.
+RETRY_AFTER_CAP_S = 2.0
 
 
 class ClientError(PilosaError):
@@ -46,16 +53,43 @@ class Client:
         body: Optional[bytes] = None,
         content_type: str = "application/json",
         accept: str = "application/json",
+        headers: Optional[dict] = None,
+        timeout: Optional[float] = None,
+        retries: int = 0,
+        deadline=None,
     ) -> tuple[int, bytes]:
+        """One HTTP exchange; ``timeout`` overrides the constructor-wide
+        default per request.  With ``retries`` > 0, a 429/503 answer is
+        retried after honoring the peer's ``Retry-After`` hint (capped
+        at RETRY_AFTER_CAP_S, never past ``deadline``)."""
         req = urllib.request.Request(self.base + path, data=body, method=method)
         if body is not None:
             req.add_header("Content-Type", content_type)
         req.add_header("Accept", accept)
-        try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-                return resp.status, resp.read()
-        except urllib.error.HTTPError as e:
-            return e.code, e.read()
+        for k, v in (headers or {}).items():
+            req.add_header(k, v)
+        attempt = 0
+        while True:
+            try:
+                with urllib.request.urlopen(
+                    req, timeout=timeout if timeout is not None else self.timeout
+                ) as resp:
+                    return resp.status, resp.read()
+            except urllib.error.HTTPError as e:
+                status, payload, resp_headers = e.code, e.read(), e.headers
+            if status not in (429, 503) or attempt >= retries:
+                return status, payload
+            attempt += 1
+            try:
+                wait = float(resp_headers.get("Retry-After", "0.25"))
+            except (TypeError, ValueError):
+                wait = 0.25
+            wait = min(max(wait, 0.0), RETRY_AFTER_CAP_S)
+            if deadline is not None:
+                left = deadline.remaining_ms() / 1000.0
+                if left <= wait:
+                    return status, payload  # a retry could not finish in budget
+            time.sleep(wait)
 
     def _json(self, method: str, path: str, obj: Any = None) -> dict:
         body = json.dumps(obj).encode() if obj is not None else None
@@ -78,13 +112,29 @@ class Client:
         slices: Optional[Sequence[int]] = None,
         column_attrs: bool = False,
         remote: bool = False,
+        deadline=None,
+        timeout: Optional[float] = None,
     ) -> dict:
-        """Execute PQL; returns the decoded QueryResponse dict."""
+        """Execute PQL; returns the decoded QueryResponse dict.
+
+        ``deadline`` (qos.Deadline) forwards the REMAINING budget to the
+        peer as the X-Pilosa-Deadline-Ms hop header and tightens the
+        socket timeout to match; a shed (429) or unavailable (503) peer
+        is retried once after its Retry-After hint.
+        """
         body = wire.encode_query_request(
             query, slices=list(slices or []), column_attrs=column_attrs, remote=remote
         )
+        headers = {}
+        if deadline is not None:
+            headers[DEADLINE_HEADER] = deadline.header_value()
+            if timeout is None:
+                # Socket bound tracks the budget (+ slack for the 504
+                # answer itself to travel back).
+                timeout = min(self.timeout, deadline.remaining_ms() / 1000.0 + 1.0)
         status, payload = self._request(
-            "POST", f"/index/{index}/query", body, content_type=PROTOBUF, accept=PROTOBUF
+            "POST", f"/index/{index}/query", body, content_type=PROTOBUF, accept=PROTOBUF,
+            headers=headers, timeout=timeout, retries=1, deadline=deadline,
         )
         if status >= 400:
             msg = payload.decode(errors="replace")
@@ -101,20 +151,32 @@ class Client:
             raise ClientError(status, resp["err"])
         return resp
 
-    def execute_remote(self, index: str, query: "pql.Query", slices: Optional[Sequence[int]] = None) -> list:
+    def execute_remote(
+        self,
+        index: str,
+        query: "pql.Query",
+        slices: Optional[Sequence[int]] = None,
+        deadline=None,
+    ) -> list:
         """Forward a parsed query for remote execution; returns typed results
         (the client half of executor.go:1009-1091).  proto3 omits
         zero-valued fields, so each QueryResult is interpreted against its
         call's expected type, as the reference does (executor.go:1068-1085).
         """
-        resp = self.execute_query(index, str(query), slices=slices, remote=True)
+        resp = self.execute_query(
+            index, str(query), slices=slices, remote=True, deadline=deadline
+        )
         return [
             _result_from_wire(r, expect=c.name)
             for r, c in zip(resp["results"], query.calls)
         ]
 
-    def execute_remote_call(self, index: str, call: "pql.Call", slices: Sequence[int]):
-        results = self.execute_remote(index, pql.Query(calls=[call]), slices=slices)
+    def execute_remote_call(
+        self, index: str, call: "pql.Call", slices: Sequence[int], deadline=None
+    ):
+        results = self.execute_remote(
+            index, pql.Query(calls=[call]), slices=slices, deadline=deadline
+        )
         return results[0]
 
     # -- schema (client.go:392-460) ----------------------------------------

@@ -45,6 +45,7 @@ import json
 import os
 import re
 import threading
+import time
 import traceback
 from datetime import datetime
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -53,7 +54,7 @@ from urllib.parse import parse_qs, urlparse
 import numpy as np
 
 from pilosa_tpu import pilosa as errors
-from pilosa_tpu import pql, wire
+from pilosa_tpu import pql, qos, wire
 from pilosa_tpu.core.cache import Pair
 from pilosa_tpu.core.frame import FrameOptions
 from pilosa_tpu.core.index import IndexOptions
@@ -84,7 +85,8 @@ def result_to_json(result):
 class Handler:
     """Routes requests to the holder/executor; transport-agnostic core."""
 
-    def __init__(self, holder, executor, cluster=None, host="", broadcaster=None, stats=None, client_factory=None):
+    def __init__(self, holder, executor, cluster=None, host="", broadcaster=None, stats=None, client_factory=None,
+                 admission=None, default_deadline_ms: float = 0.0):
         self.holder = holder
         self.executor = executor
         self.cluster = cluster
@@ -93,6 +95,11 @@ class Handler:
         self.stats = stats
         self._profiling = None  # active jax trace dir, if any
         self.client_factory = client_factory
+        # Request-lifecycle QoS: the per-class admission gate (None =
+        # unbounded, the pre-QoS behavior) and the server's default
+        # deadline for requests that carry no X-Pilosa-Deadline-Ms.
+        self.admission = admission
+        self.default_deadline_ms = default_deadline_ms
         self.version = VERSION
         self._routes = self._build_routes()
 
@@ -135,7 +142,47 @@ class Handler:
         ]
 
     def dispatch(self, method: str, path: str, params: dict, body: bytes, headers: dict):
-        """Returns (status, content_type, payload bytes)."""
+        """Returns (status, content_type, payload bytes[, extra headers]).
+
+        The QoS door wraps every route: the request's deadline is built
+        once (header > configured default), the request is classified
+        (read / write / admin) and admitted through the per-class
+        bounded gate — a full door answers 429 + Retry-After
+        immediately, an expired deadline answers 504 BEFORE the route
+        executes, and per-class latency lands in the stats histograms
+        that /debug/vars serves.
+        """
+        deadline = qos.deadline_from_headers(headers, self.default_deadline_ms)
+        cls = qos.classify_request(method, path, body)
+        t0 = time.perf_counter()
+        try:
+            if self.admission is not None:
+                with self.admission.admit(cls, deadline):
+                    if deadline is not None:
+                        deadline.check("admission")
+                    return self._dispatch_route(method, path, params, body, headers, deadline)
+            if deadline is not None and deadline.expired():
+                raise qos.DeadlineExceeded("admission")
+            return self._dispatch_route(method, path, params, body, headers, deadline)
+        except qos.ShedError as e:
+            return (
+                e.status,
+                "application/json",
+                json.dumps({"error": str(e)}).encode(),
+                {"Retry-After": f"{e.retry_after:.3f}"},
+            )
+        except qos.DeadlineExceeded as e:
+            if self.stats is not None:
+                self.stats.count("qos.expired")
+            return 504, "application/json", json.dumps({"error": str(e)}).encode()
+        finally:
+            if self.stats is not None:
+                self.stats.histogram(
+                    f"qos.latency_ms.{cls}", (time.perf_counter() - t0) * 1e3
+                )
+
+    def _dispatch_route(self, method: str, path: str, params: dict, body: bytes,
+                        headers: dict, deadline=None):
         matched_path = False
         for m, pattern, fn in self._routes:
             match = pattern.match(path)
@@ -145,7 +192,10 @@ class Handler:
             if m != method:
                 continue
             try:
-                return fn(params=params, body=body, headers=headers, **match.groupdict())
+                return fn(params=params, body=body, headers=headers,
+                          deadline=deadline, **match.groupdict())
+            except (qos.ShedError, qos.DeadlineExceeded):
+                raise  # QoS outcomes map to 429/504 in dispatch()
             except HTTPError as e:
                 return e.status, "application/json", json.dumps({"error": e.message}).encode()
             except errors.ErrIndexNotFound as e:
@@ -411,7 +461,7 @@ class Handler:
 
     # -- query (handler.go:179-243) ----------------------------------------
 
-    def post_query(self, index=None, params=None, body=b"", headers=None, **kw):
+    def post_query(self, index=None, params=None, body=b"", headers=None, deadline=None, **kw):
         headers = headers or {}
         params = params or {}
         if self._sends_protobuf(headers):
@@ -427,9 +477,11 @@ class Handler:
             column_attrs = self._param(params, "columnAttrs") == "true"
             remote = self._param(params, "remote") == "true"
 
-        opt = ExecOptions(remote=remote)
+        opt = ExecOptions(remote=remote, deadline=deadline)
         try:
             results = self.executor.execute(index, query_str, slices=slices, opt=opt)
+        except qos.DeadlineExceeded:
+            raise  # 504, not the 400 a PilosaError would map to
         except (PilosaError, pql.ParseError) as e:
             if self._wants_protobuf(headers):
                 return 400, PROTOBUF, wire.encode_query_response(err=str(e))
@@ -632,10 +684,14 @@ class _HTTPRequestHandler(BaseHTTPRequestHandler):
         length = int(self.headers.get("Content-Length") or 0)
         body = self.rfile.read(length) if length else b""
         headers = {k.lower(): v for k, v in self.headers.items()}
-        status, ctype, payload = self.handler.dispatch(method, parsed.path, params, body, headers)
+        out = self.handler.dispatch(method, parsed.path, params, body, headers)
+        status, ctype, payload = out[:3]
+        extra = out[3] if len(out) > 3 else {}
         self.send_response(status)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(payload)))
+        for k, v in extra.items():
+            self.send_header(k, v)
         self.end_headers()
         self.wfile.write(payload)
 

@@ -72,6 +72,18 @@ class Server:
             not in ("0", "false", "no"),
         )
         self.broadcaster, self.receiver = self._build_broadcast()
+        from pilosa_tpu.qos import CLASS_ADMIN, CLASS_READ, CLASS_WRITE, AdmissionController
+
+        self.admission = AdmissionController(
+            depths={
+                CLASS_READ: self.config.qos_read_depth,
+                CLASS_WRITE: self.config.qos_write_depth,
+                CLASS_ADMIN: self.config.qos_admin_depth,
+            },
+            queue_wait_ms=self.config.qos_queue_wait_ms,
+            retry_after_ms=self.config.qos_retry_after_ms,
+            stats=stats,
+        )
         self.handler = Handler(
             self.holder,
             self.executor,
@@ -80,6 +92,8 @@ class Server:
             broadcaster=bc.SchemaBroadcaster(self.broadcaster),
             stats=stats,
             client_factory=self.client_factory,
+            admission=self.admission,
+            default_deadline_ms=self.config.default_deadline_ms,
         )
         self.syncer = HolderSyncer(self.holder, self.cluster, self.host, self.client_factory)
 

@@ -9,10 +9,17 @@ speaks the plain UDP statsd wire format (datadog-compatible with |#tags).
 
 from __future__ import annotations
 
+import random
 import socket
 import threading
 from collections import defaultdict
 from typing import Iterable
+
+# Per-series sample cap for the expvar histogram/timing reservoirs: a
+# long-lived server records totals/min/max exactly and keeps a uniform
+# Algorithm-R sample of this size for the percentiles, instead of
+# appending every observation forever.
+RESERVOIR_CAP = 4096
 
 
 class NopStatsClient:
@@ -48,8 +55,14 @@ class ExpvarStatsClient:
         self._counters: dict[str, int] = defaultdict(int)
         self._gauges: dict[str, float] = {}
         self._sets: dict[str, str] = {}
+        # Bounded reservoirs (RESERVOIR_CAP samples) + exact running
+        # metadata per series: [count, min, max] for histograms,
+        # [count, sum] for timings.
         self._histograms: dict[str, list[float]] = defaultdict(list)
+        self._hist_meta: dict[str, list[float]] = {}
         self._timings: dict[str, list[float]] = defaultdict(list)
+        self._timing_meta: dict[str, list[float]] = {}
+        self._rng = random.Random(0)
         self._tags = tags
         self._children: dict[tuple[str, ...], ExpvarStatsClient] = {}
 
@@ -67,7 +80,10 @@ class ExpvarStatsClient:
             child._gauges = self._gauges
             child._sets = self._sets
             child._histograms = self._histograms
+            child._hist_meta = self._hist_meta
             child._timings = self._timings
+            child._timing_meta = self._timing_meta
+            child._rng = self._rng
             self._children[key] = child
         return child
 
@@ -79,9 +95,27 @@ class ExpvarStatsClient:
         with self._lock:
             self._gauges[self._key(name)] = value
 
+    def _reservoir_add(self, samples: list[float], n_total: int, value: float) -> None:
+        """Algorithm R: every observation has cap/n odds of residing in
+        the sample once the reservoir is full — bounded memory, uniform
+        percentiles."""
+        if len(samples) < RESERVOIR_CAP:
+            samples.append(value)
+            return
+        j = self._rng.randrange(n_total)
+        if j < RESERVOIR_CAP:
+            samples[j] = value
+
     def histogram(self, name: str, value: float) -> None:
         with self._lock:
-            self._histograms[self._key(name)].append(value)
+            key = self._key(name)
+            meta = self._hist_meta.get(key)
+            if meta is None:
+                meta = self._hist_meta[key] = [0, value, value]
+            meta[0] += 1
+            meta[1] = min(meta[1], value)
+            meta[2] = max(meta[2], value)
+            self._reservoir_add(self._histograms[key], meta[0], value)
 
     def set(self, name: str, value: str) -> None:
         with self._lock:
@@ -89,7 +123,13 @@ class ExpvarStatsClient:
 
     def timing(self, name: str, value: float) -> None:
         with self._lock:
-            self._timings[self._key(name)].append(value)
+            key = self._key(name)
+            meta = self._timing_meta.get(key)
+            if meta is None:
+                meta = self._timing_meta[key] = [0, 0.0]
+            meta[0] += 1
+            meta[1] += value
+            self._reservoir_add(self._timings[key], meta[0], value)
 
     def snapshot(self) -> dict:
         with self._lock:
@@ -98,17 +138,21 @@ class ExpvarStatsClient:
             out.update(self._sets)
             for name, vals in self._histograms.items():
                 if vals:
+                    # count/min/max are exact totals; the percentiles
+                    # read the bounded reservoir.
+                    n_total, lo, hi = self._hist_meta[name]
                     s = sorted(vals)
                     out[name] = {
-                        "count": len(s),
-                        "min": s[0],
-                        "max": s[-1],
+                        "count": int(n_total),
+                        "min": lo,
+                        "max": hi,
                         "p50": s[len(s) // 2],
                         "p99": s[min(len(s) - 1, int(len(s) * 0.99))],
                     }
             for name, vals in self._timings.items():
                 if vals:
-                    out[name + ".avg_ms"] = sum(vals) / len(vals) * 1000
+                    n_total, total = self._timing_meta[name]
+                    out[name + ".avg_ms"] = total / n_total * 1000
             return out
 
 

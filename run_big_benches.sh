@@ -55,4 +55,10 @@ run BENCH_CONFIG=mixed BENCH_ROWS=256 BENCH_SLICES=8
 #    replay vs one control-plane entry per request.
 run BENCH_CONFIG=lockstep_coalesce
 run BENCH_CONFIG=lockstep_coalesce BENCH_THREADS=32
+# 9) Request-lifecycle QoS under overload: a real HTTP server at 2x door
+#    capacity, QoS on (bounded admission + deadlines; shed 429s, p99 near
+#    presat) vs off (unbounded; p99 degrades with the queue).  The second
+#    line pushes deeper overload on a wider door.
+run BENCH_CONFIG=overload
+run BENCH_CONFIG=overload BENCH_QOS_DEPTH=8 BENCH_THREADS=64
 echo "ALL DONE $(date +%H:%M:%S)" >> $OUT

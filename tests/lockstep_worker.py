@@ -138,6 +138,10 @@ def main() -> int:
                 # control-plane batch entries vs requests carried.
                 "batches": svc.stat_batches,
                 "requests": svc.stat_requests,
+                # QoS telemetry: arrival-queue sheds (rank 0) and
+                # expired requests dropped at replay (every rank).
+                "shed": svc.stat_shed,
+                "expired": svc.stat_expired,
             }
         ),
         flush=True,

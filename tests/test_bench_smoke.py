@@ -94,6 +94,24 @@ def test_bench_config_emits_json(cfg, extra):
         assert by["mixed_50_50"]["patch_planes"] > 0
 
 
+def test_bench_overload_emits_json():
+    """The request-lifecycle QoS bench must keep working: a real HTTP
+    server past saturation, QoS on (bounded admission + deadlines —
+    shed rate > 0, goodput holds) vs off (unbounded, p99 degrades)."""
+    stdout = _run({"BENCH_CONFIG": "overload", "BENCH_SMOKE": "1"}, timeout=300)
+    result = json.loads(stdout.strip().splitlines()[-1])
+    assert result["metric"] == "overload_goodput_qps" and result["value"] > 0
+    names = [t["tier"] for t in result["tiers"]]
+    assert names == ["presat", "overload_qos_on", "overload_qos_off"]
+    by = {t["tier"]: t for t in result["tiers"]}
+    # Overload really overloads AND the door really sheds.
+    assert by["overload_qos_on"]["shed_rate"] > 0
+    assert by["overload_qos_on"]["served"] > 0
+    # QoS off admits everything: nothing is shed, everything is served.
+    assert by["overload_qos_off"]["shed_rate"] == 0
+    assert all(t["goodput_qps"] > 0 for t in result["tiers"])
+
+
 def test_star_trace_example_runs():
     stdout = _run({}, script=os.path.join("examples", "star_trace.py"))
     assert "top stargazers:" in stdout and "user 1 attrs:" in stdout

@@ -144,7 +144,7 @@ class _LockstepJob:
         assert self.out_lines[0], f"rank 0 never became ready:\n{self._all_stderr()}"
         assert json.loads(self.out_lines[0][0]).get("ready"), self.out_lines[0][0]
 
-    def query(self, q, timeout=60):
+    def query(self, q, timeout=60, headers=None):
         import urllib.request
 
         req = urllib.request.Request(
@@ -152,6 +152,8 @@ class _LockstepJob:
             data=q.encode(),
             method="POST",
         )
+        for k, v in (headers or {}).items():
+            req.add_header(k, v)
         with urllib.request.urlopen(req, timeout=timeout) as resp:
             return json.loads(resp.read())
 
@@ -401,6 +403,63 @@ def test_lockstep_coalescing_batches_requests():
     finally:
         job.cleanup()
     assert {o["probe"] for o in outs} == {9}
+
+
+def test_lockstep_expired_deadline_dropped_identically():
+    """An EXPIRED request (X-Pilosa-Deadline-Ms: 0) must be dropped
+    identically on every rank: rank 0 marks it expired ONCE at ship
+    time, the flag rides the batch entry, and every rank skips it
+    before execution — the client gets a 504, batch siblings (reads and
+    writes from concurrent clients) are unaffected, and the replicated
+    holders stay convergent (the expired write landed on NO rank)."""
+    import urllib.error
+    from concurrent.futures import ThreadPoolExecutor
+
+    job = _LockstepJob(2)
+    try:
+        job.wait_ready()
+        q_read = 'Count(Bitmap(rowID=0, frame="f"))'
+        base = job.query(q_read)["results"][0]
+
+        def run(args):
+            q, hdrs = args
+            try:
+                return ("ok", job.query(q, headers=hdrs)["results"])
+            except urllib.error.HTTPError as e:
+                return ("err", e.code)
+
+        expired_hdr = {"X-Pilosa-Deadline-Ms": "0"}
+        wcols = list(range(600, 610))
+        jobs = (
+            [(q_read, None)] * 10
+            # Expired WRITES: dropped on every rank or the replicas
+            # diverge (a rank that applied one would count extra bits).
+            + [(f'SetBit(rowID=0, frame="f", columnID={c})', expired_hdr)
+               for c in range(650, 655)]
+            + [(f'SetBit(rowID=0, frame="f", columnID={c})', None) for c in wcols]
+            # A generous deadline must behave like no deadline at all.
+            + [(q_read, {"X-Pilosa-Deadline-Ms": "60000"})] * 5
+        )
+        import random
+
+        random.Random(11).shuffle(jobs)
+        with ThreadPoolExecutor(8) as pool:
+            outs = list(pool.map(run, jobs))
+        for (q, hdrs), o in zip(jobs, outs):
+            if hdrs and hdrs.get("X-Pilosa-Deadline-Ms") == "0":
+                assert o == ("err", 504), (q, o)
+            else:
+                assert o[0] == "ok", (q, o)
+        # Only the live writes landed — on BOTH ranks identically.
+        after = job.query(q_read)["results"][0]
+        assert after == base + len(wcols)
+        outs = job.shutdown_and_collect()
+    finally:
+        job.cleanup()
+    assert outs[0]["probe"] == outs[1]["probe"] == after
+    # Every rank dropped the same expired requests (workers count drops
+    # at replay; rank 0 counts them in _run_batch).
+    assert outs[0]["expired"] == outs[1]["expired"] == 5
 
 
 def test_lockstep_worker_death_mid_stream():
