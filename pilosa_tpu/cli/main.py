@@ -120,6 +120,11 @@ def cmd_lockstep(args) -> int:
         connect_timeout=cfg.lockstep_connect_timeout,
         queue_depth=cfg.lockstep_queue_depth,
         default_deadline_ms=cfg.default_deadline_ms,
+        # [qcache] wiring: the service forces min-cost-ms to 0 itself
+        # (wall-clock admission is rank-local; lockstep hit/miss must be
+        # a pure function of replicated state).
+        qcache_enabled=cfg.qcache_enabled,
+        qcache_max_bytes=cfg.qcache_max_bytes,
     )
     if svc.rank == 0:
         print(

@@ -64,6 +64,21 @@ class Config:
     # Row ceiling for the cached all-pairs Gram strategy (4096 rows = a
     # 64 MiB Gram; raise on host-attached hardware).
     gram_rows_max: int = 4096
+    # -- query result cache ([qcache] TOML section) ----------------------
+    # Generation-keyed whole-query result cache in front of the
+    # executor: exact (any write to a touched fragment bumps a
+    # generation and misses the entry), byte-bounded, cost-admitted.
+    qcache_enabled: bool = True
+    qcache_max_bytes: int = 256 << 20
+    # Admission floor: only results whose measured execution cost is at
+    # least this many ms are stored (cheaper requests would pay more in
+    # cache bookkeeping than a hit saves).
+    qcache_min_cost_ms: float = 1.0
+    # -- rank-cache tuning ([cache] TOML section) ------------------------
+    # Debounce on RankCache invalidation (ranked TopN caches recalculate
+    # at most once per this many seconds; cache.go:219-226's hard-coded
+    # 10 s, promoted).
+    ranking_debounce_s: float = 10.0
     # -- request-lifecycle QoS ([qos] TOML section) ----------------------
     # Default per-request time budget in ms when the client sends no
     # X-Pilosa-Deadline-Ms header; 0 = unbounded (pre-QoS behavior).
@@ -112,6 +127,14 @@ class Config:
         )
         cfg.repair_rows_max = int(raw.get("repair-rows-max", cfg.repair_rows_max))
         cfg.gram_rows_max = int(raw.get("gram-rows-max", cfg.gram_rows_max))
+        qc = raw.get("qcache", {})
+        cfg.qcache_enabled = bool(qc.get("enabled", cfg.qcache_enabled))
+        cfg.qcache_max_bytes = int(qc.get("max-bytes", cfg.qcache_max_bytes))
+        cfg.qcache_min_cost_ms = float(qc.get("min-cost-ms", cfg.qcache_min_cost_ms))
+        cache = raw.get("cache", {})
+        cfg.ranking_debounce_s = _interval(
+            cache.get("ranking-debounce-s"), cfg.ranking_debounce_s
+        )
         qos = raw.get("qos", {})
         cfg.default_deadline_ms = 1000.0 * _interval(
             qos.get("default-deadline"), cfg.default_deadline_ms / 1000.0
@@ -168,6 +191,14 @@ class Config:
             self.repair_rows_max = int(env["PILOSA_TPU_REPAIR_ROWS_MAX"])
         if "PILOSA_TPU_GRAM_ROWS_MAX" in env:
             self.gram_rows_max = int(env["PILOSA_TPU_GRAM_ROWS_MAX"])
+        if "PILOSA_TPU_QCACHE" in env:
+            self.qcache_enabled = env["PILOSA_TPU_QCACHE"].lower() in ("1", "true", "yes")
+        if "PILOSA_TPU_QCACHE_MAX_BYTES" in env:
+            self.qcache_max_bytes = int(env["PILOSA_TPU_QCACHE_MAX_BYTES"])
+        if "PILOSA_TPU_QCACHE_MIN_COST_MS" in env:
+            self.qcache_min_cost_ms = float(env["PILOSA_TPU_QCACHE_MIN_COST_MS"])
+        if "PILOSA_TPU_RANKING_DEBOUNCE_S" in env:
+            self.ranking_debounce_s = float(env["PILOSA_TPU_RANKING_DEBOUNCE_S"])
         if "PILOSA_TPU_DEADLINE_MS" in env:
             self.default_deadline_ms = float(env["PILOSA_TPU_DEADLINE_MS"])
         if "PILOSA_TPU_QOS_READ_DEPTH" in env:

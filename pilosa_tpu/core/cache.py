@@ -20,6 +20,13 @@ from typing import Iterable
 
 THRESHOLD_FACTOR = 1.1
 
+# RankCache invalidation debounce (cache.go:219-226's hard-coded 10 s,
+# promoted to config).  Resolution order at RankCache construction:
+# ctor arg > PILOSA_TPU_RANKING_DEBOUNCE_S env > this module default —
+# the server assigns [cache] ranking-debounce-s here before opening the
+# holder, so deeply-nested fragment construction needs no threading.
+DEFAULT_RANKING_DEBOUNCE_S = 10.0
+
 # Cache type names (frame.go:33-40).
 CACHE_TYPE_LRU = "lru"
 CACHE_TYPE_RANKED = "ranked"
@@ -94,16 +101,24 @@ class RankCache:
 
     Keeps up to ``max_entries`` top rows by count plus a slop buffer;
     ``threshold_value`` is the count of the first evicted rank, and adds
-    below it are ignored.  ``invalidate`` is debounced to once per 10s
-    (cache.go:219-226); ``recalculate`` forces it.
+    below it are ignored.  ``invalidate`` is debounced to once per
+    ``debounce_s`` (default 10 s, cache.go:219-226; config
+    ``[cache] ranking-debounce-s`` / PILOSA_TPU_RANKING_DEBOUNCE_S);
+    ``recalculate`` forces it.
     """
 
-    def __init__(self, max_entries: int, _now=time.monotonic):
+    def __init__(self, max_entries: int, _now=time.monotonic, debounce_s=None):
+        import os
+
         self.max_entries = max_entries
         self.threshold_buffer = int(THRESHOLD_FACTOR * max_entries)
         self.threshold_value = 0
         self.entries: dict[int, int] = {}
         self.rankings: list[Pair] = []
+        if debounce_s is None:
+            raw = os.environ.get("PILOSA_TPU_RANKING_DEBOUNCE_S")
+            debounce_s = float(raw) if raw else DEFAULT_RANKING_DEBOUNCE_S
+        self.debounce_s = float(debounce_s)
         self._now = _now
         self._update_time = _now() - 1e9
 
@@ -129,7 +144,7 @@ class RankCache:
         return sorted(self.entries.keys())
 
     def invalidate(self) -> None:
-        if self._now() - self._update_time < 10:
+        if self._now() - self._update_time < self.debounce_s:
             return
         self.recalculate()
 
@@ -181,9 +196,9 @@ class SimpleCache:
         return pairs_sorted(Pair(id=k, count=v) for k, v in self.entries.items() if v > 0)
 
 
-def new_cache(cache_type: str, size: int):
+def new_cache(cache_type: str, size: int, ranking_debounce_s=None):
     if cache_type == CACHE_TYPE_RANKED:
-        return RankCache(size)
+        return RankCache(size, debounce_s=ranking_debounce_s)
     if cache_type == CACHE_TYPE_LRU:
         return LRUCache(size)
     if cache_type in ("", "simple", "none"):

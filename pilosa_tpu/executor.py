@@ -31,6 +31,7 @@ from typing import Any, Optional, Sequence
 import numpy as np
 
 from pilosa_tpu import pql
+from pilosa_tpu import qcache as qcache_mod
 from pilosa_tpu.core import cache as cache_mod
 from pilosa_tpu.core.fragment import TopOptions
 from pilosa_tpu.core import timequantum as tq
@@ -164,6 +165,10 @@ class ExecOptions:
     # between calls and between fan-out slice chunks, and forwarded to
     # remote nodes as the remaining budget.  None = unbounded.
     deadline: Any = None
+    # Per-request qcache bypass (X-Pilosa-No-Cache: the request neither
+    # reads nor stores a query-result cache entry) — the A/B lever for
+    # hit-rate measurement and stale-read debugging.
+    no_cache: bool = False
 
 
 class QueryBitmap:
@@ -224,6 +229,7 @@ class Executor:
         serve_state_cache: int = 0,
         repair_rows_max: Optional[int] = None,
         gram_rows_max: int = 0,
+        qcache: Any = "env",
     ):
         self.holder = holder
         self.engine = new_engine(engine) if isinstance(engine, str) else engine
@@ -296,6 +302,15 @@ class Executor:
         self._dirty_rows: dict[tuple[str, str], Optional[set]] = {}
         self._dirty_mu = threading.Lock()
         self._gram_env_cache: Optional[tuple[bool, int]] = None  # lazy env read
+        # Generation-keyed query result cache (qcache.QueryCache), the
+        # whole-query memoization layer in front of every read path.
+        # Default sentinel "env" = enabled only when PILOSA_TPU_QCACHE is
+        # truthy, so directly-constructed executors (tests, benches,
+        # embedders) keep pre-qcache behavior; the server and lockstep
+        # service pass a configured instance (or None = disabled).
+        if qcache == "env":
+            qcache = qcache_mod.from_env()
+        self.qcache = qcache
         if write_queue:
             from pilosa_tpu.ingest import WriteQueue
 
@@ -315,12 +330,31 @@ class Executor:
             # Door checkpoint: an already-expired request never touches
             # the serve lane (fast paths included).
             opt.deadline.check("pre-execution")
+        qtoken = None
         if isinstance(query, str):
+            # Query result cache: a valid generation-keyed entry answers
+            # the whole request here — no parse, no dispatch, no device
+            # work.  A cacheable miss carries a _Pending token through
+            # execution; the read return paths below commit it (errors
+            # propagate past the commit, so they are never cached).
+            if self.qcache is not None:
+                if opt is not None and opt.no_cache:
+                    self.qcache.note_bypass()
+                else:
+                    skey = tuple(slices) if slices else None
+                    cached, qtoken = self.qcache.lookup(
+                        self.holder, index, query, skey,
+                        remote=bool(opt is not None and opt.remote),
+                    )
+                    if cached is not None:
+                        return cached
             w = self._singleton_write_fast(index, query, slices, opt)
             if w is not None:
                 return w
             fast = self._flat_fast_path(index, query, slices, opt)
             if fast is not None:
+                if qtoken is not None:
+                    self.qcache.commit(self.holder, qtoken, fast)
                 return fast
             query = pql.parse_cached(query)
         if not query.calls:
@@ -383,6 +417,8 @@ class Executor:
                 if call.is_inverse(frame.row_label, idx.column_label):
                     call_slices = inv_slices
             results.append(self._execute_call(index, call, call_slices, opt))
+        if qtoken is not None:
+            self.qcache.commit(self.holder, qtoken, results)
         return results
 
     # -- query-batch fusion ------------------------------------------------
@@ -854,6 +890,11 @@ class Executor:
         self._fastwrite_cache.pop((index, frame), None)
         with self._dirty_mu:
             self._dirty_rows.pop((index, frame), None)
+        if self.qcache is not None:
+            # A recreated namesake frame gets fresh generations (the
+            # counter never repeats), so validity already prevents stale
+            # serving — the purge reclaims the bytes eagerly.
+            self.qcache.purge_frame(index, frame)
 
     def drop_index_state(self, index: str) -> None:
         """Index-deletion analog of drop_frame_state (every frame)."""
@@ -869,6 +910,8 @@ class Executor:
         with self._dirty_mu:
             for k in [k for k in self._dirty_rows if k[0] == index]:
                 del self._dirty_rows[k]
+        if self.qcache is not None:
+            self.qcache.purge_index(index)
 
     def _capture_serve_state(self, index: str, fname: str, slices, glut, box) -> None:
         """Snapshot the single-call serve lane's state after a warm-Gram
@@ -1530,12 +1573,14 @@ class Executor:
             return local_fn(node_slices)
 
         def remote_map(client, node_slices):
+            # Conditional kwargs: custom client factories (tests,
+            # embedders) need not know the QoS/qcache kwargs.
+            kw = {}
             if opt.deadline is not None:
-                res = client.execute_remote(
-                    index, batch_query, node_slices, deadline=opt.deadline
-                )
-            else:
-                res = client.execute_remote(index, batch_query, node_slices)
+                kw["deadline"] = opt.deadline
+            if opt.no_cache:
+                kw["no_cache"] = True  # a bypass bypasses peer caches too
+            res = client.execute_remote(index, batch_query, node_slices, **kw)
             if len(res) != len(idxs):
                 raise PilosaError(
                     f"fused batch: peer returned {len(res)} results for {len(idxs)} calls"
@@ -2550,13 +2595,14 @@ class Executor:
             client = self.client_factory(node.host)
             if remote_map is not None:
                 return remote_map(client, node_slices)
-            # deadline= only when set: custom client factories (tests,
-            # embedders) need not know the QoS kwargs.
+            # Conditional kwargs only when set: custom client factories
+            # (tests, embedders) need not know the QoS/qcache kwargs.
+            kw = {}
             if opt.deadline is not None:
-                return client.execute_remote_call(
-                    index, c, node_slices, deadline=opt.deadline
-                )
-            return client.execute_remote_call(index, c, node_slices)
+                kw["deadline"] = opt.deadline
+            if opt.no_cache:
+                kw["no_cache"] = True
+            return client.execute_remote_call(index, c, node_slices, **kw)
 
         # Mid-query node-failure retry (executor.go:1147-1159): when a
         # remote node becomes UNREACHABLE (transport-level OSError — refused

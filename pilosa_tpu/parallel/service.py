@@ -127,14 +127,42 @@ class LockstepService:
         connect_timeout: Optional[float] = None,
         queue_depth: Optional[int] = None,
         default_deadline_ms: Optional[float] = None,
+        qcache_enabled: Optional[bool] = None,
+        qcache_max_bytes: Optional[int] = None,
     ):
         import jax
+
+        from pilosa_tpu import qcache as qcache_mod
 
         self.holder = holder
         self.rank = jax.process_index()
         self.n_ranks = jax.process_count()
         self.engine = MeshEngine(devices if devices is not None else jax.devices())
-        self.executor = Executor(holder, engine=self.engine)
+        # Query result cache, DETERMINISTIC variant: hit/miss must be a
+        # pure function of replicated state (request strings + the
+        # lockstep total order of writes), so every rank hits or misses
+        # identically and no rank skips a collective another rank runs —
+        # the same rule as error isolation and expired-request drops.
+        # Wall-clock cost admission is rank-local, so min_cost_ms is
+        # FORCED to 0 here (admit every eligible read); byte-accounted
+        # eviction stays deterministic because result sizes and the
+        # serialized execution order are identical on every rank.
+        if qcache_enabled is None:
+            qcache_enabled = os.environ.get("PILOSA_TPU_QCACHE", "").lower() in (
+                "1", "true", "yes",
+            )
+        if qcache_max_bytes is None:
+            qcache_max_bytes = int(
+                os.environ.get(
+                    "PILOSA_TPU_QCACHE_MAX_BYTES", str(qcache_mod.DEFAULT_MAX_BYTES)
+                )
+            )
+        qc = (
+            qcache_mod.QueryCache(max_bytes=qcache_max_bytes, min_cost_ms=0.0)
+            if qcache_enabled
+            else None
+        )
+        self.executor = Executor(holder, engine=self.engine, qcache=qc)
         self.control_addr = control_addr
         self.http_addr = http_addr
         self._workers: list[socket.socket] = []

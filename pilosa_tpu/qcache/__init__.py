@@ -1,0 +1,384 @@
+"""Generation-keyed query result cache: exact whole-query memoization.
+
+No reference analog — the reference re-executes every PQL request from
+scratch.  Production bitmap-index traffic is heavily skewed toward
+repeated queries (the same dashboards and segments hit over and over),
+and the Roaring line of work wins precisely by never recomputing what
+set algebra already knows; this subsystem applies the same principle
+one level up, at whole-query granularity, in front of the executor.
+
+Design:
+
+- **Key**: canonical fingerprint of the parsed PQL call tree (the
+  deterministic ``str(Query)`` rendering, memoized per raw request
+  string) + the target index + the explicit slice set, so formatting
+  variants of the same call tree share one entry and per-node remote
+  sub-requests (``slices=[...]``) never collide with coordinator
+  requests.
+- **Validity**: the fragment *generation vector* the execution could
+  have touched — every (view, slice) fragment generation of every
+  frame the call tree references, plus the index/frame schema header
+  (max slice, labels, time quantum).  Fragment generations come from a
+  process-global counter bumped inside the fragment's own locked
+  mutation methods, so ANY writer (executor paths, imports, restores,
+  anti-entropy sync) invalidates matching entries with zero explicit
+  invalidation traffic, and a deleted+recreated fragment can never
+  revive an old entry (the counter never repeats).  The vector is
+  snapshotted BEFORE execution and re-checked at store time: a write
+  landing mid-execution skips the store rather than stamping post-write
+  tokens onto possibly pre-write results (the same rule as the
+  executor's serve-state capture).
+- **Store**: byte-accounted LRU with cost-aware admission — only
+  results whose measured execution cost clears ``min_cost_ms`` are
+  admitted (cheap requests would pay more in cache bookkeeping than
+  they save); errors are never cached (an exception never reaches the
+  commit), and write-bearing or non-deterministic trees are never
+  cached (see CACHEABLE_CALLS).
+
+**What is cacheable**: every top-level call must be one of
+``Count / Intersect / Union / Difference / Xor / Range``.  ``Bitmap``
+is excluded at top level because it attaches row/column attributes,
+which mutate without a generation bump (SetRowAttrs touches the attr
+store only); ``TopN`` is excluded because its rank-cache ranking
+recalculates on a time debounce, so a fresh execution may legitimately
+differ without any write.  Bitmap leaves INSIDE set-op trees are fine —
+only top-level Bitmap calls attach attrs.
+
+**Lockstep determinism**: hit/miss decisions depend only on replicated
+state — the request strings (shipped in the batch entry), the mutation
+order (the lockstep total order), and deterministic result sizes —
+EXCEPT wall-clock cost admission, which is rank-local.  The lockstep
+service therefore builds its cache with ``min_cost_ms=0`` (admit every
+eligible read), making every decision a pure function of replicated
+state: every rank hits or misses identically and no rank skips a
+collective another rank runs (the same determinism rule as lockstep
+error isolation and expired-request drops).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from typing import Optional
+
+# Per-request cache bypass header: the request neither reads nor stores
+# a cache entry (A/B measurement, stale-read debugging).
+NO_CACHE_HEADER = "X-Pilosa-No-Cache"
+
+# Top-level call names whose results are pure functions of fragment
+# contents (see module docstring for the Bitmap/TopN exclusions).
+CACHEABLE_CALLS = frozenset(
+    {"Count", "Intersect", "Union", "Difference", "Xor", "Range"}
+)
+
+# Call names that reference a frame (default frame when the arg is
+# absent) anywhere in a tree.
+_FRAME_CALLS = frozenset({"Bitmap", "Range", "TopN"})
+
+DEFAULT_MAX_BYTES = 256 << 20
+DEFAULT_MIN_COST_MS = 1.0
+
+# Don't fingerprint megabyte request bodies (same bound as the parse
+# cache): bulk-import-sized requests are never dashboard repeats.
+_FINGERPRINT_MAX_LEN = 1 << 16
+
+
+def referenced_frames(query) -> tuple:
+    """Sorted tuple of frame names a parsed Query can touch."""
+    from pilosa_tpu.executor import DEFAULT_FRAME
+
+    frames: set = set()
+
+    def walk(call):
+        if call.name in _FRAME_CALLS or "frame" in call.args:
+            frames.add(call.string_arg("frame") or DEFAULT_FRAME)
+        for ch in call.children:
+            walk(ch)
+
+    for c in query.calls:
+        walk(c)
+    return tuple(sorted(frames))
+
+
+def generation_vector(holder, index: str, frames: tuple) -> Optional[tuple]:
+    """The validity token for one (index, frame set): the schema header
+    plus every existing fragment's write generation across ALL views of
+    each referenced frame (standard, inverse, and time views — a
+    superset of what any one execution reads, so invalidation is
+    conservative but exactness never depends on knowing the exact view
+    cover).  None when the index is gone (nothing to validate against).
+    """
+    idx = holder.index(index)
+    if idx is None:
+        return None
+    vec: list = [
+        (idx.max_slice(), idx.max_inverse_slice(), idx.column_label, idx.time_quantum)
+    ]
+    for fname in frames:
+        fr = holder.frame(index, fname)
+        if fr is None:
+            vec.append((fname, None))
+            continue
+        vec.append((fname, fr.row_label, fr.inverse_enabled, fr.time_quantum))
+        # list() snapshots: schema merges / writes may insert views or
+        # fragments concurrently — a racing insert at worst makes this
+        # vector stale, which is a conservative miss, never a stale hit.
+        for vname, view in sorted(list(fr.views.items()), key=lambda kv: kv[0]):
+            for s, frag in sorted(list(view.fragments.items()), key=lambda kv: kv[0]):
+                if frag is not None:
+                    vec.append((vname, s, frag.generation))
+    return tuple(vec)
+
+
+def result_nbytes(results) -> int:
+    """Byte-accounting estimate for one result list (duck-typed so this
+    module never imports the executor)."""
+    n = 512  # key + vector + entry overhead
+    for r in results:
+        segments = getattr(r, "segments", None)
+        if segments is not None:  # QueryBitmap
+            n += 128 + sum(
+                int(getattr(seg, "nbytes", 64)) + 96 for seg in segments.values()
+            )
+        elif isinstance(r, list):  # TopN pairs (excluded today, sized anyway)
+            n += 64 + 96 * len(r)
+        else:  # counts / bools
+            n += 48
+    return n
+
+
+class _Pending:
+    """A cacheable miss in flight: key + pre-execution validity tokens.
+    Returned by :meth:`QueryCache.lookup`, consumed by :meth:`commit`."""
+
+    __slots__ = ("key", "index", "frames", "vec0", "t0")
+
+    def __init__(self, key, index, frames, vec0, t0):
+        self.key = key
+        self.index = index
+        self.frames = frames
+        self.vec0 = vec0
+        self.t0 = t0
+
+
+class _Entry:
+    __slots__ = ("index", "frames", "vec", "results", "nbytes")
+
+    def __init__(self, index, frames, vec, results, nbytes):
+        self.index = index
+        self.frames = frames
+        self.vec = vec
+        self.results = results
+        self.nbytes = nbytes
+
+
+class QueryCache:
+    """The byte-accounted, generation-validated query result LRU.
+
+    Thread-safe.  Counters (``hits / misses / bypasses / evictions /
+    stores`` and the ``bytes`` gauge) are exposed both as attributes
+    (tests, bench) and through the optional stats client
+    (``qcache.hit`` etc. at /debug/vars).
+    """
+
+    def __init__(
+        self,
+        max_bytes: int = DEFAULT_MAX_BYTES,
+        min_cost_ms: float = DEFAULT_MIN_COST_MS,
+        stats=None,
+        clock=time.perf_counter,
+    ):
+        from pilosa_tpu.stats import NOP_STATS
+
+        self.max_bytes = int(max_bytes)
+        self.min_cost_ms = float(min_cost_ms)
+        self.stats = stats if stats is not None else NOP_STATS
+        self._clock = clock
+        self._mu = threading.Lock()
+        self._store: "OrderedDict[tuple, _Entry]" = OrderedDict()
+        # Raw request string -> (fingerprint, frames) for eligible
+        # queries, or None for ineligible/unparseable ones; bounded LRU
+        # so adversarial unique queries can't grow it without limit.
+        self._canon: "OrderedDict[str, Optional[tuple]]" = OrderedDict()
+        self._canon_max = 512
+        self.bytes = 0
+        self.hits = 0
+        self.misses = 0
+        self.bypasses = 0
+        self.evictions = 0
+        self.stores = 0
+
+    # -- fingerprinting ---------------------------------------------------
+
+    def _canonical(self, query_str: str) -> Optional[tuple]:
+        """(fingerprint, frames) for an eligible query string, None for
+        write-bearing / non-cacheable / unparseable ones.  Memoized: the
+        steady-state repeated request pays one dict lookup, not a parse
+        + render."""
+        with self._mu:
+            if query_str in self._canon:
+                self._canon.move_to_end(query_str)
+                return self._canon[query_str]
+        info = None
+        if len(query_str) <= _FINGERPRINT_MAX_LEN:
+            from pilosa_tpu import pql
+
+            try:
+                q = pql.parse_cached(query_str)
+            except Exception:  # noqa: BLE001 — normal path raises the real error
+                q = None
+            if (
+                q is not None
+                and q.calls
+                and all(c.name in CACHEABLE_CALLS for c in q.calls)
+            ):
+                info = (str(q), referenced_frames(q))
+        with self._mu:
+            self._canon[query_str] = info
+            self._canon.move_to_end(query_str)
+            while len(self._canon) > self._canon_max:
+                self._canon.popitem(last=False)
+        return info
+
+    # -- the request path -------------------------------------------------
+
+    def note_bypass(self) -> None:
+        """A request that declined the cache (X-Pilosa-No-Cache)."""
+        with self._mu:
+            self.bypasses += 1
+        self.stats.count("qcache.bypass")
+
+    def lookup(self, holder, index: str, query_str: str, slices_key, remote: bool = False):
+        """One request's cache probe.
+
+        Returns ``(results, pending)``: a valid entry yields
+        ``(list-copy of results, None)``; a cacheable miss yields
+        ``(None, _Pending)`` for :meth:`commit` after execution; an
+        ineligible request yields ``(None, None)`` and counts a bypass.
+        ``remote`` is part of the key: a remote-serving execution covers
+        local slices only, never a coordinator's global answer (remote
+        reads always carry explicit slices today — this keys the
+        invariant rather than assuming it).
+        """
+        info = self._canonical(query_str)
+        if info is None:
+            self.note_bypass()
+            return None, None
+        fp, frames = info
+        key = (index, fp, slices_key, remote)
+        with self._mu:
+            entry = self._store.get(key)
+        vec = generation_vector(holder, index, frames)
+        if entry is not None:
+            if vec is not None and vec == entry.vec:
+                with self._mu:
+                    if key in self._store:
+                        self._store.move_to_end(key)
+                    self.hits += 1
+                self.stats.count("qcache.hit")
+                return list(entry.results), None
+            # Stale: a generation moved (or the schema did) — drop it
+            # now rather than waiting for LRU churn.
+            self._pop(key)
+        with self._mu:
+            self.misses += 1
+        self.stats.count("qcache.miss")
+        if vec is None:
+            return None, None  # index missing: the execution will raise
+        return None, _Pending(key, index, frames, vec, self._clock())
+
+    def commit(self, holder, pending: _Pending, results) -> bool:
+        """Admit one executed miss.  Declines when the measured cost is
+        under ``min_cost_ms`` (not worth the bookkeeping) or a write
+        landed mid-execution (the vector moved — storing would stamp
+        pre-write results with post-write tokens).  Returns True when
+        the entry was stored."""
+        cost_ms = (self._clock() - pending.t0) * 1e3
+        if cost_ms < self.min_cost_ms:
+            return False
+        vec1 = generation_vector(holder, pending.index, pending.frames)
+        if vec1 is None or vec1 != pending.vec0:
+            return False
+        nbytes = result_nbytes(results)
+        if nbytes > self.max_bytes:
+            return False
+        entry = _Entry(pending.index, pending.frames, pending.vec0, list(results), nbytes)
+        with self._mu:
+            old = self._store.pop(pending.key, None)
+            if old is not None:
+                self.bytes -= old.nbytes
+            self._store[pending.key] = entry
+            self.bytes += nbytes
+            self.stores += 1
+            while self.bytes > self.max_bytes and self._store:
+                _, ev = self._store.popitem(last=False)
+                self.bytes -= ev.nbytes
+                self.evictions += 1
+                self.stats.count("qcache.evict")
+        self.stats.count("qcache.store")
+        self.stats.gauge("qcache.bytes", self.bytes)
+        return True
+
+    # -- invalidation hooks ------------------------------------------------
+
+    def _pop(self, key) -> None:
+        with self._mu:
+            entry = self._store.pop(key, None)
+            if entry is not None:
+                self.bytes -= entry.nbytes
+        self.stats.gauge("qcache.bytes", self.bytes)
+
+    def purge_frame(self, index: str, frame: str) -> int:
+        """Drop every entry that touches one (index, frame) — wired to
+        frame deletion so a recreated namesake can never serve (or pin
+        the memory of) the old frame's results.  Returns the count."""
+        with self._mu:
+            victims = [
+                k
+                for k, e in self._store.items()
+                if e.index == index and frame in e.frames
+            ]
+            for k in victims:
+                self.bytes -= self._store.pop(k).nbytes
+        if victims:
+            self.stats.gauge("qcache.bytes", self.bytes)
+        return len(victims)
+
+    def purge_index(self, index: str) -> int:
+        """Index-deletion analog of :meth:`purge_frame` (every frame)."""
+        with self._mu:
+            victims = [k for k, e in self._store.items() if e.index == index]
+            for k in victims:
+                self.bytes -= self._store.pop(k).nbytes
+        if victims:
+            self.stats.gauge("qcache.bytes", self.bytes)
+        return len(victims)
+
+    def clear(self) -> None:
+        with self._mu:
+            self._store.clear()
+            self.bytes = 0
+        self.stats.gauge("qcache.bytes", 0)
+
+    def __len__(self) -> int:
+        return len(self._store)
+
+
+def from_env(min_cost_ms: Optional[float] = None, stats=None) -> Optional[QueryCache]:
+    """Build a cache from ``PILOSA_TPU_QCACHE_*`` env, or None when not
+    enabled — the default for directly-constructed executors, so
+    embedders/tests/benches opt in explicitly (the server and CLI wire
+    the ``[qcache]`` config instead).  ``min_cost_ms`` overrides the env
+    (the lockstep service forces 0: wall-clock admission is rank-local,
+    and a replicated decision needs a replicated input)."""
+    import os
+
+    if os.environ.get("PILOSA_TPU_QCACHE", "").lower() not in ("1", "true", "yes"):
+        return None
+    max_bytes = int(os.environ.get("PILOSA_TPU_QCACHE_MAX_BYTES", str(DEFAULT_MAX_BYTES)))
+    if min_cost_ms is None:
+        min_cost_ms = float(
+            os.environ.get("PILOSA_TPU_QCACHE_MIN_COST_MS", str(DEFAULT_MIN_COST_MS))
+        )
+    return QueryCache(max_bytes=max_bytes, min_cost_ms=min_cost_ms, stats=stats)

@@ -21,6 +21,7 @@ from pilosa_tpu.core.cache import Pair
 from pilosa_tpu.executor import QueryBitmap
 from pilosa_tpu.ops.bitwise import pack_positions
 from pilosa_tpu.pilosa import SLICE_WIDTH, PilosaError
+from pilosa_tpu.qcache import NO_CACHE_HEADER
 from pilosa_tpu.qos import DEADLINE_HEADER
 
 PROTOBUF = "application/x-protobuf"
@@ -114,18 +115,24 @@ class Client:
         remote: bool = False,
         deadline=None,
         timeout: Optional[float] = None,
+        no_cache: bool = False,
     ) -> dict:
         """Execute PQL; returns the decoded QueryResponse dict.
 
         ``deadline`` (qos.Deadline) forwards the REMAINING budget to the
         peer as the X-Pilosa-Deadline-Ms hop header and tightens the
         socket timeout to match; a shed (429) or unavailable (503) peer
-        is retried once after its Retry-After hint.
+        is retried once after its Retry-After hint.  ``no_cache`` sets
+        X-Pilosa-No-Cache so the peer's query result cache neither
+        serves nor stores this request (A/B measurement, stale-read
+        debugging).
         """
         body = wire.encode_query_request(
             query, slices=list(slices or []), column_attrs=column_attrs, remote=remote
         )
         headers = {}
+        if no_cache:
+            headers[NO_CACHE_HEADER] = "1"
         if deadline is not None:
             headers[DEADLINE_HEADER] = deadline.header_value()
             if timeout is None:
@@ -157,6 +164,7 @@ class Client:
         query: "pql.Query",
         slices: Optional[Sequence[int]] = None,
         deadline=None,
+        no_cache: bool = False,
     ) -> list:
         """Forward a parsed query for remote execution; returns typed results
         (the client half of executor.go:1009-1091).  proto3 omits
@@ -164,7 +172,8 @@ class Client:
         call's expected type, as the reference does (executor.go:1068-1085).
         """
         resp = self.execute_query(
-            index, str(query), slices=slices, remote=True, deadline=deadline
+            index, str(query), slices=slices, remote=True, deadline=deadline,
+            no_cache=no_cache,
         )
         return [
             _result_from_wire(r, expect=c.name)
@@ -172,10 +181,12 @@ class Client:
         ]
 
     def execute_remote_call(
-        self, index: str, call: "pql.Call", slices: Sequence[int], deadline=None
+        self, index: str, call: "pql.Call", slices: Sequence[int], deadline=None,
+        no_cache: bool = False,
     ):
         results = self.execute_remote(
-            index, pql.Query(calls=[call]), slices=slices, deadline=deadline
+            index, pql.Query(calls=[call]), slices=slices, deadline=deadline,
+            no_cache=no_cache,
         )
         return results[0]
 

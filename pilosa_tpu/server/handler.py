@@ -54,7 +54,7 @@ from urllib.parse import parse_qs, urlparse
 import numpy as np
 
 from pilosa_tpu import pilosa as errors
-from pilosa_tpu import pql, qos, wire
+from pilosa_tpu import pql, qcache as qcache_mod, qos, wire
 from pilosa_tpu.core.cache import Pair
 from pilosa_tpu.core.frame import FrameOptions
 from pilosa_tpu.core.index import IndexOptions
@@ -477,7 +477,12 @@ class Handler:
             column_attrs = self._param(params, "columnAttrs") == "true"
             remote = self._param(params, "remote") == "true"
 
-        opt = ExecOptions(remote=remote, deadline=deadline)
+        # Per-request qcache bypass (A/B measurement, stale-read
+        # debugging): the request neither reads nor stores an entry.
+        no_cache = (headers.get(qcache_mod.NO_CACHE_HEADER.lower(), "") or "").strip().lower() in (
+            "1", "true", "yes"
+        )
+        opt = ExecOptions(remote=remote, deadline=deadline, no_cache=no_cache)
         try:
             results = self.executor.execute(index, query_str, slices=slices, opt=opt)
         except qos.DeadlineExceeded:

@@ -52,9 +52,30 @@ class Server:
         self.host = self.config.host
         self.data_dir = os.path.expanduser(self.config.data_dir)
 
+        # [cache] ranking-debounce-s: fragments resolve the module
+        # default at RankCache construction, so setting it before the
+        # holder opens covers every fragment without threading the value
+        # through Holder -> Index -> Frame -> Fragment.
+        from pilosa_tpu.core import cache as cache_mod
+
+        cache_mod.DEFAULT_RANKING_DEBOUNCE_S = self.config.ranking_debounce_s
+
         self.holder = Holder(self.data_dir, stats=stats)
         self.cluster = self._build_cluster()
         self.client_factory = lambda host: Client(host)
+        # Generation-keyed query result cache ([qcache]): sits in front
+        # of the executor's read paths; None = disabled.
+        from pilosa_tpu.qcache import QueryCache
+
+        self.qcache = (
+            QueryCache(
+                max_bytes=self.config.qcache_max_bytes,
+                min_cost_ms=self.config.qcache_min_cost_ms,
+                stats=stats,
+            )
+            if self.config.qcache_enabled
+            else None
+        )
         self.executor = Executor(
             self.holder,
             engine=self.config.engine,
@@ -65,6 +86,7 @@ class Server:
             serve_state_cache=self.config.serve_state_cache,
             repair_rows_max=self.config.repair_rows_max,
             gram_rows_max=self.config.gram_rows_max,
+            qcache=self.qcache,
             # Server ingest routes singleton SetBits through the
             # group-commit queue (concurrent clients batch into one
             # fragment pass + WAL append); opt out via env for A/B runs.

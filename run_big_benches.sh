@@ -55,7 +55,15 @@ run BENCH_CONFIG=mixed BENCH_ROWS=256 BENCH_SLICES=8
 #    replay vs one control-plane entry per request.
 run BENCH_CONFIG=lockstep_coalesce
 run BENCH_CONFIG=lockstep_coalesce BENCH_THREADS=32
-# 9) Request-lifecycle QoS under overload: a real HTTP server at 2x door
+# 9) Generation-keyed query result cache: Zipf-skewed repeated read mix
+#    with interleaved writes, cache-on vs cache-off tiers in the JSON
+#    (hit rate + ms/request; read-your-writes asserted in-run); the
+#    second line pushes a wider pool at heavier skew (dashboard-fleet
+#    shape), the third an unskewed mix (worst case for the cache).
+run BENCH_CONFIG=qcache
+run BENCH_CONFIG=qcache BENCH_QUERY_POOL=512 BENCH_ZIPF_S=1.3
+run BENCH_CONFIG=qcache BENCH_ZIPF_S=0.0
+# 10) Request-lifecycle QoS under overload: a real HTTP server at 2x door
 #    capacity, QoS on (bounded admission + deadlines; shed 429s, p99 near
 #    presat) vs off (unbounded; p99 degrades with the queue).  The second
 #    line pushes deeper overload on a wider door.

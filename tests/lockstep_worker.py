@@ -142,6 +142,13 @@ def main() -> int:
                 # expired requests dropped at replay (every rank).
                 "shed": svc.stat_shed,
                 "expired": svc.stat_expired,
+                # Query-result-cache telemetry (PILOSA_TPU_QCACHE=1):
+                # hit/miss decisions must be IDENTICAL on every rank —
+                # they are pure functions of replicated state (the
+                # lockstep service forces min-cost-ms to 0).
+                "qcache_hits": getattr(svc.executor.qcache, "hits", -1),
+                "qcache_misses": getattr(svc.executor.qcache, "misses", -1),
+                "qcache_stores": getattr(svc.executor.qcache, "stores", -1),
             }
         ),
         flush=True,

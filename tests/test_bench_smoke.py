@@ -94,6 +94,29 @@ def test_bench_config_emits_json(cfg, extra):
         assert by["mixed_50_50"]["patch_planes"] > 0
 
 
+def test_bench_qcache_emits_json():
+    """The query-result-cache bench must keep working: a Zipf-skewed
+    repeated read mix with interleaved writes, cache on vs off on the
+    same schedule.  The Zipf tier must actually HIT (skewed repeats are
+    the whole point) and read-your-writes must hold in both tiers (a
+    write to a touched fragment forces a miss; the next answer reflects
+    it)."""
+    stdout = _run({"BENCH_CONFIG": "qcache", "BENCH_SMOKE": "1"}, timeout=300)
+    result = json.loads(stdout.strip().splitlines()[-1])
+    assert result["metric"] == "qcache_read_qps" and result["value"] > 0
+    names = [t["tier"] for t in result["tiers"]]
+    assert names == ["qcache_on", "qcache_off"]
+    by = {t["tier"]: t for t in result["tiers"]}
+    assert by["qcache_on"]["hit_rate"] > 0.5
+    assert by["qcache_on"]["hits"] > 0 and by["qcache_on"]["misses"] > 0
+    # Cache off = no cache at all: nothing can hit.
+    assert by["qcache_off"]["hit_rate"] == 0 and by["qcache_off"]["hits"] == 0
+    # Read-your-writes + the numpy ground-truth gate held in BOTH tiers
+    # (the bench itself asserts them; the fields record it).
+    assert all(t["rw_ok"] and t["gate_ok"] for t in result["tiers"])
+    assert all(t["ms_per_request"] > 0 for t in result["tiers"])
+
+
 def test_bench_overload_emits_json():
     """The request-lifecycle QoS bench must keep working: a real HTTP
     server past saturation, QoS on (bounded admission + deadlines —

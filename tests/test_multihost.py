@@ -462,6 +462,41 @@ def test_lockstep_expired_deadline_dropped_identically():
     assert outs[0]["expired"] == outs[1]["expired"] == 5
 
 
+def test_lockstep_qcache_identical_hit_miss_on_all_ranks():
+    """Query result cache under lockstep (PILOSA_TPU_QCACHE=1): hit and
+    miss decisions must be IDENTICAL on every rank — they are pure
+    functions of replicated state (the request strings ride the batch
+    wire, writes replay in the total order, and the service forces the
+    rank-local wall-clock admission floor to 0) — so a cache hit skips
+    the executor (and its collectives) on EVERY rank at once, never on
+    some.  Read-your-writes: a replayed write bumps the same fragment
+    generations everywhere, so the next read misses identically and
+    reflects the write."""
+    job = _LockstepJob(2, env_extra={"PILOSA_TPU_QCACHE": "1"})
+    try:
+        job.wait_ready()
+        q = 'Count(Bitmap(rowID=0, frame="f"))'
+        assert job.query(q)["results"] == [8]   # miss, stored
+        assert job.query(q)["results"] == [8]   # hit
+        assert job.query(q)["results"] == [8]   # hit
+        # A write through the service: replayed on every rank, bumps the
+        # touched fragment's generation everywhere.
+        assert job.query('SetBit(rowID=0, frame="f", columnID=77)')["results"] == [True]
+        # Read-your-writes: the next read misses (identically) and
+        # serves the post-write count; the one after hits the new entry.
+        assert job.query(q)["results"] == [9]   # miss, stored
+        assert job.query(q)["results"] == [9]   # hit
+        outs = job.shutdown_and_collect()
+    finally:
+        job.cleanup()
+    by_pid = {o["pid"]: o for o in outs}
+    # Every rank made the same decisions: 3 hits, 2 misses, 2 stores.
+    for k, want in (("qcache_hits", 3), ("qcache_misses", 2), ("qcache_stores", 2)):
+        assert by_pid[0][k] == by_pid[1][k] == want, (k, outs)
+    # Replicated holders stayed convergent through cached serving.
+    assert by_pid[0]["probe"] == by_pid[1]["probe"] == 9
+
+
 def test_lockstep_worker_death_mid_stream():
     """A worker rank SIGKILLed MID-REQUEST-STREAM: the in-flight or next
     request errors, every subsequent request is refused (the service

@@ -1,0 +1,544 @@
+"""Generation-keyed query result cache (pilosa_tpu/qcache/).
+
+Covers: exact cache/execution equivalence under interleaved writes (a
+stateful property test in the style of test_fragment_stateful.py), the
+admission/eviction/error/bypass unit semantics, the X-Pilosa-No-Cache
+header end to end through the HTTP handler, deletion purge hooks, the
+canonical call-tree fingerprint, /debug/vars counters, and the
+[cache] ranking-debounce-s promotion (satellite).
+"""
+
+import json
+import tempfile
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.core.frame import FrameOptions
+from pilosa_tpu.core.holder import Holder
+from pilosa_tpu.executor import ExecOptions, Executor
+from pilosa_tpu.pilosa import SLICE_WIDTH, PilosaError
+from pilosa_tpu.qcache import (
+    NO_CACHE_HEADER,
+    QueryCache,
+    generation_vector,
+    referenced_frames,
+)
+
+Q_PAIR = 'Count(Intersect(Bitmap(rowID=0, frame="f"), Bitmap(rowID=1, frame="f")))'
+
+
+@pytest.fixture()
+def env(tmp_path):
+    h = Holder(str(tmp_path / "d"))
+    h.open()
+    h.create_index("i").create_frame("f", FrameOptions())
+    fr = h.index("i").frame("f")
+    for c in range(10):
+        fr.set_bit("standard", 0, c)
+    for c in range(5, 15):
+        fr.set_bit("standard", 1, c)
+    qc = QueryCache(min_cost_ms=0.0)
+    ex = Executor(h, engine="numpy", qcache=qc)
+    yield h, fr, ex, qc
+    h.close()
+
+
+def test_hit_serves_identical_results(env):
+    h, fr, ex, qc = env
+    r1 = ex.execute("i", Q_PAIR)
+    r2 = ex.execute("i", Q_PAIR)
+    assert r1 == r2 == [5]
+    assert (qc.hits, qc.misses, qc.stores) == (1, 1, 1)
+    assert len(qc) == 1 and qc.bytes > 0
+
+
+def test_executor_write_invalidates(env):
+    h, fr, ex, qc = env
+    assert ex.execute("i", Q_PAIR) == [5]
+    ex.execute("i", 'SetBit(rowID=0, frame="f", columnID=7)')  # already set: no change
+    # An idempotent write that changed nothing bumps no generation, so
+    # the entry stays valid.
+    assert ex.execute("i", Q_PAIR) == [5] and qc.hits == 1
+    ex.execute("i", 'SetBit(rowID=0, frame="f", columnID=12)')
+    # Read-your-writes: the generation bump forces a miss and the fresh
+    # answer reflects the write.
+    assert ex.execute("i", Q_PAIR) == [6]
+    assert qc.misses == 2
+
+
+def test_direct_fragment_write_invalidates(env):
+    """The validity token is the fragment generation, maintained inside
+    the fragment's own locked mutators — so writers that never touch
+    this executor (imports, sync, another executor) still invalidate."""
+    h, fr, ex, qc = env
+    assert ex.execute("i", Q_PAIR) == [5]
+    fr.set_bit("standard", 1, 2)
+    assert ex.execute("i", Q_PAIR) == [6]
+    fr.import_bits(np.array([0], dtype=np.uint64), np.array([13], dtype=np.uint64))
+    assert ex.execute("i", Q_PAIR) == [7]
+    assert qc.hits == 0 and qc.misses == 3
+
+
+def test_new_slice_invalidates(env):
+    h, fr, ex, qc = env
+    assert ex.execute("i", Q_PAIR) == [5]
+    fr.set_bit("standard", 0, SLICE_WIDTH + 3)  # new max slice
+    fr.set_bit("standard", 1, SLICE_WIDTH + 3)
+    assert ex.execute("i", Q_PAIR) == [6]
+
+
+def test_admission_min_cost_ms():
+    """Only results whose measured cost clears min-cost-ms are stored."""
+    clk = [0.0]
+
+    def fake_clock():
+        return clk[0]
+
+    qc = QueryCache(min_cost_ms=5.0, clock=fake_clock)
+    with tempfile.TemporaryDirectory() as d:
+        h = Holder(d)
+        h.open()
+        h.create_index("i").create_frame("f", FrameOptions())
+        h.index("i").frame("f").set_bit("standard", 0, 1)
+        # Cheap execution (0 ms on the fake clock): not admitted.
+        _, tok = qc.lookup(h, "i", Q_PAIR, None)
+        assert tok is not None
+        assert not qc.commit(h, tok, [1])
+        assert qc.stores == 0 and len(qc) == 0
+        # Expensive execution (10 ms): admitted.
+        _, tok = qc.lookup(h, "i", Q_PAIR, None)
+        clk[0] += 0.010
+        assert qc.commit(h, tok, [1])
+        assert qc.stores == 1 and len(qc) == 1
+        cached, _ = qc.lookup(h, "i", Q_PAIR, None)
+        assert cached == [1]
+        h.close()
+
+
+def test_byte_bound_eviction(env):
+    h, fr, ex, qc = env
+    qc.max_bytes = 2 * 560 + 10  # room for ~2 count entries
+    qs = [
+        f'Count(Intersect(Bitmap(rowID={a}, frame="f"), Bitmap(rowID={a}, frame="f")))'
+        for a in range(6)
+    ]
+    for q in qs:
+        ex.execute("i", q)
+    assert qc.evictions > 0
+    assert qc.bytes <= qc.max_bytes
+    assert len(qc) >= 1
+    # LRU: the most recent entry survived, the oldest was evicted.
+    assert ex.execute("i", qs[-1]) == ex.execute("i", qs[-1])
+    hits0 = qc.hits
+    ex.execute("i", qs[-1])
+    assert qc.hits == hits0 + 1
+    misses0 = qc.misses
+    ex.execute("i", qs[0])
+    assert qc.misses == misses0 + 1
+
+
+def test_oversized_result_never_stored(env):
+    h, fr, ex, qc = env
+    qc.max_bytes = 8  # smaller than any entry
+    ex.execute("i", Q_PAIR)
+    assert qc.stores == 0 and qc.bytes == 0
+
+
+def test_errors_never_cached(env):
+    h, fr, ex, qc = env
+    bad = 'Count(Bitmap(rowID=0, frame="nope"))'
+    for _ in range(2):
+        with pytest.raises(PilosaError):
+            ex.execute("i", bad)
+    assert qc.stores == 0 and qc.hits == 0
+    assert qc.misses == 2  # eligible shape, but the error aborts the commit
+
+
+def test_write_and_nondeterministic_trees_bypass(env):
+    h, fr, ex, qc = env
+    # Writes, TopN (rank-cache debounce timing), and top-level Bitmap
+    # (attaches attrs, which mutate without a generation bump) must
+    # never be cached.
+    ex.execute("i", 'SetBit(rowID=0, frame="f", columnID=99)')
+    ex.execute("i", 'TopN(frame="f", n=2)')
+    ex.execute("i", 'Bitmap(rowID=0, frame="f")')
+    # A mixed request carrying any write stays uncacheable as a whole.
+    ex.execute("i", f'SetBit(rowID=0, frame="f", columnID=98) {Q_PAIR}')
+    assert qc.stores == 0 and len(qc) == 0
+    assert qc.bypasses == 4
+
+
+def test_no_cache_exec_option(env):
+    h, fr, ex, qc = env
+    r1 = ex.execute("i", Q_PAIR)
+    nc = ExecOptions(no_cache=True)
+    r2 = ex.execute("i", Q_PAIR, opt=nc)
+    assert r1 == r2
+    # Bypass neither read nor stored: one store from r1, no hit for r2.
+    assert qc.stores == 1 and qc.hits == 0 and qc.bypasses == 1
+
+
+def test_no_cache_header_through_handler(env):
+    """X-Pilosa-No-Cache: 1 threads through the HTTP handler into
+    ExecOptions — the per-request A/B lever."""
+    from pilosa_tpu.server.handler import Handler
+
+    h, fr, ex, qc = env
+    handler = Handler(h, ex)
+
+    def post(headers=None):
+        status, _, payload = handler.dispatch(
+            "POST", "/index/i/query", {}, Q_PAIR.encode(), headers or {}
+        )[:3]
+        assert status == 200
+        return json.loads(payload)["results"]
+
+    assert post() == [5]
+    assert post() == [5] and qc.hits == 1
+    assert post({NO_CACHE_HEADER.lower(): "1"}) == [5]
+    assert qc.hits == 1 and qc.bypasses == 1  # neither served nor stored
+
+
+def test_client_sets_no_cache_header():
+    from pilosa_tpu.server.client import Client
+
+    captured = {}
+
+    class _Cli(Client):
+        def _request(self, method, path, body=None, **kw):
+            captured.update(kw.get("headers") or {})
+            from pilosa_tpu import wire
+
+            return 200, wire.encode_query_response(results=[0])
+
+    c = _Cli("localhost:1")
+    c.execute_query("i", "Count(Bitmap(rowID=0))", no_cache=True)
+    assert captured.get(NO_CACHE_HEADER) == "1"
+    captured.clear()
+    c.execute_query("i", "Count(Bitmap(rowID=0))")
+    assert NO_CACHE_HEADER not in captured
+
+
+def test_purge_on_frame_and_index_drop(env):
+    h, fr, ex, qc = env
+    ex.execute("i", Q_PAIR)
+    assert len(qc) == 1
+    ex.drop_frame_state("i", "f")
+    assert len(qc) == 0 and qc.bytes == 0
+    ex.execute("i", Q_PAIR)
+    assert len(qc) == 1
+    ex.drop_index_state("i")
+    assert len(qc) == 0 and qc.bytes == 0
+
+
+def test_delete_frame_route_purges(env):
+    """The HTTP deletion route drives the purge, so a recreated
+    namesake frame can never serve the old frame's results."""
+    from pilosa_tpu.server.handler import Handler
+
+    h, fr, ex, qc = env
+    handler = Handler(h, ex)
+    assert ex.execute("i", Q_PAIR) == [5]
+    status, _, _ = handler.dispatch("DELETE", "/index/i/frame/f", {}, b"", {})[:3]
+    assert status == 200 and len(qc) == 0
+    h.index("i").create_frame("f", FrameOptions())
+    fr2 = h.index("i").frame("f")
+    fr2.set_bit("standard", 0, 1)
+    fr2.set_bit("standard", 1, 1)
+    assert ex.execute("i", Q_PAIR) == [1]
+
+
+def test_canonical_fingerprint_shares_entry(env):
+    h, fr, ex, qc = env
+    ex.execute("i", Q_PAIR)
+    # Same call tree, different formatting: one entry, served as a hit.
+    variant = 'Count(Intersect(Bitmap(rowID=0,frame="f"),Bitmap(rowID=1,frame="f")))'
+    assert ex.execute("i", variant) == [5]
+    assert qc.hits == 1 and len(qc) == 1
+
+
+def test_slices_key_separates_partial_requests(env):
+    h, fr, ex, qc = env
+    full = ex.execute("i", Q_PAIR)
+    part = ex.execute("i", Q_PAIR, slices=[0])
+    assert full == part == [5]  # single-slice dataset: same answer
+    assert len(qc) == 2 and qc.hits == 0
+    assert ex.execute("i", Q_PAIR, slices=[0]) == [5]
+    assert qc.hits == 1
+
+
+def test_stats_counters_at_debug_vars(tmp_path):
+    from pilosa_tpu.stats import ExpvarStatsClient
+
+    stats = ExpvarStatsClient()
+    h = Holder(str(tmp_path / "d"))
+    h.open()
+    h.create_index("i").create_frame("f", FrameOptions())
+    h.index("i").frame("f").set_bit("standard", 0, 1)
+    h.index("i").frame("f").set_bit("standard", 1, 1)
+    qc = QueryCache(min_cost_ms=0.0, stats=stats)
+    ex = Executor(h, engine="numpy", qcache=qc)
+    ex.execute("i", Q_PAIR)
+    ex.execute("i", Q_PAIR)
+    ex.execute("i", Q_PAIR, opt=ExecOptions(no_cache=True))
+    snap = stats.snapshot()
+    assert snap["qcache.hit"] == 1
+    assert snap["qcache.miss"] == 1
+    assert snap["qcache.store"] == 1
+    assert snap["qcache.bypass"] == 1
+    assert snap["qcache.bytes"] > 0
+    h.close()
+
+
+def test_generation_vector_shape(env):
+    h, fr, ex, qc = env
+    v1 = generation_vector(h, "i", ("f",))
+    v2 = generation_vector(h, "i", ("f",))
+    assert v1 == v2
+    fr.set_bit("standard", 3, 3)
+    assert generation_vector(h, "i", ("f",)) != v1
+    assert generation_vector(h, "missing", ("f",)) is None
+    # Missing frames are distinguishable from empty ones.
+    assert ("ghost", None) in generation_vector(h, "i", ("ghost",))
+
+
+def test_referenced_frames():
+    from pilosa_tpu import pql
+
+    q = pql.parse(
+        'Count(Intersect(Bitmap(rowID=1, frame="a"), Bitmap(rowID=2, frame="b")))'
+        ' Count(Bitmap(rowID=3))'
+    )
+    assert referenced_frames(q) == ("a", "b", "general")
+
+
+def test_executor_env_default(monkeypatch):
+    """Direct Executor construction keeps pre-qcache behavior unless
+    PILOSA_TPU_QCACHE opts in (the server wires [qcache] explicitly)."""
+    with tempfile.TemporaryDirectory() as d:
+        h = Holder(d)
+        h.open()
+        monkeypatch.delenv("PILOSA_TPU_QCACHE", raising=False)
+        assert Executor(h, engine="numpy").qcache is None
+        monkeypatch.setenv("PILOSA_TPU_QCACHE", "1")
+        monkeypatch.setenv("PILOSA_TPU_QCACHE_MAX_BYTES", "1024")
+        monkeypatch.setenv("PILOSA_TPU_QCACHE_MIN_COST_MS", "2.5")
+        ex = Executor(h, engine="numpy")
+        assert ex.qcache is not None
+        assert ex.qcache.max_bytes == 1024
+        assert ex.qcache.min_cost_ms == 2.5
+        h.close()
+
+
+def test_server_wiring_and_debug_vars(tmp_path):
+    """[qcache] config reaches the real server: repeated HTTP queries
+    hit, /debug/vars carries the counters, and disabling via config
+    yields no cache at all."""
+    import urllib.request
+
+    from pilosa_tpu.config import Config
+    from pilosa_tpu.server.server import Server
+
+    cfg = Config(
+        data_dir=str(tmp_path / "d"), host="127.0.0.1:0", engine="numpy",
+        qcache_min_cost_ms=0.0,
+    )
+    s = Server(cfg)
+    s.open()
+    try:
+        base = f"http://{s.host}"
+
+        def post(path, data):
+            req = urllib.request.Request(base + path, data=data.encode(), method="POST")
+            return json.loads(urllib.request.urlopen(req, timeout=30).read())
+
+        post("/index/i", "{}")
+        post("/index/i/frame/f", "{}")
+        post("/index/i/query", 'SetBit(rowID=0, frame="f", columnID=1)')
+        post("/index/i/query", 'SetBit(rowID=1, frame="f", columnID=1)')
+        r1 = post("/index/i/query", Q_PAIR)
+        r2 = post("/index/i/query", Q_PAIR)
+        assert r1 == r2 and r1["results"] == [1]
+        assert s.qcache is not None and s.qcache.hits == 1
+        with urllib.request.urlopen(base + "/debug/vars", timeout=30) as resp:
+            snap = json.loads(resp.read())
+        assert snap["qcache.hit"] == 1 and snap["qcache.bytes"] > 0
+    finally:
+        s.close()
+    cfg2 = Config(data_dir=str(tmp_path / "d2"), host="127.0.0.1:0",
+                  engine="numpy", qcache_enabled=False)
+    s2 = Server(cfg2)
+    assert s2.qcache is None and s2.executor.qcache is None
+
+
+# -- config surface ---------------------------------------------------------
+
+
+def test_qcache_config_toml_and_env(monkeypatch):
+    from pilosa_tpu.config import Config
+
+    cfg = Config.from_dict(
+        {"qcache": {"enabled": False, "max-bytes": 4096, "min-cost-ms": 7.5}}
+    )
+    assert cfg.qcache_enabled is False
+    assert cfg.qcache_max_bytes == 4096
+    assert cfg.qcache_min_cost_ms == 7.5
+    monkeypatch.setenv("PILOSA_TPU_QCACHE", "true")
+    monkeypatch.setenv("PILOSA_TPU_QCACHE_MAX_BYTES", "8192")
+    monkeypatch.setenv("PILOSA_TPU_QCACHE_MIN_COST_MS", "0.5")
+    cfg.apply_env()
+    assert cfg.qcache_enabled is True
+    assert cfg.qcache_max_bytes == 8192
+    assert cfg.qcache_min_cost_ms == 0.5
+
+
+def test_ranking_debounce_promotion(monkeypatch):
+    """[cache] ranking-debounce-s: ctor arg > env > default (the PR-3
+    [lockstep] promotion pattern), and the debounce actually moves."""
+    from pilosa_tpu.config import Config
+    from pilosa_tpu.core.cache import RankCache
+
+    cfg = Config.from_dict({"cache": {"ranking-debounce-s": "2s"}})
+    assert cfg.ranking_debounce_s == 2.0
+    monkeypatch.setenv("PILOSA_TPU_RANKING_DEBOUNCE_S", "3.5")
+    cfg.apply_env()
+    assert cfg.ranking_debounce_s == 3.5
+
+    now = [100.0]
+    rc = RankCache(4, _now=lambda: now[0], debounce_s=2.0)
+    assert rc.debounce_s == 2.0
+    rc.add(1, 10)  # first invalidate recalculates (update_time far past)
+    t0 = rc._update_time
+    now[0] += 1.0
+    rc.add(2, 20)  # inside the 2 s debounce: no recalc
+    assert rc._update_time == t0
+    now[0] += 1.5
+    rc.add(3, 30)  # past it: recalc
+    assert rc._update_time > t0
+
+    # Env override at construction when no ctor arg is given.
+    rc2 = RankCache(4, _now=lambda: now[0])
+    assert rc2.debounce_s == 3.5
+    monkeypatch.delenv("PILOSA_TPU_RANKING_DEBOUNCE_S")
+    rc3 = RankCache(4, _now=lambda: now[0])
+    assert rc3.debounce_s == 10.0
+
+
+# -- stateful equivalence (style of test_fragment_stateful.py) ---------------
+
+_QUERIES = [
+    'Count(Bitmap(rowID=0, frame="f"))',
+    'Count(Intersect(Bitmap(rowID=0, frame="f"), Bitmap(rowID=1, frame="f")))',
+    'Count(Union(Bitmap(rowID=1, frame="f"), Bitmap(rowID=2, frame="f"),'
+    ' Bitmap(rowID=3, frame="f")))',
+    'Count(Difference(Bitmap(rowID=2, frame="f"), Bitmap(rowID=3, frame="f")))',
+    'Count(Xor(Bitmap(rowID=4, frame="f"), Bitmap(rowID=5, frame="f")))',
+    'Intersect(Bitmap(rowID=0, frame="f"), Bitmap(rowID=4, frame="f"))',
+]
+
+
+def _assert_equivalent(got, want):
+    if hasattr(got[0], "segments"):  # QueryBitmap: compare bit sets
+        assert got[0].bits() == want[0].bits()
+    else:
+        assert got == want
+
+
+@pytest.mark.parametrize("seed", [11, 23, 47])
+def test_equivalence_random_interleaving(tmp_path, seed):
+    """Random interleavings of writes (executor + direct-fragment),
+    clears, and repeated queries: every answer from the cached executor
+    must equal a FRESH uncached execution of the same query — the
+    exactness contract (read-your-writes included, since a fresh
+    execution by definition sees every prior write).  Deterministic
+    seeds so the suite needs no hypothesis; the machine below upgrades
+    to shrinking fuzz when hypothesis is installed."""
+    rng = np.random.default_rng(seed)
+    h = Holder(str(tmp_path / "d"))
+    h.open()
+    h.create_index("i").create_frame("f", FrameOptions())
+    fr = h.index("i").frame("f")
+    qc = QueryCache(min_cost_ms=0.0)
+    ex = Executor(h, engine="numpy", qcache=qc)
+    fresh = Executor(h, engine="numpy", qcache=None)
+    try:
+        for _ in range(200):
+            op = rng.integers(0, 5)
+            r = int(rng.integers(0, 6))
+            c = int(rng.integers(0, 64)) if rng.random() < 0.7 else int(
+                rng.integers(SLICE_WIDTH - 8, SLICE_WIDTH + 64)
+            )
+            if op == 0:
+                ex.execute("i", f'SetBit(rowID={r}, frame="f", columnID={c})')
+            elif op == 1:
+                fr.set_bit("standard", r, c)
+            elif op == 2:
+                fr.clear_bit("standard", r, c)
+            else:  # queries twice as likely as any single write kind
+                q = _QUERIES[int(rng.integers(0, len(_QUERIES)))]
+                _assert_equivalent(ex.execute("i", q), fresh.execute("i", q))
+        assert qc.hits > 0  # the interleaving really exercised the cache
+    finally:
+        h.close()
+
+
+try:
+    from hypothesis import settings
+    from hypothesis import strategies as st
+    from hypothesis.stateful import RuleBasedStateMachine, rule
+except ImportError:
+    pass
+else:
+    _ROW = st.integers(0, 5)
+    _COL = st.one_of(
+        st.integers(0, 64), st.integers(SLICE_WIDTH - 8, SLICE_WIDTH + 64)
+    )
+    _QIDX = st.integers(0, len(_QUERIES) - 1)
+
+    class QCacheEquivalenceMachine(RuleBasedStateMachine):
+        """Shrinking-fuzz upgrade of the seeded interleaving test."""
+
+        def __init__(self):
+            super().__init__()
+            import shutil
+
+            self._dir = tempfile.mkdtemp()
+            self.h = Holder(self._dir)
+            self.h.open()
+            self.h.create_index("i").create_frame("f", FrameOptions())
+            self.fr = self.h.index("i").frame("f")
+            self.qc = QueryCache(min_cost_ms=0.0)
+            self.ex = Executor(self.h, engine="numpy", qcache=self.qc)
+            self.fresh = Executor(self.h, engine="numpy", qcache=None)
+            self._shutil = shutil
+
+        def teardown(self):
+            try:
+                self.h.close()
+            finally:
+                self._shutil.rmtree(self._dir, ignore_errors=True)
+
+        @rule(r=_ROW, c=_COL)
+        def executor_write(self, r, c):
+            self.ex.execute("i", f'SetBit(rowID={r}, frame="f", columnID={c})')
+
+        @rule(r=_ROW, c=_COL)
+        def direct_write(self, r, c):
+            self.fr.set_bit("standard", r, c)
+
+        @rule(r=_ROW, c=_COL)
+        def clear(self, r, c):
+            self.fr.clear_bit("standard", r, c)
+
+        @rule(k=_QIDX)
+        def query(self, k):
+            _assert_equivalent(
+                self.ex.execute("i", _QUERIES[k]),
+                self.fresh.execute("i", _QUERIES[k]),
+            )
+
+    QCacheEquivalenceMachine.TestCase.settings = settings(
+        max_examples=20, stateful_step_count=30, deadline=None
+    )
+    TestQCacheEquivalence = QCacheEquivalenceMachine.TestCase

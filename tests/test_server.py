@@ -664,7 +664,13 @@ def test_serve_lane_through_http_server(tmp_path):
     from pilosa_tpu.executor import Executor
     from pilosa_tpu.server.server import Server
 
-    cfg = Config(data_dir=str(tmp_path / "d"), host="127.0.0.1:0", engine="jax")
+    # qcache OFF: this test proves the layer BELOW it (the native serve
+    # lane) engages; with the query result cache on, byte-identical
+    # repeats are answered above the executor and never arm the lane.
+    cfg = Config(
+        data_dir=str(tmp_path / "d"), host="127.0.0.1:0", engine="jax",
+        qcache_enabled=False,
+    )
     s = Server(cfg)
     s.open()
     try:
