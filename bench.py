@@ -1693,7 +1693,8 @@ def bench_qcache() -> dict:
                 ex.execute("q", q)
             if qc is not None:
                 qc.clear()
-                qc.hits = qc.misses = qc.bypasses = qc.evictions = qc.stores = 0
+                qc.hits = qc.misses = qc.bypasses = qc.ineligible = 0
+                qc.evictions = qc.stores = 0
             wcount = 0
             lat: list = []
             t0 = time.perf_counter()

@@ -108,7 +108,7 @@ def cmd_lockstep(args) -> int:
         process_id=args.process_id,
         local_device_count=args.local_devices,
     )
-    holder = Holder(cfg.data_dir)
+    holder = Holder(cfg.data_dir, ranking_debounce_s=cfg.ranking_debounce_s)
     holder.open()
     host, _, port = cfg.host.partition(":")
     ctrl_host, _, ctrl_port = args.control.partition(":")

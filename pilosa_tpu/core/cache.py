@@ -21,10 +21,11 @@ from typing import Iterable
 THRESHOLD_FACTOR = 1.1
 
 # RankCache invalidation debounce (cache.go:219-226's hard-coded 10 s,
-# promoted to config).  Resolution order at RankCache construction:
-# ctor arg > PILOSA_TPU_RANKING_DEBOUNCE_S env > this module default —
-# the server assigns [cache] ranking-debounce-s here before opening the
-# holder, so deeply-nested fragment construction needs no threading.
+# promoted to config).  The configured value ([cache] ranking-debounce-s,
+# env-resolved once in Config._apply_env) threads through Holder ->
+# Index -> Frame -> View -> Fragment construction; an absent ctor arg
+# falls back to this module default — no module-global mutation, so two
+# servers in one process never leak each other's setting.
 DEFAULT_RANKING_DEBOUNCE_S = 10.0
 
 # Cache type names (frame.go:33-40).
@@ -103,21 +104,19 @@ class RankCache:
     ``threshold_value`` is the count of the first evicted rank, and adds
     below it are ignored.  ``invalidate`` is debounced to once per
     ``debounce_s`` (default 10 s, cache.go:219-226; config
-    ``[cache] ranking-debounce-s`` / PILOSA_TPU_RANKING_DEBOUNCE_S);
+    ``[cache] ranking-debounce-s`` / PILOSA_TPU_RANKING_DEBOUNCE_S,
+    resolved in Config and threaded through holder construction);
     ``recalculate`` forces it.
     """
 
     def __init__(self, max_entries: int, _now=time.monotonic, debounce_s=None):
-        import os
-
         self.max_entries = max_entries
         self.threshold_buffer = int(THRESHOLD_FACTOR * max_entries)
         self.threshold_value = 0
         self.entries: dict[int, int] = {}
         self.rankings: list[Pair] = []
         if debounce_s is None:
-            raw = os.environ.get("PILOSA_TPU_RANKING_DEBOUNCE_S")
-            debounce_s = float(raw) if raw else DEFAULT_RANKING_DEBOUNCE_S
+            debounce_s = DEFAULT_RANKING_DEBOUNCE_S
         self.debounce_s = float(debounce_s)
         self._now = _now
         self._update_time = _now() - 1e9

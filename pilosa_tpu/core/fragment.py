@@ -119,6 +119,7 @@ class Fragment:
         max_opn: int = DEFAULT_MAX_OPN,
         row_attr_store=None,
         stats=None,
+        ranking_debounce_s=None,
     ):
         self.path = path
         self.index = index
@@ -127,6 +128,7 @@ class Fragment:
         self.slice = slice_i
         self.cache_type = cache_type
         self.cache_size = cache_size
+        self.ranking_debounce_s = ranking_debounce_s
         self.max_opn = max_opn
         from pilosa_tpu.stats import NOP_STATS
 
@@ -137,7 +139,7 @@ class Fragment:
         # (fragment.go:69 mu analog).
         self._mu = threading.RLock()
         self.storage: roaring.Bitmap = roaring.Bitmap()
-        self.cache = cache_mod.new_cache(cache_type, cache_size)
+        self.cache = cache_mod.new_cache(cache_type, cache_size, ranking_debounce_s)
         self._wal = None  # append handle to the data file
         self._row_cache: OrderedDict[int, np.ndarray] = OrderedDict()
         self._row_cache_max = 64
@@ -1008,7 +1010,9 @@ class Fragment:
         self._rebuild_cache()
 
     def _rebuild_cache(self) -> None:
-        self.cache = cache_mod.new_cache(self.cache_type, self.cache_size)
+        self.cache = cache_mod.new_cache(
+            self.cache_type, self.cache_size, self.ranking_debounce_s
+        )
         positions = self.storage.to_array()
         if len(positions):
             rows, counts = np.unique(positions // np.uint64(SLICE_WIDTH), return_counts=True)

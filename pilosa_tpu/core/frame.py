@@ -75,7 +75,15 @@ class FrameOptions:
 
 
 class Frame:
-    def __init__(self, path: str, index: str, name: str, stats=None, on_new_fragment=None):
+    def __init__(
+        self,
+        path: str,
+        index: str,
+        name: str,
+        stats=None,
+        on_new_fragment=None,
+        ranking_debounce_s=None,
+    ):
         from pilosa_tpu.stats import NOP_STATS
 
         validate_name(name)
@@ -84,6 +92,7 @@ class Frame:
         self.name = name
         self.stats = stats if stats is not None else NOP_STATS
         self.on_new_fragment = on_new_fragment
+        self.ranking_debounce_s = ranking_debounce_s
 
         self.row_label = DEFAULT_ROW_LABEL
         self.inverse_enabled = False
@@ -194,6 +203,7 @@ class Frame:
             row_attr_store=self.row_attr_store,
             on_new_fragment=self.on_new_fragment,
             stats=self.stats.with_tags(f"view:{name}"),
+            ranking_debounce_s=self.ranking_debounce_s,
         )
         v.open()
         self.views[name] = v

@@ -27,11 +27,14 @@ CACHE_FLUSH_INTERVAL = 60.0  # seconds (holder.go:30-31)
 
 
 class Holder:
-    def __init__(self, path: str, stats=None):
+    def __init__(self, path: str, stats=None, ranking_debounce_s=None):
         from pilosa_tpu.stats import NopStatsClient
 
         self.path = path
         self.stats = stats if stats is not None else NopStatsClient()
+        # [cache] ranking-debounce-s, threaded down through Index ->
+        # Frame -> View -> Fragment -> RankCache; None = module default.
+        self.ranking_debounce_s = ranking_debounce_s
         # Guards index create/delete against concurrent schema merges
         # (gossip push/pull runs from two threads; holder.go:35 mu analog).
         self._mu = threading.RLock()
@@ -54,6 +57,7 @@ class Holder:
                 entry,
                 stats=self.stats.with_tags(f"index:{entry}"),
                 on_new_fragment=self._fragment_hook,
+                ranking_debounce_s=self.ranking_debounce_s,
             )
             idx.open()
             self.indexes[entry] = idx
@@ -100,6 +104,7 @@ class Holder:
             name,
             stats=self.stats.with_tags(f"index:{name}"),
             on_new_fragment=self._fragment_hook,
+            ranking_debounce_s=self.ranking_debounce_s,
         )
         idx.open()
         idx.apply_options(opt)

@@ -43,7 +43,14 @@ class IndexOptions:
 
 
 class Index:
-    def __init__(self, path: str, name: str, stats=None, on_new_fragment=None):
+    def __init__(
+        self,
+        path: str,
+        name: str,
+        stats=None,
+        on_new_fragment=None,
+        ranking_debounce_s=None,
+    ):
         from pilosa_tpu.stats import NopStatsClient
 
         validate_name(name)
@@ -51,6 +58,7 @@ class Index:
         self.name = name
         self.stats = stats if stats is not None else NopStatsClient()
         self.on_new_fragment = on_new_fragment
+        self.ranking_debounce_s = ranking_debounce_s
 
         self.column_label = DEFAULT_COLUMN_LABEL
         self.time_quantum = ""
@@ -79,6 +87,7 @@ class Index:
                 entry,
                 stats=self.stats.with_tags(f"frame:{entry}"),
                 on_new_fragment=self.on_new_fragment,
+                ranking_debounce_s=self.ranking_debounce_s,
             )
             frame.open()
             self.frames[entry] = frame
@@ -176,6 +185,7 @@ class Index:
             name,
             stats=self.stats.with_tags(f"frame:{name}"),
             on_new_fragment=self.on_new_fragment,
+            ranking_debounce_s=self.ranking_debounce_s,
         )
         frame.open()
         if not opt.time_quantum and self.time_quantum:

@@ -43,6 +43,7 @@ class View:
         row_attr_store=None,
         on_new_fragment: Optional[Callable[[str, str, str, int], None]] = None,
         stats=None,
+        ranking_debounce_s=None,
     ):
         self.path = path
         self.index = index
@@ -50,6 +51,7 @@ class View:
         self.name = name
         self.cache_type = cache_type
         self.cache_size = cache_size
+        self.ranking_debounce_s = ranking_debounce_s
         self.row_attr_store = row_attr_store
         from pilosa_tpu.stats import NOP_STATS
 
@@ -93,6 +95,7 @@ class View:
             cache_size=self.cache_size,
             row_attr_store=self.row_attr_store,
             stats=self.stats.with_tags(f"slice:{slice_i}"),
+            ranking_debounce_s=self.ranking_debounce_s,
         )
         f.open()
         self.fragments[slice_i] = f

@@ -338,13 +338,24 @@ class Executor:
             # execution; the read return paths below commit it (errors
             # propagate past the commit, so they are never cached).
             if self.qcache is not None:
+                remote = bool(opt is not None and opt.remote)
                 if opt is not None and opt.no_cache:
                     self.qcache.note_bypass()
+                elif self.cluster is not None and not remote:
+                    # Multi-node coordinator scope: the answer covers
+                    # remotely-owned slices, but cluster writes apply
+                    # only on owner nodes — the LOCAL generation vector
+                    # can never see them, so such an entry would serve
+                    # stale reads forever.  Remote sub-requests (explicit
+                    # locally-owned slices, whose writes always land
+                    # locally on every owner) stay cacheable.
+                    self.qcache.note_ineligible()
                 else:
-                    skey = tuple(slices) if slices else None
+                    # Order-insensitive slice-set key; an explicit empty
+                    # list stays distinct from None (= all slices).
+                    skey = None if slices is None else tuple(sorted(slices))
                     cached, qtoken = self.qcache.lookup(
-                        self.holder, index, query, skey,
-                        remote=bool(opt is not None and opt.remote),
+                        self.holder, index, query, skey, remote=remote,
                     )
                     if cached is not None:
                         return cached

@@ -44,6 +44,18 @@ recalculates on a time debounce, so a fresh execution may legitimately
 differ without any write.  Bitmap leaves INSIDE set-op trees are fine —
 only top-level Bitmap calls attach attrs.
 
+**Multi-node clusters**: validity is judged against the LOCAL holder's
+generation vector, but cluster writes are applied only on slice-owner
+nodes (the coordinator forwards without a local write when it is not an
+owner) — so a coordinator-scope result covering remotely-owned slices
+could never be invalidated by those writes.  The executor therefore
+caches only ``remote=True`` sub-requests when it has a cluster: those
+execute purely over locally-owned slices, and every write to a locally
+owned slice is applied locally on every owner, so local generations
+fully cover them.  Coordinator-scope requests are counted ineligible
+and always execute fresh (each peer's cached sub-answer still saves the
+per-node work).
+
 **Lockstep determinism**: hit/miss decisions depend only on replicated
 state — the request strings (shipped in the batch entry), the mutation
 order (the lockstep total order), and deterministic result sizes —
@@ -176,10 +188,13 @@ class _Entry:
 class QueryCache:
     """The byte-accounted, generation-validated query result LRU.
 
-    Thread-safe.  Counters (``hits / misses / bypasses / evictions /
-    stores`` and the ``bytes`` gauge) are exposed both as attributes
-    (tests, bench) and through the optional stats client
-    (``qcache.hit`` etc. at /debug/vars).
+    Thread-safe.  Counters (``hits / misses / bypasses / ineligible /
+    evictions / stores`` and the ``bytes`` gauge) are exposed both as
+    attributes (tests, bench) and through the optional stats client
+    (``qcache.hit`` etc. at /debug/vars).  ``bypasses`` counts ONLY
+    client-requested skips (X-Pilosa-No-Cache) so the A/B hit-rate
+    denominator stays clean; writes, unparseable queries, and
+    cluster-scope requests count as ``ineligible``.
     """
 
     def __init__(
@@ -206,6 +221,7 @@ class QueryCache:
         self.hits = 0
         self.misses = 0
         self.bypasses = 0
+        self.ineligible = 0
         self.evictions = 0
         self.stores = 0
 
@@ -244,10 +260,21 @@ class QueryCache:
     # -- the request path -------------------------------------------------
 
     def note_bypass(self) -> None:
-        """A request that declined the cache (X-Pilosa-No-Cache)."""
+        """A request that DECLINED the cache (X-Pilosa-No-Cache) —
+        distinct from ineligible traffic so the A/B hit-rate denominator
+        (hits / (hits + misses + bypasses)) measures only requests the
+        cache could have served."""
         with self._mu:
             self.bypasses += 1
         self.stats.count("qcache.bypass")
+
+    def note_ineligible(self) -> None:
+        """A request the cache can never serve: a write-bearing or
+        unparseable tree, or a cluster coordinator-scope request whose
+        validity the local generation vector cannot cover."""
+        with self._mu:
+            self.ineligible += 1
+        self.stats.count("qcache.ineligible")
 
     def lookup(self, holder, index: str, query_str: str, slices_key, remote: bool = False):
         """One request's cache probe.
@@ -255,7 +282,8 @@ class QueryCache:
         Returns ``(results, pending)``: a valid entry yields
         ``(list-copy of results, None)``; a cacheable miss yields
         ``(None, _Pending)`` for :meth:`commit` after execution; an
-        ineligible request yields ``(None, None)`` and counts a bypass.
+        ineligible request yields ``(None, None)`` and counts as
+        ineligible (never a bypass — those are client-requested only).
         ``remote`` is part of the key: a remote-serving execution covers
         local slices only, never a coordinator's global answer (remote
         reads always carry explicit slices today — this keys the
@@ -263,7 +291,7 @@ class QueryCache:
         """
         info = self._canonical(query_str)
         if info is None:
-            self.note_bypass()
+            self.note_ineligible()
             return None, None
         fp, frames = info
         key = (index, fp, slices_key, remote)

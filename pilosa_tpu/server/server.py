@@ -52,15 +52,14 @@ class Server:
         self.host = self.config.host
         self.data_dir = os.path.expanduser(self.config.data_dir)
 
-        # [cache] ranking-debounce-s: fragments resolve the module
-        # default at RankCache construction, so setting it before the
-        # holder opens covers every fragment without threading the value
-        # through Holder -> Index -> Frame -> Fragment.
-        from pilosa_tpu.core import cache as cache_mod
-
-        cache_mod.DEFAULT_RANKING_DEBOUNCE_S = self.config.ranking_debounce_s
-
-        self.holder = Holder(self.data_dir, stats=stats)
+        # [cache] ranking-debounce-s threads through holder construction
+        # (Holder -> Index -> Frame -> View -> Fragment), never a module
+        # global — two servers in one process keep independent settings.
+        self.holder = Holder(
+            self.data_dir,
+            stats=stats,
+            ranking_debounce_s=self.config.ranking_debounce_s,
+        )
         self.cluster = self._build_cluster()
         self.client_factory = lambda host: Client(host)
         # Generation-keyed query result cache ([qcache]): sits in front

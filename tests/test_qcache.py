@@ -155,7 +155,7 @@ def test_errors_never_cached(env):
     assert qc.misses == 2  # eligible shape, but the error aborts the commit
 
 
-def test_write_and_nondeterministic_trees_bypass(env):
+def test_write_and_nondeterministic_trees_ineligible(env):
     h, fr, ex, qc = env
     # Writes, TopN (rank-cache debounce timing), and top-level Bitmap
     # (attaches attrs, which mutate without a generation bump) must
@@ -166,7 +166,10 @@ def test_write_and_nondeterministic_trees_bypass(env):
     # A mixed request carrying any write stays uncacheable as a whole.
     ex.execute("i", f'SetBit(rowID=0, frame="f", columnID=98) {Q_PAIR}')
     assert qc.stores == 0 and len(qc) == 0
-    assert qc.bypasses == 4
+    # Uncacheable traffic counts as INELIGIBLE, never as a bypass — the
+    # bypass counter is reserved for explicit X-Pilosa-No-Cache requests
+    # so the A/B hit-rate denominator stays clean.
+    assert qc.ineligible == 4 and qc.bypasses == 0
 
 
 def test_no_cache_exec_option(env):
@@ -268,6 +271,85 @@ def test_slices_key_separates_partial_requests(env):
     assert qc.hits == 1
 
 
+def test_slices_key_order_insensitive_and_empty_distinct(env):
+    """The slice-set key is a SET: the same slices in a different order
+    share one entry, and an explicit empty list never aliases the
+    all-slices (None) request."""
+    h, fr, ex, qc = env
+    fr.set_bit("standard", 0, SLICE_WIDTH + 3)
+    fr.set_bit("standard", 1, SLICE_WIDTH + 3)
+    assert ex.execute("i", Q_PAIR, slices=[0, 1]) == [6]
+    assert ex.execute("i", Q_PAIR, slices=[1, 0]) == [6]  # same entry: hit
+    assert qc.hits == 1 and len(qc) == 1
+    full = ex.execute("i", Q_PAIR)  # None = all slices: its own entry
+    assert full == [6] and len(qc) == 2
+    # An explicit empty list keys its own entry — it never aliases the
+    # all-slices (None) key (execution happens to answer both the same
+    # way today; the key must not bake that coincidence in).
+    misses0 = qc.misses
+    ex.execute("i", Q_PAIR, slices=[])
+    assert qc.misses == misses0 + 1 and len(qc) == 3
+    assert ex.execute("i", Q_PAIR) == [6]
+    assert qc.hits == 2
+
+
+def test_multi_node_cluster_scope_never_cached(tmp_path):
+    """Clustered executors cache ONLY remote-scope sub-requests: a
+    coordinator-scope answer covers remotely-owned slices whose writes
+    never bump local generations (the coordinator forwards them without
+    a local write), so caching it would serve stale reads forever."""
+    from pilosa_tpu.cluster import Cluster, Node
+
+    h = Holder(str(tmp_path / "d"))
+    h.open()
+    h.create_index("i").create_frame("f", FrameOptions())
+    fr = h.index("i").frame("f")
+    for s in range(4):
+        fr.set_bit("standard", 0, s * SLICE_WIDTH + 1)
+        fr.set_bit("standard", 1, s * SLICE_WIDTH + 1)
+
+    hosts = ["h0:1", "h1:1"]
+    cluster = Cluster([Node(host) for host in hosts], replica_n=2)
+
+    class PeerClient:
+        """Stand-in peer answering from the same holder, uncached."""
+
+        def __init__(self, host):
+            self.host = host
+
+        def execute_remote(self, index, query, slices=None, **kw):
+            return Executor(h, engine="numpy").execute(
+                index, query, slices=slices, opt=ExecOptions(remote=True)
+            )
+
+        def execute_remote_call(self, index, call, slices, **kw):
+            from pilosa_tpu import pql
+
+            return self.execute_remote(index, pql.Query(calls=[call]), slices)[0]
+
+    qc = QueryCache(min_cost_ms=0.0)
+    ex = Executor(
+        h, engine="numpy", cluster=cluster, client_factory=PeerClient,
+        host="h0:1", qcache=qc,
+    )
+    try:
+        # Coordinator scope: correct answers, but never cached.
+        assert ex.execute("i", Q_PAIR) == [4]
+        assert ex.execute("i", Q_PAIR) == [4]
+        assert qc.ineligible == 2 and qc.stores == 0 and len(qc) == 0
+        # Remote scope (what peers ask THIS node): cacheable, and a
+        # local write (the forwarded-write path on an owner) invalidates.
+        ropt = ExecOptions(remote=True)
+        assert ex.execute("i", Q_PAIR, slices=[0], opt=ropt) == [1]
+        assert ex.execute("i", Q_PAIR, slices=[0], opt=ropt) == [1]
+        assert qc.hits == 1 and qc.stores == 1
+        fr.set_bit("standard", 0, 2)
+        fr.set_bit("standard", 1, 2)
+        assert ex.execute("i", Q_PAIR, slices=[0], opt=ropt) == [2]
+    finally:
+        h.close()
+
+
 def test_stats_counters_at_debug_vars(tmp_path):
     from pilosa_tpu.stats import ExpvarStatsClient
 
@@ -282,11 +364,13 @@ def test_stats_counters_at_debug_vars(tmp_path):
     ex.execute("i", Q_PAIR)
     ex.execute("i", Q_PAIR)
     ex.execute("i", Q_PAIR, opt=ExecOptions(no_cache=True))
+    ex.execute("i", 'SetBit(rowID=2, frame="f", columnID=3)')
     snap = stats.snapshot()
     assert snap["qcache.hit"] == 1
     assert snap["qcache.miss"] == 1
     assert snap["qcache.store"] == 1
     assert snap["qcache.bypass"] == 1
+    assert snap["qcache.ineligible"] == 1  # the write, not a bypass
     assert snap["qcache.bytes"] > 0
     h.close()
 
@@ -393,9 +477,11 @@ def test_qcache_config_toml_and_env(monkeypatch):
     assert cfg.qcache_min_cost_ms == 0.5
 
 
-def test_ranking_debounce_promotion(monkeypatch):
-    """[cache] ranking-debounce-s: ctor arg > env > default (the PR-3
-    [lockstep] promotion pattern), and the debounce actually moves."""
+def test_ranking_debounce_promotion(tmp_path, monkeypatch):
+    """[cache] ranking-debounce-s: Config resolves TOML + env ONCE
+    (apply_env), the value threads through Holder -> Index -> Frame ->
+    View -> Fragment construction (no module global — two holders in
+    one process keep independent settings), and the debounce moves."""
     from pilosa_tpu.config import Config
     from pilosa_tpu.core.cache import RankCache
 
@@ -417,12 +503,26 @@ def test_ranking_debounce_promotion(monkeypatch):
     rc.add(3, 30)  # past it: recalc
     assert rc._update_time > t0
 
-    # Env override at construction when no ctor arg is given.
-    rc2 = RankCache(4, _now=lambda: now[0])
-    assert rc2.debounce_s == 3.5
-    monkeypatch.delenv("PILOSA_TPU_RANKING_DEBOUNCE_S")
-    rc3 = RankCache(4, _now=lambda: now[0])
-    assert rc3.debounce_s == 10.0
+    # RankCache itself never reads the env — Config is the only
+    # resolution point, so construction is deterministic.
+    assert RankCache(4, _now=lambda: now[0]).debounce_s == 10.0
+
+    # The configured value reaches deeply-nested fragment caches through
+    # holder construction, and a second holder keeps its own setting.
+    ha = Holder(str(tmp_path / "a"), ranking_debounce_s=cfg.ranking_debounce_s)
+    hb = Holder(str(tmp_path / "b"))
+    for h in (ha, hb):
+        h.open()
+        h.create_index("i").create_frame(
+            "f", FrameOptions(cache_type="ranked", cache_size=4)
+        )
+        h.index("i").frame("f").set_bit("standard", 0, 1)
+    frag_a = ha.index("i").frame("f").view("standard").fragment(0)
+    frag_b = hb.index("i").frame("f").view("standard").fragment(0)
+    assert frag_a.cache.debounce_s == 3.5
+    assert frag_b.cache.debounce_s == 10.0  # module default, not leaked
+    ha.close()
+    hb.close()
 
 
 # -- stateful equivalence (style of test_fragment_stateful.py) ---------------

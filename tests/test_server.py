@@ -210,6 +210,78 @@ def test_two_node_cluster_distributed_query(tmp_path):
             s.close()
 
 
+def test_two_node_cluster_qcache_invalidation(tmp_path):
+    """qcache in a multi-node HTTP cluster: a write to a REMOTELY-owned
+    slice must be visible through the coordinator's very next read.
+    Cluster writes apply only on slice-owner nodes, so the coordinator's
+    local generation vector can never see them — coordinator-scope
+    results are therefore never cached (counted ineligible); only each
+    node's remote sub-requests are, and those invalidate locally."""
+    import socket
+
+    def free_port():
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    p0, p1 = free_port(), free_port()
+    hosts = [f"127.0.0.1:{p0}", f"127.0.0.1:{p1}"]
+    servers = []
+    for i, p in enumerate((p0, p1)):
+        cfg = Config(
+            data_dir=str(tmp_path / f"n{i}"),
+            host=hosts[i],
+            engine="numpy",
+            cluster=ClusterConfig(type="static", hosts=list(hosts)),
+            # Admit every eligible result: any unsafely-keyed entry
+            # WOULD be stored and served, so staleness can't hide
+            # behind cost-based admission.
+            qcache_min_cost_ms=0.0,
+        )
+        s = Server(cfg)
+        s.open()
+        servers.append(s)
+    try:
+        c0, c1 = Client(hosts[0]), Client(hosts[1])
+        for c in (c0, c1):
+            c.create_index("i")
+            c.create_frame("i", "f")
+        bits = [(1, s * SLICE_WIDTH + 7) for s in range(4)]
+        cluster = servers[0].cluster
+        c0.import_bits("i", "f", bits, fragment_nodes=cluster.fragment_nodes)
+        servers[0]._monitor_max_slices()
+        servers[1]._monitor_max_slices()
+
+        q = 'Count(Bitmap(rowID=1, frame="f"))'
+        assert c0.execute_query("i", q)["results"][0]["n"] == 4
+        assert c0.execute_query("i", q)["results"][0]["n"] == 4
+        # The coordinator never cached its global answers.
+        assert servers[0].qcache.stores == 0
+        assert servers[0].qcache.ineligible >= 2
+
+        # Write a NEW bit into a slice node 0 does NOT own: the
+        # coordinator only forwards it, so no local generation moves —
+        # exactly the write a coordinator-scope cache entry would miss.
+        remote_slice = next(
+            s for s in range(4)
+            if all(n.host != hosts[0] for n in cluster.fragment_nodes("i", s))
+        )
+        col = remote_slice * SLICE_WIDTH + 99
+        r = c0.execute_query("i", f'SetBit(rowID=1, frame="f", columnID={col})')
+        assert r["results"][0]["changed"] is True
+        # Read-your-writes THROUGH the coordinator, immediately.
+        assert c0.execute_query("i", q)["results"][0]["n"] == 5
+        # And through the other node too (it owns the written slice).
+        assert c1.execute_query("i", q)["results"][0]["n"] == 5
+
+        # Per-node remote sub-requests DID use the cache: the repeated
+        # coordinator reads hit on the peer's remote-scope entries.
+        assert (servers[0].qcache.hits + servers[1].qcache.hits) > 0
+    finally:
+        for s in servers:
+            s.close()
+
+
 def test_webui_served_to_browsers(srv):
     """`/` serves the console to Accept: text/html clients and the plain
     banner to API clients; /assets/* serves the bundle (handler.go:132-145)."""
