@@ -1753,6 +1753,66 @@ def bench_qcache() -> dict:
         assert out["rw_ok"], "read-your-writes violated: a write did not force a miss"
         return out
 
+    def trace_overhead_check() -> dict:
+        """In-run guard for the request tracer's OFF path: serving with
+        a head-sampling tracer at sample-rate 0.01 must cost <= 5% vs
+        tracing fully disabled — the unsampled path is a single branch
+        per instrumentation site, and this keeps it that way.  Best-of-N
+        tight loops over a warm cached query on both sides (min is
+        robust to scheduler noise); an absolute per-request escape
+        hatch (< 20 us) keeps CI boxes with coarse timers honest."""
+        import tempfile
+
+        from pilosa_tpu.trace import Tracer
+
+        n = int(os.environ.get("BENCH_TRACE_ITERS", "1500" if smoke else "6000"))
+        with tempfile.TemporaryDirectory() as d:
+            h = Holder(d)
+            h.open()
+            h.create_index("q").create_frame("f", FrameOptions())
+            fr = h.index("q").frame("f")
+            rows = np.repeat(np.arange(8, dtype=np.uint64), 50)
+            fr.import_bits(rows, rng.integers(0, SLICE_WIDTH, size=len(rows)).astype(np.uint64))
+            ex = Executor(h, qcache=QueryCache(min_cost_ms=0.0))
+            q = pool[0]
+            for _ in range(3):
+                ex.execute("q", q)  # warm: jit, serve lane, cache entry
+            tracer = Tracer(sample_rate=0.01)
+            from pilosa_tpu.executor import ExecOptions
+
+            def loop(traced: bool) -> float:
+                best = float("inf")
+                for _ in range(3):
+                    t0 = time.perf_counter()
+                    if traced:
+                        for _i in range(n):
+                            tr = tracer.begin(None)  # ~1% sampled
+                            if tr is None:
+                                ex.execute("q", q)
+                            else:
+                                ex.execute("q", q, opt=ExecOptions(span=tr.root))
+                                tracer.finish_request(
+                                    tr, name="bench", dt_ms=tr.root.finish().ms
+                                )
+                    else:
+                        for _i in range(n):
+                            ex.execute("q", q)
+                    best = min(best, time.perf_counter() - t0)
+                return best
+
+            t_off = loop(False)
+            t_on = loop(True)
+            h.close()
+        overhead = t_on / t_off - 1.0
+        ok = overhead <= 0.05 or (t_on - t_off) / n <= 20e-6
+        assert ok, (
+            f"tracing at sample-rate=0.01 cost {overhead * 100:.1f}% vs disabled "
+            f"(off {t_off / n * 1e6:.1f} us/req, on {t_on / n * 1e6:.1f} us/req) — "
+            "the unsampled path must stay a single branch per site"
+        )
+        return {"trace_overhead": round(overhead, 4), "trace_ok": ok,
+                "trace_sampled": tracer.stat_sampled}
+
     # Two alternating passes per tier, best-of by ms/request: jit and
     # allocator caches are process-wide, so whichever tier runs first
     # pays residual one-time costs — best-of-two with alternation keeps
@@ -1763,8 +1823,9 @@ def bench_qcache() -> dict:
     ons.append(run(True))
     on = min(ons, key=lambda r: r["ms_per_request"])
     off = min(offs, key=lambda r: r["ms_per_request"])
+    trace_ab = trace_overhead_check()
     tiers = [
-        {"tier": "qcache_on", **{k: (round(v, 3) if isinstance(v, float) else v) for k, v in on.items()}},
+        {"tier": "qcache_on", **{k: (round(v, 3) if isinstance(v, float) else v) for k, v in on.items()}, **trace_ab},
         {"tier": "qcache_off", **{k: (round(v, 3) if isinstance(v, float) else v) for k, v in off.items()}},
     ]
     speedup = off["ms_per_request"] / on["ms_per_request"]

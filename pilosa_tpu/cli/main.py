@@ -125,6 +125,10 @@ def cmd_lockstep(args) -> int:
         # a pure function of replicated state).
         qcache_enabled=cfg.qcache_enabled,
         qcache_max_bytes=cfg.qcache_max_bytes,
+        # [trace] wiring: rank 0 decides sampling at ship time and
+        # records spans; workers only read the replicated wire flag.
+        trace_sample_rate=cfg.trace_sample_rate,
+        trace_slow_ms=cfg.trace_slow_ms,
     )
     if svc.rank == 0:
         print(

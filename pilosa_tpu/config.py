@@ -92,6 +92,16 @@ class Config:
     # the Retry-After hint returned with a 429/503.
     qos_queue_wait_ms: float = 100.0
     qos_retry_after_ms: float = 250.0
+    # -- request tracing ([trace] TOML section) --------------------------
+    # Head-sampling rate for the request-scoped span tracer (0.0 = only
+    # X-Pilosa-Trace-forced requests trace; 1.0 = every request).
+    trace_sample_rate: float = 0.0
+    # Slow-query threshold in ms: requests slower than this land in the
+    # /debug/traces ring REGARDLESS of sampling and emit one structured
+    # line on the pilosa_tpu.slowquery logger.  0 = disabled.
+    trace_slow_ms: float = 0.0
+    # Bounded in-memory ring of finished traces served at /debug/traces.
+    trace_ring: int = 256
     # -- lockstep service ([lockstep] TOML section) ----------------------
     # Rank-0 wait for a worker's receipt ack (control-plane latency +
     # scheduling, not execution) and a worker's connect retry window at
@@ -148,6 +158,10 @@ class Config:
         cfg.qos_retry_after_ms = 1000.0 * _interval(
             qos.get("retry-after"), cfg.qos_retry_after_ms / 1000.0
         )
+        tr = raw.get("trace", {})
+        cfg.trace_sample_rate = float(tr.get("sample-rate", cfg.trace_sample_rate))
+        cfg.trace_slow_ms = float(tr.get("slow-ms", cfg.trace_slow_ms))
+        cfg.trace_ring = int(tr.get("ring", cfg.trace_ring))
         ls = raw.get("lockstep", {})
         cfg.lockstep_ack_timeout = _interval(
             ls.get("ack-timeout"), cfg.lockstep_ack_timeout
@@ -211,6 +225,12 @@ class Config:
             self.qos_queue_wait_ms = float(env["PILOSA_TPU_QOS_QUEUE_WAIT_MS"])
         if "PILOSA_TPU_QOS_RETRY_AFTER_MS" in env:
             self.qos_retry_after_ms = float(env["PILOSA_TPU_QOS_RETRY_AFTER_MS"])
+        if "PILOSA_TPU_TRACE_SAMPLE_RATE" in env:
+            self.trace_sample_rate = float(env["PILOSA_TPU_TRACE_SAMPLE_RATE"])
+        if "PILOSA_TPU_TRACE_SLOW_MS" in env:
+            self.trace_slow_ms = float(env["PILOSA_TPU_TRACE_SLOW_MS"])
+        if "PILOSA_TPU_TRACE_RING" in env:
+            self.trace_ring = int(env["PILOSA_TPU_TRACE_RING"])
         if "PILOSA_TPU_LOCKSTEP_ACK_TIMEOUT" in env:
             self.lockstep_ack_timeout = float(env["PILOSA_TPU_LOCKSTEP_ACK_TIMEOUT"])
         if "PILOSA_TPU_LOCKSTEP_CONNECT_TIMEOUT" in env:

@@ -24,7 +24,7 @@ import os
 import re
 import threading
 from collections import OrderedDict
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace as dc_replace
 from datetime import datetime
 from typing import Any, Optional, Sequence
 
@@ -169,6 +169,11 @@ class ExecOptions:
     # reads nor stores a query-result cache entry) — the A/B lever for
     # hit-rate measurement and stale-read debugging.
     no_cache: bool = False
+    # Request trace span (trace.Span): the root the serving door opened
+    # for a SAMPLED request.  None (the common case) keeps every
+    # instrumentation site a single branch — the tracing-off path adds
+    # no objects and no calls.
+    span: Any = None
 
 
 class QueryBitmap:
@@ -330,6 +335,10 @@ class Executor:
             # Door checkpoint: an already-expired request never touches
             # the serve lane (fast paths included).
             opt.deadline.check("pre-execution")
+        # Request trace span (None = unsampled: every site below is one
+        # branch).  Tags record the cache disposition and which strategy
+        # lane answered; child spans time the stages.
+        span = opt.span if opt is not None else None
         qtoken = None
         if isinstance(query, str):
             # Query result cache: a valid generation-keyed entry answers
@@ -341,6 +350,8 @@ class Executor:
                 remote = bool(opt is not None and opt.remote)
                 if opt is not None and opt.no_cache:
                     self.qcache.note_bypass()
+                    if span is not None:
+                        span.tags["qcache"] = "bypass"
                 elif self.cluster is not None and not remote:
                     # Multi-node coordinator scope: the answer covers
                     # remotely-owned slices, but cluster writes apply
@@ -350,24 +361,45 @@ class Executor:
                     # locally-owned slices, whose writes always land
                     # locally on every owner) stay cacheable.
                     self.qcache.note_ineligible()
+                    if span is not None:
+                        span.tags["qcache"] = "ineligible"
                 else:
                     # Order-insensitive slice-set key; an explicit empty
                     # list stays distinct from None (= all slices).
                     skey = None if slices is None else tuple(sorted(slices))
+                    qsp = span.child("qcache.lookup") if span is not None else None
                     cached, qtoken = self.qcache.lookup(
                         self.holder, index, query, skey, remote=remote,
                     )
+                    if qsp is not None:
+                        qsp.finish()
+                        # qtoken None without a hit = the lookup judged
+                        # the query ineligible (write-bearing tree, ...).
+                        span.tags["qcache"] = (
+                            "hit" if cached is not None
+                            else "miss" if qtoken is not None
+                            else "ineligible"
+                        )
                     if cached is not None:
                         return cached
             w = self._singleton_write_fast(index, query, slices, opt)
             if w is not None:
+                if span is not None:
+                    span.tags["lane"] = "write_fast"
                 return w
             fast = self._flat_fast_path(index, query, slices, opt)
             if fast is not None:
+                if span is not None:
+                    # The compiled-query lane answered (native serve /
+                    # Gram / gather kernels behind one entry point).
+                    span.tags["lane"] = "flat"
                 if qtoken is not None:
                     self.qcache.commit(self.holder, qtoken, fast)
                 return fast
+            psp = span.child("parse") if span is not None else None
             query = pql.parse_cached(query)
+            if psp is not None:
+                psp.finish()
         if not query.calls:
             raise ErrQueryRequired("query required")
         if self.max_writes_per_request and query.write_call_n() > self.max_writes_per_request:
@@ -406,9 +438,20 @@ class Executor:
         if batched_writes is not None:
             return batched_writes
 
+        fsp = span.child("fused") if span is not None else None
         fused = self._fuse_count_pair_batch(index, query.calls, std_slices, inv_slices, opt)
         if fused is None:
             fused = self._fuse_count_range_batch(index, query.calls, std_slices, opt)
+        if fsp is not None:
+            fsp.finish()
+            if fused is None:
+                # No fused group matched: the span only measured the
+                # (cheap) match attempt — drop it from the tree.
+                span.children.remove(fsp)
+            else:
+                fsp.tags["calls"] = len(fused)
+                fsp.tags["slices"] = len(std_slices or [])
+                span.tags["lane"] = "fused"
 
         results = []
         for i, call in enumerate(query.calls):
@@ -419,6 +462,7 @@ class Executor:
             if fused is not None and i in fused:
                 results.append(fused[i])
                 continue
+            csp = span.child(f"call.{call.name}") if span is not None else None
             call_slices = std_slices
             if call.supports_inverse() and std_slices is not None and inv_slices is not None:
                 frame_name = call.string_arg("frame") or DEFAULT_FRAME
@@ -427,7 +471,12 @@ class Executor:
                     raise ErrFrameNotFound(frame_name)
                 if call.is_inverse(frame.row_label, idx.column_label):
                     call_slices = inv_slices
-            results.append(self._execute_call(index, call, call_slices, opt))
+            # The call's fan-out/remote spans nest under the call span
+            # (shallow option copy — opt itself is shared state).
+            call_opt = opt if csp is None else dc_replace(opt, span=csp)
+            results.append(self._execute_call(index, call, call_slices, call_opt))
+            if csp is not None:
+                csp.finish()
         if qtoken is not None:
             self.qcache.commit(self.holder, qtoken, results)
         return results
@@ -1583,7 +1632,7 @@ class Executor:
         def local_map(node_slices):
             return local_fn(node_slices)
 
-        def remote_map(client, node_slices):
+        def remote_map(client, node_slices, trace_span=None):
             # Conditional kwargs: custom client factories (tests,
             # embedders) need not know the QoS/qcache kwargs.
             kw = {}
@@ -1591,6 +1640,8 @@ class Executor:
                 kw["deadline"] = opt.deadline
             if opt.no_cache:
                 kw["no_cache"] = True  # a bypass bypasses peer caches too
+            if trace_span is not None:
+                kw["trace_span"] = trace_span
             res = client.execute_remote(index, batch_query, node_slices, **kw)
             if len(res) != len(idxs):
                 raise PilosaError(
@@ -2583,8 +2634,16 @@ class Executor:
             # either (executor.go:1115-1244); this is its bounded-memory
             # analog.
             chunk = int(os.environ.get("PILOSA_TPU_SLICE_CHUNK", "2048"))
+            span = opt.span
             if len(node_slices) <= chunk:
-                return local_map(node_slices)
+                if span is None:
+                    return local_map(node_slices)
+                csp = span.child("slices")
+                csp.tags["n"] = len(node_slices)
+                try:
+                    return local_map(node_slices)
+                finally:
+                    csp.finish()
             result = zero
             for i in range(0, len(node_slices), chunk):
                 if opt.deadline is not None and i:
@@ -2592,7 +2651,17 @@ class Executor:
                     # bigger-than-memory scan stops streaming once the
                     # request's budget is gone.
                     opt.deadline.check("between slice chunks")
+                csp = None
+                if span is not None:
+                    # One span per slice chunk: the streaming regime's
+                    # per-chunk upload+dispatch time is exactly where
+                    # big-index requests go slow.
+                    csp = span.child("slice_chunk")
+                    csp.tags["start"] = i
+                    csp.tags["n"] = len(node_slices[i : i + chunk])
                 result = reduce_fn(result, local_map(node_slices[i : i + chunk]))
+                if csp is not None:
+                    csp.finish()
             return result
 
         if self.cluster is None or opt.remote or self.client_factory is None:
@@ -2604,16 +2673,31 @@ class Executor:
             if node.host == self.host:
                 return local_chunked(node_slices)
             client = self.client_factory(node.host)
-            if remote_map is not None:
-                return remote_map(client, node_slices)
-            # Conditional kwargs only when set: custom client factories
-            # (tests, embedders) need not know the QoS/qcache kwargs.
-            kw = {}
-            if opt.deadline is not None:
-                kw["deadline"] = opt.deadline
-            if opt.no_cache:
-                kw["no_cache"] = True
-            return client.execute_remote_call(index, c, node_slices, **kw)
+            rsp = None
+            if opt.span is not None:
+                # Remote hop span: the client forwards the trace id in
+                # X-Pilosa-Trace and grafts the peer's span tree (from
+                # X-Pilosa-Trace-Spans) under this span, so the
+                # coordinator's trace shows the remote node's stages.
+                rsp = opt.span.child("remote")
+                rsp.tags["host"] = node.host
+                rsp.tags["slices"] = len(node_slices)
+            try:
+                if remote_map is not None:
+                    return remote_map(client, node_slices, trace_span=rsp)
+                # Conditional kwargs only when set: custom client factories
+                # (tests, embedders) need not know the QoS/qcache kwargs.
+                kw = {}
+                if opt.deadline is not None:
+                    kw["deadline"] = opt.deadline
+                if opt.no_cache:
+                    kw["no_cache"] = True
+                if rsp is not None:
+                    kw["trace_span"] = rsp
+                return client.execute_remote_call(index, c, node_slices, **kw)
+            finally:
+                if rsp is not None:
+                    rsp.finish()
 
         # Mid-query node-failure retry (executor.go:1147-1159): when a
         # remote node becomes UNREACHABLE (transport-level OSError — refused

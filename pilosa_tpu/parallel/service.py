@@ -56,6 +56,18 @@ lockstep invariant holds (the client gets a 504).  The arrival queue
 is bounded (``queue_depth``, default 256): a request landing on a full
 queue gets 429 + Retry-After at the door, and a degraded control plane
 answers 503 + Retry-After instead of 400.
+
+TRACING: the head-sampling decision for the request tracer
+(``PILOSA_TPU_TRACE_SAMPLE_RATE`` / ``_SLOW_MS``, or ctor args from the
+CLI's [trace] config) is decided ONCE — on rank 0 at ship time, forced
+by an inbound ``X-Pilosa-Trace`` header — and rides the batch entry as
+a per-request ``trace`` flag, exactly like expiry: every rank reads
+the flag (never its own RNG), so the decision is identical everywhere.
+Tracing never changes execution, so workers only COUNT the flags
+(``stat_traced``, the determinism probe); rank 0 additionally records
+each traced request's queue/ship/execute phases (the ship span covers
+the worker fan-out + receipt-ack barrier) into its tracer ring, served
+at ``/debug/traces`` by the full server or read off ``svc.tracer``.
 """
 
 from __future__ import annotations
@@ -65,8 +77,11 @@ import os
 import socket
 import struct
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
+
+_now = time.perf_counter
 
 from pilosa_tpu.engine import MeshEngine
 from pilosa_tpu.executor import Executor
@@ -129,10 +144,13 @@ class LockstepService:
         default_deadline_ms: Optional[float] = None,
         qcache_enabled: Optional[bool] = None,
         qcache_max_bytes: Optional[int] = None,
+        trace_sample_rate: Optional[float] = None,
+        trace_slow_ms: Optional[float] = None,
     ):
         import jax
 
         from pilosa_tpu import qcache as qcache_mod
+        from pilosa_tpu import trace as trace_mod
 
         self.holder = holder
         self.rank = jax.process_index()
@@ -195,6 +213,22 @@ class LockstepService:
         if default_deadline_ms is None:
             default_deadline_ms = float(os.environ.get("PILOSA_TPU_DEADLINE_MS", "0"))
         self.default_deadline_ms = default_deadline_ms
+        # Request tracer: the sampling decision is made on rank 0 at
+        # ship time and rides the batch entry as a per-request flag —
+        # every rank reads the flag, never its own RNG, so the decision
+        # is replicated (same rule as expiry).  Only rank 0 records
+        # spans; workers count the flags (stat_traced).  Ctor args (the
+        # CLI passes [trace] config) > env > off.
+        if trace_sample_rate is None and trace_slow_ms is None:
+            self.tracer = trace_mod.from_env()
+        else:
+            rate = trace_sample_rate if trace_sample_rate is not None else 0.0
+            slow = trace_slow_ms if trace_slow_ms is not None else 0.0
+            self.tracer = (
+                trace_mod.Tracer(sample_rate=rate, slow_ms=slow)
+                if (rate > 0 or slow > 0)
+                else None
+            )
         # PIPELINED total order: _order_mu only covers sequence assignment
         # + the worker sends (cheap), so N requests can be in flight on
         # the control plane at once; local execution is serialized in
@@ -236,6 +270,10 @@ class LockstepService:
         self.stat_requests = 0
         self.stat_shed = 0
         self.stat_expired = 0
+        # Trace flags observed in executed batch entries: every rank
+        # counts the SAME number (the flag rides the wire, decided once
+        # on rank 0) — the lockstep determinism probe for sampling.
+        self.stat_traced = 0
 
     # -- rank 0 ----------------------------------------------------------
 
@@ -280,7 +318,7 @@ class LockstepService:
                         raise OSError("worker closed control connection")
                     self._acked[i] += 1
 
-    def _execute(self, index: str, query: str, deadline=None):
+    def _execute(self, index: str, query: str, deadline=None, trace_force=False):
         """Serve one request through the coalescing queue.
 
         ADMISSION: the arrival queue is bounded (``queue_depth``) — a
@@ -309,7 +347,7 @@ class LockstepService:
                     f"lockstep arrival queue full ({self.queue_depth}); retry",
                     retry_after=0.25,
                 )
-            self._q.append(((index, query, deadline), slot))
+            self._q.append(((index, query, deadline, trace_force, _now()), slot))
             while not slot[0]:
                 if not self._shipping and self._q and self._inflight < 2:
                     self._shipping = True
@@ -333,7 +371,7 @@ class LockstepService:
                     if shipped is not None:
                         self._q_cv.release()
                         try:
-                            self._run_batch(shipped[0], batch, shipped[1])
+                            self._run_batch(shipped[0], batch, shipped[1], shipped[2])
                         finally:
                             self._q_cv.acquire()
                     self._inflight -= 1
@@ -344,11 +382,20 @@ class LockstepService:
             raise slot[1]
         return slot[1]
 
-    def _ship_batch(self, items) -> tuple[int, list[bool]]:
+    def _ship_batch(self, items) -> tuple[int, list[bool], list]:
         """Assign the batch's slot in the total order and replicate it:
         one control-plane send per worker plus one ack round for the
         WHOLE batch (the per-request fixed cost this coalescing
-        amortizes).  Returns (seq, expired flags).
+        amortizes).  Returns (seq, expired flags, per-request traces).
+
+        TRACING rides the same wire rule as deadlines: the sampling
+        decision is made HERE, once, on rank 0 (forced by the client's
+        X-Pilosa-Trace header or the tracer's coin flip) and ships as a
+        per-request ``trace`` flag — every rank reads the flag, never
+        its own RNG, so the decision is replicated.  Rank 0 builds the
+        Trace objects (queue span = arrival -> ship; ship span = worker
+        fan-out + receipt-ack barrier) and _run_batch closes them with
+        the execute phase.
 
         DEADLINES ride the wire entry: expiry is decided ONCE, here on
         rank 0 at ship time, and the per-request ``expired`` flag (plus
@@ -370,15 +417,33 @@ class LockstepService:
         idempotent).  A dead rank forces a restart exactly like the
         collective hang it would otherwise cause.
         """
+        from pilosa_tpu.trace import Trace
+
         reqs = []
         expired: list[bool] = []
-        for index, query, d in items:
+        traces: list = []
+        t_ship = _now()
+        for index, query, d, tforce, t_enq in items:
             exp = bool(d is not None and d.expired())
             expired.append(exp)
-            entry = {"index": index, "query": query, "expired": exp}
+            traced = self.tracer is not None and self.tracer.decide(force=tforce)
+            entry = {"index": index, "query": query, "expired": exp,
+                     "trace": traced}
             if d is not None:
                 entry["deadline_ms"] = max(0, int(d.remaining_ms()))
             reqs.append(entry)
+            tr = None
+            if traced:
+                tr = Trace(f"lockstep {index}", forced=tforce)
+                # The queue phase already happened (arrival -> ship):
+                # record it with its measured duration.
+                qsp = tr.root.child("lockstep.queue")
+                qsp.ms = (t_ship - t_enq) * 1e3
+            traces.append(tr)
+        ship_spans = [
+            tr.root.child("lockstep.ship") if tr is not None else None
+            for tr in traces
+        ]
         with self._order_mu:
             if self._degraded:
                 raise DegradedError(
@@ -396,7 +461,12 @@ class LockstepService:
             self._await_acks(seq)
         except (OSError, socket.timeout) as e:
             raise self._degrade(e)
-        return seq, expired
+        for sp in ship_spans:
+            if sp is not None:
+                # Covers the worker fan-out sends plus the receipt-ack
+                # barrier — the control-plane cost the batch amortizes.
+                sp.finish().annotate(ranks=self.n_ranks, batch=len(items))
+        return seq, expired, traces
 
     def _exec_batch_entries(self, entries, deliver) -> None:
         """Drop expired entries (the flag decided at ship time — every
@@ -408,6 +478,11 @@ class LockstepService:
         """
         live: list = []  # (original position, (index, query))
         for pos, e in enumerate(entries):
+            if e.get("trace"):
+                # Ship-time sampling flag off the wire: every rank sees
+                # (and counts) the same flags — the determinism probe
+                # the 2-rank trace test asserts on.
+                self.stat_traced += 1
             if e.get("expired"):
                 self.stat_expired += 1
                 deliver(pos, DeadlineExceeded("dropped at lockstep replay"))
@@ -502,12 +577,16 @@ class LockstepService:
                 except PilosaError as e:
                     deliver(pos, e)
 
-    def _run_batch(self, seq: int, batch, expired=None) -> None:
+    def _run_batch(self, seq: int, batch, expired=None, traces=None) -> None:
         """Execute one shipped batch in its slot of the total order and
         fill every submitter's result slot; never raises (siblings would
         hang on an unfilled slot otherwise).  ``expired`` carries the
         ship-time per-request expiry flags — the SAME flags the workers
         read off the wire, so the drop is identical on every rank.
+        ``traces`` carries the per-request rank-0 Trace objects for
+        ship-time-sampled requests: the execute phase (this batch's
+        slot wait + device execution) closes each one here and lands it
+        in the tracer ring.
 
         Requests execute through the batch units (_exec_batch_units):
         adjacent read-only requests fuse into one executor pass,
@@ -541,15 +620,33 @@ class LockstepService:
                     slot[0] = True
 
                 flags = expired or [False] * len(batch)
+                trs = traces or [None] * len(batch)
                 entries = [
-                    {"index": it[0], "query": it[1], "expired": flags[i]}
+                    {"index": it[0], "query": it[1], "expired": flags[i],
+                     "trace": trs[i] is not None}
                     for i, (it, _) in enumerate(batch)
+                ]
+                exec_spans = [
+                    tr.root.child("lockstep.execute") if tr is not None else None
+                    for tr in trs
                 ]
                 try:
                     self._exec_batch_entries(entries, deliver)
                 except Exception as e:  # noqa: BLE001 — rank-local failure
                     self._degraded = True
                     err = e
+                finally:
+                    for tr, sp, (it, _) in zip(trs, exec_spans, batch):
+                        if tr is None:
+                            continue
+                        sp.finish()
+                        tr.root.finish()
+                        # finish_request: ring entry + the slow-query
+                        # log line when the request cleared slow-ms.
+                        self.tracer.finish_request(
+                            tr, name=tr.root.name, dt_ms=tr.root.ms,
+                            body=it[1].encode("utf-8", errors="replace"),
+                        )
             if err is not None:
                 for _, slot in batch:
                     if not slot[0]:
@@ -579,9 +676,15 @@ class LockstepService:
             deadline = deadline_from_headers(
                 headers, self.service.default_deadline_ms
             )
+            # X-Pilosa-Trace force override: the decision itself is made
+            # on rank 0 at SHIP time (one place, replicated as a wire
+            # flag), this only carries the client's request for it.
+            trace_force = bool((headers.get("x-pilosa-trace") or "").strip())
             retry_after = None
             try:
-                results = self.service._execute(index, query, deadline=deadline)
+                results = self.service._execute(
+                    index, query, deadline=deadline, trace_force=trace_force
+                )
                 body = json.dumps(
                     {"results": [result_to_json(r) for r in results]}
                 ).encode()

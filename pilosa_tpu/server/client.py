@@ -23,6 +23,7 @@ from pilosa_tpu.ops.bitwise import pack_positions
 from pilosa_tpu.pilosa import SLICE_WIDTH, PilosaError
 from pilosa_tpu.qcache import NO_CACHE_HEADER
 from pilosa_tpu.qos import DEADLINE_HEADER
+from pilosa_tpu.trace import TRACE_HEADER, TRACE_SPANS_HEADER
 
 PROTOBUF = "application/x-protobuf"
 
@@ -58,11 +59,18 @@ class Client:
         timeout: Optional[float] = None,
         retries: int = 0,
         deadline=None,
+        capture: Optional[dict] = None,
     ) -> tuple[int, bytes]:
         """One HTTP exchange; ``timeout`` overrides the constructor-wide
         default per request.  With ``retries`` > 0, a 429/503 answer is
         retried after honoring the peer's ``Retry-After`` hint (capped
-        at RETRY_AFTER_CAP_S, never past ``deadline``)."""
+        at RETRY_AFTER_CAP_S, never past ``deadline``).  ``capture``
+        (a dict) receives the final response's headers under
+        ``"headers"`` — the trace hop reads X-Pilosa-Trace-Spans from
+        it.  The SAME Request object serves every retry attempt, so a
+        retried request keeps its identity (deadline budget and trace
+        id headers included): the peer sees one request retried, never
+        two distinct root spans."""
         req = urllib.request.Request(self.base + path, data=body, method=method)
         if body is not None:
             req.add_header("Content-Type", content_type)
@@ -75,9 +83,13 @@ class Client:
                 with urllib.request.urlopen(
                     req, timeout=timeout if timeout is not None else self.timeout
                 ) as resp:
+                    if capture is not None:
+                        capture["headers"] = resp.headers
                     return resp.status, resp.read()
             except urllib.error.HTTPError as e:
                 status, payload, resp_headers = e.code, e.read(), e.headers
+                if capture is not None:
+                    capture["headers"] = resp_headers
             if status not in (429, 503) or attempt >= retries:
                 return status, payload
             attempt += 1
@@ -116,6 +128,7 @@ class Client:
         deadline=None,
         timeout: Optional[float] = None,
         no_cache: bool = False,
+        trace_span=None,
     ) -> dict:
         """Execute PQL; returns the decoded QueryResponse dict.
 
@@ -125,7 +138,12 @@ class Client:
         is retried once after its Retry-After hint.  ``no_cache`` sets
         X-Pilosa-No-Cache so the peer's query result cache neither
         serves nor stores this request (A/B measurement, stale-read
-        debugging).
+        debugging).  ``trace_span`` (trace.Span) propagates the request
+        trace across the hop: the trace id goes out in X-Pilosa-Trace
+        (forcing the peer to trace), and the peer's span tree from the
+        X-Pilosa-Trace-Spans response header is grafted under it.  The
+        retry reuses the same Request object, so a retried hop keeps
+        ONE trace identity — no duplicate root spans on the peer.
         """
         body = wire.encode_query_request(
             query, slices=list(slices or []), column_attrs=column_attrs, remote=remote
@@ -133,16 +151,27 @@ class Client:
         headers = {}
         if no_cache:
             headers[NO_CACHE_HEADER] = "1"
+        if trace_span is not None:
+            headers[TRACE_HEADER] = getattr(trace_span, "trace_id", "") or "1"
         if deadline is not None:
             headers[DEADLINE_HEADER] = deadline.header_value()
             if timeout is None:
                 # Socket bound tracks the budget (+ slack for the 504
                 # answer itself to travel back).
                 timeout = min(self.timeout, deadline.remaining_ms() / 1000.0 + 1.0)
+        capture: Optional[dict] = {} if trace_span is not None else None
         status, payload = self._request(
             "POST", f"/index/{index}/query", body, content_type=PROTOBUF, accept=PROTOBUF,
             headers=headers, timeout=timeout, retries=1, deadline=deadline,
+            capture=capture,
         )
+        if trace_span is not None and capture and capture.get("headers") is not None:
+            raw = capture["headers"].get(TRACE_SPANS_HEADER)
+            if raw:
+                try:
+                    trace_span.graft(json.loads(raw))
+                except ValueError:
+                    pass  # a malformed header never fails the query
         if status >= 400:
             msg = payload.decode(errors="replace")
             try:
@@ -165,6 +194,7 @@ class Client:
         slices: Optional[Sequence[int]] = None,
         deadline=None,
         no_cache: bool = False,
+        trace_span=None,
     ) -> list:
         """Forward a parsed query for remote execution; returns typed results
         (the client half of executor.go:1009-1091).  proto3 omits
@@ -173,7 +203,7 @@ class Client:
         """
         resp = self.execute_query(
             index, str(query), slices=slices, remote=True, deadline=deadline,
-            no_cache=no_cache,
+            no_cache=no_cache, trace_span=trace_span,
         )
         return [
             _result_from_wire(r, expect=c.name)
@@ -182,11 +212,11 @@ class Client:
 
     def execute_remote_call(
         self, index: str, call: "pql.Call", slices: Sequence[int], deadline=None,
-        no_cache: bool = False,
+        no_cache: bool = False, trace_span=None,
     ):
         results = self.execute_remote(
             index, pql.Query(calls=[call]), slices=slices, deadline=deadline,
-            no_cache=no_cache,
+            no_cache=no_cache, trace_span=trace_span,
         )
         return results[0]
 

@@ -54,7 +54,7 @@ from urllib.parse import parse_qs, urlparse
 import numpy as np
 
 from pilosa_tpu import pilosa as errors
-from pilosa_tpu import pql, qcache as qcache_mod, qos, wire
+from pilosa_tpu import pql, qcache as qcache_mod, qos, trace as trace_mod, wire
 from pilosa_tpu.core.cache import Pair
 from pilosa_tpu.core.frame import FrameOptions
 from pilosa_tpu.core.index import IndexOptions
@@ -86,7 +86,7 @@ class Handler:
     """Routes requests to the holder/executor; transport-agnostic core."""
 
     def __init__(self, holder, executor, cluster=None, host="", broadcaster=None, stats=None, client_factory=None,
-                 admission=None, default_deadline_ms: float = 0.0):
+                 admission=None, default_deadline_ms: float = 0.0, tracer=None):
         self.holder = holder
         self.executor = executor
         self.cluster = cluster
@@ -100,6 +100,10 @@ class Handler:
         # deadline for requests that carry no X-Pilosa-Deadline-Ms.
         self.admission = admission
         self.default_deadline_ms = default_deadline_ms
+        # Request-scoped span tracer (trace.Tracer); None = no tracing
+        # at all (embedders) — the server always passes one so the
+        # X-Pilosa-Trace force override works without a restart.
+        self.tracer = tracer
         self.version = VERSION
         self._routes = self._build_routes()
 
@@ -123,6 +127,7 @@ class Handler:
             ("GET", re.compile(r"^/index/(?P<index>[^/]+)/frame/(?P<frame>[^/]+)/views$"), self.get_frame_views),
             ("PATCH", re.compile(r"^/index/(?P<index>[^/]+)/time-quantum$"), self.patch_index_time_quantum),
             ("GET", re.compile(r"^/debug/vars$"), self.get_expvar),
+            ("GET", re.compile(r"^/debug/traces$"), self.get_debug_traces),
             ("GET", re.compile(r"^/debug/pprof(?:/(?P<path>.*))?$"), self.get_pprof),
             ("POST", re.compile(r"^/debug/profile/start$"), self.post_profile_start),
             ("POST", re.compile(r"^/debug/profile/stop$"), self.post_profile_stop),
@@ -144,7 +149,38 @@ class Handler:
     def dispatch(self, method: str, path: str, params: dict, body: bytes, headers: dict):
         """Returns (status, content_type, payload bytes[, extra headers]).
 
-        The QoS door wraps every route: the request's deadline is built
+        The TRACE door wraps the QoS door: the head-sampling decision is
+        made once here (``X-Pilosa-Trace`` forces it — the client
+        override and the cross-node hop), the root span rides down into
+        the route (post_query threads it through ExecOptions into the
+        executor), and at completion the tracer records the ring entry,
+        emits the slow-query log line for any request past ``slow-ms``
+        (sampled or not), and — for propagated traces — returns the
+        serialized span tree in the ``X-Pilosa-Trace-Spans`` response
+        header so the coordinator grafts the peer's sub-spans.  With no
+        tracer (embedders) this wrapper is a single branch.
+        """
+        tracer = self.tracer
+        if tracer is None:
+            return self._dispatch_qos(method, path, params, body, headers, None)
+        trace = tracer.begin(headers, name=f"{method} {path}")
+        t0 = time.perf_counter()
+        out = self._dispatch_qos(
+            method, path, params, body, headers, trace.root if trace else None
+        )
+        dt_ms = (time.perf_counter() - t0) * 1e3
+        extra = tracer.finish_request(
+            trace, name=f"{method} {path}", dt_ms=dt_ms, body=body, status=out[0]
+        )
+        if extra:
+            merged = dict(out[3]) if len(out) > 3 else {}
+            merged.update(extra)
+            out = (out[0], out[1], out[2], merged)
+        return out
+
+    def _dispatch_qos(self, method: str, path: str, params: dict, body: bytes,
+                      headers: dict, span=None):
+        """The QoS door wraps every route: the request's deadline is built
         once (header > configured default), the request is classified
         (read / write / admin) and admitted through the per-class
         bounded gate — a full door answers 429 + Retry-After
@@ -157,14 +193,21 @@ class Handler:
         t0 = time.perf_counter()
         try:
             if self.admission is not None:
+                asp = span.child("qos.admit") if span is not None else None
                 with self.admission.admit(cls, deadline):
+                    if asp is not None:
+                        asp.finish()
                     if deadline is not None:
                         deadline.check("admission")
-                    return self._dispatch_route(method, path, params, body, headers, deadline)
+                    return self._dispatch_route(method, path, params, body, headers,
+                                                deadline, span)
             if deadline is not None and deadline.expired():
                 raise qos.DeadlineExceeded("admission")
-            return self._dispatch_route(method, path, params, body, headers, deadline)
+            return self._dispatch_route(method, path, params, body, headers,
+                                        deadline, span)
         except qos.ShedError as e:
+            if span is not None:
+                span.tags["qos"] = "shed"
             return (
                 e.status,
                 "application/json",
@@ -172,6 +215,8 @@ class Handler:
                 {"Retry-After": f"{e.retry_after:.3f}"},
             )
         except qos.DeadlineExceeded as e:
+            if span is not None:
+                span.tags["qos"] = "expired"
             if self.stats is not None:
                 self.stats.count("qos.expired")
             return 504, "application/json", json.dumps({"error": str(e)}).encode()
@@ -182,7 +227,7 @@ class Handler:
                 )
 
     def _dispatch_route(self, method: str, path: str, params: dict, body: bytes,
-                        headers: dict, deadline=None):
+                        headers: dict, deadline=None, span=None):
         matched_path = False
         for m, pattern, fn in self._routes:
             match = pattern.match(path)
@@ -193,7 +238,7 @@ class Handler:
                 continue
             try:
                 return fn(params=params, body=body, headers=headers,
-                          deadline=deadline, **match.groupdict())
+                          deadline=deadline, span=span, **match.groupdict())
             except (qos.ShedError, qos.DeadlineExceeded):
                 raise  # QoS outcomes map to 429/504 in dispatch()
             except HTTPError as e:
@@ -310,6 +355,22 @@ class Handler:
         if self.stats is not None and hasattr(self.stats, "snapshot"):
             stats = self.stats.snapshot()
         return self._json(stats)
+
+    def get_debug_traces(self, params=None, **kw):
+        """Finished request traces, newest-first (bounded ring).
+        ``?min-ms=`` filters by total duration, ``?limit=`` caps the
+        page (default 64)."""
+        if self.tracer is None:
+            return self._json({"traces": []})
+        params = params or {}
+        try:
+            min_ms = float(self._param(params, "min-ms", 0) or 0)
+            limit = int(self._param(params, "limit", 64) or 64)
+        except ValueError:
+            raise HTTPError(400, "bad min-ms/limit")
+        return self._json(
+            {"traces": self.tracer.traces_json(min_ms=min_ms, limit=limit)}
+        )
 
     def get_pprof(self, path="", params=None, **kw):
         """/debug/pprof with net/http/pprof semantics (handler.go:99):
@@ -461,7 +522,7 @@ class Handler:
 
     # -- query (handler.go:179-243) ----------------------------------------
 
-    def post_query(self, index=None, params=None, body=b"", headers=None, deadline=None, **kw):
+    def post_query(self, index=None, params=None, body=b"", headers=None, deadline=None, span=None, **kw):
         headers = headers or {}
         params = params or {}
         if self._sends_protobuf(headers):
@@ -482,7 +543,8 @@ class Handler:
         no_cache = (headers.get(qcache_mod.NO_CACHE_HEADER.lower(), "") or "").strip().lower() in (
             "1", "true", "yes"
         )
-        opt = ExecOptions(remote=remote, deadline=deadline, no_cache=no_cache)
+        opt = ExecOptions(remote=remote, deadline=deadline, no_cache=no_cache,
+                          span=span)
         try:
             results = self.executor.execute(index, query_str, slices=slices, opt=opt)
         except qos.DeadlineExceeded:

@@ -93,6 +93,13 @@ class Server:
             not in ("0", "false", "no"),
         )
         self.broadcaster, self.receiver = self._build_broadcast()
+        # Request-scoped span tracer ([trace] sample-rate / slow-ms /
+        # ring).  Always constructed: the zero-rate default costs one
+        # header lookup per request and keeps the X-Pilosa-Trace force
+        # override (and the slow-query log, when slow-ms is set) live.
+        from pilosa_tpu import trace as trace_mod
+
+        self.tracer = trace_mod.from_config(self.config, stats=stats)
         from pilosa_tpu.qos import CLASS_ADMIN, CLASS_READ, CLASS_WRITE, AdmissionController
 
         self.admission = AdmissionController(
@@ -115,8 +122,11 @@ class Server:
             client_factory=self.client_factory,
             admission=self.admission,
             default_deadline_ms=self.config.default_deadline_ms,
+            tracer=self.tracer,
         )
-        self.syncer = HolderSyncer(self.holder, self.cluster, self.host, self.client_factory)
+        self.syncer = HolderSyncer(
+            self.holder, self.cluster, self.host, self.client_factory, stats=stats
+        )
 
         self._httpd = None
         self._closing = threading.Event()

@@ -139,7 +139,9 @@ class ExpvarStatsClient:
             for name, vals in self._histograms.items():
                 if vals:
                     # count/min/max are exact totals; the percentiles
-                    # read the bounded reservoir.
+                    # (p50/p95/p99 — the dashboard set, so consumers of
+                    # e.g. qos.latency_ms.<class> never re-derive them
+                    # from raw samples) read the bounded reservoir.
                     n_total, lo, hi = self._hist_meta[name]
                     s = sorted(vals)
                     out[name] = {
@@ -147,6 +149,7 @@ class ExpvarStatsClient:
                         "min": lo,
                         "max": hi,
                         "p50": s[len(s) // 2],
+                        "p95": s[min(len(s) - 1, int(len(s) * 0.95))],
                         "p99": s[min(len(s) - 1, int(len(s) * 0.99))],
                     }
             for name, vals in self._timings.items():

@@ -6,21 +6,42 @@ peer; for every frame: sync row attrs; for every view/owned slice:
 compare per-block checksums against replica peers, pull differing blocks,
 majority-vote merge (fragment.merge_block), and push set/clear diffs back
 to each peer as SetBit/ClearBit PQL.
+
+Peer failures during a sync pass are SKIPPED (a dead replica must not
+break anti-entropy for the live pair) but never silently: every skip
+counts ``syncer.peer_errors`` (tagged ``node:<host>``) and updates the
+``syncer.last_peer_error`` string at /debug/vars, so a steady anti-
+entropy stall (bad peer address, auth wall, wedged node) is visible on
+a dashboard instead of only as slowly diverging replicas.
 """
 
 from __future__ import annotations
 
 
-
 class HolderSyncer:
-    def __init__(self, holder, cluster, host: str, client_factory):
+    def __init__(self, holder, cluster, host: str, client_factory, stats=None):
+        from pilosa_tpu.stats import NOP_STATS
+
         self.holder = holder
         self.cluster = cluster
         self.host = host
         self.client_factory = client_factory
+        self.stats = stats if stats is not None else NOP_STATS
+        # Process-lifetime totals (tests, embedders without an expvar
+        # sink); the tagged per-node counters live in the stats client.
+        self.stat_peer_errors = 0
+        self.last_peer_error = ""
 
     def _peers(self):
         return [n for n in self.cluster.nodes if n.host != self.host]
+
+    def _note_peer_error(self, host: str, where: str, e: BaseException) -> None:
+        """One skipped peer interaction: count it (node-tagged) and keep
+        the last error string visible at /debug/vars."""
+        self.stat_peer_errors += 1
+        self.last_peer_error = f"{host} {where}: {e}"
+        self.stats.with_tags(f"node:{host}").count("syncer.peer_errors")
+        self.stats.set("syncer.last_peer_error", self.last_peer_error)
 
     # -- attrs (holder.go:385-470) ----------------------------------------
 
@@ -32,7 +53,8 @@ class HolderSyncer:
             client = self.client_factory(node.host)
             try:
                 missing = client.column_attr_diff(index_name, idx.column_attr_store.blocks())
-            except Exception:
+            except Exception as e:  # noqa: BLE001 — skip the peer, visibly
+                self._note_peer_error(node.host, "column-attr diff", e)
                 continue
             for id, attrs in missing.items():
                 idx.column_attr_store.set_attrs(id, attrs)
@@ -45,7 +67,8 @@ class HolderSyncer:
             client = self.client_factory(node.host)
             try:
                 missing = client.row_attr_diff(index_name, frame_name, frame.row_attr_store.blocks())
-            except Exception:
+            except Exception as e:  # noqa: BLE001 — skip the peer, visibly
+                self._note_peer_error(node.host, "row-attr diff", e)
                 continue
             for id, attrs in missing.items():
                 frame.row_attr_store.set_attrs(id, attrs)
@@ -70,7 +93,8 @@ class HolderSyncer:
                 peer_blocks.append(
                     (node, dict(client.fragment_blocks(index_name, frame_name, view_name, slice_i)))
                 )
-            except Exception:
+            except Exception as e:  # noqa: BLE001 — skip the peer, visibly
+                self._note_peer_error(node.host, "fragment blocks", e)
                 continue
 
         # Blocks differing on any replica (or missing somewhere).
@@ -93,7 +117,8 @@ class HolderSyncer:
                         client.block_data(index_name, frame_name, view_name, slice_i, bid)
                     )
                     nodes.append(node)
-                except Exception:
+                except Exception as e:  # noqa: BLE001 — skip the peer, visibly
+                    self._note_peer_error(node.host, "block data", e)
                     continue
             diffs = frag.merge_block(bid, pair_sets)
             # Push each peer its converging diff straight at the fragment
@@ -114,7 +139,8 @@ class HolderSyncer:
                         (set_rows.tolist(), set_cols.tolist()),
                         (clear_rows.tolist(), clear_cols.tolist()),
                     )
-                except Exception:
+                except Exception as e:  # noqa: BLE001 — skip the peer, visibly
+                    self._note_peer_error(node.host, "block-diff push", e)
                     continue
 
     # -- full pass (holder.go:364-384) --------------------------------------

@@ -63,6 +63,10 @@ run BENCH_CONFIG=lockstep_coalesce BENCH_THREADS=32
 run BENCH_CONFIG=qcache
 run BENCH_CONFIG=qcache BENCH_QUERY_POOL=512 BENCH_ZIPF_S=1.3
 run BENCH_CONFIG=qcache BENCH_ZIPF_S=0.0
+#    Tracing on/off A/B rides the qcache config (trace_overhead /
+#    trace_ok in the qcache_on tier): head sampling at 0.01 must stay
+#    within 5% of tracing disabled — bigger loop for a tighter bound.
+run BENCH_CONFIG=qcache BENCH_TRACE_ITERS=40000
 # 10) Request-lifecycle QoS under overload: a real HTTP server at 2x door
 #    capacity, QoS on (bounded admission + deadlines; shed 429s, p99 near
 #    presat) vs off (unbounded; p99 degrades with the queue).  The second

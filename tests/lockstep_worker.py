@@ -149,6 +149,28 @@ def main() -> int:
                 "qcache_hits": getattr(svc.executor.qcache, "hits", -1),
                 "qcache_misses": getattr(svc.executor.qcache, "misses", -1),
                 "qcache_stores": getattr(svc.executor.qcache, "stores", -1),
+                # Tracing telemetry (PILOSA_TPU_TRACE_SAMPLE_RATE): the
+                # sampling decision is made on rank 0 at ship time and
+                # rides the batch entry — every rank counts the SAME
+                # wire flags, so stat_traced must agree across ranks.
+                "traced": svc.stat_traced,
+                # Rank 0 records ship/execute phases into its ring.
+                "trace_ring": (
+                    len(svc.tracer.traces_json(limit=10000))
+                    if svc.tracer is not None
+                    else 0
+                ),
+                "trace_phases": sorted(
+                    {
+                        c["name"]
+                        for t in (
+                            svc.tracer.traces_json(limit=10000)
+                            if svc.tracer is not None
+                            else []
+                        )
+                        for c in t["spans"].get("children", [])
+                    }
+                ),
             }
         ),
         flush=True,

@@ -115,6 +115,11 @@ def test_bench_qcache_emits_json():
     # (the bench itself asserts them; the fields record it).
     assert all(t["rw_ok"] and t["gate_ok"] for t in result["tiers"])
     assert all(t["ms_per_request"] > 0 for t in result["tiers"])
+    # Tracing overhead guard ran in-run: head sampling at 0.01 must
+    # cost <= 5% vs tracing disabled (the bench asserts; the fields
+    # record the measured ratio).
+    assert by["qcache_on"]["trace_ok"] is True
+    assert "trace_overhead" in by["qcache_on"]
 
 
 def test_bench_overload_emits_json():

@@ -497,6 +497,38 @@ def test_lockstep_qcache_identical_hit_miss_on_all_ranks():
     assert by_pid[0]["probe"] == by_pid[1]["probe"] == 9
 
 
+def test_lockstep_trace_sampling_decided_on_rank0():
+    """Request tracing under lockstep (PILOSA_TPU_TRACE_SAMPLE_RATE=1):
+    the sampling decision is made ONCE on rank 0 at ship time and rides
+    the batch wire entry as a per-request ``trace`` flag — every rank
+    counts the SAME flags (never its own RNG), so the ranks agree on
+    exactly which requests were sampled.  Rank 0 records each traced
+    request's phases (queue/ship/execute — ship covers the worker
+    fan-out + receipt-ack barrier) into its tracer ring; workers record
+    nothing (tracing never changes execution)."""
+    job = _LockstepJob(2, env_extra={"PILOSA_TPU_TRACE_SAMPLE_RATE": "1"})
+    try:
+        job.wait_ready()
+        q = 'Count(Bitmap(rowID=0, frame="f"))'
+        n = 6
+        for i in range(n - 1):
+            assert job.query(q)["results"] == [8]
+        # The force-header path composes: still one ship-time decision.
+        assert job.query(q, headers={"X-Pilosa-Trace": "1"})["results"] == [8]
+        outs = job.shutdown_and_collect()
+    finally:
+        job.cleanup()
+    by_pid = {o["pid"]: o for o in outs}
+    # Every rank observed the same sampling decisions off the wire.
+    assert by_pid[0]["traced"] == by_pid[1]["traced"] == n
+    # Only rank 0 recorded spans, with the lockstep phases present.
+    assert by_pid[0]["trace_ring"] == n
+    assert by_pid[1]["trace_ring"] == 0
+    assert {"lockstep.queue", "lockstep.ship", "lockstep.execute"} <= set(
+        by_pid[0]["trace_phases"]
+    )
+
+
 def test_lockstep_worker_death_mid_stream():
     """A worker rank SIGKILLed MID-REQUEST-STREAM: the in-flight or next
     request errors, every subsequent request is refused (the service

@@ -170,10 +170,12 @@ def test_expvar_histogram_reservoir_bounded():
     assert len(c._timings["t"]) == RESERVOIR_CAP
     snap = c.snapshot()
     h = snap["lat"]
-    assert set(h) == {"count", "min", "max", "p50", "p99"}
+    assert set(h) == {"count", "min", "max", "p50", "p95", "p99"}
     assert h["count"] == n and h["min"] == 0.0 and h["max"] == float(n - 1)
-    # Percentiles come from a uniform sample of the full stream.
+    # Percentiles come from a uniform sample of the full stream —
+    # pre-computed (p50/p95/p99) so dashboards never re-derive them.
     assert 0.3 * n < h["p50"] < 0.7 * n
+    assert 0.85 * n < h["p95"] <= h["p99"]
     assert h["p99"] > 0.9 * n
     # Timing average is exact (running sum), not reservoir-estimated.
     assert snap["t.avg_ms"] == pytest.approx((n - 1) / 2 * 1000)

@@ -282,6 +282,162 @@ def test_two_node_cluster_qcache_invalidation(tmp_path):
             s.close()
 
 
+def test_debug_traces_and_slow_query_log(tmp_path):
+    """Tracing end to end through a real server: sampled requests land
+    in /debug/traces (newest-first, min-ms filterable) with executor
+    stage spans, requests past [trace] slow-ms emit one structured
+    slow-query log line, and the X-Pilosa-Trace force override samples
+    even at rate 0."""
+    import logging
+
+    s = make_server(
+        tmp_path, name="tr0",
+        trace_sample_rate=1.0, trace_slow_ms=0.0001, qcache_min_cost_ms=0.0,
+    )
+    records = []
+    h = logging.Handler()
+    h.emit = lambda rec: records.append(rec.getMessage())
+    logging.getLogger("pilosa_tpu.slowquery").addHandler(h)
+    try:
+        c = Client(s.host)
+        c.create_index("i")
+        c.create_frame("i", "f")
+        c.execute_query("i", 'SetBit(rowID=1, frame="f", columnID=3)')
+        q = 'Count(Bitmap(rowID=1, frame="f"))'
+        c.execute_query("i", q)  # miss
+        c.execute_query("i", q)  # hit
+
+        with urllib.request.urlopen(f"http://{s.host}/debug/traces", timeout=30) as r:
+            traces = json.loads(r.read())["traces"]
+        assert traces, "sampled requests never reached the ring"
+        # Newest-first: the LAST query (the cache hit) leads.
+        query_traces = [t for t in traces if t["name"].endswith("/index/i/query")]
+        assert len(query_traces) >= 3
+        hit = query_traces[0]
+        assert hit["ms"] > 0 and hit["spans"]["tags"]["status"] == 200
+        assert hit["spans"]["tags"]["qcache"] == "hit"
+        names = [c_["name"] for c_ in hit["spans"]["children"]]
+        assert "qos.admit" in names and "qcache.lookup" in names
+        # The miss before it carried the execution stages.
+        miss = query_traces[1]
+        assert miss["spans"]["tags"]["qcache"] == "miss"
+        # min-ms filter: an impossible floor returns nothing.
+        with urllib.request.urlopen(
+            f"http://{s.host}/debug/traces?min-ms=1e9", timeout=30
+        ) as r:
+            assert json.loads(r.read())["traces"] == []
+
+        # Slow-query log: slow-ms is microscopic, so every request
+        # logged — structured JSON with fingerprint + stage breakdown.
+        assert records, "no slow-query log lines emitted"
+        recs = [json.loads(r.split("slow-query ", 1)[1]) for r in records]
+        qrecs = [r for r in recs if r["name"].endswith("/index/i/query")]
+        assert qrecs, recs
+        rec = qrecs[-1]
+        assert rec["ms"] > 0 and rec["fp"] and rec["trace_id"]
+        assert "Count(" in rec["snippet"]
+        # The miss's breakdown attributed the execution stages.
+        miss_rec = next(r for r in qrecs if r["tags"].get("qcache") == "miss")
+        assert "call.Count" in miss_rec["stages"] or "fused" in miss_rec["stages"]
+
+        # Force override: a zero-rate tracer still samples on demand.
+        s.tracer.sample_rate = 0.0
+        before = len(s.tracer.traces_json(limit=1000))
+        req = urllib.request.Request(
+            f"http://{s.host}/index/i/query", data=q.encode(), method="POST"
+        )
+        urllib.request.urlopen(req, timeout=30).read()  # unsampled
+        req.add_header("X-Pilosa-Trace", "1")
+        urllib.request.urlopen(req, timeout=30).read()  # forced
+        after = s.tracer.traces_json(limit=1000)
+        # The unsampled request appears only if slow (root-only); the
+        # forced one definitely appears with forced=True.
+        assert any(t["forced"] for t in after[: len(after) - before])
+        # /debug/vars carries the tracer counters.
+        snap = json.loads(
+            urllib.request.urlopen(f"http://{s.host}/debug/vars", timeout=30).read()
+        )
+        assert snap.get("trace.sampled", 0) >= 3
+        assert snap.get("trace.slow", 0) >= 1
+    finally:
+        logging.getLogger("pilosa_tpu.slowquery").removeHandler(h)
+        s.close()
+
+
+def test_two_node_cluster_trace_remote_subspans(tmp_path):
+    """Cross-node propagation: a force-traced coordinator query fans out
+    to the peer with the trace id in X-Pilosa-Trace; the peer's span
+    tree comes back in X-Pilosa-Trace-Spans and lands grafted under the
+    coordinator's remote span — ONE trace shows both sides of the hop."""
+    import socket
+
+    def free_port():
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    hosts = [f"127.0.0.1:{free_port()}" for _ in range(2)]
+    servers = []
+    for i, h in enumerate(hosts):
+        cfg = Config(
+            data_dir=str(tmp_path / f"n{i}"),
+            host=h,
+            engine="numpy",
+            cluster=ClusterConfig(type="static", hosts=list(hosts)),
+        )
+        s = Server(cfg)
+        s.open()
+        servers.append(s)
+    try:
+        c0 = Client(hosts[0])
+        for c in (c0, Client(hosts[1])):
+            c.create_index("i")
+            c.create_frame("i", "f")
+        bits = [(1, s * SLICE_WIDTH + 7) for s in range(4)]
+        cluster = servers[0].cluster
+        c0.import_bits("i", "f", bits, fragment_nodes=cluster.fragment_nodes)
+        servers[0]._monitor_max_slices()
+        servers[1]._monitor_max_slices()
+
+        req = urllib.request.Request(
+            f"http://{hosts[0]}/index/i/query",
+            data=b'Count(Bitmap(rowID=1, frame="f"))',
+            method="POST",
+        )
+        req.add_header("X-Pilosa-Trace", "1")
+        resp = urllib.request.urlopen(req, timeout=60)
+        assert json.loads(resp.read())["results"] == [4]
+        # The coordinator returned its own span tree too (propagation).
+        assert resp.headers.get("X-Pilosa-Trace-Spans")
+
+        traces = servers[0].tracer.traces_json(limit=10)
+        tr = next(t for t in traces if t["name"].endswith("/index/i/query"))
+
+        def walk(span, out):
+            out.append(span)
+            for ch in span.get("children", []):
+                walk(ch, out)
+            return out
+
+        spans = walk(tr["spans"], [])
+        remotes = [sp for sp in spans if sp["name"] == "remote"]
+        assert remotes, f"no remote hop span in {tr}"
+        assert remotes[0]["tags"]["host"] == hosts[1]
+        # The peer's own root span (its handler door) was grafted under
+        # the hop — with the same trace id having forced it.
+        peer_roots = [
+            sp for sp in spans if sp["name"].startswith("POST /index/i/query")
+            and sp is not tr["spans"]
+        ]
+        assert peer_roots, f"peer sub-spans missing from {tr}"
+        # And the peer recorded the hop under the SAME trace id.
+        peer_traces = servers[1].tracer.traces_json(limit=10)
+        assert any(t["id"] == tr["id"] for t in peer_traces)
+    finally:
+        for s in servers:
+            s.close()
+
+
 def test_webui_served_to_browsers(srv):
     """`/` serves the console to Accept: text/html clients and the plain
     banner to API clients; /assets/* serves the bundle (handler.go:132-145)."""

@@ -116,14 +116,49 @@ def test_three_node_majority_vote(three_replicated_nodes):
 
 def test_sync_survives_down_peer(two_replicated_nodes):
     """A dead replica must not break anti-entropy for the live pair
-    (executor.go:1147-1159-style degradation: skip, don't crash)."""
+    (executor.go:1147-1159-style degradation: skip, don't crash) — but
+    the skips must be VISIBLE: syncer.peer_errors counts per node and
+    the last error string lands at /debug/vars, so a silent anti-entropy
+    stall shows on a dashboard instead of only as diverging replicas."""
     s0, s1 = two_replicated_nodes
     c0 = Client(s0.host)
     for c in (c0, Client(s1.host)):
         c.create_index("i")
         c.create_frame("i", "f")
     c0.execute_query("i", 'SetBit(rowID=5, frame="f", columnID=77)', remote=True)
+    assert s0.syncer.stat_peer_errors == 0
     s1.close()  # peer goes dark
     s0.syncer.sync_holder()  # must not raise
     r = c0.execute_query("i", 'Bitmap(rowID=5, frame="f")', remote=True)
     assert r["results"][0]["bitmap"]["bits"] == [77]
+    # Every swallowed peer failure was counted, node-tagged, with the
+    # last error string kept.
+    assert s0.syncer.stat_peer_errors > 0
+    assert s1.host in s0.syncer.last_peer_error
+    snap = s0.stats.snapshot()
+    key = f"syncer.peer_errors[node:{s1.host}]"
+    assert snap.get(key, 0) == s0.syncer.stat_peer_errors
+    assert s1.host in snap.get("syncer.last_peer_error", "")
+
+
+def test_syncer_counts_errors_without_stats_client(tmp_path):
+    """Directly-constructed syncers (no stats sink) still count — the
+    NOP stats coercion keeps emission sites guard-free."""
+    from pilosa_tpu.cluster import Cluster, Node
+    from pilosa_tpu.core.holder import Holder
+    from pilosa_tpu.server.client import Client as _Client
+    from pilosa_tpu.syncer import HolderSyncer
+
+    h = Holder(str(tmp_path / "d"))
+    h.open()
+    h.create_index("i")
+    cluster = Cluster(
+        nodes=[Node(host="127.0.0.1:1"), Node(host="127.0.0.1:9")], replica_n=2
+    )
+    sy = HolderSyncer(
+        h, cluster, "127.0.0.1:1", lambda host: _Client(host, timeout=0.2)
+    )
+    sy.sync_index_attrs("i")  # dead peer: swallowed, counted
+    assert sy.stat_peer_errors == 1
+    assert "127.0.0.1:9" in sy.last_peer_error
+    h.close()

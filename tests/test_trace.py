@@ -1,0 +1,401 @@
+"""Request-tracing tests: span trees, head sampling, the slow-query
+log, wire propagation, executor integration, and the client-retry
+satellites (one retry = one trace identity; deadline expiry during
+backoff aborts the retry).
+
+End-to-end HTTP coverage (/debug/traces, slow log through a real
+server, two-node remote sub-spans) lives in test_server.py; the 2-rank
+lockstep sampling-determinism test lives in test_multihost.py.
+"""
+
+import json
+import logging
+import time
+
+import pytest
+
+from pilosa_tpu.config import Config
+from pilosa_tpu.trace import (
+    TRACE_HEADER,
+    TRACE_SPANS_HEADER,
+    Span,
+    Trace,
+    Tracer,
+    fingerprint,
+)
+
+
+# -- spans --------------------------------------------------------------------
+
+
+def test_span_tree_offsets_and_tags():
+    root = Span("root", trace_id="t1")
+    a = root.child("parse")
+    a.finish()
+    b = root.child("call.Count").annotate(slices=4)
+    b.finish()
+    root.finish()
+    assert a.trace_id == "t1"  # children inherit the trace identity
+    js = root.to_json()
+    assert js["name"] == "root" and js["ms"] >= 0
+    names = [c["name"] for c in js["children"]]
+    assert names == ["parse", "call.Count"]
+    assert js["children"][1]["tags"] == {"slices": 4}
+    # Offsets are relative to the root's own start — no wall clock.
+    assert all(c["start_ms"] >= 0 for c in js["children"])
+
+
+def test_span_finish_idempotent_and_unfinished_serializes():
+    sp = Span("x")
+    sp.finish()
+    ms1 = sp.ms
+    time.sleep(0.002)
+    sp.finish()
+    assert sp.ms == ms1  # idempotent
+    live = Span("still-running")
+    js = live.to_json()
+    assert js["ms"] >= 0  # measured at serialization, not an error
+
+
+def test_span_graft_keeps_remote_payload_verbatim():
+    root = Span("root")
+    remote = root.child("remote")
+    payload = [{"name": "POST /index/i/query", "start_ms": 0.0, "ms": 3.2,
+                "children": [{"name": "parse", "start_ms": 0.1, "ms": 0.2}]}]
+    remote.graft(payload)
+    remote.finish()
+    js = root.to_json()
+    grafted = js["children"][0]["children"][0]
+    assert grafted["name"] == "POST /index/i/query"
+    assert grafted["children"][0]["name"] == "parse"
+
+
+def test_stage_breakdown_sums_duplicate_names():
+    root = Span("root")
+    for ms in (1.0, 2.0):
+        c = root.child("slice_chunk")
+        c.ms = ms
+    c = root.child("parse")
+    c.ms = 0.5
+    bd = root.stage_breakdown()
+    assert bd == {"slice_chunk": 3.0, "parse": 0.5}
+
+
+# -- sampling -----------------------------------------------------------------
+
+
+def test_head_sampling_rate_zero_only_forced():
+    t = Tracer(sample_rate=0.0)
+    assert t.begin({}) is None  # never sampled
+    tr = t.begin({TRACE_HEADER.lower(): "1"})
+    assert tr is not None and tr.forced and tr.propagate
+    # A bare override gets a fresh id; a propagated id is adopted.
+    assert len(tr.id) == 16
+    tr2 = t.begin({TRACE_HEADER.lower(): "abc123def"})
+    assert tr2.id == "abc123def"
+
+
+def test_head_sampling_rate_one_and_decide():
+    t = Tracer(sample_rate=1.0)
+    tr = t.begin({})
+    assert tr is not None and not tr.forced and not tr.propagate
+    assert t.decide() is True
+    t0 = Tracer(sample_rate=0.0)
+    assert t0.decide() is False and t0.decide(force=True) is True
+
+
+def test_ring_bounded_newest_first_min_ms_filter():
+    t = Tracer(sample_rate=1.0, ring=4)
+    for i in range(8):
+        tr = Trace(f"q{i}")
+        tr.root.ms = float(i)
+        t.record(tr)
+    snap = t.traces_json()
+    assert len(snap) == 4  # bounded
+    assert [e["name"] for e in snap] == ["q7", "q6", "q5", "q4"]  # newest-first
+    assert [e["name"] for e in t.traces_json(min_ms=6.0)] == ["q7", "q6"]
+    assert len(t.traces_json(limit=1)) == 1
+
+
+# -- slow-query log -----------------------------------------------------------
+
+
+def test_slow_request_bypasses_sampling_and_logs(caplog):
+    t = Tracer(sample_rate=0.0, slow_ms=5.0)
+    # Fast + unsampled: nothing recorded, nothing logged.
+    assert t.finish_request(None, name="POST /q", dt_ms=1.0, body=b"x") is None
+    assert len(t) == 0
+    with caplog.at_level(logging.WARNING, logger="pilosa_tpu.slowquery"):
+        t.finish_request(None, name="POST /q", dt_ms=72.0,
+                         body=b'Count(Bitmap(rowID=1, frame="f"))')
+    assert len(t) == 1 and t.stat_slow == 1
+    entry = t.traces_json()[0]
+    assert entry["slow"] and entry["ms"] == 72.0
+    assert entry["spans"]["tags"]["unsampled"] is True
+    rec = json.loads(caplog.records[-1].message.split("slow-query ", 1)[1])
+    assert rec["ms"] == 72.0 and rec["fp"] and "Count(" in rec["snippet"]
+
+
+def test_slow_sampled_trace_logs_stage_breakdown(caplog):
+    t = Tracer(sample_rate=1.0, slow_ms=1.0)
+    tr = t.begin({}, name="POST /q")
+    tr.root.tags["qcache"] = "miss"
+    sp = tr.root.child("parse")
+    sp.ms = 0.4
+    sp = tr.root.child("call.Count")
+    sp.ms = 9.0
+    with caplog.at_level(logging.WARNING, logger="pilosa_tpu.slowquery"):
+        t.finish_request(tr, name="POST /q", dt_ms=10.0, body=b"Count(...)")
+    rec = json.loads(caplog.records[-1].message.split("slow-query ", 1)[1])
+    assert rec["stages"] == {"parse": 0.4, "call.Count": 9.0}
+    assert rec["tags"]["qcache"] == "miss"  # cache disposition surfaced
+
+
+def test_propagate_returns_header_and_truncates_oversize():
+    t = Tracer(sample_rate=0.0)
+    tr = t.begin({TRACE_HEADER.lower(): "deadbeef"}, name="POST /q")
+    extra = t.finish_request(tr, name="POST /q", dt_ms=1.0)
+    payload = json.loads(extra[TRACE_SPANS_HEADER])
+    assert payload[0]["name"] == "POST /q"
+    # Oversize trees degrade to the root rather than breaking the header.
+    tr2 = t.begin({TRACE_HEADER.lower(): "deadbeef"}, name="POST /q")
+    for i in range(3000):
+        tr2.root.child(f"span-{i}").finish()
+    extra2 = t.finish_request(tr2, name="POST /q", dt_ms=1.0)
+    raw = extra2[TRACE_SPANS_HEADER]
+    assert len(raw) < 32000
+    slim = json.loads(raw)[0]
+    assert slim.get("truncated") and "children" not in slim
+
+
+def test_fingerprint_stable_and_bounded():
+    a = fingerprint(b"Count(Bitmap(rowID=1))" * 100)
+    b = fingerprint(b"Count(Bitmap(rowID=1))" * 100)
+    assert a == b and len(a["snippet"]) <= 120 and len(a["fp"]) == 12
+    assert fingerprint(b"") == {"fp": "", "snippet": ""}
+
+
+# -- config promotion ---------------------------------------------------------
+
+
+def test_config_trace_toml_and_env(tmp_path):
+    toml = tmp_path / "c.toml"
+    toml.write_text(
+        """
+[trace]
+  sample-rate = 0.25
+  slow-ms = 150.0
+  ring = 64
+"""
+    )
+    cfg = Config.from_toml(str(toml))
+    assert cfg.trace_sample_rate == 0.25
+    assert cfg.trace_slow_ms == 150.0
+    assert cfg.trace_ring == 64
+    cfg.apply_env({
+        "PILOSA_TPU_TRACE_SAMPLE_RATE": "0.5",
+        "PILOSA_TPU_TRACE_SLOW_MS": "75",
+        "PILOSA_TPU_TRACE_RING": "32",
+    })
+    assert cfg.trace_sample_rate == 0.5
+    assert cfg.trace_slow_ms == 75.0
+    assert cfg.trace_ring == 32
+    # Defaults: tracing off (only the force header samples).
+    assert Config().trace_sample_rate == 0.0 and Config().trace_slow_ms == 0.0
+
+
+# -- executor integration -----------------------------------------------------
+
+
+@pytest.fixture
+def holder(tmp_path):
+    from pilosa_tpu.core.frame import FrameOptions
+    from pilosa_tpu.core.holder import Holder
+
+    h = Holder(str(tmp_path / "d"))
+    h.open()
+    idx = h.create_index("i")
+    idx.create_frame("f", FrameOptions())
+    fr = idx.frame("f")
+    for r in range(3):
+        for c in range(r, 30 + r):
+            fr.set_bit("standard", r, c)
+    yield h
+    h.close()
+
+
+def test_executor_spans_sequential_path(holder):
+    from pilosa_tpu.executor import ExecOptions, Executor
+
+    ex = Executor(holder, engine="numpy")
+    root = Span("root")
+    res = ex.execute("i", 'TopN(frame="f", n=2) Bitmap(rowID=1, frame="f")',
+                     opt=ExecOptions(span=root))
+    assert len(res) == 2
+    names = [c.name for c in root.children]
+    assert "parse" in names
+    assert "call.TopN" in names and "call.Bitmap" in names
+    # Fan-out spans nest under the calls.
+    topn = next(c for c in root.children if c.name == "call.TopN")
+    assert any(c.name in ("slices", "slice_chunk") for c in topn.children)
+    assert all(c.ms is not None for c in root.children)
+
+
+def test_executor_spans_fused_and_lanes(holder):
+    import os
+
+    from pilosa_tpu.executor import ExecOptions, Executor
+
+    os.environ["PILOSA_TPU_NO_FASTLANE"] = "1"  # land in the AST fused lane
+    try:
+        ex = Executor(holder, engine="numpy")
+        root = Span("root")
+        q = ('Count(Intersect(Bitmap(rowID=0, frame="f"), Bitmap(rowID=1, frame="f"))) '
+             'Count(Union(Bitmap(rowID=1, frame="f"), Bitmap(rowID=2, frame="f")))')
+        ex.execute("i", q, opt=ExecOptions(span=root))
+        assert root.tags.get("lane") == "fused"
+        fsp = next(c for c in root.children if c.name == "fused")
+        assert fsp.tags["calls"] == 2 and fsp.tags["slices"] >= 1
+    finally:
+        del os.environ["PILOSA_TPU_NO_FASTLANE"]
+    # Fast lanes tag without span children (single-branch sites).
+    ex2 = Executor(holder, engine="numpy")
+    root2 = Span("root")
+    ex2.execute("i", 'SetBit(rowID=9, frame="f", columnID=3)',
+                opt=ExecOptions(span=root2))
+    assert root2.tags.get("lane") == "write_fast"
+
+
+def test_executor_qcache_span_outcomes(holder):
+    from pilosa_tpu.executor import ExecOptions, Executor
+    from pilosa_tpu.qcache import QueryCache
+
+    ex = Executor(holder, engine="numpy", qcache=QueryCache(min_cost_ms=0.0))
+    q = 'Count(Bitmap(rowID=1, frame="f"))'
+    r1 = Span("r1")
+    ex.execute("i", q, opt=ExecOptions(span=r1))
+    assert r1.tags["qcache"] == "miss"
+    r2 = Span("r2")
+    ex.execute("i", q, opt=ExecOptions(span=r2))
+    assert r2.tags["qcache"] == "hit"
+    assert any(c.name == "qcache.lookup" for c in r2.children)
+    r3 = Span("r3")
+    ex.execute("i", q, opt=ExecOptions(span=r3, no_cache=True))
+    assert r3.tags["qcache"] == "bypass"
+
+
+def test_executor_untraced_requests_build_no_spans(holder):
+    """The off path: no span objects anywhere (opt.span=None and the
+    default ExecOptions) — guard against accidental always-on costs."""
+    from pilosa_tpu.executor import ExecOptions, Executor
+
+    ex = Executor(holder, engine="numpy")
+    opt = ExecOptions()
+    assert opt.span is None
+    res = ex.execute("i", 'Count(Bitmap(rowID=1, frame="f"))', opt=opt)
+    assert res and opt.span is None
+
+
+# -- client satellites: retry keeps ONE trace/request identity ----------------
+
+
+class _StubHTTP:
+    """Minimal scripted HTTP stub (same shape as test_qos's)."""
+
+    def __init__(self, script):
+        import http.server
+        import threading
+
+        self.requests = []
+        stub = self
+
+        class H(http.server.BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def _serve(self):
+                n = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(n) if n else b""
+                stub.requests.append(
+                    {"path": self.path, "headers": dict(self.headers), "body": body}
+                )
+                status, headers, payload = (
+                    script[min(len(stub.requests), len(script)) - 1]
+                )
+                self.send_response(status)
+                for k, v in headers.items():
+                    self.send_header(k, v)
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            do_GET = do_POST = _serve
+
+            def log_message(self, *a):
+                pass
+
+        self.httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self.host = f"127.0.0.1:{self.httpd.server_address[1]}"
+        t = threading.Thread(target=self.httpd.serve_forever, daemon=True)
+        t.start()
+
+    def close(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def test_client_retry_reuses_trace_identity():
+    """One capped Retry-After retry must reuse the SAME trace/request
+    identity: the retried attempt carries the identical X-Pilosa-Trace
+    id, and the hop span grafts exactly ONE peer payload — never a
+    duplicate root span per attempt."""
+    from pilosa_tpu import wire
+    from pilosa_tpu.server.client import Client
+
+    ok = wire.encode_query_response(results=[1])
+    peer_spans = json.dumps([{"name": "POST /index/i/query", "start_ms": 0.0,
+                              "ms": 1.5}])
+    stub = _StubHTTP([
+        (429, {"Retry-After": "0.05", "Content-Type": "application/json"},
+         b'{"error": "shed"}'),
+        (200, {"Content-Type": "application/x-protobuf",
+               TRACE_SPANS_HEADER: peer_spans}, ok),
+    ])
+    try:
+        c = Client(stub.host)
+        hop = Span("remote", trace_id="feedface12345678")
+        resp = c.execute_query("i", "Count(Bitmap(rowID=1))", trace_span=hop)
+        assert resp["results"]
+        assert len(stub.requests) == 2  # one retry happened
+        ids = [r["headers"].get(TRACE_HEADER) for r in stub.requests]
+        assert ids == ["feedface12345678", "feedface12345678"]  # same identity
+        # Exactly one grafted peer payload (from the final response).
+        assert len(hop.children) == 1
+        assert hop.children[0]["name"] == "POST /index/i/query"
+    finally:
+        stub.close()
+
+
+def test_client_deadline_expiry_during_backoff_aborts_retry():
+    """Deadline expiry during the Retry-After backoff must abort the
+    retry: the client returns the shed answer after ONE attempt instead
+    of sleeping past the budget."""
+    from pilosa_tpu.qos import Deadline
+    from pilosa_tpu.server.client import Client, ClientError
+
+    stub = _StubHTTP([
+        (429, {"Retry-After": "1.5"}, b'{"error": "shed"}'),
+        (200, {}, b"never reached"),
+    ])
+    try:
+        c = Client(stub.host)
+        t0 = time.monotonic()
+        with pytest.raises(ClientError) as e:
+            c.execute_query(
+                "i", "Count(Bitmap(rowID=1))", deadline=Deadline(200),
+                trace_span=Span("remote", trace_id="aa"),
+            )
+        assert e.value.status == 429
+        assert len(stub.requests) == 1  # the retry was aborted, not slept
+        assert time.monotonic() - t0 < 1.0
+    finally:
+        stub.close()
