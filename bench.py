@@ -1612,6 +1612,265 @@ def bench_overload() -> dict:
     }
 
 
+def bench_replica() -> dict:
+    """Replicated serving groups tier: N group SUBPROCESSES (each a full
+    Server with its own holder and GIL — the dev-rig analog of one
+    lockstep job per group) behind the ReplicaRouter, read QPS measured
+    at 1 vs 2+ groups plus a router-off direct baseline:
+
+    - ``direct_1g``: clients hit group 0's front door directly (no
+      router) — the per-group ceiling and the router-overhead baseline;
+    - ``router_1g``: the router over ONE group — isolates router cost;
+    - ``router_Ng``: the router over all N groups — read throughput
+      must SCALE with group count (``scaling_1_to_2`` is the headline
+      ratio; acceptance >= 1.6x on the bench host).
+
+    In-run invariants (fields in the router_Ng tier, asserted here):
+    cross-group read-your-writes (a write acked by the router is
+    visible on a direct read of EVERY group, and immediate router reads
+    agree whichever group serves) and failover (killing one group's
+    process leaves reads serving from the survivors while writes refuse
+    503 until the set is quorate).  Groups are separate PROCESSES, so
+    the scaling headline needs physical cores (>= n_groups + 1); a
+    1-cpu box records ~1.0 by construction (the ``cpus`` field says
+    which regime a line measured).  BENCH_SMOKE=1 shrinks the shapes
+    for CI."""
+    import subprocess
+    import sys
+    import tempfile
+    import urllib.error
+    import urllib.request
+    from concurrent.futures import ThreadPoolExecutor
+
+    from pilosa_tpu.server.client import Client
+
+    smoke = os.environ.get("BENCH_SMOKE", "").lower() in ("1", "true", "yes")
+    n_groups = int(os.environ.get("BENCH_GROUPS", "2"))
+    n_clients = int(os.environ.get("BENCH_THREADS", "4" if smoke else "16"))
+    phase_s = float(os.environ.get("BENCH_REPLICA_SECS", "1.2" if smoke else "8"))
+    n_slices = int(os.environ.get("BENCH_SLICES", "2" if smoke else "4"))
+    n_rows = int(os.environ.get("BENCH_ROWS", "8" if smoke else "16"))
+    batch = int(os.environ.get("BENCH_BATCH", "8" if smoke else "32"))
+    bits_per_row = int(os.environ.get("BENCH_BITS_PER_ROW", "500" if smoke else "20000"))
+
+    from pilosa_tpu.pilosa import SLICE_WIDTH
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    worker = os.path.join(repo, "tests", "replica_group_worker.py")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"  # numpy engine; jax must not probe TPUs
+    env["PYTHONPATH"] = repo
+    env.pop("PILOSA_TPU_QCACHE", None)  # measure execution, not cache hits
+
+    queries = []
+    for seed in range(8):
+        prs = np.random.default_rng(seed).integers(0, n_rows, size=(batch, 2))
+        queries.append(" ".join(
+            f'Count(Intersect(Bitmap(rowID={a}, frame="f"), Bitmap(rowID={b}, frame="f")))'
+            for a, b in prs.tolist()
+        ))
+
+    def read_phase(host: str, dur_s: float) -> dict:
+        """Closed-loop read load: each client posts back-to-back."""
+        t_end = time.perf_counter() + dur_s
+
+        def client(i: int) -> tuple[int, int]:
+            served = errors = 0
+            k = i
+            while time.perf_counter() < t_end:
+                q = queries[k % len(queries)]
+                k += 1
+                req = urllib.request.Request(
+                    f"http://{host}/index/r/query", data=q.encode(), method="POST")
+                try:
+                    with urllib.request.urlopen(req, timeout=60) as resp:
+                        resp.read()
+                    served += 1
+                except (urllib.error.URLError, OSError):
+                    errors += 1
+            return served, errors
+
+        t0 = time.perf_counter()
+        with ThreadPoolExecutor(n_clients) as pool:
+            outs = list(pool.map(client, range(n_clients)))
+        dt = time.perf_counter() - t0
+        served = sum(s for s, _ in outs)
+        errors = sum(e for _, e in outs)
+        assert errors == 0, f"read phase saw {errors} transport errors"
+        return {"read_qps": round(served / dt, 1), "served": served,
+                "clients": n_clients}
+
+    def free_port():
+        import socket
+
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        p = s.getsockname()[1]
+        s.close()
+        return p
+
+    errs = [
+        tempfile.NamedTemporaryFile("w+", delete=False) for _ in range(n_groups + 2)
+    ]
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker, f"g{i}"],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE, stderr=errs[i],
+            cwd=repo, env=env, text=True)
+        for i in range(n_groups)
+    ]
+    tiers = []
+    try:
+        hosts = []
+        for p in procs[:n_groups]:
+            line = json.loads(p.stdout.readline())
+            assert line.get("ready"), line
+            hosts.append(line["host"])
+
+        # ROUTERS run as their own processes (the production shape —
+        # `pilosa-tpu replica-router`): the bench process only runs the
+        # closed-loop clients, so the measured scaling is group-side,
+        # not the bench's own GIL.
+        def spawn_router(group_hosts, errfile):
+            port = free_port()
+            p = subprocess.Popen(
+                [sys.executable, "-m", "pilosa_tpu", "replica-router",
+                 "--groups", ",".join(
+                     f"g{i}={h}" for i, h in enumerate(group_hosts)),
+                 "--port", str(port)],
+                stdin=subprocess.PIPE, stdout=subprocess.PIPE, stderr=errfile,
+                cwd=repo, env=env, text=True)
+            line = p.stdout.readline()
+            assert "replica-router" in line, line
+            return p, port
+
+        router_all, all_port = spawn_router(hosts, errs[n_groups])
+        procs.append(router_all)
+        router_one, one_port = spawn_router(hosts[:1], errs[n_groups + 1])
+        procs.append(router_one)
+
+        # Seed THROUGH the router: schema + import fan to every group
+        # (the write path under test is also the loader).
+        rc = Client(f"127.0.0.1:{all_port}")
+        rc.create_index("r")
+        rc.create_frame("r", "f")
+        rng = np.random.default_rng(41)
+        bits = []
+        for r in range(n_rows):
+            for s in range(n_slices):
+                cols = rng.integers(0, SLICE_WIDTH - 4096, size=bits_per_row)
+                bits.extend((r, int(c) + s * SLICE_WIDTH) for c in cols)
+        rc.import_bits("r", "f", bits)
+
+        def direct(host, q):
+            req = urllib.request.Request(
+                f"http://{host}/index/r/query", data=q.encode(), method="POST")
+            with urllib.request.urlopen(req, timeout=60) as resp:
+                return json.loads(resp.read())["results"]
+
+        for h in hosts:  # warm every group's serve lane
+            for q in queries:
+                direct(h, q)
+
+        tiers.append({"tier": "direct_1g", "groups": 1,
+                      **read_phase(hosts[0], phase_s)})
+        tiers.append({"tier": "router_1g", "groups": 1,
+                      **read_phase(f"127.0.0.1:{one_port}", phase_s)})
+
+        # Cross-group read-your-writes, proven through the full-set
+        # router BEFORE its throughput phase: the acked write is on
+        # every group, and immediate router reads agree.
+        probe_q = 'Count(Bitmap(rowID=0, frame="f"))'
+        base = direct(hosts[0], probe_q)[0]
+        rc.execute_query("r", f'SetBit(rowID=0, frame="f", columnID={SLICE_WIDTH - 1})')
+        rw_ok = all(direct(h, probe_q) == [base + 1] for h in hosts)
+        for _ in range(2 * n_groups):  # router reads spread over groups
+            rw_ok = rw_ok and (
+                direct(f"127.0.0.1:{all_port}", probe_q) == [base + 1]
+            )
+        assert rw_ok, "cross-group read-your-writes violated"
+
+        tiers.append({"tier": f"router_{n_groups}g", "groups": n_groups,
+                      **read_phase(f"127.0.0.1:{all_port}", phase_s)})
+
+        # Failover: kill the LAST group's process; reads keep serving
+        # from the survivors, writes refuse 503 until quorate.
+        procs[n_groups - 1].kill()
+        ok_reads = 0
+        for _ in range(10):
+            try:
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{all_port}/index/r/query",
+                    data=probe_q.encode(), method="POST")
+                with urllib.request.urlopen(req, timeout=30) as resp:
+                    resp.read()
+                ok_reads += 1
+            except (urllib.error.URLError, OSError):
+                pass  # at most the probe that trips the health mark
+        write_503 = False
+        try:
+            rc.execute_query("r", 'SetBit(rowID=0, frame="f", columnID=7)')
+        except Exception as e:  # noqa: BLE001 — ClientError carries .status
+            write_503 = getattr(e, "status", None) == 503
+        failover_ok = ok_reads >= 8 and write_503
+        assert failover_ok, (ok_reads, write_503)
+        # Router observability over HTTP (the router runs out-of-process).
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{all_port}/debug/vars", timeout=10
+        ) as resp:
+            snap = json.loads(resp.read())
+        tiers[-1]["rw_ok"] = rw_ok
+        tiers[-1]["failover_ok"] = failover_ok
+        tiers[-1]["failovers"] = snap.get("replica.failover", 0)
+        tiers[-1]["write_fanout"] = snap.get("replica.write_fanout", 0)
+    finally:
+        for p in procs[n_groups:]:  # router processes: no stdin protocol
+            try:
+                p.terminate()
+            except Exception:  # noqa: BLE001
+                pass
+        for p in procs[:n_groups]:
+            try:
+                p.stdin.write("\n")
+                p.stdin.flush()
+            except Exception:  # noqa: BLE001 — already dead
+                pass
+        for p in procs:
+            try:
+                p.wait(timeout=30)
+            except Exception:  # noqa: BLE001
+                p.kill()
+        for f in errs:
+            f.close()
+            os.unlink(f.name)
+
+    by = {t["tier"]: t for t in tiers}
+    qps_1 = by["router_1g"]["read_qps"]
+    qps_n = by[f"router_{n_groups}g"]["read_qps"]
+    scaling = round(qps_n / qps_1, 3) if qps_1 else None
+    router_overhead = (
+        round(by["direct_1g"]["read_qps"] / qps_1, 3) if qps_1 else None
+    )
+    return {
+        "metric": "replica_read_qps",
+        "value": qps_n,
+        "unit": (
+            f"read requests/sec via the replica router over {n_groups} groups "
+            f"({n_clients} clients, batch {batch}; 1-group router {qps_1} q/s "
+            f"= x{scaling} scaling on {os.cpu_count()} cpus, direct/router "
+            f"overhead x{router_overhead}; rw + failover asserted in-run)"
+        ),
+        "vs_baseline": scaling,
+        "scaling_1_to_2": scaling,
+        "router_overhead": router_overhead,
+        # Group processes scale with PHYSICAL cores: scaling toward
+        # n_groups needs cpus >= n_groups + 1 (router + clients ride the
+        # remainder); a 1-cpu CI box records ~1.0 by construction.
+        "cpus": os.cpu_count(),
+        "tiers": tiers,
+    }
+
+
 def bench_qcache() -> dict:
     """Query-result-cache tier: a Zipf-skewed repeated read mix (the
     dashboard steady state — the same few queries hit over and over)
@@ -1861,6 +2120,7 @@ def main() -> None:
             "mixed": bench_mixed,
             "overload": bench_overload,
             "qcache": bench_qcache,
+            "replica": bench_replica,
             "intersect_count_stream": bench_intersect_stream,
             "intersect_count_4krows": bench_intersect_4krows,
             "topn_p50": bench_topn_p50,

@@ -112,6 +112,11 @@ def cmd_lockstep(args) -> int:
     holder.open()
     host, _, port = cfg.host.partition(":")
     ctrl_host, _, ctrl_port = args.control.partition(":")
+    # [replica] group: this job's serving-group identity behind the
+    # replica router ("name" or "name@epoch"; flag > env/TOML).
+    from pilosa_tpu.replica import parse_group
+
+    gname, gepoch = parse_group(getattr(args, "group", None) or cfg.replica_group)
     svc = LockstepService(
         holder,
         control_addr=(ctrl_host or "127.0.0.1", int(ctrl_port)),
@@ -129,6 +134,8 @@ def cmd_lockstep(args) -> int:
         # records spans; workers only read the replicated wire flag.
         trace_sample_rate=cfg.trace_sample_rate,
         trace_slow_ms=cfg.trace_slow_ms,
+        group=gname,
+        group_epoch=gepoch,
     )
     if svc.rank == 0:
         print(
@@ -145,6 +152,53 @@ def cmd_lockstep(args) -> int:
             svc.shutdown()
     finally:
         holder.close()
+    return 0
+
+
+# -- replica-router (replicated serving groups; no reference analog — the
+# reference's ReplicaN picks owners inside one cluster, this routes across
+# whole serving groups) ------------------------------------------------------
+
+def cmd_replica_router(args) -> int:
+    """Front a set of replica serving groups: fan reads across healthy
+    groups (least-inflight, one-shot failover), sequence writes to ALL
+    groups in one total order.
+    """
+    from pilosa_tpu import trace as trace_mod
+    from pilosa_tpu.replica import router_from_config
+    from pilosa_tpu.stats import new_stats_client
+
+    cfg = _load_config(args)
+    if getattr(args, "groups", None):
+        cfg.replica_groups = [g.strip() for g in args.groups.split(",") if g.strip()]
+    if getattr(args, "port", None) is not None:
+        cfg.replica_router_port = args.port
+    if not cfg.replica_groups:
+        print("error: no replica groups configured "
+              "(--groups / [replica] groups / PILOSA_TPU_REPLICA_GROUPS)",
+              file=sys.stderr)
+        return 1
+    stats = new_stats_client(cfg.stats)
+    router = router_from_config(
+        cfg, stats=stats, tracer=trace_mod.from_config(cfg, stats=stats)
+    )
+    router.serve()
+    print(
+        f"pilosa-tpu replica-router on http://{router.host}:{router.port} "
+        f"over {len(router.groups)} groups: "
+        + ", ".join(f"{g.name}={g.base}" for g in router.groups),
+        flush=True,
+    )
+    if args.test_exit:  # for CLI tests: start, report, stop
+        router.close()
+        return 0
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        print("shutting down")
+    finally:
+        router.close()
     return 0
 
 
@@ -350,7 +404,26 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--num-processes", type=int, help="job size (with --coordinator)")
     s.add_argument("--process-id", type=int, help="this rank (with --coordinator)")
     s.add_argument("--local-devices", type=int, help="virtual CPU devices per process (dev rigs)")
+    s.add_argument(
+        "--group",
+        help="replica serving-group identity for this job: name[@epoch] "
+             "([replica] group / PILOSA_TPU_REPLICA_GROUP)",
+    )
     s.set_defaults(fn=cmd_lockstep)
+
+    s = sub.add_parser(
+        "replica-router",
+        help="route reads across replica serving groups; sequence writes to all",
+    )
+    s.add_argument("--host", help="router bind host:port (port part ignored; see --port)")
+    s.add_argument(
+        "--groups",
+        help="comma-separated group front doors: host:port or name=host:port "
+             "([replica] groups / PILOSA_TPU_REPLICA_GROUPS)",
+    )
+    s.add_argument("--port", type=int, help="router bind port ([replica] router-port)")
+    s.add_argument("--test-exit", action="store_true", help=argparse.SUPPRESS)
+    s.set_defaults(fn=cmd_replica_router)
 
     s = sub.add_parser("import", help="bulk-import CSV row,col[,timestamp] bits")
     s.add_argument("--host", default="localhost:10101")

@@ -102,6 +102,18 @@ class Config:
     trace_slow_ms: float = 0.0
     # Bounded in-memory ring of finished traces served at /debug/traces.
     trace_ring: int = 256
+    # -- replicated serving groups ([replica] TOML section) --------------
+    # This server's serving-group identity ("g0" or "g0@3" with an
+    # explicit epoch) behind the replica router; "" = not in a group.
+    replica_group: str = ""
+    # Router: the group front doors to fan over ("host:port" or
+    # "name=host:port"; names default to g0, g1, ...).
+    replica_groups: list[str] = field(default_factory=list)
+    # Router bind port (the front door clients talk to).
+    replica_router_port: int = 10111
+    # One-shot read failover to a sibling group on connect/5xx failure
+    # (reads are side-effect-free, so the retry is always safe).
+    replica_failover: bool = True
     # -- lockstep service ([lockstep] TOML section) ----------------------
     # Rank-0 wait for a worker's receipt ack (control-plane latency +
     # scheduling, not execution) and a worker's connect retry window at
@@ -162,6 +174,11 @@ class Config:
         cfg.trace_sample_rate = float(tr.get("sample-rate", cfg.trace_sample_rate))
         cfg.trace_slow_ms = float(tr.get("slow-ms", cfg.trace_slow_ms))
         cfg.trace_ring = int(tr.get("ring", cfg.trace_ring))
+        rep = raw.get("replica", {})
+        cfg.replica_group = str(rep.get("group", cfg.replica_group))
+        cfg.replica_groups = list(rep.get("groups", cfg.replica_groups))
+        cfg.replica_router_port = int(rep.get("router-port", cfg.replica_router_port))
+        cfg.replica_failover = bool(rep.get("failover", cfg.replica_failover))
         ls = raw.get("lockstep", {})
         cfg.lockstep_ack_timeout = _interval(
             ls.get("ack-timeout"), cfg.lockstep_ack_timeout
@@ -231,6 +248,19 @@ class Config:
             self.trace_slow_ms = float(env["PILOSA_TPU_TRACE_SLOW_MS"])
         if "PILOSA_TPU_TRACE_RING" in env:
             self.trace_ring = int(env["PILOSA_TPU_TRACE_RING"])
+        if "PILOSA_TPU_REPLICA_GROUP" in env:
+            self.replica_group = env["PILOSA_TPU_REPLICA_GROUP"]
+        if "PILOSA_TPU_REPLICA_GROUPS" in env:
+            self.replica_groups = [
+                g.strip() for g in env["PILOSA_TPU_REPLICA_GROUPS"].split(",")
+                if g.strip()
+            ]
+        if "PILOSA_TPU_REPLICA_ROUTER_PORT" in env:
+            self.replica_router_port = int(env["PILOSA_TPU_REPLICA_ROUTER_PORT"])
+        if "PILOSA_TPU_REPLICA_FAILOVER" in env:
+            self.replica_failover = env["PILOSA_TPU_REPLICA_FAILOVER"].lower() in (
+                "1", "true", "yes",
+            )
         if "PILOSA_TPU_LOCKSTEP_ACK_TIMEOUT" in env:
             self.lockstep_ack_timeout = float(env["PILOSA_TPU_LOCKSTEP_ACK_TIMEOUT"])
         if "PILOSA_TPU_LOCKSTEP_CONNECT_TIMEOUT" in env:

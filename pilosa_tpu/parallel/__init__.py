@@ -12,6 +12,7 @@ data plane (pilosa_tpu.cluster — heterogeneous clusters).
 """
 
 from pilosa_tpu.parallel.multihost import (  # noqa: F401
+    MultiHostReplicaMesh,
     MultiHostSliceMesh,
     init_multihost,
 )

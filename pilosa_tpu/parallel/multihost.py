@@ -30,7 +30,7 @@ from typing import Optional, Sequence
 
 import numpy as np
 
-from pilosa_tpu.parallel.sharded import SliceMesh, _require_divisible
+from pilosa_tpu.parallel.sharded import ReplicaMesh, SliceMesh, _require_divisible
 
 
 def init_multihost(
@@ -161,3 +161,62 @@ class MultiHostSliceMesh(SliceMesh):
         from jax.experimental import multihost_utils
 
         return np.asarray(multihost_utils.process_allgather(arr, tiled=True))
+
+
+class MultiHostReplicaMesh(ReplicaMesh):
+    """2-D (slice x replica) mesh over the GLOBAL device list of a
+    ``jax.distributed`` job — the device plane of one replicated serving
+    group at pod scale.
+
+    ``hybrid`` defaults to True: the replica axis is laid across DCN
+    granules (``mesh_utils.create_hybrid_device_mesh``) so every
+    slice-axis psum stays on ICI inside a pod and only cross-replica
+    traffic crosses DCN — the multi-pod layout BACKLOG.md prescribes.
+    ReplicaMesh's guarded fallback keeps construction working on dev
+    rigs without a DCN topology (gloo CPU jobs), so the same code path
+    is testable with multi-process CPU meshes.
+
+    Adds the process-boundary helpers the serving path needs: which
+    replica column this process's devices sit in, and which global
+    slices it owns WITHIN that column (the 2-D analog of
+    MultiHostSliceMesh's contiguous ownership rule).
+    """
+
+    def __init__(self, n_replicas: int = 2, devices: Sequence | None = None,
+                 hybrid: bool = True):
+        import jax
+
+        super().__init__(
+            n_replicas=n_replicas,
+            devices=devices if devices is not None else jax.devices(),
+            hybrid=hybrid,
+        )
+        self.process_index = jax.process_index()
+        self.process_count = jax.process_count()
+
+    def _local_positions(self) -> list[tuple[int, int]]:
+        """(slice row, replica column) of every local device in the
+        mesh.  Local devices outside an explicit device subset own
+        nothing (skipped, not an error) — the SliceMesh rule in 2-D."""
+        import jax
+
+        pos = {d: (int(r), int(c))
+               for (r, c), d in np.ndenumerate(self.mesh.devices)}
+        return [pos[d] for d in jax.local_devices() if d in pos]
+
+    def local_replica_groups(self) -> list[int]:
+        """Replica columns this process participates in.  A well-formed
+        hybrid layout keeps each process inside ONE column (its pod);
+        flat CPU fallbacks may straddle several."""
+        return sorted({c for _, c in self._local_positions()})
+
+    def owned_slices(self, n_slices: int) -> list[int]:
+        """Global slice indices whose shards live on THIS process (in
+        any replica column it holds — each column is a full copy, so
+        ownership is per (row, column) device)."""
+        _require_divisible(n_slices, self.n_devices)
+        per_dev = n_slices // self.n_devices
+        out = set()
+        for r, _c in self._local_positions():
+            out.update(range(r * per_dev, (r + 1) * per_dev))
+        return sorted(out)

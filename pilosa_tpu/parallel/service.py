@@ -76,6 +76,7 @@ import json
 import os
 import socket
 import struct
+import sys
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -146,15 +147,35 @@ class LockstepService:
         qcache_max_bytes: Optional[int] = None,
         trace_sample_rate: Optional[float] = None,
         trace_slow_ms: Optional[float] = None,
+        group: Optional[str] = None,
+        group_epoch: Optional[int] = None,
     ):
         import jax
 
         from pilosa_tpu import qcache as qcache_mod
         from pilosa_tpu import trace as trace_mod
+        from pilosa_tpu.replica import parse_group
 
         self.holder = holder
         self.rank = jax.process_index()
         self.n_ranks = jax.process_count()
+        # GROUP IDENTITY (replica serving groups): this job is one
+        # serving group behind the replica router.  The name@epoch pair
+        # rides every HTTP response (X-Pilosa-Group — the router's
+        # epoch-bump detection) and every control-plane batch entry
+        # (``gepoch``): every rank of a group is constructed with the
+        # SAME epoch, so a worker receiving an entry from a DIFFERENT
+        # epoch is talking to a stale rank 0 from a previous incarnation
+        # and fail-stops rather than replaying writes the restarted
+        # group never acknowledged.  Ctor args (the CLI passes [replica]
+        # config) > PILOSA_TPU_REPLICA_GROUP env ("name[@epoch]") > off.
+        if group is None and group_epoch is None:
+            group, env_epoch = parse_group(
+                os.environ.get("PILOSA_TPU_REPLICA_GROUP", "")
+            )
+            group_epoch = env_epoch
+        self.group = group or ""
+        self.group_epoch = int(group_epoch or 0)
         self.engine = MeshEngine(devices if devices is not None else jax.devices())
         # Query result cache, DETERMINISTIC variant: hit/miss must be a
         # pure function of replicated state (request strings + the
@@ -451,10 +472,17 @@ class LockstepService:
                 )
             seq = self._next_seq
             self._next_seq += 1
+            entry = {"op": "batch", "seq": seq, "reqs": reqs}
+            if self.group:
+                # Group identity on the wire: workers fail-stop on an
+                # epoch mismatch (a stale rank 0 from a previous group
+                # incarnation must never drive a restarted worker).
+                entry["group"] = self.group
+                entry["gepoch"] = self.group_epoch
             try:
                 for w in self._workers:
                     w.settimeout(self.ack_timeout)
-                    _send_msg(w, {"op": "batch", "seq": seq, "reqs": reqs})
+                    _send_msg(w, entry)
             except (OSError, socket.timeout) as e:
                 raise self._degrade(e)
         try:
@@ -664,6 +692,37 @@ class LockstepService:
         def log_message(self, *a):  # quiet
             pass
 
+        def _group_header(self) -> None:
+            from pilosa_tpu.replica import GROUP_HEADER, format_group
+
+            if self.service.group:
+                self.send_header(
+                    GROUP_HEADER,
+                    format_group(self.service.group, self.service.group_epoch),
+                )
+
+        def do_GET(self):
+            # Replica-router health probe: 200 while the group can
+            # serve, 503 once degraded (a restarted job answers with a
+            # bumped epoch in X-Pilosa-Group).
+            if self.path.rstrip("/") != "/replica/health":
+                self.send_error(404)
+                return
+            svc = self.service
+            status = 503 if svc._degraded else 200
+            body = json.dumps({
+                "group": svc.group,
+                "epoch": svc.group_epoch,
+                "ranks": svc.n_ranks,
+                "state": "DEGRADED" if svc._degraded else "UP",
+            }).encode()
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self._group_header()
+            self.end_headers()
+            self.wfile.write(body)
+
         def do_POST(self):
             parts = self.path.strip("/").split("/")
             if len(parts) != 3 or parts[0] != "index" or parts[2] != "query":
@@ -713,10 +772,23 @@ class LockstepService:
             self.send_header("Content-Length", str(len(body)))
             if retry_after is not None:
                 self.send_header("Retry-After", f"{retry_after:.3f}")
+            self._group_header()
             self.end_headers()
             self.wfile.write(body)
 
     # -- workers ---------------------------------------------------------
+
+    def _epoch_ok(self, msg: dict) -> bool:
+        """A control-plane entry replays only when its group identity
+        matches this rank's.  Entries without the fields (legacy wire,
+        or a group-less job) always pass — the guard only bites when
+        BOTH sides carry an identity and they disagree."""
+        if "gepoch" not in msg and "group" not in msg:
+            return True
+        return (
+            msg.get("group", self.group) == self.group
+            and int(msg.get("gepoch", self.group_epoch)) == self.group_epoch
+        )
 
     def _worker_loop(self) -> None:
         import time
@@ -772,6 +844,21 @@ class LockstepService:
             # returned the same error to that request's client) and
             # resolve identically on every rank — the batch, and the
             # lockstep, continue with the next request.
+            if not self._epoch_ok(msg):
+                # A batch entry from a DIFFERENT group epoch: this
+                # worker belongs to a restarted incarnation of the
+                # group and the sender is stale (or vice versa).
+                # Replaying would advance this rank's generation
+                # vectors past what the group ever acknowledged —
+                # fail-stop, exactly like a rank-local failure.
+                print(
+                    f"lockstep group epoch mismatch: entry "
+                    f"{msg.get('group')}@{msg.get('gepoch')} != local "
+                    f"{self.group}@{self.group_epoch}; fail-stop",
+                    file=sys.stderr,
+                )
+                dead = True
+                continue
             if msg.get("op") == "batch":
                 reqs = msg["reqs"]
             else:

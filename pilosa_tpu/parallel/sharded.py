@@ -486,19 +486,45 @@ class ReplicaMesh(SliceMesh):
             # reshape (n_replicas, n_slice) then transpose, keeping the
             # slice-axis psum on ICI within a pod and only cross-replica
             # traffic on DCN.
-            dev_array = np.asarray(
-                mesh_utils.create_hybrid_device_mesh(
-                    (n_slice,), (n_replicas,), devices=devices,
-                )
-            ).reshape(n_replicas, n_slice).T
+            try:
+                dev_array = np.asarray(
+                    mesh_utils.create_hybrid_device_mesh(
+                        (n_slice,), (n_replicas,), devices=devices,
+                    )
+                ).reshape(n_replicas, n_slice).T
+            except Exception:  # noqa: BLE001 — no DCN topology on this host
+                # Hosts without a DCN topology (single-process CPU runs,
+                # one-host TPU boxes: every device is one granule, and
+                # create_hybrid_device_mesh needs >= n_replicas of them)
+                # fall back to a plain create_device_mesh reshape, so a
+                # hybrid request never needs real multi-pod hardware.
+                hybrid = False
+                dev_array = self._flat_2d(n_replicas, n_slice, devices)
         else:
-            # Same orientation: consecutive (ICI-adjacent) devices run
-            # along the slice axis within one replica group.
-            dev_array = np.array(devices).reshape(n_replicas, n_slice).T
+            dev_array = self._flat_2d(n_replicas, n_slice, devices)
+        self.hybrid = hybrid  # the layout actually BUILT, post-fallback
         self.mesh = Mesh(dev_array, (self.AXIS, self.REPLICA_AXIS))
         # SliceMesh API compat: helpers divide the slice axis by this.
         self.n_devices = n_slice
         self.n_replicas = n_replicas
+
+    @staticmethod
+    def _flat_2d(n_replicas: int, n_slice: int, devices) -> np.ndarray:
+        """(slice, replica) layout without DCN awareness: consecutive
+        (ICI-adjacent) devices run along the slice axis within one
+        replica group.  ``create_device_mesh`` keeps physical adjacency
+        on real TPU topologies; virtual/CPU device lists (no coords)
+        fall through to a plain reshape with the same orientation."""
+        try:
+            from jax.experimental import mesh_utils
+
+            return np.asarray(
+                mesh_utils.create_device_mesh(
+                    (n_replicas, n_slice), devices=devices
+                )
+            ).T
+        except Exception:  # noqa: BLE001 — virtual devices without topology
+            return np.array(devices).reshape(n_replicas, n_slice).T
 
 
 def replica_gather_count(mesh: ReplicaMesh, op: str, row_matrix, pairs,

@@ -1,0 +1,78 @@
+"""Replicated serving groups: read fan-out over a 2-D (slice x replica) mesh.
+
+No single reference analog — the reference's ReplicaN (cluster.go:220-240)
+replicates FRAGMENTS across ring nodes inside one cluster and lets the
+executor pick any owner at query time (executor.go:1147-1159).  Here the
+unit of replication is a whole SERVING GROUP: each group is a full
+LockstepService-style unit (or a plain Server on dev rigs) owning a
+complete copy of every slice, and a front-end ROUTER fans reads across
+groups — read QPS grows with group count while one lockstep group's
+semantics stay exactly what the stack already proved.
+
+Pieces:
+
+- :mod:`pilosa_tpu.replica.router` — :class:`ReplicaRouter`, the HTTP
+  front door: classifies requests with the QoS classifier, routes READS
+  to the least-inflight healthy group (one-shot failover to a sibling
+  on connect/5xx failure), and ships WRITES total-ordered to ALL groups
+  through one sequencer so every group's fragment generation vectors
+  advance identically — which is what keeps each group's qcache and
+  serve-state machinery read-your-writes correct with zero new
+  invalidation traffic.
+- :mod:`pilosa_tpu.replica.mesh` — device-mesh construction for the
+  group's device plane: 2-D ``(slice, replica)`` via
+  ``mesh_utils.create_hybrid_device_mesh`` when multihost (replica axis
+  on DCN, slice collectives on ICI) with a flat single-process fallback
+  so CPU/test environments run the same code.
+
+GROUP IDENTITY: every serving group carries a ``group`` name and an
+integer ``group epoch`` (bumped on each job restart).  The identity
+rides every HTTP response as the ``X-Pilosa-Group: <name>@<epoch>``
+header (the router records it and counts epoch bumps) and every
+lockstep control-plane batch entry as a ``gepoch`` field (workers
+fail-stop on a mismatch — a stale rank 0 from a previous incarnation
+can never feed entries to restarted workers).  An epoch bump tells the
+router the group's IN-MEMORY state (generation vectors, qcache) was
+rebuilt from disk; nothing cross-group needs invalidating because no
+cache entry ever crosses a group boundary.
+
+Config: ``[replica] group / groups / router-port / failover`` TOML keys
+with ``PILOSA_TPU_REPLICA_*`` env overrides, wired through
+``pilosa-tpu replica-router`` and the lockstep CLI.
+"""
+
+from __future__ import annotations
+
+# Response header carrying the serving group's identity ("name@epoch"):
+# set by every group front door, read back by the router (epoch-bump
+# detection) and by clients that want to know which replica answered.
+GROUP_HEADER = "X-Pilosa-Group"
+
+
+def parse_group(spec: str) -> tuple[str, int]:
+    """Split a ``name[@epoch]`` group identity; epoch defaults to 0."""
+    spec = (spec or "").strip()
+    name, _, epoch = spec.partition("@")
+    try:
+        return name, int(epoch or 0)
+    except ValueError:
+        return name, 0
+
+
+def format_group(name: str, epoch: int = 0) -> str:
+    return f"{name}@{int(epoch)}" if name else ""
+
+
+def __getattr__(name):
+    # PEP 562 lazy export: keep this package importable from the handler
+    # and client modules without pulling the router's qos/trace imports
+    # at module-import time (same contract as pilosa_tpu/parallel).
+    if name in ("ReplicaRouter", "GroupState", "router_from_config"):
+        from pilosa_tpu.replica import router as _router
+
+        return getattr(_router, name)
+    if name == "build_group_mesh":
+        from pilosa_tpu.replica.mesh import build_group_mesh
+
+        return build_group_mesh
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

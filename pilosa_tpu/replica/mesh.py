@@ -1,0 +1,51 @@
+"""Device-mesh construction for one replica serving group.
+
+One group's device plane is the 2-D ``(slice, replica)`` mesh the
+collectives already prove (parallel/sharded.py ReplicaMesh,
+tests/test_multihost.py::test_lockstep_four_ranks_replica_mesh): the
+``slice`` axis shards the bitmap stacks, the ``replica`` axis holds
+full copies that split read batches.  What this module decides is the
+PHYSICAL layout:
+
+- MULTIHOST (a joined ``jax.distributed`` job spanning pods): the
+  hybrid T5X-style layout via ``mesh_utils.create_hybrid_device_mesh``
+  (SNIPPETS.md [1]) — the replica axis rides DCN between pods while
+  every slice-axis psum stays on ICI inside a pod, the multi-pod shape
+  BACKLOG.md prescribes.
+- SINGLE PROCESS (CPU rigs, tests, one-host TPU boxes): a flat 2-D
+  reshape; there is no DCN topology to exploit, and
+  ``create_hybrid_device_mesh`` cannot even build (it needs >= 2 DCN
+  granules) — ReplicaMesh's guarded fallback handles a hybrid request
+  gracefully, but asking for the flat layout directly skips the probe.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+
+def build_group_mesh(n_replicas: int = 2, devices: Optional[Sequence] = None,
+                     hybrid: Optional[bool] = None):
+    """Build the (slice x replica) mesh for one serving group.
+
+    ``hybrid=None`` (the default) decides from the job shape: hybrid
+    when this process is part of a multi-process ``jax.distributed``
+    job (replica axis on DCN), flat otherwise.  Returns a
+    :class:`~pilosa_tpu.parallel.multihost.MultiHostReplicaMesh` in the
+    multihost case (slice-ownership helpers included) and a plain
+    :class:`~pilosa_tpu.parallel.sharded.ReplicaMesh` otherwise.
+    """
+    import jax
+
+    multihost = jax.process_count() > 1
+    if hybrid is None:
+        hybrid = multihost
+    if multihost:
+        from pilosa_tpu.parallel.multihost import MultiHostReplicaMesh
+
+        return MultiHostReplicaMesh(
+            n_replicas=n_replicas, devices=devices, hybrid=hybrid
+        )
+    from pilosa_tpu.parallel.sharded import ReplicaMesh
+
+    return ReplicaMesh(n_replicas=n_replicas, devices=devices, hybrid=hybrid)

@@ -1,0 +1,554 @@
+"""The replica read router: one front door over N serving groups.
+
+The reference fans a read to ANY of a fragment's ``ReplicaN`` owners at
+query time (executor.go:1147-1159) — replication buys read throughput,
+not just durability.  This router is that idea at GROUP granularity:
+each group is a complete serving unit (a lockstep job or a plain
+server) holding a full copy of every slice, so ANY group can answer ANY
+read and read QPS scales with group count.
+
+Routing policy:
+
+- CLASSIFY with the QoS classifier (``qos.classify_request`` — the same
+  byte-scan the admission door uses, so a request is a write here iff
+  it is a write there).  A false read->write positive only costs fan-out
+  latency; a false negative is impossible for PQL mutating calls.
+- READS (and admin GETs) go to ONE healthy group: least-inflight pick,
+  ties broken by fewest-routed so an idle router round-robins.  On a
+  connect failure or a 5xx answer the group is marked unhealthy and the
+  read fails over ONCE to a sibling group (reads are side-effect-free,
+  so the retry is safe; ``[replica] failover = false`` disables it).
+- WRITES (and mutating admin — schema must stay identical everywhere)
+  ship to ALL groups through ONE sequencer: the sequencer lock is held
+  for the whole fan-out, so every group applies every write in the same
+  total order and the groups' fragment generation vectors advance
+  identically.  That is the invariant that keeps each group's qcache
+  and serve-state repair read-your-writes correct with zero cross-group
+  invalidation traffic.  A write is ACKed only after EVERY group
+  applied it, so a read routed to any group immediately after the ack
+  sees it.
+
+Failure semantics:
+
+- The group set must be QUORATE (every configured group healthy) for
+  writes: a write against a degraded set answers 503 + Retry-After
+  WITHOUT touching any group.  Because no write is accepted while a
+  group is down, a recovering group missed no acknowledged writes and
+  rejoins with no catch-up protocol.
+- A write that fails MID-fan-out (connect error / 5xx from one group)
+  answers 502: it may be partially applied (earlier groups committed).
+  The failed group is marked unhealthy — so reads stop routing there
+  and further writes refuse — and the client retries the (idempotent)
+  write once the set is quorate again.
+- Health recovery is probe-driven: a background thread GETs
+  ``/replica/health`` on unhealthy groups and restores them on a 200.
+  A restarted group comes back with a bumped epoch in its
+  ``X-Pilosa-Group`` header; the router records it and counts
+  ``replica.epoch_bump``.
+
+Observability: ``replica.routed.<group>`` / ``replica.failover`` /
+``replica.write_fanout`` (+ refused/error) counters and per-group
+``replica.healthy.<group>`` / ``replica.inflight.<group>`` gauges at
+the router's own ``/debug/vars``; routed requests tag their trace root
+with ``group=<g>`` (and graft the group's span tree under the forward
+span), so the router's ``/debug/traces`` shows which replica served a
+read.  ``/replica/status`` returns the live group table.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import parse_qs, urlparse
+
+from pilosa_tpu import qos
+from pilosa_tpu.qos import DEADLINE_HEADER
+from pilosa_tpu.replica import GROUP_HEADER
+from pilosa_tpu.stats import NOP_STATS
+from pilosa_tpu.trace import TRACE_HEADER, TRACE_SPANS_HEADER
+
+# Headers never forwarded on a hop: ownership is per-connection, the
+# router recomputes lengths, and deadline/trace headers are REWRITTEN
+# (remaining budget, router trace id) rather than copied.
+_HOP_HEADERS = frozenset(
+    ("host", "content-length", "connection", "accept-encoding",
+     DEADLINE_HEADER.lower(), TRACE_HEADER.lower())
+)
+
+
+class GroupState:
+    """Router-side record of one serving group."""
+
+    __slots__ = ("name", "base", "healthy", "inflight", "routed", "epoch")
+
+    def __init__(self, name: str, base: str):
+        self.name = name
+        if "://" not in base:
+            base = "http://" + base
+        self.base = base.rstrip("/")
+        self.healthy = True
+        self.inflight = 0
+        self.routed = 0
+        self.epoch: Optional[str] = None  # last X-Pilosa-Group seen
+
+    def to_json(self) -> dict:
+        return {
+            "name": self.name,
+            "base": self.base,
+            "healthy": self.healthy,
+            "inflight": self.inflight,
+            "routed": self.routed,
+            "epoch": self.epoch,
+        }
+
+
+def _parse_group_spec(i: int, spec: str) -> GroupState:
+    """``host:port`` or ``name=host:port`` (names default to g<i>)."""
+    spec = spec.strip()
+    if "=" in spec and "://" not in spec.split("=", 1)[0]:
+        name, base = spec.split("=", 1)
+        return GroupState(name.strip(), base.strip())
+    return GroupState(f"g{i}", spec)
+
+
+class ReplicaRouter:
+    """HTTP front door fanning reads over replica serving groups."""
+
+    def __init__(
+        self,
+        groups,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        failover: bool = True,
+        default_deadline_ms: float = 0.0,
+        timeout: float = 30.0,
+        probe_interval_s: float = 1.0,
+        stats=None,
+        tracer=None,
+    ):
+        if not groups:
+            raise ValueError("replica router needs at least one group")
+        self.groups = [_parse_group_spec(i, g) for i, g in enumerate(groups)]
+        if len({g.name for g in self.groups}) != len(self.groups):
+            raise ValueError("duplicate replica group names")
+        self.host = host
+        self.port = port
+        self.failover = failover
+        self.default_deadline_ms = default_deadline_ms
+        self.timeout = timeout
+        self.probe_interval_s = probe_interval_s
+        self.stats = stats if stats is not None else NOP_STATS
+        self.tracer = tracer
+        self._mu = threading.Lock()  # group table (health/inflight/epoch)
+        # The write sequencer: held for a write's WHOLE fan-out, so all
+        # groups see all writes in one total order.
+        self._seq_mu = threading.Lock()
+        self.write_seq = 0
+        self._httpd = None
+        self._stop = threading.Event()
+        self._probe_thread: Optional[threading.Thread] = None
+        for g in self.groups:
+            self.stats.gauge(f"replica.healthy.{g.name}", 1)
+            self.stats.gauge(f"replica.inflight.{g.name}", 0)
+
+    # -- group table ------------------------------------------------------
+
+    def _pick(self, exclude=None) -> Optional[GroupState]:
+        """Least-inflight healthy group (ties: fewest routed, so an idle
+        router spreads sequential reads round-robin across groups)."""
+        with self._mu:
+            live = [
+                g for g in self.groups
+                if g.healthy and (exclude is None or g is not exclude)
+            ]
+            if not live:
+                return None
+            g = min(live, key=lambda g: (g.inflight, g.routed))
+            g.routed += 1
+            g.inflight += 1
+            self.stats.gauge(f"replica.inflight.{g.name}", g.inflight)
+        self.stats.count(f"replica.routed.{g.name}")
+        return g
+
+    def _release(self, g: GroupState) -> None:
+        with self._mu:
+            g.inflight -= 1
+            self.stats.gauge(f"replica.inflight.{g.name}", g.inflight)
+
+    def _mark_unhealthy(self, g: GroupState, why: str) -> None:
+        with self._mu:
+            if not g.healthy:
+                return
+            g.healthy = False
+        self.stats.gauge(f"replica.healthy.{g.name}", 0)
+        self.stats.count(f"replica.unhealthy.{g.name}")
+        self.stats.set("replica.last_failure", f"{g.name}: {why}")
+
+    def _mark_healthy(self, g: GroupState) -> None:
+        with self._mu:
+            if g.healthy:
+                return
+            g.healthy = True
+        self.stats.gauge(f"replica.healthy.{g.name}", 1)
+        self.stats.count("replica.recovered")
+
+    def _note_epoch(self, g: GroupState, hdr: Optional[str]) -> None:
+        """Track the group identity header; a changed epoch means the
+        group restarted (in-memory generation vectors rebuilt) — counted
+        so dashboards can correlate it with that group's cold caches."""
+        if not hdr:
+            return
+        if g.epoch is not None and g.epoch != hdr:
+            self.stats.count("replica.epoch_bump")
+        g.epoch = hdr
+
+    def healthy_count(self) -> int:
+        with self._mu:
+            return sum(1 for g in self.groups if g.healthy)
+
+    def quorate(self) -> bool:
+        """Writes need the FULL group set: while any group is down no
+        write is accepted, which is exactly what lets a recovering group
+        rejoin with no catch-up (it missed no acknowledged writes)."""
+        return self.healthy_count() == len(self.groups)
+
+    # -- the hop ----------------------------------------------------------
+
+    def _forward(self, g: GroupState, method: str, path_qs: str, body: bytes,
+                 headers: dict, deadline=None, trace_id: str = ""):
+        """One HTTP exchange with a group.  Returns (status, ctype,
+        payload, response headers); raises OSError on a connect/transport
+        failure (the caller's failover trigger)."""
+        fwd = {k: v for k, v in headers.items() if k.lower() not in _HOP_HEADERS}
+        timeout = self.timeout
+        if deadline is not None:
+            # Hop rule (qos/deadline.py): forward the REMAINING budget,
+            # tighten the socket to match (+1s for the 504 to travel).
+            fwd[DEADLINE_HEADER] = deadline.header_value()
+            timeout = min(timeout, deadline.remaining_ms() / 1000.0 + 1.0)
+        if trace_id:
+            fwd[TRACE_HEADER] = trace_id
+        req = urllib.request.Request(
+            g.base + path_qs, data=body if body else None, method=method
+        )
+        for k, v in fwd.items():
+            req.add_header(k, v)
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                status, payload, rheaders = resp.status, resp.read(), resp.headers
+        except urllib.error.HTTPError as e:
+            status, payload, rheaders = e.code, e.read(), e.headers
+        except urllib.error.URLError as e:
+            # Normalize to OSError for the failover path (URLError wraps
+            # the socket-level reason).
+            raise OSError(str(e.reason))
+        self._note_epoch(g, rheaders.get(GROUP_HEADER))
+        return status, rheaders.get("Content-Type", "application/json"), payload, rheaders
+
+    # -- read path --------------------------------------------------------
+
+    def _route_read(self, method: str, path_qs: str, body: bytes, headers: dict,
+                    deadline=None, trace=None):
+        g = self._pick()
+        if g is None:
+            return self._shed(503, "no healthy replica group", retry_after=1.0)
+        attempt, first, last = 0, g, g
+        while True:
+            last = g
+            sp = trace.root.child("forward") if trace is not None else None
+            try:
+                out = self._forward(
+                    g, method, path_qs, body, headers, deadline=deadline,
+                    trace_id=(trace.id if trace is not None else ""),
+                )
+            except OSError as e:
+                self._release(g)
+                if sp is not None:
+                    sp.finish().annotate(group=g.name, error=str(e))
+                self._mark_unhealthy(g, str(e))
+                out = None
+            else:
+                self._release(g)
+                if sp is not None:
+                    sp.finish().annotate(group=g.name, status=out[0])
+                    raw = out[3].get(TRACE_SPANS_HEADER)
+                    if raw:
+                        try:
+                            sp.graft(json.loads(raw))
+                        except ValueError:
+                            pass
+                if out[0] < 500:
+                    if trace is not None:
+                        trace.root.tags["group"] = g.name
+                    extra = {GROUP_HEADER: out[3].get(GROUP_HEADER) or g.name}
+                    ra = out[3].get("Retry-After")
+                    if ra:
+                        extra["Retry-After"] = ra
+                    return out[0], out[1], out[2], extra
+                # 5xx: this group cannot serve; a degraded lockstep
+                # group answers 503 until its job restarts, so stop
+                # routing reads there and let the probe restore it.
+                self._mark_unhealthy(g, f"HTTP {out[0]} on read")
+            # One-shot failover: reads are side-effect-free, so the
+            # retry on a sibling is always safe.
+            if not self.failover or attempt >= 1:
+                break
+            attempt += 1
+            g = self._pick(exclude=first)
+            if g is None:
+                break
+            self.stats.count("replica.failover")
+        if out is not None:
+            return out[0], out[1], out[2], {GROUP_HEADER: last.name}
+        return self._shed(503, "replica group unreachable", retry_after=1.0)
+
+    # -- write path -------------------------------------------------------
+
+    def _route_write(self, method: str, path_qs: str, body: bytes, headers: dict,
+                     deadline=None, trace=None):
+        """Total-ordered fan-out: the sequencer lock is held end to end,
+        so group k's generation vectors advance through exactly the same
+        write sequence as group 0's — the cross-group read-your-writes
+        invariant the tests pin."""
+        with self._seq_mu:
+            if not self.quorate():
+                with self._mu:
+                    down = [g.name for g in self.groups if not g.healthy]
+                self.stats.count("replica.write_refused")
+                if trace is not None:
+                    trace.root.tags["qos"] = "write_refused"
+                return self._shed(
+                    503,
+                    f"write refused: replica group set not quorate (down: {', '.join(down)})",
+                    retry_after=1.0,
+                )
+            self.write_seq += 1
+            first_out = None
+            for g in self.groups:
+                sp = trace.root.child("forward") if trace is not None else None
+                g.inflight += 1
+                self.stats.gauge(f"replica.inflight.{g.name}", g.inflight)
+                try:
+                    out = self._forward(
+                        g, method, path_qs, body, headers, deadline=deadline,
+                        trace_id=(trace.id if trace is not None else ""),
+                    )
+                except OSError as e:
+                    if sp is not None:
+                        sp.finish().annotate(group=g.name, error=str(e))
+                    self._mark_unhealthy(g, str(e))
+                    self.stats.count("replica.write_error")
+                    return self._partial_write(g, str(e))
+                finally:
+                    self._release(g)
+                if sp is not None:
+                    sp.finish().annotate(group=g.name, status=out[0])
+                if out[0] >= 500:
+                    self._mark_unhealthy(g, f"HTTP {out[0]} on write")
+                    self.stats.count("replica.write_error")
+                    return self._partial_write(g, f"HTTP {out[0]}")
+                # 4xx is deterministic (identical schema + total order):
+                # every group answers the same, keep fanning so a
+                # mutating call that DID apply elsewhere stays aligned.
+                if first_out is None:
+                    first_out = out
+            self.stats.count("replica.write_fanout")
+        status, ctype, payload, rheaders = first_out
+        return status, ctype, payload, {GROUP_HEADER: "all"}
+
+    def _partial_write(self, g: GroupState, why: str):
+        """A write failed mid-fan-out: earlier groups committed, ``g``
+        did not.  502 tells the client the write may be partially
+        applied — with ``g`` now unhealthy, further writes refuse (503)
+        until the probe restores the set, and the retried (idempotent)
+        write re-aligns the groups."""
+        return (
+            502,
+            "application/json",
+            json.dumps({
+                "error": f"write failed on group {g.name} ({why}); "
+                "may be partially applied — retry when the group set is quorate"
+            }).encode(),
+            {"Retry-After": "1.000"},
+        )
+
+    @staticmethod
+    def _shed(status: int, message: str, retry_after: float = 1.0):
+        return (
+            status,
+            "application/json",
+            json.dumps({"error": message}).encode(),
+            {"Retry-After": f"{retry_after:.3f}"},
+        )
+
+    # -- dispatch ---------------------------------------------------------
+
+    def handle(self, method: str, path_qs: str, body: bytes, headers: dict):
+        """Serve one request.  Returns (status, ctype, payload, extra
+        headers).  ``headers`` keys must be lowercase."""
+        parsed = urlparse(path_qs)
+        path = parsed.path
+        if method == "GET" and path == "/debug/vars":
+            snap = self.stats.snapshot() if hasattr(self.stats, "snapshot") else {}
+            return 200, "application/json", (json.dumps(snap) + "\n").encode(), {}
+        if method == "GET" and path == "/debug/traces":
+            return self._debug_traces(parse_qs(parsed.query))
+        if method == "GET" and path == "/replica/status":
+            with self._mu:
+                table = [g.to_json() for g in self.groups]
+            payload = json.dumps({
+                "groups": table,
+                "quorate": all(g["healthy"] for g in table),
+                "write_seq": self.write_seq,
+            }).encode()
+            return 200, "application/json", payload, {}
+
+        deadline = qos.deadline_from_headers(headers, self.default_deadline_ms)
+        if deadline is not None and deadline.expired():
+            return (
+                504, "application/json",
+                json.dumps({"error": "deadline exceeded (router)"}).encode(), {},
+            )
+        cls = qos.classify_request(method, path, body)
+        # Mutating admin (schema, deletions) must apply to EVERY group or
+        # the replicas' schemas diverge; admin GETs route like reads.
+        fan_all = cls == qos.CLASS_WRITE or (
+            cls == qos.CLASS_ADMIN and method in ("POST", "DELETE", "PATCH")
+        )
+        trace = (
+            self.tracer.begin(headers, name=f"{method} {path}")
+            if self.tracer is not None
+            else None
+        )
+        t0 = time.perf_counter()
+        if fan_all:
+            out = self._route_write(method, path_qs, body, headers,
+                                    deadline=deadline, trace=trace)
+        else:
+            out = self._route_read(method, path_qs, body, headers,
+                                   deadline=deadline, trace=trace)
+        if self.tracer is not None:
+            extra = self.tracer.finish_request(
+                trace, name=f"{method} {path}",
+                dt_ms=(time.perf_counter() - t0) * 1e3,
+                body=body, status=out[0],
+            )
+            if extra:
+                merged = dict(out[3])
+                merged.update(extra)
+                out = (out[0], out[1], out[2], merged)
+        return out
+
+    def _debug_traces(self, params: dict):
+        if self.tracer is None:
+            return 200, "application/json", b'{"traces": []}\n', {}
+        try:
+            min_ms = float((params.get("min-ms") or ["0"])[0] or 0)
+            limit = int((params.get("limit") or ["64"])[0] or 64)
+        except ValueError:
+            return 400, "application/json", b'{"error": "bad min-ms/limit"}', {}
+        payload = json.dumps(
+            {"traces": self.tracer.traces_json(min_ms=min_ms, limit=limit)}
+        ).encode()
+        return 200, "application/json", payload, {}
+
+    # -- health probe -----------------------------------------------------
+
+    def _probe_once(self) -> None:
+        with self._mu:
+            down = [g for g in self.groups if not g.healthy]
+        for g in down:
+            try:
+                req = urllib.request.Request(g.base + "/replica/health", method="GET")
+                with urllib.request.urlopen(req, timeout=2.0) as resp:
+                    ok = resp.status == 200
+                    hdr = resp.headers.get(GROUP_HEADER)
+            except (urllib.error.URLError, OSError):
+                # Unreachable OR alive-but-degraded (an HTTPError is a
+                # URLError): either way the group stays unhealthy.
+                continue
+            if ok:
+                self._note_epoch(g, hdr)
+                self._mark_healthy(g)
+
+    def _probe_loop(self) -> None:
+        while not self._stop.wait(self.probe_interval_s):
+            try:
+                self._probe_once()
+            except Exception:  # noqa: BLE001 — the probe must never die
+                pass
+
+    # -- lifecycle --------------------------------------------------------
+
+    class _Handler(BaseHTTPRequestHandler):
+        router: "ReplicaRouter"
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *a):  # quiet
+            pass
+
+        def _run(self, method: str) -> None:
+            length = int(self.headers.get("Content-Length") or 0)
+            body = self.rfile.read(length) if length else b""
+            headers = {k.lower(): v for k, v in self.headers.items()}
+            status, ctype, payload, extra = self.router.handle(
+                method, self.path, body, headers
+            )
+            self.send_response(status)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(payload)))
+            for k, v in extra.items():
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(payload)
+
+        def do_GET(self):
+            self._run("GET")
+
+        def do_POST(self):
+            self._run("POST")
+
+        def do_DELETE(self):
+            self._run("DELETE")
+
+        def do_PATCH(self):
+            self._run("PATCH")
+
+    def serve(self) -> "ReplicaRouter":
+        """Bind and serve in a background thread; returns self (the
+        resolved port lands in ``self.port``)."""
+        cls = type("BoundRouter", (self._Handler,), {"router": self})
+        self._httpd = ThreadingHTTPServer((self.host, self.port), cls)
+        self.port = self._httpd.server_address[1]
+        t = threading.Thread(target=self._httpd.serve_forever, daemon=True)
+        t.start()
+        self._probe_thread = threading.Thread(target=self._probe_loop, daemon=True)
+        self._probe_thread.start()
+        return self
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+
+
+def router_from_config(cfg, stats=None, tracer=None) -> ReplicaRouter:
+    """Build a router from Config ([replica] TOML + PILOSA_TPU_REPLICA_*
+    env, resolved by Config itself) — the CLI entry point's constructor."""
+    host, _, port = (cfg.host or "127.0.0.1").replace("http://", "").partition(":")
+    return ReplicaRouter(
+        cfg.replica_groups,
+        host=host or "127.0.0.1",
+        port=cfg.replica_router_port,
+        failover=cfg.replica_failover,
+        default_deadline_ms=cfg.default_deadline_ms,
+        stats=stats,
+        tracer=tracer,
+    )

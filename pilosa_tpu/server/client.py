@@ -23,6 +23,7 @@ from pilosa_tpu.ops.bitwise import pack_positions
 from pilosa_tpu.pilosa import SLICE_WIDTH, PilosaError
 from pilosa_tpu.qcache import NO_CACHE_HEADER
 from pilosa_tpu.qos import DEADLINE_HEADER
+from pilosa_tpu.replica import GROUP_HEADER
 from pilosa_tpu.trace import TRACE_HEADER, TRACE_SPANS_HEADER
 
 PROTOBUF = "application/x-protobuf"
@@ -159,13 +160,13 @@ class Client:
                 # Socket bound tracks the budget (+ slack for the 504
                 # answer itself to travel back).
                 timeout = min(self.timeout, deadline.remaining_ms() / 1000.0 + 1.0)
-        capture: Optional[dict] = {} if trace_span is not None else None
+        capture: dict = {}
         status, payload = self._request(
             "POST", f"/index/{index}/query", body, content_type=PROTOBUF, accept=PROTOBUF,
             headers=headers, timeout=timeout, retries=1, deadline=deadline,
             capture=capture,
         )
-        if trace_span is not None and capture and capture.get("headers") is not None:
+        if trace_span is not None and capture.get("headers") is not None:
             raw = capture["headers"].get(TRACE_SPANS_HEADER)
             if raw:
                 try:
@@ -185,6 +186,12 @@ class Client:
         resp = wire.decode_query_response(payload)
         if resp.get("err"):
             raise ClientError(status, resp["err"])
+        # Replica attribution: which serving group (or "all", for a
+        # router write fan-out) answered — absent off group-less hosts.
+        if capture.get("headers") is not None:
+            grp = capture["headers"].get(GROUP_HEADER)
+            if grp:
+                resp["group"] = grp
         return resp
 
     def execute_remote(
@@ -249,6 +256,11 @@ class Client:
 
     def status(self) -> dict:
         return self._json("GET", "/status")["status"]
+
+    def replica_status(self) -> dict:
+        """The replica router's live group table (/replica/status):
+        per-group health/inflight/epoch plus the quorum flag."""
+        return self._json("GET", "/replica/status")
 
     def version(self) -> str:
         return self._json("GET", "/version")["version"]

@@ -86,7 +86,8 @@ class Handler:
     """Routes requests to the holder/executor; transport-agnostic core."""
 
     def __init__(self, holder, executor, cluster=None, host="", broadcaster=None, stats=None, client_factory=None,
-                 admission=None, default_deadline_ms: float = 0.0, tracer=None):
+                 admission=None, default_deadline_ms: float = 0.0, tracer=None,
+                 group: str = ""):
         self.holder = holder
         self.executor = executor
         self.cluster = cluster
@@ -104,6 +105,11 @@ class Handler:
         # at all (embedders) — the server always passes one so the
         # X-Pilosa-Trace force override works without a restart.
         self.tracer = tracer
+        # Replica serving-group identity ("name" or "name@epoch",
+        # [replica] group): stamped on every response as X-Pilosa-Group
+        # so the router can record which group answered and detect
+        # epoch bumps across restarts.
+        self.group = group
         self.version = VERSION
         self._routes = self._build_routes()
 
@@ -126,6 +132,7 @@ class Handler:
             ("PATCH", re.compile(r"^/index/(?P<index>[^/]+)/frame/(?P<frame>[^/]+)/time-quantum$"), self.patch_frame_time_quantum),
             ("GET", re.compile(r"^/index/(?P<index>[^/]+)/frame/(?P<frame>[^/]+)/views$"), self.get_frame_views),
             ("PATCH", re.compile(r"^/index/(?P<index>[^/]+)/time-quantum$"), self.patch_index_time_quantum),
+            ("GET", re.compile(r"^/replica/health$"), self.get_replica_health),
             ("GET", re.compile(r"^/debug/vars$"), self.get_expvar),
             ("GET", re.compile(r"^/debug/traces$"), self.get_debug_traces),
             ("GET", re.compile(r"^/debug/pprof(?:/(?P<path>.*))?$"), self.get_pprof),
@@ -162,7 +169,8 @@ class Handler:
         """
         tracer = self.tracer
         if tracer is None:
-            return self._dispatch_qos(method, path, params, body, headers, None)
+            out = self._dispatch_qos(method, path, params, body, headers, None)
+            return self._with_group(out)
         trace = tracer.begin(headers, name=f"{method} {path}")
         t0 = time.perf_counter()
         out = self._dispatch_qos(
@@ -176,7 +184,18 @@ class Handler:
             merged = dict(out[3]) if len(out) > 3 else {}
             merged.update(extra)
             out = (out[0], out[1], out[2], merged)
-        return out
+        return self._with_group(out)
+
+    def _with_group(self, out):
+        """Stamp the serving group's identity on every response — the
+        replica router's per-read attribution and epoch-bump signal."""
+        if not self.group:
+            return out
+        from pilosa_tpu.replica import GROUP_HEADER
+
+        merged = dict(out[3]) if len(out) > 3 else {}
+        merged.setdefault(GROUP_HEADER, self.group)
+        return (out[0], out[1], out[2], merged)
 
     def _dispatch_qos(self, method: str, path: str, params: dict, body: bytes,
                       headers: dict, span=None):
@@ -349,6 +368,12 @@ class Handler:
         if inverse:
             m = self.holder.max_inverse_slices()
         return self._json({"maxSlices": m})
+
+    def get_replica_health(self, **kw):
+        """Replica-router health probe: a 200 here restores an
+        unhealthy group in the router's table (the lockstep front end
+        serves the same route, answering 503 while degraded)."""
+        return self._json({"group": self.group, "state": "UP"})
 
     def get_expvar(self, **kw):
         stats = {}

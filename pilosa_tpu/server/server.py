@@ -123,6 +123,9 @@ class Server:
             admission=self.admission,
             default_deadline_ms=self.config.default_deadline_ms,
             tracer=self.tracer,
+            # [replica] group: this server's serving-group identity
+            # behind the replica router (X-Pilosa-Group on responses).
+            group=self.config.replica_group,
         )
         self.syncer = HolderSyncer(
             self.holder, self.cluster, self.host, self.client_factory, stats=stats

@@ -73,4 +73,11 @@ run BENCH_CONFIG=qcache BENCH_TRACE_ITERS=40000
 #    line pushes deeper overload on a wider door.
 run BENCH_CONFIG=overload
 run BENCH_CONFIG=overload BENCH_QOS_DEPTH=8 BENCH_THREADS=64
+# 11) Replicated serving groups: read QPS through the replica router at
+#    1 vs 2 groups (scaling_1_to_2 is the headline; needs >= 3 cores) +
+#    router on/off overhead, with cross-group read-your-writes and
+#    failover (reads survive a killed group, writes 503 until quorate)
+#    asserted in-run.  The second line scales the group fleet.
+run BENCH_CONFIG=replica
+run BENCH_CONFIG=replica BENCH_GROUPS=4 BENCH_THREADS=32
 echo "ALL DONE $(date +%H:%M:%S)" >> $OUT

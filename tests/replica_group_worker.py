@@ -1,0 +1,46 @@
+"""Subprocess serving-group worker for the replica-router bench/tests:
+one full Server (numpy engine by default) = one replica group front
+door, in its own process so groups scale across GILs the way real
+groups scale across jobs.
+
+Run: python tests/replica_group_worker.py <group-name> [engine]
+
+Prints ``{"ready": true, "host": ..., "group": ...}`` once serving,
+shuts down when a line arrives on stdin.  The qcache is DISABLED so
+read phases measure real execution scaling, not cache hits
+(PILOSA_TPU_QCACHE=1 in the environment turns it back on).
+"""
+
+import json
+import os
+import sys
+import tempfile
+
+
+def main() -> int:
+    group = sys.argv[1] if len(sys.argv) > 1 else "g0"
+    engine = sys.argv[2] if len(sys.argv) > 2 else "numpy"
+
+    from pilosa_tpu.config import Config
+    from pilosa_tpu.server.server import Server
+
+    qcache_on = os.environ.get("PILOSA_TPU_QCACHE", "").lower() in ("1", "true", "yes")
+    with tempfile.TemporaryDirectory() as d:
+        cfg = Config(
+            data_dir=d,
+            host="127.0.0.1:0",
+            engine=engine,
+            stats="expvar",
+            qcache_enabled=qcache_on,
+            replica_group=group,
+        )
+        srv = Server(cfg)
+        srv.open()
+        print(json.dumps({"ready": True, "host": srv.host, "group": group}), flush=True)
+        sys.stdin.readline()  # parent signals shutdown
+        srv.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -140,6 +140,29 @@ def test_bench_overload_emits_json():
     assert all(t["goodput_qps"] > 0 for t in result["tiers"])
 
 
+def test_bench_replica_emits_json():
+    """The replicated-serving-groups bench must keep working: group
+    subprocesses behind out-of-process routers, read QPS at 1 vs N
+    groups + a router-off direct baseline, with cross-group
+    read-your-writes and failover (reads survive a killed group, writes
+    503 until quorate) asserted in-run.  The scaling RATIO is recorded,
+    not asserted — it needs physical cores the CI box may not have
+    (the ``cpus`` field disambiguates)."""
+    stdout = _run({"BENCH_CONFIG": "replica", "BENCH_SMOKE": "1"}, timeout=300)
+    result = json.loads(stdout.strip().splitlines()[-1])
+    assert result["metric"] == "replica_read_qps" and result["value"] > 0
+    names = [t["tier"] for t in result["tiers"]]
+    assert names == ["direct_1g", "router_1g", "router_2g"]
+    by = {t["tier"]: t for t in result["tiers"]}
+    assert all(t["read_qps"] > 0 and t["served"] > 0 for t in result["tiers"])
+    # The bench asserted these in-run; the fields record it.
+    assert by["router_2g"]["rw_ok"] is True
+    assert by["router_2g"]["failover_ok"] is True
+    assert by["router_2g"]["failovers"] >= 1
+    assert by["router_2g"]["write_fanout"] >= 1  # schema + import + probe write
+    assert result["scaling_1_to_2"] > 0 and result["cpus"] >= 1
+
+
 def test_star_trace_example_runs():
     stdout = _run({}, script=os.path.join("examples", "star_trace.py"))
     assert "top stargazers:" in stdout and "user 1 attrs:" in stdout

@@ -529,6 +529,99 @@ def test_lockstep_trace_sampling_decided_on_rank0():
     )
 
 
+def test_replica_router_over_two_lockstep_groups():
+    """Replica serving groups at full depth: TWO 2-rank lockstep jobs
+    (groups g0/g1, identities via PILOSA_TPU_REPLICA_GROUP) behind one
+    ReplicaRouter.  Writes through the router fan to BOTH groups (each
+    group replays them on every rank — generation vectors advance
+    identically everywhere); reads spread across groups and see every
+    acked write; killing one group's WORKER rank degrades that group
+    (its control plane fail-stops), the router fails reads over to the
+    survivor and refuses writes 503 until the set is quorate."""
+    import urllib.error
+
+    from pilosa_tpu.replica import GROUP_HEADER, ReplicaRouter
+    from pilosa_tpu.stats import ExpvarStatsClient
+
+    g0 = _LockstepJob(2, env_extra={"PILOSA_TPU_REPLICA_GROUP": "g0@1"})
+    g1 = _LockstepJob(2, env_extra={"PILOSA_TPU_REPLICA_GROUP": "g1@1"})
+    router = None
+    try:
+        g0.wait_ready()
+        g1.wait_ready()
+        stats = ExpvarStatsClient()
+        router = ReplicaRouter(
+            [f"g0=127.0.0.1:{g0.http}", f"g1=127.0.0.1:{g1.http}"],
+            probe_interval_s=0.2, stats=stats,
+        ).serve()
+
+        def via_router(q, timeout=60):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{router.port}/index/g/query",
+                data=q.encode(), method="POST",
+            )
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                return json.loads(resp.read()), resp.headers.get(GROUP_HEADER)
+
+        q_read = 'Count(Bitmap(rowID=0, frame="f"))'
+        # Both groups seeded identically (8 slices x 1 bit for row 0... the
+        # worker seeds 2 bits/row over max(4, 2*nprocs)=4 slices): read
+        # through the router agrees with a direct read on either group.
+        want = g0.query(q_read)["results"]
+        assert g1.query(q_read)["results"] == want
+        out, grp = via_router(q_read)
+        assert out["results"] == want and grp in ("g0@1", "g1@1")
+
+        # A write through the router lands on BOTH groups (and, inside
+        # each group, replays on every rank over the control plane).
+        out, grp = via_router('SetBit(rowID=0, frame="f", columnID=901)')
+        assert out["results"] == [True] and grp == "all"
+        after = want[0] + 1
+        assert g0.query(q_read)["results"] == [after]
+        assert g1.query(q_read)["results"] == [after]
+        # Cross-group read-your-writes: immediate router reads see it on
+        # whichever group serves (round-robin spreads the ties).
+        served = set()
+        for _ in range(4):
+            out, grp = via_router(q_read)
+            assert out["results"] == [after]
+            served.add(grp)
+        assert served == {"g0@1", "g1@1"}
+
+        # Kill g1's WORKER rank: g1's control plane fail-stops on the
+        # next shipped entry, the router marks it unhealthy and keeps
+        # reads serving from g0.
+        g1.procs[1].kill()
+        ok_reads = 0
+        for _ in range(12):
+            try:
+                out, grp = via_router(q_read, timeout=30)
+            except (urllib.error.HTTPError, urllib.error.URLError, OSError):
+                continue  # the probe that tripped the degrade
+            assert out["results"] == [after]
+            ok_reads += 1
+        assert ok_reads >= 8, "reads stopped serving after one group died"
+        g1_state = router.groups[1]
+        assert not g1_state.healthy
+        # Writes refuse while non-quorate — g0 is NOT advanced past g1.
+        try:
+            via_router('SetBit(rowID=0, frame="f", columnID=902)', timeout=30)
+            assert False, "write acked against a non-quorate group set"
+        except urllib.error.HTTPError as e:
+            assert e.code == 503
+        assert g0.query(q_read)["results"] == [after]
+        assert stats.snapshot().get("replica.failover", 0) >= 1
+
+        outs = g0.shutdown_and_collect()
+        # g0's ranks converged on the routed writes.
+        assert {o["probe"] for o in outs} == {after}
+    finally:
+        if router is not None:
+            router.close()
+        g0.cleanup()
+        g1.cleanup()
+
+
 def test_lockstep_worker_death_mid_stream():
     """A worker rank SIGKILLed MID-REQUEST-STREAM: the in-flight or next
     request errors, every subsequent request is refused (the service

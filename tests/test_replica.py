@@ -1,0 +1,490 @@
+"""Replicated serving groups: the read router over N full serving units.
+
+Each group here is a complete in-process Server (numpy engine) with its
+own holder — the fast-rig analog of a lockstep job per group (the
+multi-process case lives in tests/test_multihost.py).  The invariants
+pinned:
+
+- WRITES ship total-ordered to ALL groups (one sequencer), so every
+  group's fragment generation vectors advance identically — a read
+  routed to EITHER group immediately after a write's ack sees it.
+- READS fan across healthy groups (least-inflight, round-robin ties)
+  and fail over ONCE to a sibling on connect/5xx failure.
+- A dead group degrades WRITES to 503 (the set must be quorate) while
+  reads keep serving from the survivors; the health probe restores a
+  recovered group.
+- Router observability: routed/failover/write_fanout counters,
+  per-group health+inflight gauges at /debug/vars, trace roots tagged
+  with the serving group.
+"""
+
+import json
+import tempfile
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.config import Config
+from pilosa_tpu.replica import (
+    GROUP_HEADER,
+    ReplicaRouter,
+    format_group,
+    parse_group,
+)
+from pilosa_tpu.stats import ExpvarStatsClient
+from pilosa_tpu.trace import Tracer
+
+
+class _Rig:
+    """Two in-process group servers + a router in front."""
+
+    def __init__(self, tmp, n_groups=2, failover=True, tracer=None,
+                 probe_interval_s=0.1, **router_kw):
+        from pilosa_tpu.server.server import Server
+
+        self.servers = []
+        for i in range(n_groups):
+            cfg = Config(
+                data_dir=f"{tmp}/g{i}", host="127.0.0.1:0", engine="numpy",
+                stats="expvar", qcache_enabled=False, replica_group=f"g{i}",
+            )
+            srv = Server(cfg)
+            srv.open()
+            self.servers.append(srv)
+        self.stats = ExpvarStatsClient()
+        self.router = ReplicaRouter(
+            [f"g{i}={srv.host}" for i, srv in enumerate(self.servers)],
+            failover=failover, probe_interval_s=probe_interval_s,
+            stats=self.stats, tracer=tracer, **router_kw,
+        ).serve()
+        self.base = f"http://127.0.0.1:{self.router.port}"
+
+    def req(self, method, path, body=None, headers=None, timeout=30):
+        rq = urllib.request.Request(self.base + path, data=body, method=method)
+        for k, v in (headers or {}).items():
+            rq.add_header(k, v)
+        try:
+            with urllib.request.urlopen(rq, timeout=timeout) as resp:
+                return resp.status, resp.read(), dict(resp.headers)
+        except urllib.error.HTTPError as e:
+            return e.code, e.read(), dict(e.headers)
+
+    def query(self, q, headers=None):
+        return self.req("POST", "/index/i/query", q.encode(), headers)
+
+    def direct_count(self, i, q='Count(Bitmap(rowID=1, frame="f"))'):
+        rq = urllib.request.Request(
+            f"http://{self.servers[i].host}/index/i/query",
+            data=q.encode(), method="POST",
+        )
+        with urllib.request.urlopen(rq, timeout=30) as resp:
+            return json.loads(resp.read())["results"][0]
+
+    def seed(self):
+        assert self.req("POST", "/index/i", b"{}")[0] == 200
+        assert self.req("POST", "/index/i/frame/f", b"{}")[0] == 200
+
+    def close(self):
+        self.router.close()
+        for s in self.servers:
+            s.close()
+
+
+@pytest.fixture
+def rig():
+    with tempfile.TemporaryDirectory() as tmp:
+        r = _Rig(tmp)
+        try:
+            yield r
+        finally:
+            r.close()
+
+
+def test_write_fanout_and_read_balance(rig):
+    """Writes (and schema mutations) apply on EVERY group; sequential
+    reads spread across groups via the least-inflight/fewest-routed
+    pick; counters account for both."""
+    rig.seed()
+    for c in range(5):
+        st, body, hdrs = rig.query(f'SetBit(rowID=1, frame="f", columnID={c})')
+        assert st == 200 and json.loads(body)["results"] == [True]
+        assert hdrs.get(GROUP_HEADER) == "all"  # write = whole group set
+    # Both groups hold the identical result of the identical write order.
+    assert rig.direct_count(0) == rig.direct_count(1) == 5
+    served = set()
+    for _ in range(4):
+        st, body, hdrs = rig.query('Count(Bitmap(rowID=1, frame="f"))')
+        assert st == 200 and json.loads(body)["results"] == [5]
+        served.add(hdrs.get(GROUP_HEADER))
+    assert served == {"g0", "g1"}  # idle router round-robins the ties
+    snap = rig.stats.snapshot()
+    assert snap["replica.routed.g0"] >= 1 and snap["replica.routed.g1"] >= 1
+    # Every data write + schema mutation fanned through the sequencer.
+    assert snap["replica.write_fanout"] == 7
+    assert snap["replica.inflight.g0"] == 0 and snap["replica.inflight.g1"] == 0
+    assert snap["replica.healthy.g0"] == 1 and snap["replica.healthy.g1"] == 1
+    # Schema mutations really reached both groups.
+    for i in range(2):
+        rq = urllib.request.Request(f"http://{rig.servers[i].host}/schema")
+        schema = json.loads(urllib.request.urlopen(rq, timeout=10).read())
+        assert [x["name"] for x in schema["indexes"]] == ["i"]
+
+
+def test_cross_group_read_your_writes(rig):
+    """A write acked by the router is visible on the IMMEDIATE next
+    read no matter which group serves it — the total-order fan-out
+    advanced both groups' generation vectors before the ack."""
+    rig.seed()
+    for step in range(1, 6):
+        assert rig.query(f'SetBit(rowID=1, frame="f", columnID={100 + step})')[0] == 200
+        # Two back-to-back reads hit BOTH groups (round-robin ties).
+        groups_seen = set()
+        for _ in range(2):
+            st, body, hdrs = rig.query('Count(Bitmap(rowID=1, frame="f"))')
+            assert st == 200
+            assert json.loads(body)["results"] == [step], hdrs.get(GROUP_HEADER)
+            groups_seen.add(hdrs.get(GROUP_HEADER))
+        assert groups_seen == {"g0", "g1"}
+    assert rig.direct_count(0) == rig.direct_count(1) == 5
+
+
+def test_failover_keeps_reads_serving_and_refuses_writes(rig):
+    """Kill one group: reads keep serving from the survivor (one-shot
+    failover on the first failed pick), writes answer 503 + Retry-After
+    until the group set is quorate again."""
+    rig.seed()
+    assert rig.query('SetBit(rowID=1, frame="f", columnID=3)')[0] == 200
+    rig.servers[1].close()  # the whole group goes away
+    for _ in range(6):
+        st, body, hdrs = rig.query('Count(Bitmap(rowID=1, frame="f"))')
+        assert st == 200 and json.loads(body)["results"] == [1]
+        assert hdrs.get(GROUP_HEADER) == "g0"
+    snap = rig.stats.snapshot()
+    assert snap.get("replica.failover", 0) >= 1
+    assert snap["replica.healthy.g1"] == 0
+    # Writes refuse without touching ANY group while non-quorate.
+    before = rig.direct_count(0)
+    st, body, hdrs = rig.query('SetBit(rowID=1, frame="f", columnID=9)')
+    assert st == 503 and "quorate" in json.loads(body)["error"]
+    assert "Retry-After" in hdrs
+    assert rig.direct_count(0) == before
+    # The group table tells the same story over HTTP.
+    status = json.loads(rig.req("GET", "/replica/status")[1])
+    assert status["quorate"] is False
+    assert {g["name"]: g["healthy"] for g in status["groups"]} == {
+        "g0": True, "g1": False,
+    }
+
+
+def test_health_probe_restores_a_live_group(rig):
+    """A group marked unhealthy (e.g. by one failed read) but actually
+    serving is restored by the background /replica/health probe — and
+    writes work again once the set is quorate."""
+    rig.seed()
+    g1 = rig.router.groups[1]
+    rig.router._mark_unhealthy(g1, "injected")
+    deadline = time.monotonic() + 5
+    while not g1.healthy and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert g1.healthy, "probe never restored a live group"
+    snap = rig.stats.snapshot()
+    assert snap.get("replica.recovered", 0) >= 1
+    assert rig.query('SetBit(rowID=1, frame="f", columnID=1)')[0] == 200
+    assert rig.direct_count(0) == rig.direct_count(1) == 1
+
+
+def test_partial_write_failure_answers_502_and_degrades(rig, monkeypatch):
+    """A write that fails MID-fan-out (first group applied, second
+    unreachable) answers 502 (may be partially applied), marks the
+    failed group unhealthy, and subsequent writes 503 until recovery."""
+    rig.seed()
+    real = rig.router._forward
+    g1 = rig.router.groups[1]
+
+    def flaky(g, method, path_qs, body, headers, **kw):
+        if g is g1 and b"SetBit" in body:
+            raise OSError("injected mid-fanout failure")
+        return real(g, method, path_qs, body, headers, **kw)
+
+    monkeypatch.setattr(rig.router, "_forward", flaky)
+    st, body, _ = rig.query('SetBit(rowID=1, frame="f", columnID=2)')
+    assert st == 502 and "partially applied" in json.loads(body)["error"]
+    assert rig.direct_count(0) == 1  # the first group DID commit
+    # Non-quorate now: the next write refuses outright (no group touched).
+    st, body, _ = rig.query('SetBit(rowID=1, frame="f", columnID=3)')
+    assert st == 503
+    snap = rig.stats.snapshot()
+    assert snap.get("replica.write_error", 0) == 1
+    assert snap.get("replica.write_refused", 0) == 1
+    # The probe restores g1 (it is actually alive), and the idempotent
+    # retry re-aligns the groups.
+    monkeypatch.setattr(rig.router, "_forward", real)
+    deadline = time.monotonic() + 5
+    while not g1.healthy and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert g1.healthy
+    assert rig.query('SetBit(rowID=1, frame="f", columnID=2)')[0] == 200
+    assert rig.direct_count(0) == rig.direct_count(1) == 1
+
+
+def test_router_deadline_and_trace():
+    """The router honors deadlines at ITS door (an expired request never
+    reaches a group) and forwards the remaining budget on the hop; a
+    forced trace tags the root with the serving group and grafts the
+    group's own span tree under the forward span."""
+    with tempfile.TemporaryDirectory() as tmp:
+        rig = _Rig(tmp, tracer=Tracer())
+        try:
+            rig.seed()
+            assert rig.query('SetBit(rowID=1, frame="f", columnID=1)')[0] == 200
+            # Expired at the router door: 504 before any forward.
+            st, _, _ = rig.query('Count(Bitmap(rowID=1, frame="f"))',
+                                 headers={"X-Pilosa-Deadline-Ms": "0"})
+            assert st == 504
+            # Forced trace rides the hop and lands in the router ring.
+            st, body, _ = rig.query('Count(Bitmap(rowID=1, frame="f"))',
+                                    headers={"X-Pilosa-Trace": "1"})
+            assert st == 200 and json.loads(body)["results"] == [1]
+            traces = json.loads(rig.req("GET", "/debug/traces")[1])["traces"]
+            root = traces[0]["spans"]
+            assert root["tags"]["group"] in ("g0", "g1")
+            fwd = [c for c in root.get("children", []) if c["name"] == "forward"]
+            assert fwd and fwd[0]["tags"]["group"] == root["tags"]["group"]
+            # The group's own span tree (its "POST /index/i/query" root)
+            # was grafted under the forward span — one trace, both sides.
+            assert any(
+                "query" in c.get("name", "") for c in fwd[0].get("children", [])
+            ), fwd[0]
+        finally:
+            rig.close()
+
+
+def test_router_debug_vars_http(rig):
+    rig.seed()
+    assert rig.query('SetBit(rowID=1, frame="f", columnID=1)')[0] == 200
+    assert rig.query('Count(Bitmap(rowID=1, frame="f"))')[0] == 200
+    st, body, _ = rig.req("GET", "/debug/vars")
+    assert st == 200
+    snap = json.loads(body)
+    assert snap["replica.write_fanout"] >= 1
+    assert any(k.startswith("replica.routed.") for k in snap)
+    assert snap["replica.healthy.g0"] == 1 and snap["replica.healthy.g1"] == 1
+
+
+def test_epoch_bump_detection(rig):
+    """A changed X-Pilosa-Group epoch on a group's responses (job
+    restart) is recorded and counted — the router's signal that the
+    group's in-memory generation vectors were rebuilt."""
+    g0 = rig.router.groups[0]
+    rig.router._note_epoch(g0, "g0@1")
+    rig.router._note_epoch(g0, "g0@1")
+    assert rig.stats.snapshot().get("replica.epoch_bump", 0) == 0
+    rig.router._note_epoch(g0, "g0@2")
+    assert rig.stats.snapshot()["replica.epoch_bump"] == 1
+    assert g0.epoch == "g0@2"
+
+
+def test_group_header_on_plain_server(rig):
+    """Every group-configured server stamps X-Pilosa-Group on every
+    response (the router's attribution source), and /replica/health
+    answers 200."""
+    for i in range(2):
+        rq = urllib.request.Request(f"http://{rig.servers[i].host}/version")
+        with urllib.request.urlopen(rq, timeout=10) as resp:
+            assert resp.headers.get(GROUP_HEADER) == f"g{i}"
+        rq = urllib.request.Request(f"http://{rig.servers[i].host}/replica/health")
+        with urllib.request.urlopen(rq, timeout=10) as resp:
+            assert resp.status == 200
+            assert json.loads(resp.read())["group"] == f"g{i}"
+
+
+def test_client_surfaces_serving_group(rig):
+    """Client.execute_query exposes which replica answered (and "all"
+    for a router write), plus the router status helper."""
+    from pilosa_tpu.server.client import Client
+
+    rig.seed()
+    c = Client(f"127.0.0.1:{rig.router.port}")
+    resp = c.execute_query("i", 'SetBit(rowID=1, frame="f", columnID=4)')
+    assert resp.get("group") == "all"
+    resp = c.execute_query("i", 'Count(Bitmap(rowID=1, frame="f"))')
+    assert resp.get("group") in ("g0", "g1")
+    status = c.replica_status()
+    assert status["quorate"] is True and len(status["groups"]) == 2
+
+
+def test_no_failover_when_disabled():
+    """[replica] failover = false: the first failed pick surfaces to
+    the client instead of retrying a sibling."""
+    with tempfile.TemporaryDirectory() as tmp:
+        rig = _Rig(tmp, failover=False)
+        try:
+            rig.seed()
+            rig.servers[1].close()
+            statuses = set()
+            for _ in range(4):
+                statuses.add(rig.query('Count(Bitmap(rowID=1, frame="f"))')[0])
+            # The read that drew the dead group answered 503; once g1 is
+            # marked unhealthy the rest route to g0 and succeed.
+            assert 503 in statuses and 200 in statuses
+            assert rig.stats.snapshot().get("replica.failover", 0) == 0
+        finally:
+            rig.close()
+
+
+# -- config / CLI promotion --------------------------------------------------
+
+
+def test_config_replica_promotion(tmp_path):
+    toml = tmp_path / "c.toml"
+    toml.write_text(
+        "[replica]\n"
+        'group = "g1@3"\n'
+        'groups = ["g0=h0:1", "g1=h1:2"]\n'
+        "router-port = 12345\n"
+        "failover = false\n"
+    )
+    cfg = Config.from_toml(str(toml))
+    assert cfg.replica_group == "g1@3"
+    assert cfg.replica_groups == ["g0=h0:1", "g1=h1:2"]
+    assert cfg.replica_router_port == 12345
+    assert cfg.replica_failover is False
+    cfg.apply_env({
+        "PILOSA_TPU_REPLICA_GROUP": "g2@5",
+        "PILOSA_TPU_REPLICA_GROUPS": "a:1, b:2",
+        "PILOSA_TPU_REPLICA_ROUTER_PORT": "4321",
+        "PILOSA_TPU_REPLICA_FAILOVER": "true",
+    })
+    assert cfg.replica_group == "g2@5"
+    assert cfg.replica_groups == ["a:1", "b:2"]
+    assert cfg.replica_router_port == 4321
+    assert cfg.replica_failover is True
+    assert parse_group(cfg.replica_group) == ("g2", 5)
+    assert parse_group("g0") == ("g0", 0)
+    assert format_group("g2", 5) == "g2@5"
+    assert format_group("") == ""
+
+
+def test_router_from_config():
+    from pilosa_tpu.replica import router_from_config
+
+    cfg = Config(host="127.0.0.1:10101")
+    cfg.replica_groups = ["127.0.0.1:1", "gX=127.0.0.1:2"]
+    cfg.replica_router_port = 0
+    cfg.replica_failover = False
+    r = router_from_config(cfg)
+    assert [g.name for g in r.groups] == ["g0", "gX"]
+    assert r.failover is False and r.host == "127.0.0.1"
+
+
+def test_cli_replica_router(rig, capsys):
+    """The replica-router subcommand wires [replica] config + flags."""
+    from pilosa_tpu.cli.main import build_parser
+
+    p = build_parser()
+    args = p.parse_args([
+        "replica-router",
+        "--groups", ",".join(f"g{i}={s.host}" for i, s in enumerate(rig.servers)),
+        "--port", "0",
+        "--test-exit",
+    ])
+    assert args.fn(args) == 0
+    out = capsys.readouterr().out
+    assert "replica-router" in out and "g0=" in out and "g1=" in out
+
+
+def test_cli_replica_router_no_groups(capsys):
+    from pilosa_tpu.cli.main import build_parser
+
+    p = build_parser()
+    args = p.parse_args(["replica-router", "--port", "0", "--test-exit"])
+    assert args.fn(args) == 1
+
+
+# -- lockstep group identity -------------------------------------------------
+
+
+def test_lockstep_group_epoch_guard(tmp_path):
+    """A group-tagged LockstepService serves normally, and the worker
+    epoch guard accepts only entries from ITS incarnation (legacy
+    entries without the fields always pass)."""
+    from pilosa_tpu.core.frame import FrameOptions
+    from pilosa_tpu.core.holder import Holder
+    from pilosa_tpu.parallel.service import LockstepService
+
+    h = Holder(str(tmp_path / "d"))
+    h.open()
+    idx = h.create_index("g")
+    idx.create_frame("f", FrameOptions())
+    idx.frame("f").set_bit("standard", 1, 3)
+    svc = LockstepService(
+        h, control_addr=("127.0.0.1", 0), group="g0", group_epoch=2
+    )
+    assert svc.group == "g0" and svc.group_epoch == 2
+    assert svc._execute("g", 'Count(Bitmap(rowID=1, frame="f"))') == [1]
+    assert svc._epoch_ok({"op": "batch"})  # legacy wire: no identity
+    assert svc._epoch_ok({"op": "batch", "group": "g0", "gepoch": 2})
+    assert not svc._epoch_ok({"op": "batch", "group": "g0", "gepoch": 1})
+    assert not svc._epoch_ok({"op": "batch", "group": "g9", "gepoch": 2})
+    h.close()
+
+
+def test_lockstep_group_from_env(tmp_path, monkeypatch):
+    from pilosa_tpu.core.holder import Holder
+    from pilosa_tpu.parallel.service import LockstepService
+
+    monkeypatch.setenv("PILOSA_TPU_REPLICA_GROUP", "g7@4")
+    h = Holder(str(tmp_path / "d"))
+    h.open()
+    svc = LockstepService(h, control_addr=("127.0.0.1", 0))
+    assert svc.group == "g7" and svc.group_epoch == 4
+    h.close()
+
+
+# -- 2-D mesh construction ---------------------------------------------------
+
+
+def test_replica_mesh_hybrid_fallback(rng):
+    """ReplicaMesh(hybrid=True) on a host with NO DCN topology (this CPU
+    rig) must fall back to the flat create_device_mesh reshape and stay
+    numerically identical to the flat mesh — tier-1 never needs real
+    multi-pod hardware."""
+    import jax
+
+    from pilosa_tpu.ops import bitwise as bw
+    from pilosa_tpu.parallel import ReplicaMesh, replica_gather_count
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    mesh = ReplicaMesh(n_replicas=2, devices=jax.devices()[:8], hybrid=True)
+    assert mesh.hybrid is False  # the fallback engaged (no DCN granules)
+    assert mesh.n_devices == 4 and mesh.n_replicas == 2
+    S, R, W, B = 8, 16, 1024, 12  # the proven test_parallel kernel shape
+    rm = rng.integers(0, 1 << 32, size=(S, R, W), dtype=np.uint32)
+    pairs = rng.integers(0, R, size=(B, 2), dtype=np.int32)
+    got = np.asarray(replica_gather_count(
+        mesh, "and", mesh.shard_stack(rm), jax.numpy.asarray(pairs), interpret=True
+    ))
+    want = [
+        int(bw.np_popcount(rm[:, int(a)] & rm[:, int(b)]).sum()) for a, b in pairs
+    ]
+    assert got.tolist() == want
+
+
+def test_build_group_mesh_single_process():
+    """build_group_mesh picks the flat layout in a single-process job
+    (no DCN to exploit) and returns a plain ReplicaMesh."""
+    import jax
+
+    from pilosa_tpu.parallel.sharded import ReplicaMesh
+    from pilosa_tpu.replica import build_group_mesh
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs 2 devices")
+    mesh = build_group_mesh(n_replicas=2)
+    assert isinstance(mesh, ReplicaMesh)
+    assert mesh.hybrid is False
+    assert mesh.n_replicas == 2
