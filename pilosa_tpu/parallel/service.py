@@ -81,6 +81,7 @@ import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
+from urllib.parse import parse_qs, urlparse
 
 _now = time.perf_counter
 
@@ -702,20 +703,62 @@ class LockstepService:
                 )
 
         def do_GET(self):
-            # Replica-router health probe: 200 while the group can
-            # serve, 503 once degraded (a restarted job answers with a
-            # bumped epoch in X-Pilosa-Group).
-            if self.path.rstrip("/") != "/replica/health":
+            # The replica router forwards admin GETs to a group like
+            # reads, so a lockstep group must answer the common
+            # read-only admin surface itself (the full server's handler
+            # table is not mounted here) — plus the router health probe:
+            # 200 while the group can serve, 503 once degraded (a
+            # restarted job answers with a bumped epoch in
+            # X-Pilosa-Group).
+            svc = self.service
+            parsed = urlparse(self.path)
+            path = parsed.path.rstrip("/") or "/"
+            status = 200
+            if path == "/replica/health":
+                status = 503 if svc._degraded else 200
+                body = json.dumps({
+                    "group": svc.group,
+                    "epoch": svc.group_epoch,
+                    "ranks": svc.n_ranks,
+                    "state": "DEGRADED" if svc._degraded else "UP",
+                }).encode()
+            elif path == "/schema":
+                body = json.dumps({"indexes": svc.holder.schema()}).encode()
+            elif path == "/status":
+                body = json.dumps({"status": {
+                    "state": "DEGRADED" if svc._degraded else "UP",
+                    "group": svc.group,
+                    "epoch": svc.group_epoch,
+                    "ranks": svc.n_ranks,
+                    "indexes": svc.holder.schema(),
+                }}).encode()
+            elif path == "/slices/max":
+                body = json.dumps({"maxSlices": svc.holder.max_slices()}).encode()
+            elif path == "/version":
+                from pilosa_tpu import __version__
+
+                body = json.dumps({"version": __version__}).encode()
+            elif path == "/debug/vars":
+                # No expvar registry on the lockstep shell — the empty
+                # snapshot a stats-less full server would serve.
+                body = b"{}"
+            elif path == "/debug/traces":
+                params = parse_qs(parsed.query)
+                try:
+                    min_ms = float((params.get("min-ms") or ["0"])[0] or 0)
+                    limit = int((params.get("limit") or ["64"])[0] or 64)
+                except ValueError:
+                    status, body = 400, b'{"error": "bad min-ms/limit"}'
+                else:
+                    traces = (
+                        svc.tracer.traces_json(min_ms=min_ms, limit=limit)
+                        if svc.tracer is not None
+                        else []
+                    )
+                    body = json.dumps({"traces": traces}).encode()
+            else:
                 self.send_error(404)
                 return
-            svc = self.service
-            status = 503 if svc._degraded else 200
-            body = json.dumps({
-                "group": svc.group,
-                "epoch": svc.group_epoch,
-                "ranks": svc.n_ranks,
-                "state": "DEGRADED" if svc._degraded else "UP",
-            }).encode()
             self.send_response(status)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))

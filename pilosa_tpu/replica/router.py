@@ -40,6 +40,15 @@ Failure semantics:
   The failed group is marked unhealthy — so reads stop routing there
   and further writes refuse — and the client retries the (idempotent)
   write once the set is quorate again.
+- A write SHED by a group (429, or any answer carrying Retry-After —
+  the admission door under load) is load-dependent, not deterministic,
+  so it is never ACKed as a success: shed before any group committed
+  passes the backpressure through verbatim (no demotion); shed after a
+  sibling committed is a partial write (502 + demotion) like a 5xx.
+- A read answered 504 spent ITS OWN deadline budget — request-scoped,
+  not a group-health signal — so it returns to the client without
+  demoting the group (a burst of tight-deadline reads must not refuse
+  writes cluster-wide via the quorum rule).
 - Health recovery is probe-driven: a background thread GETs
   ``/replica/health`` on unhealthy groups and restores them on a 200.
   A restarted group comes back with a bumped epoch in its
@@ -282,7 +291,13 @@ class ReplicaRouter:
                             sp.graft(json.loads(raw))
                         except ValueError:
                             pass
-                if out[0] < 500:
+                if out[0] < 500 or out[0] == 504:
+                    # <500 is an answer; 504 is deadline-exceeded for
+                    # THIS request's own budget — request-scoped, not a
+                    # group-health signal, so it must never demote the
+                    # group (a burst of tight-deadline reads would
+                    # otherwise mark every group unhealthy and refuse
+                    # all writes via the quorum rule).
                     if trace is not None:
                         trace.root.tags["group"] = g.name
                     extra = {GROUP_HEADER: out[3].get(GROUP_HEADER) or g.name}
@@ -290,9 +305,9 @@ class ReplicaRouter:
                     if ra:
                         extra["Retry-After"] = ra
                     return out[0], out[1], out[2], extra
-                # 5xx: this group cannot serve; a degraded lockstep
-                # group answers 503 until its job restarts, so stop
-                # routing reads there and let the probe restore it.
+                # Other 5xx: this group cannot serve; a degraded
+                # lockstep group answers 503 until its job restarts, so
+                # stop routing reads there and let the probe restore it.
                 self._mark_unhealthy(g, f"HTTP {out[0]} on read")
             # One-shot failover: reads are side-effect-free, so the
             # retry on a sibling is always safe.
@@ -329,10 +344,12 @@ class ReplicaRouter:
                 )
             self.write_seq += 1
             first_out = None
+            applied = False  # any group committed (2xx) so far
             for g in self.groups:
                 sp = trace.root.child("forward") if trace is not None else None
-                g.inflight += 1
-                self.stats.gauge(f"replica.inflight.{g.name}", g.inflight)
+                with self._mu:  # inflight is shared with _pick/_release
+                    g.inflight += 1
+                    self.stats.gauge(f"replica.inflight.{g.name}", g.inflight)
                 try:
                     out = self._forward(
                         g, method, path_qs, body, headers, deadline=deadline,
@@ -348,13 +365,37 @@ class ReplicaRouter:
                     self._release(g)
                 if sp is not None:
                     sp.finish().annotate(group=g.name, status=out[0])
-                if out[0] >= 500:
+                # A shed (429, or any non-5xx answer carrying
+                # Retry-After) is LOAD-dependent, not deterministic:
+                # under load one group can shed a write its siblings
+                # applied, so it must never be ACKed as a success.
+                shed = out[0] == 429 or (out[0] < 500 and out[3].get("Retry-After"))
+                if shed and not applied:
+                    # Shed before ANY group committed: nothing is
+                    # partially applied, so pass the backpressure
+                    # through verbatim — no demotion (the group is
+                    # loaded, not broken) and the client just retries.
+                    self.stats.count("replica.write_shed")
+                    extra = {GROUP_HEADER: g.name}
+                    ra = out[3].get("Retry-After")
+                    if ra:
+                        extra["Retry-After"] = ra
+                    return out[0], out[1], out[2], extra
+                if out[0] >= 500 or shed:
+                    # Failed (or shed) AFTER a sibling committed: the
+                    # write is partially applied.  Demote the group so
+                    # further writes refuse (503) until the probe
+                    # restores it — the idempotent retry then re-aligns
+                    # the groups.
                     self._mark_unhealthy(g, f"HTTP {out[0]} on write")
                     self.stats.count("replica.write_error")
                     return self._partial_write(g, f"HTTP {out[0]}")
-                # 4xx is deterministic (identical schema + total order):
-                # every group answers the same, keep fanning so a
-                # mutating call that DID apply elsewhere stays aligned.
+                # Deterministic 4xx (parse/schema: 400/404/409) answers
+                # identically on every group (identical schema + total
+                # order) — keep fanning so a mutating call that DID
+                # apply elsewhere stays aligned.
+                if out[0] < 300:
+                    applied = True
                 if first_out is None:
                     first_out = out
             self.stats.count("replica.write_fanout")

@@ -230,6 +230,83 @@ def test_partial_write_failure_answers_502_and_degrades(rig, monkeypatch):
     assert rig.direct_count(0) == rig.direct_count(1) == 1
 
 
+def test_write_shed_never_acked_as_success(rig, monkeypatch):
+    """A 429 shed is LOAD-dependent, not deterministic: shed at the
+    FIRST group passes the backpressure through (nothing applied, no
+    demotion); shed AFTER a sibling committed is a partial write (502 +
+    demotion) — the client never gets a success ack while a group
+    silently missed the write."""
+    rig.seed()
+    real = rig.router._forward
+    g0, g1 = rig.router.groups
+    shed = (
+        429, "application/json",
+        json.dumps({"error": "shed"}).encode(), {"Retry-After": "0.250"},
+    )
+
+    def shed_first(g, method, path_qs, body, headers, **kw):
+        if g is g0 and b"SetBit" in body:
+            return shed
+        return real(g, method, path_qs, body, headers, **kw)
+
+    monkeypatch.setattr(rig.router, "_forward", shed_first)
+    st, body, hdrs = rig.query('SetBit(rowID=1, frame="f", columnID=2)')
+    assert st == 429 and hdrs.get("Retry-After") == "0.250"
+    # Nothing applied anywhere, and a loaded group is NOT demoted.
+    assert rig.direct_count(0) == 0 and rig.direct_count(1) == 0
+    assert g0.healthy and g1.healthy
+    assert rig.stats.snapshot().get("replica.write_shed", 0) == 1
+
+    # Shed at the SECOND group after the first committed: partial write.
+    def shed_second(g, method, path_qs, body, headers, **kw):
+        if g is g1 and b"SetBit" in body:
+            return shed
+        return real(g, method, path_qs, body, headers, **kw)
+
+    monkeypatch.setattr(rig.router, "_forward", shed_second)
+    st, body, _ = rig.query('SetBit(rowID=1, frame="f", columnID=2)')
+    assert st == 502 and "partially applied" in json.loads(body)["error"]
+    assert rig.direct_count(0) == 1 and rig.direct_count(1) == 0
+    assert not g1.healthy  # demoted: further writes refuse until recovery
+    assert rig.query('SetBit(rowID=1, frame="f", columnID=3)')[0] == 503
+    # The probe restores g1 (it is alive) and the idempotent retry
+    # re-aligns the groups.
+    monkeypatch.setattr(rig.router, "_forward", real)
+    deadline = time.monotonic() + 5
+    while not g1.healthy and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert g1.healthy
+    assert rig.query('SetBit(rowID=1, frame="f", columnID=2)')[0] == 200
+    assert rig.direct_count(0) == rig.direct_count(1) == 1
+
+
+def test_read_504_is_request_scoped_not_group_health(rig, monkeypatch):
+    """A 504 spent the REQUEST's own deadline budget, not the group's
+    health: it returns to the client without demoting the group, so a
+    burst of tight-deadline reads can never mark every group unhealthy
+    and refuse writes cluster-wide via the quorum rule."""
+    rig.seed()
+    real = rig.router._forward
+
+    def deadline_504(g, method, path_qs, body, headers, **kw):
+        if b"Count" in body:
+            return (
+                504, "application/json",
+                json.dumps({"error": "deadline exceeded"}).encode(), {},
+            )
+        return real(g, method, path_qs, body, headers, **kw)
+
+    monkeypatch.setattr(rig.router, "_forward", deadline_504)
+    for _ in range(6):  # enough to have drawn BOTH groups
+        assert rig.query('Count(Bitmap(rowID=1, frame="f"))')[0] == 504
+    assert all(g.healthy for g in rig.router.groups)
+    assert rig.router.quorate()
+    assert rig.stats.snapshot().get("replica.failover", 0) == 0
+    # Writes still flow: the deadline burst demoted nobody.
+    monkeypatch.setattr(rig.router, "_forward", real)
+    assert rig.query('SetBit(rowID=1, frame="f", columnID=1)')[0] == 200
+
+
 def test_router_deadline_and_trace():
     """The router honors deadlines at ITS door (an expired request never
     reaches a group) and forwards the remaining budget on the hop; a
@@ -430,6 +507,79 @@ def test_lockstep_group_epoch_guard(tmp_path):
     assert not svc._epoch_ok({"op": "batch", "group": "g0", "gepoch": 1})
     assert not svc._epoch_ok({"op": "batch", "group": "g9", "gepoch": 2})
     h.close()
+
+
+def test_lockstep_front_end_serves_admin_gets(tmp_path):
+    """The lockstep front end answers the common read-only admin GETs
+    the router forwards like reads (/schema, /status, /slices/max,
+    /version, /debug/vars, /debug/traces) — not just /replica/health —
+    so admin tooling works unchanged through the router over lockstep
+    groups."""
+    import threading
+
+    from pilosa_tpu.core.frame import FrameOptions
+    from pilosa_tpu.core.holder import Holder
+    from pilosa_tpu.parallel.service import LockstepService
+
+    h = Holder(str(tmp_path / "d"))
+    h.open()
+    idx = h.create_index("g")
+    idx.create_frame("f", FrameOptions())
+    idx.frame("f").set_bit("standard", 1, 3)
+    svc = LockstepService(
+        h, control_addr=("127.0.0.1", 0), http_addr=("127.0.0.1", 0),
+        group="g0", group_epoch=1,
+    )
+    threading.Thread(target=svc.serve_forever, daemon=True).start()
+    deadline = time.monotonic() + 10
+    while svc._httpd is None and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert svc._httpd is not None, "lockstep front end never bound"
+    base = f"http://{svc.http_addr[0]}:{svc.http_addr[1]}"
+
+    def get(path):
+        rq = urllib.request.Request(base + path)
+        try:
+            with urllib.request.urlopen(rq, timeout=10) as resp:
+                return resp.status, json.loads(resp.read()), dict(resp.headers)
+        except urllib.error.HTTPError as e:
+            return e.code, {}, dict(e.headers)
+
+    try:
+        st, schema, hdrs = get("/schema")
+        assert st == 200 and [x["name"] for x in schema["indexes"]] == ["g"]
+        assert hdrs.get(GROUP_HEADER) == "g0@1"
+        st, status, _ = get("/status")
+        assert st == 200 and status["status"]["state"] == "UP"
+        assert status["status"]["group"] == "g0"
+        st, sm, _ = get("/slices/max")
+        assert st == 200 and "maxSlices" in sm
+        st, ver, _ = get("/version")
+        assert st == 200 and "version" in ver
+        assert get("/debug/vars")[0] == 200
+        st, tr, _ = get("/debug/traces")
+        assert st == 200 and tr["traces"] == []
+        assert get("/replica/health")[0] == 200
+        assert get("/nope")[0] == 404
+        # Through the router: admin GETs route like reads and now
+        # answer over a lockstep group instead of 404ing.
+        router = ReplicaRouter(
+            [f"g0={svc.http_addr[0]}:{svc.http_addr[1]}"],
+            stats=ExpvarStatsClient(),
+        ).serve()
+        try:
+            rq = urllib.request.Request(
+                f"http://127.0.0.1:{router.port}/schema"
+            )
+            with urllib.request.urlopen(rq, timeout=10) as resp:
+                assert resp.status == 200
+                got = json.loads(resp.read())
+                assert [x["name"] for x in got["indexes"]] == ["g"]
+        finally:
+            router.close()
+    finally:
+        svc.shutdown()
+        h.close()
 
 
 def test_lockstep_group_from_env(tmp_path, monkeypatch):
