@@ -1871,6 +1871,223 @@ def bench_replica() -> dict:
     }
 
 
+def bench_recovery() -> dict:
+    """Durable-write-log recovery tier: write availability through the
+    replica router when a group dies, and convergence time when it
+    comes back.  3 group SUBPROCESSES (pinned data dirs, so a restart
+    resumes from disk) behind an out-of-process CLI router running a
+    DURABLE WAL:
+
+    - ``writes_3g``: sequential write throughput with the full group
+      set (the fixed-cost baseline: WAL append + 3-way fan-out);
+    - ``writes_2g``: the LAST group is SIGKILLed mid-stream and the
+      writes keep flowing on the degraded quorum — the tier asserts
+      ZERO failed writes in this phase (the old full-set rule 503'd
+      every one of them);
+    - ``catchup``: the killed group restarts (same data dir, bumped
+      epoch), the router replays the missed WAL suffix, and the tier
+      measures time-to-rejoin plus asserts CONVERGENCE (identical
+      query results on every group) and that reads route to the
+      rejoined group again.
+
+    ``BENCH_RECOVERY_WRITES`` sizes each write phase; ``BENCH_SMOKE=1``
+    shrinks for CI."""
+    import shutil
+    import subprocess
+    import sys
+    import tempfile
+    import urllib.error
+    import urllib.request
+
+    from pilosa_tpu.server.client import Client
+
+    smoke = os.environ.get("BENCH_SMOKE", "").lower() in ("1", "true", "yes")
+    n_writes = int(os.environ.get("BENCH_RECOVERY_WRITES", "60" if smoke else "600"))
+    batch = int(os.environ.get("BENCH_BATCH", "8"))
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    worker = os.path.join(repo, "tests", "replica_group_worker.py")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = repo
+    env.pop("PILOSA_TPU_QCACHE", None)
+
+    def free_port():
+        import socket
+
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        p = s.getsockname()[1]
+        s.close()
+        return p
+
+    root = tempfile.mkdtemp(prefix="pilosa_recovery_")
+    errs = [open(os.path.join(root, f"err{i}.log"), "w+") for i in range(4)]
+    # FIXED front-door ports: a restarted group must come back at the
+    # same address the router holds.
+    group_ports = [free_port() for _ in range(3)]
+
+    def spawn_group(i: int, epoch: int):
+        genv = dict(env)
+        genv["PILOSA_WORKER_DATA_DIR"] = os.path.join(root, f"g{i}")
+        genv["PILOSA_WORKER_HOST"] = f"127.0.0.1:{group_ports[i]}"
+        p = subprocess.Popen(
+            [sys.executable, worker, f"g{i}@{epoch}"],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE, stderr=errs[i],
+            cwd=repo, env=genv, text=True)
+        line = json.loads(p.stdout.readline())
+        assert line.get("ready"), line
+        return p, line["host"]
+
+    procs = []
+    tiers = []
+    try:
+        groups = [spawn_group(i, 1) for i in range(3)]
+        procs = [p for p, _ in groups]
+        hosts = [h for _, h in groups]
+
+        router_port = free_port()
+        router = subprocess.Popen(
+            [sys.executable, "-m", "pilosa_tpu", "replica-router",
+             "--groups", ",".join(f"g{i}={h}" for i, h in enumerate(hosts)),
+             "--port", str(router_port),
+             "--wal-dir", os.path.join(root, "wal"),
+             "--probe-interval", "0.1"],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=errs[3], cwd=repo, env=env, text=True)
+        procs.append(router)
+        line = router.stdout.readline()
+        assert "replica-router" in line, line
+
+        rc = Client(f"127.0.0.1:{router_port}", timeout=60)
+        rc.create_index("r")
+        rc.create_frame("r", "f")
+
+        def write_phase(start: int, n: int) -> dict:
+            """Sequential batched writes; every one must COMMIT."""
+            failed = 0
+            t0 = time.perf_counter()
+            for k in range(start, start + n, batch):
+                q = " ".join(
+                    f'SetBit(rowID=1, frame="f", columnID={c})'
+                    for c in range(k, min(k + batch, start + n))
+                )
+                try:
+                    rc.execute_query("r", q)
+                except Exception:  # noqa: BLE001 — ClientError carries status
+                    failed += 1
+            dt = time.perf_counter() - t0
+            return {
+                "write_qps": round(n / dt, 1),
+                "writes": n,
+                "failed_batches": failed,
+                "batch": batch,
+            }
+
+        def rstatus() -> dict:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{router_port}/replica/status", timeout=10
+            ) as resp:
+                return json.loads(resp.read())
+
+        def direct_count(host: str) -> int:
+            req = urllib.request.Request(
+                f"http://{host}/index/r/query",
+                data=b'Count(Bitmap(rowID=1, frame="f"))', method="POST")
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                return json.loads(resp.read())["results"][0]
+
+        tiers.append({"tier": "writes_3g", "groups": 3, **write_phase(0, n_writes)})
+        assert tiers[-1]["failed_batches"] == 0, tiers[-1]
+
+        # Kill the LAST group hard, mid-stream: writes must KEEP
+        # COMMITTING on the degraded quorum — the headline behavior the
+        # WAL buys (the old full-set rule turned this into a 503 storm).
+        procs[2].kill()
+        tiers.append({
+            "tier": "writes_2g", "groups": 2,
+            **write_phase(n_writes, n_writes),
+        })
+        no_storm = tiers[-1]["failed_batches"] == 0
+        assert no_storm, tiers[-1]
+        assert direct_count(hosts[0]) == direct_count(hosts[1]) == 2 * n_writes
+
+        # Restart the dead group (same data dir, bumped epoch) and time
+        # catch-up: restart -> probe -> WAL suffix replay -> rejoin.
+        t_restart = time.perf_counter()
+        p2, h2 = spawn_group(2, 2)
+        procs[2] = p2
+        hosts[2] = h2
+        catchup_s = None
+        deadline = time.monotonic() + (60 if smoke else 300)
+        while time.monotonic() < deadline:
+            g2 = next(g for g in rstatus()["groups"] if g["name"] == "g2")
+            if g2["healthy"] and g2["caughtUp"]:
+                catchup_s = round(time.perf_counter() - t_restart, 3)
+                break
+            time.sleep(0.05)
+        assert catchup_s is not None, "g2 never rejoined"
+        converged = (
+            direct_count(hosts[2]) == direct_count(hosts[0]) == 2 * n_writes
+        )
+        assert converged
+        # Reads route to the rejoined group again.
+        served = set()
+        for _ in range(12):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{router_port}/index/r/query",
+                data=b'Count(Bitmap(rowID=1, frame="f"))', method="POST")
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                resp.read()
+                served.add((resp.headers.get("X-Pilosa-Group") or "").split("@")[0])
+        rejoined_reads = "g2" in served
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{router_port}/debug/vars", timeout=10
+        ) as resp:
+            snap = json.loads(resp.read())
+        tiers.append({
+            "tier": "catchup",
+            "catchup_s": catchup_s,
+            "replayed": snap.get("replica.replayed", 0),
+            "lag_at_restart": n_writes // batch + (1 if n_writes % batch else 0),
+            "converged": converged,
+            "rejoined_reads": rejoined_reads,
+            "wal": rstatus()["wal"],
+        })
+    finally:
+        for p in procs:
+            try:
+                p.kill()
+            except Exception:  # noqa: BLE001
+                pass
+        for p in procs:
+            try:
+                p.wait(timeout=30)
+            except Exception:  # noqa: BLE001
+                pass
+        for f in errs:
+            f.close()
+        shutil.rmtree(root, ignore_errors=True)
+
+    by = {t["tier"]: t for t in tiers}
+    qps3, qps2 = by["writes_3g"]["write_qps"], by["writes_2g"]["write_qps"]
+    return {
+        "metric": "recovery_write_qps",
+        "value": qps2,
+        "unit": (
+            f"committed writes/sec on the DEGRADED quorum (2/3 groups, batch "
+            f"{batch}; full set {qps3} w/s; zero failed writes with a group "
+            f"down; catch-up replayed the {by['catchup']['replayed']}-record "
+            f"WAL suffix in {by['catchup']['catchup_s']} s and the group "
+            f"rejoined reads converged)"
+        ),
+        "vs_baseline": round(qps2 / qps3, 3) if qps3 else None,
+        "catchup_s": by["catchup"]["catchup_s"],
+        "cpus": os.cpu_count(),
+        "tiers": tiers,
+    }
+
+
 def bench_qcache() -> dict:
     """Query-result-cache tier: a Zipf-skewed repeated read mix (the
     dashboard steady state — the same few queries hit over and over)
@@ -2121,6 +2338,7 @@ def main() -> None:
             "overload": bench_overload,
             "qcache": bench_qcache,
             "replica": bench_replica,
+            "recovery": bench_recovery,
             "intersect_count_stream": bench_intersect_stream,
             "intersect_count_4krows": bench_intersect_4krows,
             "topn_p50": bench_topn_p50,

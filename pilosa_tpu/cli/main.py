@@ -173,6 +173,10 @@ def cmd_replica_router(args) -> int:
         cfg.replica_groups = [g.strip() for g in args.groups.split(",") if g.strip()]
     if getattr(args, "port", None) is not None:
         cfg.replica_router_port = args.port
+    if getattr(args, "wal_dir", None):
+        cfg.replica_wal_dir = args.wal_dir
+    if getattr(args, "probe_interval", None) is not None:
+        cfg.replica_probe_interval = args.probe_interval
     if not cfg.replica_groups:
         print("error: no replica groups configured "
               "(--groups / [replica] groups / PILOSA_TPU_REPLICA_GROUPS)",
@@ -183,10 +187,14 @@ def cmd_replica_router(args) -> int:
         cfg, stats=stats, tracer=trace_mod.from_config(cfg, stats=stats)
     )
     router.serve()
+    wal_note = (
+        f", wal: {cfg.replica_wal_dir}" if cfg.replica_wal_dir else ", wal: memory"
+    )
     print(
         f"pilosa-tpu replica-router on http://{router.host}:{router.port} "
         f"over {len(router.groups)} groups: "
-        + ", ".join(f"{g.name}={g.base}" for g in router.groups),
+        + ", ".join(f"{g.name}={g.base}" for g in router.groups)
+        + wal_note,
         flush=True,
     )
     if args.test_exit:  # for CLI tests: start, report, stop
@@ -422,6 +430,16 @@ def build_parser() -> argparse.ArgumentParser:
              "([replica] groups / PILOSA_TPU_REPLICA_GROUPS)",
     )
     s.add_argument("--port", type=int, help="router bind port ([replica] router-port)")
+    s.add_argument(
+        "--wal-dir", dest="wal_dir",
+        help="durable write-ahead-log directory ([replica] wal-dir; "
+             "omit for an in-memory log)",
+    )
+    s.add_argument(
+        "--probe-interval", dest="probe_interval", type=float,
+        help="base health-probe interval in seconds, doubled with jitter "
+             "per failed probe ([replica] probe-interval)",
+    )
     s.add_argument("--test-exit", action="store_true", help=argparse.SUPPRESS)
     s.set_defaults(fn=cmd_replica_router)
 

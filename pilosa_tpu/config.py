@@ -114,6 +114,23 @@ class Config:
     # One-shot read failover to a sibling group on connect/5xx failure
     # (reads are side-effect-free, so the retry is always safe).
     replica_failover: bool = True
+    # Health-probe cadence for down/lagging groups: the base interval,
+    # doubled (with jitter) per failed probe up to the cap and reset on
+    # recovery — a dead group is not hammered in lockstep by every
+    # router.
+    replica_probe_interval: float = 1.0
+    replica_probe_max_interval: float = 30.0
+    # Router write-ahead log directory ("" = in-memory: same sequence /
+    # abort / replay semantics, no crash durability) and the backlog
+    # bound: a laggard that would pin the log past wal-max-bytes is
+    # declared stale (operator resync) instead of growing it unbounded.
+    replica_wal_dir: str = ""
+    replica_wal_max_bytes: int = 64 << 20
+    # -- HTTP client ([client] TOML section) ------------------------------
+    # Retry budget for door sheds (429/503 — both issued BEFORE any
+    # execution, so writes are safe to retry): total extra attempts per
+    # logical request, deadline-aware, decorrelated-jitter backoff.
+    client_retry_budget: int = 2
     # -- lockstep service ([lockstep] TOML section) ----------------------
     # Rank-0 wait for a worker's receipt ack (control-plane latency +
     # scheduling, not execution) and a worker's connect retry window at
@@ -179,6 +196,20 @@ class Config:
         cfg.replica_groups = list(rep.get("groups", cfg.replica_groups))
         cfg.replica_router_port = int(rep.get("router-port", cfg.replica_router_port))
         cfg.replica_failover = bool(rep.get("failover", cfg.replica_failover))
+        cfg.replica_probe_interval = _interval(
+            rep.get("probe-interval"), cfg.replica_probe_interval
+        )
+        cfg.replica_probe_max_interval = _interval(
+            rep.get("probe-max-interval"), cfg.replica_probe_max_interval
+        )
+        cfg.replica_wal_dir = str(rep.get("wal-dir", cfg.replica_wal_dir))
+        cfg.replica_wal_max_bytes = int(
+            rep.get("wal-max-bytes", cfg.replica_wal_max_bytes)
+        )
+        cli = raw.get("client", {})
+        cfg.client_retry_budget = int(
+            cli.get("retry-budget", cfg.client_retry_budget)
+        )
         ls = raw.get("lockstep", {})
         cfg.lockstep_ack_timeout = _interval(
             ls.get("ack-timeout"), cfg.lockstep_ack_timeout
@@ -261,6 +292,20 @@ class Config:
             self.replica_failover = env["PILOSA_TPU_REPLICA_FAILOVER"].lower() in (
                 "1", "true", "yes",
             )
+        if "PILOSA_TPU_REPLICA_PROBE_INTERVAL" in env:
+            self.replica_probe_interval = float(
+                env["PILOSA_TPU_REPLICA_PROBE_INTERVAL"]
+            )
+        if "PILOSA_TPU_REPLICA_PROBE_MAX_INTERVAL" in env:
+            self.replica_probe_max_interval = float(
+                env["PILOSA_TPU_REPLICA_PROBE_MAX_INTERVAL"]
+            )
+        if "PILOSA_TPU_REPLICA_WAL_DIR" in env:
+            self.replica_wal_dir = env["PILOSA_TPU_REPLICA_WAL_DIR"]
+        if "PILOSA_TPU_REPLICA_WAL_MAX_BYTES" in env:
+            self.replica_wal_max_bytes = int(env["PILOSA_TPU_REPLICA_WAL_MAX_BYTES"])
+        if "PILOSA_TPU_CLIENT_RETRY_BUDGET" in env:
+            self.client_retry_budget = int(env["PILOSA_TPU_CLIENT_RETRY_BUDGET"])
         if "PILOSA_TPU_LOCKSTEP_ACK_TIMEOUT" in env:
             self.lockstep_ack_timeout = float(env["PILOSA_TPU_LOCKSTEP_ACK_TIMEOUT"])
         if "PILOSA_TPU_LOCKSTEP_CONNECT_TIMEOUT" in env:

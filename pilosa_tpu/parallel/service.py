@@ -177,6 +177,20 @@ class LockstepService:
             group_epoch = env_epoch
         self.group = group or ""
         self.group_epoch = int(group_epoch or 0)
+        # Replica durability: rank 0 tracks (and persists beside the
+        # holder data) the highest router write sequence this group has
+        # applied — reported on every response (X-Pilosa-Applied-Seq)
+        # and at /replica/health, so a restarted lockstep job tells the
+        # router exactly which WAL suffix to replay.  Workers never see
+        # HTTP headers; the front end is the single writer.
+        from pilosa_tpu.replica.catchup import AppliedSeq
+
+        holder_path = getattr(holder, "path", None)
+        self.applied_seq = AppliedSeq(
+            os.path.join(holder_path, "applied_seq")
+            if (self.group and holder_path and self.rank == 0)
+            else None
+        )
         self.engine = MeshEngine(devices if devices is not None else jax.devices())
         # Query result cache, DETERMINISTIC variant: hit/miss must be a
         # pure function of replicated state (request strings + the
@@ -694,12 +708,19 @@ class LockstepService:
             pass
 
         def _group_header(self) -> None:
-            from pilosa_tpu.replica import GROUP_HEADER, format_group
+            from pilosa_tpu.replica import (
+                APPLIED_SEQ_HEADER,
+                GROUP_HEADER,
+                format_group,
+            )
 
             if self.service.group:
                 self.send_header(
                     GROUP_HEADER,
                     format_group(self.service.group, self.service.group_epoch),
+                )
+                self.send_header(
+                    APPLIED_SEQ_HEADER, str(self.service.applied_seq.value)
                 )
 
         def do_GET(self):
@@ -720,6 +741,7 @@ class LockstepService:
                     "group": svc.group,
                     "epoch": svc.group_epoch,
                     "ranks": svc.n_ranks,
+                    "appliedSeq": svc.applied_seq.value,
                     "state": "DEGRADED" if svc._degraded else "UP",
                 }).encode()
             elif path == "/schema":
@@ -730,6 +752,7 @@ class LockstepService:
                     "group": svc.group,
                     "epoch": svc.group_epoch,
                     "ranks": svc.n_ranks,
+                    "appliedSeq": svc.applied_seq.value,
                     "indexes": svc.holder.schema(),
                 }}).encode()
             elif path == "/slices/max":
@@ -783,6 +806,7 @@ class LockstepService:
             # flag), this only carries the client's request for it.
             trace_force = bool((headers.get("x-pilosa-trace") or "").strip())
             retry_after = None
+            status = 500
             try:
                 results = self.service._execute(
                     index, query, deadline=deadline, trace_force=trace_force
@@ -810,6 +834,13 @@ class LockstepService:
                 # not a silently dropped connection.
                 body = json.dumps({"error": f"internal: {e}"}).encode()
                 status = 500
+            # Replica durability: a router-sequenced write that answered
+            # deterministically (applied, or a deterministic 400) is
+            # recorded as this group's applied high-water mark; sheds
+            # (429), degraded 503s, and internal errors stay replayable.
+            from pilosa_tpu.replica.catchup import note_applied_from_headers
+
+            note_applied_from_headers(self.service.applied_seq, headers, status)
             self.send_response(status)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))

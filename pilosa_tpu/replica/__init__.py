@@ -36,8 +36,21 @@ router the group's IN-MEMORY state (generation vectors, qcache) was
 rebuilt from disk; nothing cross-group needs invalidating because no
 cache entry ever crosses a group boundary.
 
-Config: ``[replica] group / groups / router-port / failover`` TOML keys
-with ``PILOSA_TPU_REPLICA_*`` env overrides, wired through
+DURABILITY & RECOVERY (PR 7): the router sequences every accepted
+write into a WRITE-AHEAD LOG (:mod:`pilosa_tpu.replica.wal`) before
+fan-out, commits on a DEGRADED QUORUM (majority of groups), and
+re-converges down/lagging groups by streaming them the missed WAL
+suffix (:mod:`pilosa_tpu.replica.catchup`) — a single dead group no
+longer halts ingest cluster-wide.  Each group tracks and reports its
+last-applied write sequence (``X-Pilosa-Applied-Seq`` beside
+``X-Pilosa-Group``, plus the ``/replica/health`` JSON); only a fully
+caught-up group serves reads.  Partial-failure orderings are
+reproducible through the deterministic fault seam
+(:mod:`pilosa_tpu.replica.faults`, ``PILOSA_TPU_FAULT_SPEC``).
+
+Config: ``[replica] group / groups / router-port / failover /
+probe-interval / probe-max-interval / wal-dir / wal-max-bytes`` TOML
+keys with ``PILOSA_TPU_REPLICA_*`` env overrides, wired through
 ``pilosa-tpu replica-router`` and the lockstep CLI.
 """
 
@@ -47,6 +60,22 @@ from __future__ import annotations
 # set by every group front door, read back by the router (epoch-bump
 # detection) and by clients that want to know which replica answered.
 GROUP_HEADER = "X-Pilosa-Group"
+
+# Request header carrying the router-assigned WAL sequence number of a
+# write (fan-out and catch-up replays alike); the group notes it as its
+# applied high-water mark once the route answers deterministically.
+WRITE_SEQ_HEADER = "X-Pilosa-Write-Seq"
+
+# Response header: the group's last-applied write sequence, stamped
+# beside X-Pilosa-Group on every response — the router's passive lag
+# tracking (the /replica/health JSON carries the same number for the
+# probe).
+APPLIED_SEQ_HEADER = "X-Pilosa-Applied-Seq"
+
+# Request header marking a catch-up replay (vs a live client write):
+# groups tag sampled trace roots ``replay=true`` so replayed traffic is
+# distinguishable at /debug/traces.
+REPLAY_HEADER = "X-Pilosa-Replay"
 
 
 def parse_group(spec: str) -> tuple[str, int]:
@@ -71,6 +100,18 @@ def __getattr__(name):
         from pilosa_tpu.replica import router as _router
 
         return getattr(_router, name)
+    if name in ("WriteAheadLog", "WalRecord"):
+        from pilosa_tpu.replica import wal as _wal
+
+        return getattr(_wal, name)
+    if name in ("AppliedSeq", "CatchupManager", "note_applied_from_headers"):
+        from pilosa_tpu.replica import catchup as _catchup
+
+        return getattr(_catchup, name)
+    if name in ("FaultInjector", "FaultError", "InjectedStatus", "NOP_FAULTS"):
+        from pilosa_tpu.replica import faults as _faults
+
+        return getattr(_faults, name)
     if name == "build_group_mesh":
         from pilosa_tpu.replica.mesh import build_group_mesh
 

@@ -13,60 +13,79 @@ Routing policy:
   byte-scan the admission door uses, so a request is a write here iff
   it is a write there).  A false read->write positive only costs fan-out
   latency; a false negative is impossible for PQL mutating calls.
-- READS (and admin GETs) go to ONE healthy group: least-inflight pick,
-  ties broken by fewest-routed so an idle router round-robins.  On a
-  connect failure or a 5xx answer the group is marked unhealthy and the
-  read fails over ONCE to a sibling group (reads are side-effect-free,
-  so the retry is safe; ``[replica] failover = false`` disables it).
+- READS (and admin GETs) go to ONE healthy, CAUGHT-UP group:
+  least-inflight pick, ties broken by fewest-routed so an idle router
+  round-robins.  On a connect failure or a 5xx answer the group is
+  marked unhealthy and the read fails over ONCE to a sibling group
+  (reads are side-effect-free, so the retry is safe; ``[replica]
+  failover = false`` disables it).  A lagging group never serves reads
+  — that is what preserves read-your-writes across groups now that a
+  write can commit without it.
 - WRITES (and mutating admin — schema must stay identical everywhere)
-  ship to ALL groups through ONE sequencer: the sequencer lock is held
-  for the whole fan-out, so every group applies every write in the same
-  total order and the groups' fragment generation vectors advance
-  identically.  That is the invariant that keeps each group's qcache
-  and serve-state repair read-your-writes correct with zero cross-group
-  invalidation traffic.  A write is ACKed only after EVERY group
-  applied it, so a read routed to any group immediately after the ack
-  sees it.
+  run through ONE sequencer: each accepted write is assigned a
+  monotonic sequence number and appended to the WRITE-AHEAD LOG
+  (``replica/wal.py``) BEFORE any group sees it, then fanned to every
+  in-rotation group with the sequence riding ``X-Pilosa-Write-Seq``.
+  The sequencer lock is held for the whole fan-out, so every group
+  applies every write in the same total order and the groups' fragment
+  generation vectors advance identically — the invariant that keeps
+  each group's qcache and serve-state repair read-your-writes correct
+  with zero cross-group invalidation traffic.
 
-Failure semantics:
+Failure semantics (the durable-log upgrade of PR 6's full-set rule):
 
-- The group set must be QUORATE (every configured group healthy) for
-  writes: a write against a degraded set answers 503 + Retry-After
-  WITHOUT touching any group.  Because no write is accepted while a
-  group is down, a recovering group missed no acknowledged writes and
-  rejoins with no catch-up protocol.
-- A write that fails MID-fan-out (connect error / 5xx from one group)
-  answers 502: it may be partially applied (earlier groups committed).
-  The failed group is marked unhealthy — so reads stop routing there
-  and further writes refuse — and the client retries the (idempotent)
-  write once the set is quorate again.
-- A write SHED by a group (429, or any answer carrying Retry-After —
-  the admission door under load) is load-dependent, not deterministic,
-  so it is never ACKed as a success: shed before any group committed
-  passes the backpressure through verbatim (no demotion); shed after a
-  sibling committed is a partial write (502 + demotion) like a 5xx.
+- QUORUM is now a MAJORITY of the configured groups.  A write COMMITS
+  (2xx to the client) once >= majority of groups applied it; groups
+  that are down, lagging, or failed mid-fan-out simply miss the write
+  and accumulate a bounded backlog in the WAL instead of blocking the
+  cluster — one dead group no longer 503s every write.  Writes refuse
+  (503 + Retry-After, touching no group and appending nothing) only
+  when fewer than a majority of groups are in rotation.
+- A write that reached SOME group but fewer than a majority answers
+  502 "may be partially applied": the record stays in the log, the
+  laggards re-converge by replay, and the idempotent client retry is
+  harmless.
+- A write SHED by a group (429, or any non-5xx answer carrying
+  Retry-After — the admission door under load) is load-dependent, not
+  deterministic: shed before ANY group committed passes the
+  backpressure through verbatim and ABORTS the log record (tombstoned
+  — replay can never deliver a write no live group holds); shed after
+  a sibling committed just makes the shedding group a laggard (demoted
+  + replayed later), and the write still commits if a majority
+  applied.
 - A read answered 504 spent ITS OWN deadline budget — request-scoped,
   not a group-health signal — so it returns to the client without
-  demoting the group (a burst of tight-deadline reads must not refuse
-  writes cluster-wide via the quorum rule).
-- Health recovery is probe-driven: a background thread GETs
-  ``/replica/health`` on unhealthy groups and restores them on a 200.
-  A restarted group comes back with a bumped epoch in its
-  ``X-Pilosa-Group`` header; the router records it and counts
-  ``replica.epoch_bump``.
+  demoting the group.
+- RECOVERY is probe + replay: a background loop probes down/lagging
+  groups with jittered exponential backoff per group (``[replica]
+  probe-interval`` base, doubled per failed probe up to
+  ``probe-max-interval``, reset on recovery — a dead group is not
+  hammered in lockstep by every router).  A live group reporting a
+  stale applied sequence gets the missed WAL suffix streamed in order
+  (``replica/catchup.py``; epoch-guarded, so a restarted incarnation
+  can't absorb a replay paced against its predecessor) and only
+  rejoins the read rotation once FULLY caught up.  A laggard whose
+  backlog would grow the WAL past ``wal-max-bytes`` is declared STALE
+  (``replica.stale.<g>``): the log compacts past it and it can only
+  rejoin via operator resync.
 
 Observability: ``replica.routed.<group>`` / ``replica.failover`` /
-``replica.write_fanout`` (+ refused/error) counters and per-group
-``replica.healthy.<group>`` / ``replica.inflight.<group>`` gauges at
-the router's own ``/debug/vars``; routed requests tag their trace root
-with ``group=<g>`` (and graft the group's span tree under the forward
-span), so the router's ``/debug/traces`` shows which replica served a
-read.  ``/replica/status`` returns the live group table.
+``replica.write_fanout`` (+ refused/error/shed), per-group
+``replica.healthy.<group>`` / ``replica.inflight.<group>`` /
+``replica.lag.<group>`` gauges and ``replica.wal_bytes`` at the
+router's own ``/debug/vars``; ``/replica/status`` returns the live
+group table (health, applied sequence, lag, caught-up/stale flags) and
+the WAL head/tail.  Routed requests tag their trace root with
+``group=<g>`` and graft the group's span tree under the forward span.
+Deterministic fault injection (``replica/faults.py``,
+``PILOSA_TPU_FAULT_SPEC``) hooks the per-group forward and the WAL
+append, so partial-failure orderings are reproducible in tests.
 """
 
 from __future__ import annotations
 
 import json
+import random
 import threading
 import time
 import urllib.error
@@ -77,23 +96,35 @@ from urllib.parse import parse_qs, urlparse
 
 from pilosa_tpu import qos
 from pilosa_tpu.qos import DEADLINE_HEADER
-from pilosa_tpu.replica import GROUP_HEADER
+from pilosa_tpu.replica import (
+    APPLIED_SEQ_HEADER,
+    GROUP_HEADER,
+    REPLAY_HEADER,
+    WRITE_SEQ_HEADER,
+)
+from pilosa_tpu.replica.catchup import CatchupManager
+from pilosa_tpu.replica.faults import FaultInjector, InjectedStatus, NOP_FAULTS
+from pilosa_tpu.replica.wal import WriteAheadLog
 from pilosa_tpu.stats import NOP_STATS
 from pilosa_tpu.trace import TRACE_HEADER, TRACE_SPANS_HEADER
 
 # Headers never forwarded on a hop: ownership is per-connection, the
-# router recomputes lengths, and deadline/trace headers are REWRITTEN
-# (remaining budget, router trace id) rather than copied.
+# router recomputes lengths, deadline/trace headers are REWRITTEN
+# (remaining budget, router trace id), and the write-sequence/replay
+# headers are ROUTER-OWNED (a client must not be able to spoof a
+# group's applied mark).
 _HOP_HEADERS = frozenset(
     ("host", "content-length", "connection", "accept-encoding",
-     DEADLINE_HEADER.lower(), TRACE_HEADER.lower())
+     DEADLINE_HEADER.lower(), TRACE_HEADER.lower(),
+     WRITE_SEQ_HEADER.lower(), REPLAY_HEADER.lower())
 )
 
 
 class GroupState:
     """Router-side record of one serving group."""
 
-    __slots__ = ("name", "base", "healthy", "inflight", "routed", "epoch")
+    __slots__ = ("name", "base", "healthy", "inflight", "routed", "epoch",
+                 "applied_seq", "caught_up", "stale", "probe_delay", "probe_at")
 
     def __init__(self, name: str, base: str):
         self.name = name
@@ -104,6 +135,19 @@ class GroupState:
         self.inflight = 0
         self.routed = 0
         self.epoch: Optional[str] = None  # last X-Pilosa-Group seen
+        # Durable-write bookkeeping: the highest WAL sequence this group
+        # is known to have applied (advanced on write acks, read
+        # passively off X-Pilosa-Applied-Seq, authoritative from the
+        # health probe), whether it is fully caught up to the WAL head
+        # (only caught-up groups serve reads or receive new writes),
+        # and whether it fell so far behind the WAL compacted past it
+        # (stale: operator resync required).
+        self.applied_seq = 0
+        self.caught_up = True
+        self.stale = False
+        # Probe backoff (jittered exponential, per group).
+        self.probe_delay = 0.0
+        self.probe_at = 0.0
 
     def to_json(self) -> dict:
         return {
@@ -113,6 +157,9 @@ class GroupState:
             "inflight": self.inflight,
             "routed": self.routed,
             "epoch": self.epoch,
+            "appliedSeq": self.applied_seq,
+            "caughtUp": self.caught_up,
+            "stale": self.stale,
         }
 
 
@@ -137,6 +184,9 @@ class ReplicaRouter:
         default_deadline_ms: float = 0.0,
         timeout: float = 30.0,
         probe_interval_s: float = 1.0,
+        probe_max_interval_s: float = 30.0,
+        wal: Optional[WriteAheadLog] = None,
+        faults: Optional[FaultInjector] = None,
         stats=None,
         tracer=None,
     ):
@@ -151,29 +201,62 @@ class ReplicaRouter:
         self.default_deadline_ms = default_deadline_ms
         self.timeout = timeout
         self.probe_interval_s = probe_interval_s
+        self.probe_max_interval_s = probe_max_interval_s
         self.stats = stats if stats is not None else NOP_STATS
         self.tracer = tracer
+        self.faults = faults if faults is not None else (
+            FaultInjector.from_env() or NOP_FAULTS
+        )
+        # The durable write log: in-memory when no path was configured
+        # (same sequencing/abort/replay semantics, no crash durability).
+        self.wal = wal if wal is not None else WriteAheadLog(
+            None, stats=self.stats, faults=self.faults
+        )
+        self.catchup = CatchupManager(self, self.wal, stats=self.stats)
         self._mu = threading.Lock()  # group table (health/inflight/epoch)
         # The write sequencer: held for a write's WHOLE fan-out, so all
         # groups see all writes in one total order.
         self._seq_mu = threading.Lock()
-        self.write_seq = 0
+        self.write_seq = self.wal.last_seq
+        # Groups constructed against an existing WAL start unknown-lag:
+        # assume caught up to the head until a probe/response says
+        # otherwise (a fresh router + fresh groups both start at 0).
+        for g in self.groups:
+            g.applied_seq = self.wal.last_seq
+        self._rng = random.Random()  # probe jitter (timing only)
         self._httpd = None
         self._stop = threading.Event()
         self._probe_thread: Optional[threading.Thread] = None
         for g in self.groups:
             self.stats.gauge(f"replica.healthy.{g.name}", 1)
             self.stats.gauge(f"replica.inflight.{g.name}", 0)
+            self.stats.gauge(f"replica.lag.{g.name}", 0)
 
     # -- group table ------------------------------------------------------
 
+    @property
+    def quorum(self) -> int:
+        """Writes commit on a MAJORITY of the configured group set."""
+        return len(self.groups) // 2 + 1
+
+    def _ready_groups(self) -> list:
+        """Groups in the write rotation: reachable, fully caught up to
+        the WAL head, and not stale."""
+        with self._mu:
+            return [
+                g for g in self.groups if g.healthy and g.caught_up and not g.stale
+            ]
+
     def _pick(self, exclude=None) -> Optional[GroupState]:
-        """Least-inflight healthy group (ties: fewest routed, so an idle
-        router spreads sequential reads round-robin across groups)."""
+        """Least-inflight healthy CAUGHT-UP group (ties: fewest routed,
+        so an idle router spreads sequential reads round-robin).  A
+        lagging group is invisible to reads until catch-up finishes —
+        the cross-group read-your-writes rule under degraded quorum."""
         with self._mu:
             live = [
                 g for g in self.groups
-                if g.healthy and (exclude is None or g is not exclude)
+                if g.healthy and g.caught_up and not g.stale
+                and (exclude is None or g is not exclude)
             ]
             if not live:
                 return None
@@ -191,9 +274,15 @@ class ReplicaRouter:
 
     def _mark_unhealthy(self, g: GroupState, why: str) -> None:
         with self._mu:
-            if not g.healthy:
-                return
+            first = g.healthy
             g.healthy = False
+            # Arm the probe backoff: first retry after the base
+            # interval, doubling (with jitter) on every failed probe.
+            if first:
+                g.probe_delay = self.probe_interval_s
+                g.probe_at = time.monotonic() + g.probe_delay * self._rng.uniform(0.5, 1.0)
+        if not first:
+            return
         self.stats.gauge(f"replica.healthy.{g.name}", 0)
         self.stats.count(f"replica.unhealthy.{g.name}")
         self.stats.set("replica.last_failure", f"{g.name}: {why}")
@@ -203,8 +292,28 @@ class ReplicaRouter:
             if g.healthy:
                 return
             g.healthy = True
+            g.probe_delay = self.probe_interval_s
         self.stats.gauge(f"replica.healthy.{g.name}", 1)
         self.stats.count("replica.recovered")
+
+    def _mark_lagging(self, g: GroupState) -> None:
+        """The group missed a sequenced write: out of the read rotation
+        until catch-up replays it to the WAL head."""
+        with self._mu:
+            g.caught_up = False
+        self.stats.gauge(
+            f"replica.lag.{g.name}", max(0, self.wal.last_seq - g.applied_seq)
+        )
+
+    def _backoff(self, g: GroupState) -> None:
+        """One failed probe: double the group's retry delay (jittered,
+        capped) so a dead group is not hammered in lockstep."""
+        with self._mu:
+            g.probe_delay = min(
+                self.probe_max_interval_s,
+                max(self.probe_interval_s, g.probe_delay * 2.0),
+            )
+            g.probe_at = time.monotonic() + g.probe_delay * self._rng.uniform(0.5, 1.5)
 
     def _note_epoch(self, g: GroupState, hdr: Optional[str]) -> None:
         """Track the group identity header; a changed epoch means the
@@ -216,23 +325,48 @@ class ReplicaRouter:
             self.stats.count("replica.epoch_bump")
         g.epoch = hdr
 
+    def _note_applied(self, g: GroupState, hdr: Optional[str]) -> None:
+        """Passive lag tracking: every group response reports its
+        applied sequence high-water mark."""
+        if not hdr:
+            return
+        try:
+            seq = int(hdr)
+        except ValueError:
+            return
+        g.applied_seq = max(g.applied_seq, seq)
+        self.stats.gauge(
+            f"replica.lag.{g.name}", max(0, self.wal.last_seq - g.applied_seq)
+        )
+
     def healthy_count(self) -> int:
         with self._mu:
             return sum(1 for g in self.groups if g.healthy)
 
     def quorate(self) -> bool:
-        """Writes need the FULL group set: while any group is down no
-        write is accepted, which is exactly what lets a recovering group
-        rejoin with no catch-up (it missed no acknowledged writes)."""
-        return self.healthy_count() == len(self.groups)
+        """True when writes can commit: at least a MAJORITY of the
+        configured groups are in rotation (healthy + caught up + not
+        stale).  Minority outages degrade durability of the margin, not
+        availability — the WAL replays the missed suffix to laggards."""
+        return len(self._ready_groups()) >= self.quorum
 
     # -- the hop ----------------------------------------------------------
 
     def _forward(self, g: GroupState, method: str, path_qs: str, body: bytes,
-                 headers: dict, deadline=None, trace_id: str = ""):
+                 headers: dict, deadline=None, trace_id: str = "",
+                 extra_headers: Optional[dict] = None):
         """One HTTP exchange with a group.  Returns (status, ctype,
         payload, response headers); raises OSError on a connect/transport
-        failure (the caller's failover trigger)."""
+        failure (the caller's failover trigger).  ``extra_headers``
+        carries router-owned headers (write sequence, replay marker)."""
+        try:
+            self.faults.hit("forward", key=g.name)
+        except InjectedStatus as e:
+            rh = {"Retry-After": "0.250"} if e.status in (429, 503) else {}
+            return (
+                e.status, "application/json",
+                json.dumps({"error": str(e)}).encode(), rh,
+            )
         fwd = {k: v for k, v in headers.items() if k.lower() not in _HOP_HEADERS}
         timeout = self.timeout
         if deadline is not None:
@@ -242,6 +376,8 @@ class ReplicaRouter:
             timeout = min(timeout, deadline.remaining_ms() / 1000.0 + 1.0)
         if trace_id:
             fwd[TRACE_HEADER] = trace_id
+        if extra_headers:
+            fwd.update(extra_headers)
         req = urllib.request.Request(
             g.base + path_qs, data=body if body else None, method=method
         )
@@ -257,6 +393,7 @@ class ReplicaRouter:
             # the socket-level reason).
             raise OSError(str(e.reason))
         self._note_epoch(g, rheaders.get(GROUP_HEADER))
+        self._note_applied(g, rheaders.get(APPLIED_SEQ_HEADER))
         return status, rheaders.get("Content-Type", "application/json"), payload, rheaders
 
     # -- read path --------------------------------------------------------
@@ -326,26 +463,52 @@ class ReplicaRouter:
 
     def _route_write(self, method: str, path_qs: str, body: bytes, headers: dict,
                      deadline=None, trace=None):
-        """Total-ordered fan-out: the sequencer lock is held end to end,
-        so group k's generation vectors advance through exactly the same
-        write sequence as group 0's — the cross-group read-your-writes
-        invariant the tests pin."""
+        """Sequence into the WAL, then total-ordered fan-out: the
+        sequencer lock is held end to end, so group k's generation
+        vectors advance through exactly the same write sequence as
+        group 0's — the cross-group read-your-writes invariant the
+        tests pin.  COMMIT RULE: >= majority applied -> 2xx; some but
+        fewer -> 502 (record stays, laggards replay); none -> the
+        record is aborted and the failure surfaces verbatim."""
         with self._seq_mu:
-            if not self.quorate():
+            ready = self._ready_groups()
+            if len(ready) < self.quorum:
                 with self._mu:
-                    down = [g.name for g in self.groups if not g.healthy]
+                    out_names = [
+                        g.name for g in self.groups
+                        if not (g.healthy and g.caught_up and not g.stale)
+                    ]
                 self.stats.count("replica.write_refused")
                 if trace is not None:
                     trace.root.tags["qos"] = "write_refused"
                 return self._shed(
                     503,
-                    f"write refused: replica group set not quorate (down: {', '.join(down)})",
+                    "write refused: replica group set not quorate "
+                    f"(need {self.quorum}/{len(self.groups)}, out: {', '.join(out_names)})",
                     retry_after=1.0,
                 )
-            self.write_seq += 1
-            first_out = None
-            applied = False  # any group committed (2xx) so far
+            # DURABILITY FIRST: the record is in the log (fsync-batched)
+            # before any group sees the write — a router crash mid-fan-out
+            # replays the tail instead of losing the order.
+            try:
+                seq = self.wal.append(
+                    method, path_qs, body, headers.get("content-type", "")
+                )
+            except OSError as e:
+                self.stats.count("replica.wal_error")
+                return self._shed(503, f"write log append failed: {e}", retry_after=1.0)
+            self.write_seq = seq
+            # Groups outside the rotation miss this sequence: their
+            # backlog grows in the WAL until catch-up (or staleness).
             for g in self.groups:
+                if g not in ready:
+                    self._mark_lagging(g)
+            first_out = None  # first answer of any kind
+            first_ok = None  # first 2xx — the committed write's answer
+            deterministic_4xx = None
+            applied = 0
+            any_failed = False
+            for g in ready:
                 sp = trace.root.child("forward") if trace is not None else None
                 with self._mu:  # inflight is shared with _pick/_release
                     g.inflight += 1
@@ -354,13 +517,16 @@ class ReplicaRouter:
                     out = self._forward(
                         g, method, path_qs, body, headers, deadline=deadline,
                         trace_id=(trace.id if trace is not None else ""),
+                        extra_headers={WRITE_SEQ_HEADER: str(seq)},
                     )
                 except OSError as e:
                     if sp is not None:
                         sp.finish().annotate(group=g.name, error=str(e))
                     self._mark_unhealthy(g, str(e))
+                    self._mark_lagging(g)
                     self.stats.count("replica.write_error")
-                    return self._partial_write(g, str(e))
+                    any_failed = True
+                    continue
                 finally:
                     self._release(g)
                 if sp is not None:
@@ -370,11 +536,13 @@ class ReplicaRouter:
                 # under load one group can shed a write its siblings
                 # applied, so it must never be ACKed as a success.
                 shed = out[0] == 429 or (out[0] < 500 and out[3].get("Retry-After"))
-                if shed and not applied:
+                if shed and applied == 0:
                     # Shed before ANY group committed: nothing is
-                    # partially applied, so pass the backpressure
+                    # applied anywhere, so abort the log record (replay
+                    # must never deliver it) and pass the backpressure
                     # through verbatim — no demotion (the group is
-                    # loaded, not broken) and the client just retries.
+                    # loaded, not broken); the client just retries.
+                    self.wal.abort(seq)
                     self.stats.count("replica.write_shed")
                     extra = {GROUP_HEADER: g.name}
                     ra = out[3].get("Retry-After")
@@ -382,37 +550,76 @@ class ReplicaRouter:
                         extra["Retry-After"] = ra
                     return out[0], out[1], out[2], extra
                 if out[0] >= 500 or shed:
-                    # Failed (or shed) AFTER a sibling committed: the
-                    # write is partially applied.  Demote the group so
-                    # further writes refuse (503) until the probe
-                    # restores it — the idempotent retry then re-aligns
-                    # the groups.
+                    # Failed (or shed) after a sibling committed: this
+                    # group missed sequence ``seq``.  Demote it — the
+                    # probe + catch-up replays the suffix and only then
+                    # re-admits it — and keep fanning: with the WAL
+                    # holding the record, one group's failure no longer
+                    # aborts the commit.
                     self._mark_unhealthy(g, f"HTTP {out[0]} on write")
+                    self._mark_lagging(g)
                     self.stats.count("replica.write_error")
-                    return self._partial_write(g, f"HTTP {out[0]}")
-                # Deterministic 4xx (parse/schema: 400/404/409) answers
-                # identically on every group (identical schema + total
-                # order) — keep fanning so a mutating call that DID
-                # apply elsewhere stays aligned.
+                    any_failed = True
+                    continue
+                g.applied_seq = max(g.applied_seq, seq)
                 if out[0] < 300:
-                    applied = True
+                    applied += 1
+                    if first_ok is None:
+                        first_ok = out
+                else:
+                    # Deterministic 4xx (parse/schema: 400/404/409)
+                    # answers identically on every group (identical
+                    # schema + total order) — keep fanning so a
+                    # mutating call that DID apply elsewhere stays
+                    # aligned; the group's applied mark still advances
+                    # (replaying it would just re-answer the same 4xx).
+                    if deterministic_4xx is None:
+                        deterministic_4xx = out
                 if first_out is None:
                     first_out = out
-            self.stats.count("replica.write_fanout")
-        status, ctype, payload, rheaders = first_out
-        return status, ctype, payload, {GROUP_HEADER: "all"}
+            if applied >= self.quorum:
+                # COMMITTED: a majority holds the write; any laggard
+                # re-converges from the log.
+                self.stats.count("replica.write_fanout")
+                status, ctype, payload, _rh = first_ok or first_out
+                result = (status, ctype, payload, {GROUP_HEADER: "all"})
+            elif applied == 0 and deterministic_4xx is not None and not any_failed:
+                # Every in-rotation group answered the same
+                # deterministic 4xx: nothing applied anywhere, nothing
+                # to replay — tombstone the record and surface the
+                # answer.
+                self.wal.abort(seq)
+                status, ctype, payload, _rh = deterministic_4xx
+                result = (status, ctype, payload, {GROUP_HEADER: "all"})
+            elif applied > 0 or deterministic_4xx is not None:
+                # Reached some group but not a majority: ambiguous for
+                # the client (502 — retry is idempotent), unambiguous
+                # for the log (the record stays; laggards replay it).
+                failed_names = ", ".join(
+                    g.name for g in ready if g.applied_seq < seq
+                )
+                result = self._partial_write(failed_names or "unknown")
+            else:
+                # Applied nowhere and at least one group failed:
+                # tombstone (no live group holds it) and shed.
+                self.wal.abort(seq)
+                result = self._shed(
+                    503, "write failed on every replica group; retry",
+                    retry_after=1.0,
+                )
+        self._maybe_compact()
+        return result
 
-    def _partial_write(self, g: GroupState, why: str):
-        """A write failed mid-fan-out: earlier groups committed, ``g``
-        did not.  502 tells the client the write may be partially
-        applied — with ``g`` now unhealthy, further writes refuse (503)
-        until the probe restores the set, and the retried (idempotent)
-        write re-aligns the groups."""
+    def _partial_write(self, failed_names: str):
+        """A write reached fewer than a majority of groups: 502 tells
+        the client it may be partially applied — the WAL record stays,
+        the lagging groups replay it during catch-up, and the
+        idempotent client retry is harmless either way."""
         return (
             502,
             "application/json",
             json.dumps({
-                "error": f"write failed on group {g.name} ({why}); "
+                "error": f"write failed on group(s) {failed_names}; "
                 "may be partially applied — retry when the group set is quorate"
             }).encode(),
             {"Retry-After": "1.000"},
@@ -426,6 +633,42 @@ class ReplicaRouter:
             json.dumps({"error": message}).encode(),
             {"Retry-After": f"{retry_after:.3f}"},
         )
+
+    # -- WAL compaction / backlog bound -----------------------------------
+
+    def _maybe_compact(self) -> None:
+        """Advance the log past the min-applied watermark once it has
+        grown past a quarter of its bound; a laggard that would pin it
+        past the bound goes STALE (replay can no longer rescue it —
+        operator resync required) so the backlog stays bounded."""
+        if self.wal.size_bytes <= max(self.wal.max_bytes // 4, 1 << 16):
+            return
+        while True:
+            with self._mu:
+                tracked = [g for g in self.groups if not g.stale]
+            if not tracked:
+                self.wal.compact(self.wal.last_seq)
+                return
+            min_applied = min(g.applied_seq for g in tracked)
+            self.wal.compact(min_applied)
+            if self.wal.size_bytes <= self.wal.max_bytes:
+                return
+            laggards = [
+                g for g in tracked
+                if g.applied_seq == min_applied and g.applied_seq < self.wal.last_seq
+            ]
+            if not laggards:
+                return  # the head itself exceeds the bound; nothing to drop
+            for g in laggards:
+                with self._mu:
+                    g.stale = True
+                self.stats.count(f"replica.stale.{g.name}")
+                self.stats.set(
+                    "replica.last_failure",
+                    f"{g.name}: lag exceeded wal-max-bytes; marked stale "
+                    "(resync required)",
+                )
+                self._mark_unhealthy(g, "stale: WAL compacted past its lag")
 
     # -- dispatch ---------------------------------------------------------
 
@@ -442,10 +685,20 @@ class ReplicaRouter:
         if method == "GET" and path == "/replica/status":
             with self._mu:
                 table = [g.to_json() for g in self.groups]
+                last = self.wal.last_seq
+            for t in table:
+                t["lag"] = max(0, last - t["appliedSeq"])
             payload = json.dumps({
                 "groups": table,
-                "quorate": all(g["healthy"] for g in table),
+                "quorate": self.quorate(),
+                "quorum": self.quorum,
                 "write_seq": self.write_seq,
+                "wal": {
+                    "firstSeq": self.wal.first_seq,
+                    "lastSeq": last,
+                    "bytes": self.wal.size_bytes,
+                    "durable": self.wal.path is not None,
+                },
             }).encode()
             return 200, "application/json", payload, {}
 
@@ -498,27 +751,61 @@ class ReplicaRouter:
         ).encode()
         return 200, "application/json", payload, {}
 
-    # -- health probe -----------------------------------------------------
+    # -- health probe + catch-up ------------------------------------------
 
     def _probe_once(self) -> None:
+        now = time.monotonic()
         with self._mu:
-            down = [g for g in self.groups if not g.healthy]
-        for g in down:
+            due = [
+                g for g in self.groups
+                if (not g.healthy or not g.caught_up) and not g.stale
+                and g.probe_at <= now
+            ]
+        for g in due:
             try:
                 req = urllib.request.Request(g.base + "/replica/health", method="GET")
                 with urllib.request.urlopen(req, timeout=2.0) as resp:
                     ok = resp.status == 200
                     hdr = resp.headers.get(GROUP_HEADER)
+                    try:
+                        health = json.loads(resp.read())
+                    except ValueError:
+                        health = {}
             except (urllib.error.URLError, OSError):
                 # Unreachable OR alive-but-degraded (an HTTPError is a
-                # URLError): either way the group stays unhealthy.
+                # URLError): back the probe off and try again later.
+                self._backoff(g)
                 continue
-            if ok:
-                self._note_epoch(g, hdr)
-                self._mark_healthy(g)
+            if not ok:
+                self._backoff(g)
+                continue
+            self._note_epoch(g, hdr)
+            reported = health.get("appliedSeq")
+            if reported is not None:
+                # The probe is AUTHORITATIVE for a restarted group: a
+                # fresh incarnation reports where its persisted state
+                # actually stands, which may be BEHIND what the router
+                # remembered of its predecessor.
+                g.applied_seq = int(reported)
+                self.stats.gauge(
+                    f"replica.lag.{g.name}",
+                    max(0, self.wal.last_seq - g.applied_seq),
+                )
+            if reported is not None and self.catchup.needed(g):
+                if not self.catchup.catch_up(g):
+                    self._backoff(g)
+                    continue
+            else:
+                # Legacy group (no applied-seq reporting) or already at
+                # the head: nothing to replay.
+                with self._mu:
+                    g.caught_up = True
+            self.stats.gauge(f"replica.lag.{g.name}", 0)
+            self._mark_healthy(g)
 
     def _probe_loop(self) -> None:
-        while not self._stop.wait(self.probe_interval_s):
+        tick = min(max(self.probe_interval_s / 4.0, 0.02), 0.5)
+        while not self._stop.wait(tick):
             try:
                 self._probe_once()
             except Exception:  # noqa: BLE001 — the probe must never die
@@ -578,18 +865,34 @@ class ReplicaRouter:
             self._httpd.shutdown()
             self._httpd.server_close()
             self._httpd = None
+        self.wal.close()
 
 
 def router_from_config(cfg, stats=None, tracer=None) -> ReplicaRouter:
     """Build a router from Config ([replica] TOML + PILOSA_TPU_REPLICA_*
     env, resolved by Config itself) — the CLI entry point's constructor."""
+    import os
+
     host, _, port = (cfg.host or "127.0.0.1").replace("http://", "").partition(":")
+    faults = FaultInjector.from_env() or NOP_FAULTS
+    wal = WriteAheadLog(
+        os.path.join(os.path.expanduser(cfg.replica_wal_dir), "router.wal")
+        if cfg.replica_wal_dir
+        else None,
+        max_bytes=cfg.replica_wal_max_bytes,
+        stats=stats if stats is not None else NOP_STATS,
+        faults=faults,
+    )
     return ReplicaRouter(
         cfg.replica_groups,
         host=host or "127.0.0.1",
         port=cfg.replica_router_port,
         failover=cfg.replica_failover,
         default_deadline_ms=cfg.default_deadline_ms,
+        probe_interval_s=cfg.replica_probe_interval,
+        probe_max_interval_s=cfg.replica_probe_max_interval,
+        wal=wal,
+        faults=faults,
         stats=stats,
         tracer=tracer,
     )

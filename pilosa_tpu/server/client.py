@@ -9,6 +9,8 @@ attr-diff sync, and the ctl tools.
 from __future__ import annotations
 
 import json
+import os
+import random
 import time
 import urllib.error
 import urllib.request
@@ -33,6 +35,16 @@ PROTOBUF = "application/x-protobuf"
 # forwarded sub-request longer than this per attempt.
 RETRY_AFTER_CAP_S = 2.0
 
+# Decorrelated-jitter backoff floor between retry attempts (AWS
+# architecture-blog discipline: each wait draws uniform(base, 3x the
+# previous wait), so a retrying fleet spreads out instead of thundering
+# back in lockstep).
+RETRY_BASE_S = 0.05
+
+# Default retry budget ([client] retry-budget): total EXTRA attempts a
+# single logical request may spend across its lifetime.
+DEFAULT_RETRY_BUDGET = 2
+
 
 class ClientError(PilosaError):
     def __init__(self, status: int, message: str):
@@ -41,11 +53,28 @@ class ClientError(PilosaError):
 
 
 class Client:
-    def __init__(self, host: str, timeout: float = 30.0):
+    def __init__(self, host: str, timeout: float = 30.0,
+                 retry_budget: Optional[int] = None, stats=None):
         if "://" not in host:
             host = "http://" + host
         self.base = host.rstrip("/")
         self.timeout = timeout
+        # Retry budget (ctor arg — the Server passes [client]
+        # retry-budget — > env > default).  Budgeted retries fire ONLY
+        # on 429/503 answers: both are door sheds in this stack
+        # (admission/quorum refusal BEFORE execution), so retrying a
+        # write is safe — a request that reached execution answers with
+        # some other status and is never retried past its first byte of
+        # effect.
+        if retry_budget is None:
+            retry_budget = int(
+                os.environ.get(
+                    "PILOSA_TPU_CLIENT_RETRY_BUDGET", str(DEFAULT_RETRY_BUDGET)
+                )
+            )
+        self.retry_budget = max(0, retry_budget)
+        self.stats = stats
+        self._rng = random.Random()
 
     # -- low level -------------------------------------------------------
 
@@ -58,20 +87,34 @@ class Client:
         accept: str = "application/json",
         headers: Optional[dict] = None,
         timeout: Optional[float] = None,
-        retries: int = 0,
+        retries: Optional[int] = None,
         deadline=None,
         capture: Optional[dict] = None,
     ) -> tuple[int, bytes]:
         """One HTTP exchange; ``timeout`` overrides the constructor-wide
-        default per request.  With ``retries`` > 0, a 429/503 answer is
-        retried after honoring the peer's ``Retry-After`` hint (capped
-        at RETRY_AFTER_CAP_S, never past ``deadline``).  ``capture``
-        (a dict) receives the final response's headers under
+        default per request.
+
+        RETRY BUDGET: a 429/503 answer — a door shed, issued BEFORE any
+        execution, so safe to retry even for writes; a request that
+        reached execution never answers 429/503 and is never retried
+        past its first byte of effect — is retried up to ``retries``
+        times (default: the client's ``retry_budget``; 0 disables).
+        Each wait uses DECORRELATED JITTER (uniform between the base
+        and 3x the previous wait, so a shedding server sees retries
+        spread out, not a thundering herd), floored by the peer's
+        ``Retry-After`` hint and capped at RETRY_AFTER_CAP_S.  The loop
+        is DEADLINE-AWARE: a wait that could not finish inside the
+        remaining budget returns the shed answer instead of sleeping
+        through it.  Each retry counts ``client.retries``.
+
+        ``capture`` (a dict) receives the final response's headers under
         ``"headers"`` — the trace hop reads X-Pilosa-Trace-Spans from
         it.  The SAME Request object serves every retry attempt, so a
         retried request keeps its identity (deadline budget and trace
         id headers included): the peer sees one request retried, never
         two distinct root spans."""
+        if retries is None:
+            retries = self.retry_budget
         req = urllib.request.Request(self.base + path, data=body, method=method)
         if body is not None:
             req.add_header("Content-Type", content_type)
@@ -79,6 +122,7 @@ class Client:
         for k, v in (headers or {}).items():
             req.add_header(k, v)
         attempt = 0
+        prev_wait = RETRY_BASE_S
         while True:
             try:
                 with urllib.request.urlopen(
@@ -94,15 +138,19 @@ class Client:
             if status not in (429, 503) or attempt >= retries:
                 return status, payload
             attempt += 1
+            wait = self._rng.uniform(RETRY_BASE_S, prev_wait * 3.0)
             try:
-                wait = float(resp_headers.get("Retry-After", "0.25"))
+                hint = float(resp_headers.get("Retry-After", "0"))
             except (TypeError, ValueError):
-                wait = 0.25
-            wait = min(max(wait, 0.0), RETRY_AFTER_CAP_S)
+                hint = 0.0
+            wait = min(max(wait, hint, 0.0), RETRY_AFTER_CAP_S)
+            prev_wait = wait
             if deadline is not None:
                 left = deadline.remaining_ms() / 1000.0
                 if left <= wait:
                     return status, payload  # a retry could not finish in budget
+            if self.stats is not None:
+                self.stats.count("client.retries")
             time.sleep(wait)
 
     def _json(self, method: str, path: str, obj: Any = None) -> dict:
@@ -136,7 +184,8 @@ class Client:
         ``deadline`` (qos.Deadline) forwards the REMAINING budget to the
         peer as the X-Pilosa-Deadline-Ms hop header and tightens the
         socket timeout to match; a shed (429) or unavailable (503) peer
-        is retried once after its Retry-After hint.  ``no_cache`` sets
+        is retried within the client's deadline-aware retry budget
+        (decorrelated jitter, floored by Retry-After).  ``no_cache`` sets
         X-Pilosa-No-Cache so the peer's query result cache neither
         serves nor stores this request (A/B measurement, stale-read
         debugging).  ``trace_span`` (trace.Span) propagates the request
@@ -163,7 +212,7 @@ class Client:
         capture: dict = {}
         status, payload = self._request(
             "POST", f"/index/{index}/query", body, content_type=PROTOBUF, accept=PROTOBUF,
-            headers=headers, timeout=timeout, retries=1, deadline=deadline,
+            headers=headers, timeout=timeout, deadline=deadline,
             capture=capture,
         )
         if trace_span is not None and capture.get("headers") is not None:

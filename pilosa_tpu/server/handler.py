@@ -87,7 +87,7 @@ class Handler:
 
     def __init__(self, holder, executor, cluster=None, host="", broadcaster=None, stats=None, client_factory=None,
                  admission=None, default_deadline_ms: float = 0.0, tracer=None,
-                 group: str = ""):
+                 group: str = "", applied_seq=None):
         self.holder = holder
         self.executor = executor
         self.cluster = cluster
@@ -110,6 +110,18 @@ class Handler:
         # so the router can record which group answered and detect
         # epoch bumps across restarts.
         self.group = group
+        # Last-applied router write sequence (replica durability): the
+        # router tags every sequenced write with X-Pilosa-Write-Seq;
+        # the handler notes it once the route answers deterministically
+        # and reports it back (X-Pilosa-Applied-Seq + /replica/health)
+        # so the router can stream exactly the missed WAL suffix to a
+        # restarted group.  The Server passes a disk-backed AppliedSeq;
+        # group-tagged embedders get an in-memory one.
+        if applied_seq is None and group:
+            from pilosa_tpu.replica.catchup import AppliedSeq
+
+            applied_seq = AppliedSeq()
+        self.applied_seq = applied_seq
         self.version = VERSION
         self._routes = self._build_routes()
 
@@ -170,13 +182,20 @@ class Handler:
         tracer = self.tracer
         if tracer is None:
             out = self._dispatch_qos(method, path, params, body, headers, None)
+            self._note_applied(headers, out[0])
             return self._with_group(out)
         trace = tracer.begin(headers, name=f"{method} {path}")
+        if trace is not None and headers.get("x-pilosa-replay"):
+            # Catch-up replays are router-originated re-deliveries, not
+            # client traffic: tag the root so /debug/traces (and the
+            # slow-query log) can split replay load from live load.
+            trace.root.tags["replay"] = True
         t0 = time.perf_counter()
         out = self._dispatch_qos(
             method, path, params, body, headers, trace.root if trace else None
         )
         dt_ms = (time.perf_counter() - t0) * 1e3
+        self._note_applied(headers, out[0])
         extra = tracer.finish_request(
             trace, name=f"{method} {path}", dt_ms=dt_ms, body=body, status=out[0]
         )
@@ -186,15 +205,27 @@ class Handler:
             out = (out[0], out[1], out[2], merged)
         return self._with_group(out)
 
+    def _note_applied(self, headers: dict, status: int) -> None:
+        """Advance the applied-sequence mark when this request carried
+        the router's write sequence and answered deterministically."""
+        if self.applied_seq is None:
+            return
+        from pilosa_tpu.replica.catchup import note_applied_from_headers
+
+        note_applied_from_headers(self.applied_seq, headers, status)
+
     def _with_group(self, out):
-        """Stamp the serving group's identity on every response — the
-        replica router's per-read attribution and epoch-bump signal."""
+        """Stamp the serving group's identity (and its applied-sequence
+        high-water mark — the router's passive lag tracking) on every
+        response — per-read attribution plus the epoch-bump signal."""
         if not self.group:
             return out
-        from pilosa_tpu.replica import GROUP_HEADER
+        from pilosa_tpu.replica import APPLIED_SEQ_HEADER, GROUP_HEADER
 
         merged = dict(out[3]) if len(out) > 3 else {}
         merged.setdefault(GROUP_HEADER, self.group)
+        if self.applied_seq is not None:
+            merged.setdefault(APPLIED_SEQ_HEADER, str(self.applied_seq.value))
         return (out[0], out[1], out[2], merged)
 
     def _dispatch_qos(self, method: str, path: str, params: dict, body: bytes,
@@ -372,8 +403,14 @@ class Handler:
     def get_replica_health(self, **kw):
         """Replica-router health probe: a 200 here restores an
         unhealthy group in the router's table (the lockstep front end
-        serves the same route, answering 503 while degraded)."""
-        return self._json({"group": self.group, "state": "UP"})
+        serves the same route, answering 503 while degraded).  The
+        reported ``appliedSeq`` is the catch-up trigger: a live group
+        behind the router's WAL head gets the missed suffix replayed
+        before it rejoins the read rotation."""
+        out = {"group": self.group, "state": "UP"}
+        if self.applied_seq is not None:
+            out["appliedSeq"] = self.applied_seq.value
+        return self._json(out)
 
     def get_expvar(self, **kw):
         stats = {}

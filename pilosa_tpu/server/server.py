@@ -61,7 +61,11 @@ class Server:
             ranking_debounce_s=self.config.ranking_debounce_s,
         )
         self.cluster = self._build_cluster()
-        self.client_factory = lambda host: Client(host)
+        # Peer clients inherit the configured retry budget ([client]
+        # retry-budget) and count their retries into this server's stats.
+        self.client_factory = lambda host: Client(
+            host, retry_budget=self.config.client_retry_budget, stats=stats
+        )
         # Generation-keyed query result cache ([qcache]): sits in front
         # of the executor's read paths; None = disabled.
         from pilosa_tpu.qcache import QueryCache
@@ -112,6 +116,17 @@ class Server:
             retry_after_ms=self.config.qos_retry_after_ms,
             stats=stats,
         )
+        # Replica durability: a group-tagged server persists its
+        # last-applied router write sequence next to the data, so a
+        # RESTARTED group reports where it left off and the router
+        # replays exactly the missed WAL suffix (replica/catchup.py).
+        from pilosa_tpu.replica.catchup import AppliedSeq
+
+        self.applied_seq = (
+            AppliedSeq(os.path.join(self.data_dir, "applied_seq"))
+            if self.config.replica_group
+            else None
+        )
         self.handler = Handler(
             self.holder,
             self.executor,
@@ -126,6 +141,7 @@ class Server:
             # [replica] group: this server's serving-group identity
             # behind the replica router (X-Pilosa-Group on responses).
             group=self.config.replica_group,
+            applied_seq=self.applied_seq,
         )
         self.syncer = HolderSyncer(
             self.holder, self.cluster, self.host, self.client_factory, stats=stats

@@ -80,4 +80,11 @@ run BENCH_CONFIG=overload BENCH_QOS_DEPTH=8 BENCH_THREADS=64
 #    asserted in-run.  The second line scales the group fleet.
 run BENCH_CONFIG=replica
 run BENCH_CONFIG=replica BENCH_GROUPS=4 BENCH_THREADS=32
+# 12) Durable write log + recovery: write throughput with 3 groups vs a
+#    SIGKILLed group on the degraded quorum (zero failed writes asserted
+#    in-run — the WAL's availability headline) and catch-up time for the
+#    restarted group's WAL-suffix replay; the second line sizes a deeper
+#    backlog so the replay phase dominates.
+run BENCH_CONFIG=recovery
+run BENCH_CONFIG=recovery BENCH_RECOVERY_WRITES=4000 BENCH_BATCH=16
 echo "ALL DONE $(date +%H:%M:%S)" >> $OUT

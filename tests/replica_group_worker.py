@@ -3,12 +3,20 @@ one full Server (numpy engine by default) = one replica group front
 door, in its own process so groups scale across GILs the way real
 groups scale across jobs.
 
-Run: python tests/replica_group_worker.py <group-name> [engine]
+Run: python tests/replica_group_worker.py <group-name[@epoch]> [engine]
 
 Prints ``{"ready": true, "host": ..., "group": ...}`` once serving,
 shuts down when a line arrives on stdin.  The qcache is DISABLED so
 read phases measure real execution scaling, not cache hits
 (PILOSA_TPU_QCACHE=1 in the environment turns it back on).
+
+RESTARTABLE groups (the recovery bench / crash tests): set
+``PILOSA_WORKER_DATA_DIR`` to pin the holder (and the persisted
+applied-sequence mark) to a fixed directory — a re-spawned worker with
+the same dir and a bumped ``name@epoch`` resumes from its on-disk
+state and reports its applied sequence, so the router replays exactly
+the missed WAL suffix.  Without the env a temp dir is used (the
+original throw-away behavior).
 """
 
 import json
@@ -17,28 +25,39 @@ import sys
 import tempfile
 
 
+def _serve(data_dir: str, group: str, engine: str, qcache_on: bool) -> None:
+    from pilosa_tpu.config import Config
+    from pilosa_tpu.server.server import Server
+
+    cfg = Config(
+        data_dir=data_dir,
+        # PILOSA_WORKER_HOST pins the front-door address so a restarted
+        # incarnation is reachable at the SAME base the router holds.
+        host=os.environ.get("PILOSA_WORKER_HOST", "127.0.0.1:0"),
+        engine=engine,
+        stats="expvar",
+        qcache_enabled=qcache_on,
+        replica_group=group,
+    )
+    srv = Server(cfg)
+    srv.open()
+    print(json.dumps({"ready": True, "host": srv.host, "group": group}), flush=True)
+    sys.stdin.readline()  # parent signals shutdown
+    srv.close()
+
+
 def main() -> int:
     group = sys.argv[1] if len(sys.argv) > 1 else "g0"
     engine = sys.argv[2] if len(sys.argv) > 2 else "numpy"
 
-    from pilosa_tpu.config import Config
-    from pilosa_tpu.server.server import Server
-
     qcache_on = os.environ.get("PILOSA_TPU_QCACHE", "").lower() in ("1", "true", "yes")
-    with tempfile.TemporaryDirectory() as d:
-        cfg = Config(
-            data_dir=d,
-            host="127.0.0.1:0",
-            engine=engine,
-            stats="expvar",
-            qcache_enabled=qcache_on,
-            replica_group=group,
-        )
-        srv = Server(cfg)
-        srv.open()
-        print(json.dumps({"ready": True, "host": srv.host, "group": group}), flush=True)
-        sys.stdin.readline()  # parent signals shutdown
-        srv.close()
+    pinned = os.environ.get("PILOSA_WORKER_DATA_DIR", "")
+    if pinned:
+        os.makedirs(pinned, exist_ok=True)
+        _serve(pinned, group, engine, qcache_on)
+    else:
+        with tempfile.TemporaryDirectory() as d:
+            _serve(d, group, engine, qcache_on)
     return 0
 
 

@@ -163,6 +163,32 @@ def test_bench_replica_emits_json():
     assert result["scaling_1_to_2"] > 0 and result["cpus"] >= 1
 
 
+def test_bench_recovery_emits_json():
+    """The durable-write-log recovery bench must keep working: 3 group
+    subprocesses behind a durable-WAL CLI router, a group SIGKILLed
+    mid-stream with writes still committing on the degraded quorum
+    (zero failed writes asserted in-run), then a restart whose WAL
+    suffix replay converges and rejoins reads."""
+    stdout = _run({"BENCH_CONFIG": "recovery", "BENCH_SMOKE": "1"}, timeout=300)
+    result = json.loads(stdout.strip().splitlines()[-1])
+    assert result["metric"] == "recovery_write_qps" and result["value"] > 0
+    names = [t["tier"] for t in result["tiers"]]
+    assert names == ["writes_3g", "writes_2g", "catchup"]
+    by = {t["tier"]: t for t in result["tiers"]}
+    # The headline: NO failed writes with a group down (the old
+    # full-set quorum rule 503'd every one of these).
+    assert by["writes_2g"]["failed_batches"] == 0
+    assert by["writes_2g"]["write_qps"] > 0
+    assert by["writes_3g"]["failed_batches"] == 0
+    # Catch-up really replayed the missed suffix and converged.
+    assert by["catchup"]["converged"] is True
+    assert by["catchup"]["rejoined_reads"] is True
+    assert by["catchup"]["replayed"] >= by["catchup"]["lag_at_restart"]
+    assert by["catchup"]["catchup_s"] > 0
+    assert by["catchup"]["wal"]["durable"] is True
+    assert result["catchup_s"] > 0 and result["cpus"] >= 1
+
+
 def test_star_trace_example_runs():
     stdout = _run({}, script=os.path.join("examples", "star_trace.py"))
     assert "top stargazers:" in stdout and "user 1 attrs:" in stdout

@@ -454,8 +454,9 @@ def test_client_honors_retry_after_on_429():
 
 
 def test_client_retry_after_capped_and_bounded():
-    """A huge Retry-After is capped, and a second 429 is NOT retried
-    (one retry on the fan-out path, not an unbounded loop)."""
+    """A huge Retry-After is capped, and the retry BUDGET bounds the
+    loop: budget 1 = exactly one retry on the fan-out path, never an
+    unbounded loop."""
     from pilosa_tpu.server.client import Client, ClientError
 
     stub = _StubHTTP([
@@ -463,7 +464,7 @@ def test_client_retry_after_capped_and_bounded():
         (429, {"Retry-After": "9999"}, b'{"error": "shed"}'),
     ])
     try:
-        c = Client(stub.host)
+        c = Client(stub.host, retry_budget=1)
         t0 = time.monotonic()
         with pytest.raises(ClientError) as e:
             c.execute_query("i", "Count(Bitmap(rowID=1))")

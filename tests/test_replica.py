@@ -5,14 +5,17 @@ own holder — the fast-rig analog of a lockstep job per group (the
 multi-process case lives in tests/test_multihost.py).  The invariants
 pinned:
 
-- WRITES ship total-ordered to ALL groups (one sequencer), so every
-  group's fragment generation vectors advance identically — a read
-  routed to EITHER group immediately after a write's ack sees it.
+- WRITES ship total-ordered to ALL groups (one sequencer, WAL-backed
+  since PR 7), so every group's fragment generation vectors advance
+  identically — a read routed to EITHER group immediately after a
+  write's ack sees it.
 - READS fan across healthy groups (least-inflight, round-robin ties)
   and fail over ONCE to a sibling on connect/5xx failure.
-- A dead group degrades WRITES to 503 (the set must be quorate) while
-  reads keep serving from the survivors; the health probe restores a
-  recovered group.
+- A dead group degrades WRITES to 503 while fewer than a MAJORITY of
+  groups remain (with 2 groups, majority = 2, so one death refuses
+  writes — the degraded-quorum cases with 3 groups live in
+  tests/test_replica_recovery.py) while reads keep serving from the
+  survivors; the health probe restores a recovered group.
 - Router observability: routed/failover/write_fanout counters,
   per-group health+inflight gauges at /debug/vars, trace roots tagged
   with the serving group.
