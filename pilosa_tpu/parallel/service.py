@@ -837,10 +837,13 @@ class LockstepService:
             # Replica durability: a router-sequenced write that answered
             # deterministically (applied, or a deterministic 400) is
             # recorded as this group's applied high-water mark; sheds
-            # (429), degraded 503s, and internal errors stay replayable.
+            # (any answer carrying Retry-After — the shared not-applied
+            # predicate), degraded 503s, and internal errors stay
+            # replayable.
             from pilosa_tpu.replica.catchup import note_applied_from_headers
 
-            note_applied_from_headers(self.service.applied_seq, headers, status)
+            note_applied_from_headers(self.service.applied_seq, headers, status,
+                                      retry_after=retry_after)
             self.send_response(status)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))

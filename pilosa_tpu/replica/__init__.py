@@ -78,6 +78,19 @@ APPLIED_SEQ_HEADER = "X-Pilosa-Applied-Seq"
 REPLAY_HEADER = "X-Pilosa-Replay"
 
 
+def write_not_applied(status: int, retry_after=None) -> bool:
+    """THE one predicate for "did this sequenced write LAND on the
+    group?", shared by the router's write fan-out, the catch-up
+    replay, and the group-side applied-mark bookkeeping so no path can
+    disagree with another about a write's fate.  NOT applied: a 429,
+    any 5xx, or any other answer carrying Retry-After (the admission
+    door's shed shape even when the status is not 429) — all
+    load/fault-dependent, so the write must stay replayable.  Applied:
+    2xx, and deterministic 4xx (parse/schema errors answer identically
+    on every group — replaying them only re-answers the same error)."""
+    return status == 429 or status >= 500 or bool(retry_after)
+
+
 def parse_group(spec: str) -> tuple[str, int]:
     """Split a ``name[@epoch]`` group identity; epoch defaults to 0."""
     spec = (spec or "").strip()

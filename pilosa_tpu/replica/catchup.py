@@ -33,7 +33,10 @@ reads the fresh incarnation's applied_seq and starts over.  The final
 records are replayed under the router's sequencer lock so no write can
 slip between "drained the suffix" and "rejoined the rotation" — only a
 FULLY caught-up group starts taking reads again, preserving the
-cross-group read-your-writes invariant.
+cross-group read-your-writes invariant.  That locked hold is
+DEADLINE-BOUND (``locked_drain_s``; ``replica.catchup_stall`` counted
+on expiry): a group that turns slow or hangs mid-drain aborts the
+round instead of stalling every write cluster-wide.
 """
 
 from __future__ import annotations
@@ -82,19 +85,24 @@ class AppliedSeq:
 
 
 def note_applied_from_headers(applied: Optional[AppliedSeq], headers: dict,
-                              status: int) -> None:
+                              status: int, retry_after=None) -> None:
     """Group-side helper: advance the applied mark when a request carried
     the router's write-sequence header and the route answered
     DETERMINISTICALLY — 2xx (applied) or a deterministic 4xx (the write
     answers identically on every group: 409 index-exists on a replayed
-    create, 400 parse errors).  A 429 shed or any 5xx is load/fault
-    dependent — the write did NOT land here and must stay replayable."""
+    create, 400 parse errors).  The decision is the SHARED
+    :func:`pilosa_tpu.replica.write_not_applied` predicate — identical
+    to the router's fan-out and replay rules, so a shed expressed as a
+    <500 status carrying Retry-After (pass ``retry_after`` from the
+    response) never advances a mark the router considers not applied."""
+    from pilosa_tpu.replica import write_not_applied
+
     if applied is None:
         return
     raw = headers.get("x-pilosa-write-seq")
     if not raw:
         return
-    if status >= 500 or status == 429:
+    if write_not_applied(status, retry_after):
         return
     try:
         applied.note(int(raw))
@@ -105,26 +113,37 @@ def note_applied_from_headers(applied: Optional[AppliedSeq], headers: dict,
 class CatchupManager:
     """Streams the missed WAL suffix to recovering groups (router side)."""
 
-    def __init__(self, router, wal, stats=None, drain_batch: int = 64):
+    def __init__(self, router, wal, stats=None, drain_batch: int = 64,
+                 locked_drain_s: float = 5.0):
         self.router = router
         self.wal = wal
         self.stats = stats if stats is not None else NOP_STATS
         # Records replayed per loop iteration OUTSIDE the sequencer
         # lock; the final <= drain_batch records replay under it so the
-        # rejoin flip races no concurrent write.
+        # rejoin flip races no concurrent write.  That locked phase is
+        # DEADLINE-BOUND (locked_drain_s, shared across its records,
+        # each socket capped at the remainder): a slow or hanging
+        # recovering group must not stall every write cluster-wide —
+        # past the bound the round aborts, the group keeps its
+        # applied_seq progress, and the next probe retries with a
+        # shorter suffix.
         self.drain_batch = drain_batch
+        self.locked_drain_s = locked_drain_s
 
     def needed(self, g) -> bool:
         return g.applied_seq < self.wal.last_seq
 
-    def _replay_one(self, g, rec, start_epoch: str) -> bool:
+    def _replay_one(self, g, rec, start_epoch: str,
+                    timeout_s: Optional[float] = None) -> bool:
         """Forward one WAL record to ``g``; returns True when the group
         applied (or deterministically answered) it AND its epoch still
-        matches the round's."""
+        matches the round's.  ``timeout_s`` caps the socket (the locked
+        drain's remaining deadline)."""
         from pilosa_tpu.replica import (
             GROUP_HEADER,
             REPLAY_HEADER,
             WRITE_SEQ_HEADER,
+            write_not_applied,
         )
 
         self.router.faults.hit("catchup", key=g.name)
@@ -133,7 +152,8 @@ class CatchupManager:
             headers["content-type"] = rec.ctype
         try:
             status, _ctype, _payload, rheaders = self.router._forward(
-                g, rec.method, rec.path, rec.body, headers
+                g, rec.method, rec.path, rec.body, headers,
+                timeout_s=timeout_s,
             )
         except OSError:
             return False
@@ -144,7 +164,11 @@ class CatchupManager:
             # not absorb a stream paced against the old one's state.
             self.stats.count("replica.catchup_abort")
             return False
-        if status >= 500 or status == 429:
+        # The SAME "did it land?" predicate as the write fan-out and
+        # the group-side bookkeeping — a shed-shaped answer (<500 with
+        # Retry-After) must not advance the mark here while the fan-out
+        # counts the identical answer as not applied.
+        if write_not_applied(status, rheaders.get("Retry-After")):
             return False
         g.applied_seq = max(g.applied_seq, rec.seq)
         self.stats.count("replica.replayed")
@@ -168,10 +192,19 @@ class CatchupManager:
                     return False
         # Phase 2: the short remainder under the sequencer lock — no new
         # write can be sequenced while the group drains to the head and
-        # rejoins, so rejoining == fully caught up, always.
+        # rejoins, so rejoining == fully caught up, always.  The lock
+        # hold is DEADLINE-BOUND: a group that turned slow mid-round
+        # (default socket timeout × drain_batch could stall writes for
+        # minutes) aborts the round instead — it keeps its applied_seq
+        # progress and the next probe retries the shorter remainder.
         with self.router._seq_mu:
+            limit = time.monotonic() + self.locked_drain_s
             for rec in self.wal.records(g.applied_seq + 1):
-                if not self._replay_one(g, rec, start_epoch):
+                left = limit - time.monotonic()
+                if left <= 0:
+                    self.stats.count("replica.catchup_stall")
+                    return False
+                if not self._replay_one(g, rec, start_epoch, timeout_s=left):
                     return False
             with self.router._mu:
                 g.caught_up = True

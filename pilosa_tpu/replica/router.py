@@ -45,14 +45,24 @@ Failure semantics (the durable-log upgrade of PR 6's full-set rule):
   502 "may be partially applied": the record stays in the log, the
   laggards re-converge by replay, and the idempotent client retry is
   harmless.
-- A write SHED by a group (429, or any non-5xx answer carrying
-  Retry-After — the admission door under load) is load-dependent, not
-  deterministic: shed before ANY group committed passes the
-  backpressure through verbatim and ABORTS the log record (tombstoned
-  — replay can never deliver a write no live group holds); shed after
-  a sibling committed just makes the shedding group a laggard (demoted
-  + replayed later), and the write still commits if a majority
-  applied.
+- A write SHED by a group (429, or any answer carrying Retry-After —
+  the admission door under load; one shared predicate,
+  ``replica.write_not_applied``, decides "did not land" for the
+  fan-out, the catch-up replay, and the group-side bookkeeping alike)
+  is load-dependent, not deterministic: shed before ANY group
+  committed — and with no AMBIGUOUS failure earlier in the fan-out —
+  passes the backpressure through verbatim and ABORTS the log record
+  (tombstoned — replay can never deliver a write no live group holds);
+  shed after a sibling committed just makes the shedding group a
+  laggard (demoted + replayed later), and the write still commits if a
+  majority applied.
+- A transport failure (or 5xx) is AMBIGUOUS: the socket may have died
+  AFTER the group applied the write, so it never proves
+  non-application.  Only provable refusals (shed / deterministic 4xx
+  everywhere) tombstone the record; when every group failed
+  ambiguously the record STAYS LIVE (502 "may be partially applied" to
+  the client) and catch-up re-delivers it — idempotent re-apply is the
+  contract, silent cross-group divergence is not.
 - A read answered 504 spent ITS OWN deadline budget — request-scoped,
   not a group-health signal — so it returns to the client without
   demoting the group.
@@ -101,6 +111,7 @@ from pilosa_tpu.replica import (
     GROUP_HEADER,
     REPLAY_HEADER,
     WRITE_SEQ_HEADER,
+    write_not_applied,
 )
 from pilosa_tpu.replica.catchup import CatchupManager
 from pilosa_tpu.replica.faults import FaultInjector, InjectedStatus, NOP_FAULTS
@@ -218,11 +229,20 @@ class ReplicaRouter:
         # groups see all writes in one total order.
         self._seq_mu = threading.Lock()
         self.write_seq = self.wal.last_seq
-        # Groups constructed against an existing WAL start unknown-lag:
-        # assume caught up to the head until a probe/response says
-        # otherwise (a fresh router + fresh groups both start at 0).
-        for g in self.groups:
-            g.applied_seq = self.wal.last_seq
+        # A router (re)started over a NON-EMPTY log must not assume any
+        # group is current: a group that was lagging when the previous
+        # incarnation died (or missed the unacked tail) would otherwise
+        # never be detected — _note_applied only raises the mark, and
+        # the probe skips caught-up groups — and would keep serving
+        # reads that miss committed writes.  So everyone starts OUT of
+        # the rotation at applied_seq=0, and the first health probe
+        # reads each group's persisted appliedSeq AUTHORITATIVELY,
+        # replays the missed suffix, and only then readmits it.  A
+        # fresh log (and the in-memory default) starts everyone caught
+        # up at 0.
+        if self.wal.last_seq > 0:
+            for g in self.groups:
+                g.caught_up = False
         self._rng = random.Random()  # probe jitter (timing only)
         self._httpd = None
         self._stop = threading.Event()
@@ -354,11 +374,14 @@ class ReplicaRouter:
 
     def _forward(self, g: GroupState, method: str, path_qs: str, body: bytes,
                  headers: dict, deadline=None, trace_id: str = "",
-                 extra_headers: Optional[dict] = None):
+                 extra_headers: Optional[dict] = None,
+                 timeout_s: Optional[float] = None):
         """One HTTP exchange with a group.  Returns (status, ctype,
         payload, response headers); raises OSError on a connect/transport
         failure (the caller's failover trigger).  ``extra_headers``
-        carries router-owned headers (write sequence, replay marker)."""
+        carries router-owned headers (write sequence, replay marker);
+        ``timeout_s`` tightens the socket below ``self.timeout`` (the
+        locked catch-up drain's per-record bound)."""
         try:
             self.faults.hit("forward", key=g.name)
         except InjectedStatus as e:
@@ -369,6 +392,8 @@ class ReplicaRouter:
             )
         fwd = {k: v for k, v in headers.items() if k.lower() not in _HOP_HEADERS}
         timeout = self.timeout
+        if timeout_s is not None:
+            timeout = min(timeout, max(timeout_s, 0.001))
         if deadline is not None:
             # Hop rule (qos/deadline.py): forward the REMAINING budget,
             # tighten the socket to match (+1s for the 504 to travel).
@@ -468,8 +493,12 @@ class ReplicaRouter:
         vectors advance through exactly the same write sequence as
         group 0's — the cross-group read-your-writes invariant the
         tests pin.  COMMIT RULE: >= majority applied -> 2xx; some but
-        fewer -> 502 (record stays, laggards replay); none -> the
-        record is aborted and the failure surfaces verbatim."""
+        fewer -> 502 (record stays, laggards replay); PROVABLY none
+        (shed / deterministic 4xx everywhere, no ambiguous failure) ->
+        the record is aborted and the refusal surfaces verbatim;
+        applied nowhere but AMBIGUOUSLY (transport failure / 5xx — the
+        write may have landed before the socket died) -> the record
+        stays live and replays, 502 to the client."""
         with self._seq_mu:
             ready = self._ready_groups()
             if len(ready) < self.quorum:
@@ -507,7 +536,11 @@ class ReplicaRouter:
             first_ok = None  # first 2xx — the committed write's answer
             deterministic_4xx = None
             applied = 0
-            any_failed = False
+            # Ambiguous failure: a transport error (or 5xx) proves
+            # NOTHING about application — the group may have applied
+            # the write before the socket died — so once one happens
+            # the record can never be tombstoned this round.
+            ambiguous = False
             for g in ready:
                 sp = trace.root.child("forward") if trace is not None else None
                 with self._mu:  # inflight is shared with _pick/_release
@@ -525,23 +558,27 @@ class ReplicaRouter:
                     self._mark_unhealthy(g, str(e))
                     self._mark_lagging(g)
                     self.stats.count("replica.write_error")
-                    any_failed = True
+                    ambiguous = True
                     continue
                 finally:
                     self._release(g)
                 if sp is not None:
                     sp.finish().annotate(group=g.name, status=out[0])
-                # A shed (429, or any non-5xx answer carrying
-                # Retry-After) is LOAD-dependent, not deterministic:
-                # under load one group can shed a write its siblings
-                # applied, so it must never be ACKed as a success.
-                shed = out[0] == 429 or (out[0] < 500 and out[3].get("Retry-After"))
-                if shed and applied == 0:
-                    # Shed before ANY group committed: nothing is
-                    # applied anywhere, so abort the log record (replay
-                    # must never deliver it) and pass the backpressure
-                    # through verbatim — no demotion (the group is
-                    # loaded, not broken); the client just retries.
+                # ONE predicate ("did the write land?") shared with the
+                # catch-up replay and the group-side bookkeeping: a
+                # shed (429, or any answer carrying Retry-After) is
+                # LOAD-dependent, not deterministic — under load one
+                # group can shed a write its siblings applied, so it
+                # must never be ACKed as a success.
+                missed = write_not_applied(out[0], out[3].get("Retry-After"))
+                shed = missed and out[0] < 500
+                if shed and applied == 0 and not ambiguous:
+                    # Shed before ANY group committed, with no
+                    # ambiguous failure earlier in the fan-out: nothing
+                    # is applied anywhere, so abort the log record
+                    # (replay must never deliver it) and pass the
+                    # backpressure through verbatim — no demotion (the
+                    # group is loaded, not broken); the client retries.
                     self.wal.abort(seq)
                     self.stats.count("replica.write_shed")
                     extra = {GROUP_HEADER: g.name}
@@ -549,17 +586,19 @@ class ReplicaRouter:
                     if ra:
                         extra["Retry-After"] = ra
                     return out[0], out[1], out[2], extra
-                if out[0] >= 500 or shed:
-                    # Failed (or shed) after a sibling committed: this
-                    # group missed sequence ``seq``.  Demote it — the
-                    # probe + catch-up replays the suffix and only then
-                    # re-admits it — and keep fanning: with the WAL
-                    # holding the record, one group's failure no longer
-                    # aborts the commit.
+                if missed:
+                    # Failed (or shed) after a sibling committed or an
+                    # ambiguous failure: this group missed sequence
+                    # ``seq``.  Demote it — the probe + catch-up
+                    # replays the suffix and only then re-admits it —
+                    # and keep fanning: with the WAL holding the
+                    # record, one group's failure no longer aborts the
+                    # commit.
                     self._mark_unhealthy(g, f"HTTP {out[0]} on write")
                     self._mark_lagging(g)
                     self.stats.count("replica.write_error")
-                    any_failed = True
+                    if out[0] >= 500:
+                        ambiguous = True
                     continue
                 g.applied_seq = max(g.applied_seq, seq)
                 if out[0] < 300:
@@ -583,30 +622,30 @@ class ReplicaRouter:
                 self.stats.count("replica.write_fanout")
                 status, ctype, payload, _rh = first_ok or first_out
                 result = (status, ctype, payload, {GROUP_HEADER: "all"})
-            elif applied == 0 and deterministic_4xx is not None and not any_failed:
+            elif applied == 0 and deterministic_4xx is not None and not ambiguous:
                 # Every in-rotation group answered the same
-                # deterministic 4xx: nothing applied anywhere, nothing
+                # deterministic 4xx: PROVABLY applied nowhere, nothing
                 # to replay — tombstone the record and surface the
                 # answer.
                 self.wal.abort(seq)
                 status, ctype, payload, _rh = deterministic_4xx
                 result = (status, ctype, payload, {GROUP_HEADER: "all"})
-            elif applied > 0 or deterministic_4xx is not None:
-                # Reached some group but not a majority: ambiguous for
-                # the client (502 — retry is idempotent), unambiguous
-                # for the log (the record stays; laggards replay it).
+            else:
+                # Reached some group but not a majority — or applied
+                # nowhere WE CAN PROVE (every group transport-failed /
+                # 5xx'd, or shed after one did; a socket that died
+                # after the request was sent may still have delivered
+                # the write).  Tombstoning here could hide a write one
+                # group actually holds — replay would then never
+                # deliver it to the siblings, permanent cross-group
+                # divergence — so the record STAYS LIVE: every demoted
+                # group gets it re-delivered by catch-up (idempotent
+                # re-apply is the contract) and the client hears 502
+                # "may be partially applied" (retry is harmless).
                 failed_names = ", ".join(
                     g.name for g in ready if g.applied_seq < seq
                 )
                 result = self._partial_write(failed_names or "unknown")
-            else:
-                # Applied nowhere and at least one group failed:
-                # tombstone (no live group holds it) and shed.
-                self.wal.abort(seq)
-                result = self._shed(
-                    503, "write failed on every replica group; retry",
-                    retry_after=1.0,
-                )
         self._maybe_compact()
         return result
 

@@ -23,10 +23,10 @@ On-disk format (little-endian), one frame per record::
 
 ``meta`` carries ``{"m": method, "p": path_qs, "t": content-type}`` —
 everything needed to re-forward the write verbatim — or ``{"x": true}``
-for an ABORT tombstone: a write that was accepted into the log but
-definitively applied NOWHERE (shed at the first group, failed on every
-group) is tombstoned so replay never delivers a write no live group
-has.  Recovery scans the file frame by frame; the first short or
+for an ABORT tombstone: a write that PROVABLY applied nowhere (shed or
+deterministically refused before any group committed — a transport
+failure proves nothing and never tombstones) is tombstoned so replay
+never delivers a write no live group has.  Recovery scans the file frame by frame; the first short or
 checksum-failing frame is a torn tail from a crash mid-append — the
 file is truncated there (``wal.torn_tail`` counted) and appends
 continue from the last good record.
@@ -108,10 +108,14 @@ class WriteAheadLog:
         self._mem_frames: dict[int, bytes] = {}  # offset -> frame (path=None)
         self._end_off = 0
         # Group commit: _synced_off trails _end_off; one leader fsyncs
-        # for every append that landed before its syscall.
+        # for every append that landed before its syscall.  _file_gen
+        # counts file swaps (compaction/close): offsets from different
+        # generations are not comparable, so the leader pins the
+        # generation with the fd and a swap invalidates both.
         self._sync_cv = threading.Condition()
         self._synced_off = 0
         self._syncing = False
+        self._file_gen = 0
         if path is not None:
             self._open_and_recover(path)
         self.stats.gauge("replica.wal_bytes", self.size_bytes)
@@ -200,26 +204,41 @@ class WriteAheadLog:
 
     def _fsync_batched(self) -> None:
         """Group commit: block until everything written so far is on
-        disk, sharing one fsync between concurrent appenders."""
+        disk, sharing one fsync between concurrent appenders.
+
+        Compaction swaps the backing file (close + rename), so the fd
+        and the target offset are pinned together with ``_file_gen``
+        under ``_sync_cv``: a generation bump while waiting means
+        ``compact()`` already fsynced everything it kept — and
+        everything it dropped was applied by every tracked group — so
+        the caller's record is durable (or moot) either way and the
+        old-file offsets must never touch ``_synced_off``."""
         if self._f is None or not self.fsync:
             return
-        target = self._end_off
+        with self._sync_cv:
+            target = self._end_off
+            gen = self._file_gen
         while True:
             with self._sync_cv:
-                if self._synced_off >= target:
+                if self._file_gen != gen or self._synced_off >= target:
                     return
                 if self._syncing:
                     self._sync_cv.wait(0.05)
                     continue
                 self._syncing = True
-            # Leader: capture the frontier BEFORE the syscall — appends
-            # landing during the fsync need the next round.
-            covered = self._end_off
+                # Leader: pin the fd and capture the frontier BEFORE
+                # the syscall — appends landing during the fsync need
+                # the next round, and compact() blocks on _syncing so
+                # the fd cannot be closed under the syscall.
+                f = self._f
+                covered = self._end_off
             try:
-                os.fsync(self._f.fileno())
+                if f is not None:
+                    os.fsync(f.fileno())
             finally:
                 with self._sync_cv:
-                    self._synced_off = max(self._synced_off, covered)
+                    if self._file_gen == gen:
+                        self._synced_off = max(self._synced_off, covered)
                     self._syncing = False
                     self._sync_cv.notify_all()
 
@@ -285,12 +304,30 @@ class WriteAheadLog:
                     out.flush()
                     if self.fsync:
                         os.fsync(out.fileno())
-                self._f.close()
-                os.replace(tmp, self.path)
-                self._f = open(self.path, "r+b")
-                self._offsets = offsets
-                self._end_off = pos
-                self._synced_off = pos
+                # Exclude the group-commit leader for the swap: an
+                # in-flight fsync must finish on the OLD fd before it
+                # closes, and no new leader may pin the fd mid-swap.
+                with self._sync_cv:
+                    while self._syncing:
+                        self._sync_cv.wait()
+                    self._syncing = True
+                try:
+                    self._f.close()
+                    os.replace(tmp, self.path)
+                    self._f = open(self.path, "r+b")
+                    self._offsets = offsets
+                    self._end_off = pos
+                finally:
+                    with self._sync_cv:
+                        # The tmp file was fsynced before the rename:
+                        # the new file is durable end to end, so the
+                        # synced frontier is exactly its end — never
+                        # the old file's (larger) offsets, which would
+                        # make later appends skip their fsync.
+                        self._file_gen += 1
+                        self._synced_off = pos
+                        self._syncing = False
+                        self._sync_cv.notify_all()
             else:
                 mem = {}
                 offsets = {}
@@ -312,6 +349,20 @@ class WriteAheadLog:
 
     def close(self) -> None:
         with self._mu:
-            if self._f is not None:
+            if self._f is None:
+                return
+            # Same swap discipline as compact(): wait out an in-flight
+            # group-commit fsync, then bump the generation so waiting
+            # followers return instead of spinning on a dead frontier.
+            with self._sync_cv:
+                while self._syncing:
+                    self._sync_cv.wait()
+                self._syncing = True
+            try:
                 self._f.close()
                 self._f = None
+            finally:
+                with self._sync_cv:
+                    self._file_gen += 1
+                    self._syncing = False
+                    self._sync_cv.notify_all()

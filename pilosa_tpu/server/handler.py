@@ -182,7 +182,7 @@ class Handler:
         tracer = self.tracer
         if tracer is None:
             out = self._dispatch_qos(method, path, params, body, headers, None)
-            self._note_applied(headers, out[0])
+            self._note_applied(headers, out)
             return self._with_group(out)
         trace = tracer.begin(headers, name=f"{method} {path}")
         if trace is not None and headers.get("x-pilosa-replay"):
@@ -195,7 +195,7 @@ class Handler:
             method, path, params, body, headers, trace.root if trace else None
         )
         dt_ms = (time.perf_counter() - t0) * 1e3
-        self._note_applied(headers, out[0])
+        self._note_applied(headers, out)
         extra = tracer.finish_request(
             trace, name=f"{method} {path}", dt_ms=dt_ms, body=body, status=out[0]
         )
@@ -205,14 +205,18 @@ class Handler:
             out = (out[0], out[1], out[2], merged)
         return self._with_group(out)
 
-    def _note_applied(self, headers: dict, status: int) -> None:
+    def _note_applied(self, headers: dict, out) -> None:
         """Advance the applied-sequence mark when this request carried
-        the router's write sequence and answered deterministically."""
+        the router's write sequence and answered deterministically.
+        The whole response tuple rides in so the shared not-applied
+        predicate sees a shed's Retry-After even on a <500 status."""
         if self.applied_seq is None:
             return
         from pilosa_tpu.replica.catchup import note_applied_from_headers
 
-        note_applied_from_headers(self.applied_seq, headers, status)
+        extra = out[3] if len(out) > 3 else {}
+        note_applied_from_headers(self.applied_seq, headers, out[0],
+                                  retry_after=extra.get("Retry-After"))
 
     def _with_group(self, out):
         """Stamp the serving group's identity (and its applied-sequence

@@ -36,7 +36,9 @@ from pilosa_tpu.config import Config
 from pilosa_tpu.replica import (
     APPLIED_SEQ_HEADER,
     GROUP_HEADER,
+    REPLAY_HEADER,
     ReplicaRouter,
+    write_not_applied,
 )
 from pilosa_tpu.replica.catchup import AppliedSeq, note_applied_from_headers
 from pilosa_tpu.replica.faults import (
@@ -180,6 +182,92 @@ def test_wal_concurrent_appends_group_commit(tmp_path):
     wal.close()
 
 
+def test_wal_compact_excludes_inflight_fsync_and_clamps_frontier(
+        tmp_path, monkeypatch):
+    """compact() swaps the backing file while a group-commit leader may
+    be inside os.fsync on the OLD fd: the swap must WAIT for that
+    leader (never close a fd under a syscall) and afterwards the
+    synced frontier must be the NEW file's end — a stale old-file
+    offset (which can exceed the compacted size) would make every
+    later append think it is already durable and silently skip its
+    fsync."""
+    import pilosa_tpu.replica.wal as walmod
+
+    wal = WriteAheadLog(str(tmp_path / "w.wal"))
+    for i in range(6):
+        wal.append("POST", f"/w{i}", b"x" * 200)
+    real_fsync = os.fsync
+    main_fd = wal._f.fileno()
+    gate = threading.Event()
+    entered = threading.Event()
+
+    def parked_fsync(fd):
+        if fd == main_fd:
+            entered.set()
+            gate.wait(10)
+        return real_fsync(fd)
+
+    monkeypatch.setattr(walmod.os, "fsync", parked_fsync)
+    # A leader enters fsync on the main file and parks there...
+    t = threading.Thread(target=lambda: wal.append("POST", "/park", b"p"))
+    t.start()
+    assert entered.wait(10)
+    # ...while compaction tries to drop everything and swap the file.
+    done = []
+    c = threading.Thread(
+        target=lambda: (wal.compact(wal.last_seq), done.append(1))
+    )
+    c.start()
+    time.sleep(0.15)
+    assert not done  # the swap waited for the in-flight leader
+    gate.set()
+    t.join(10)
+    c.join(10)
+    assert done and not t.is_alive() and not c.is_alive()
+    # Frontier clamped to the compacted (empty) file, not stranded at
+    # the old file's larger offset.
+    assert wal._synced_off == wal._end_off == wal.size_bytes
+    calls = []
+    monkeypatch.setattr(
+        walmod.os, "fsync", lambda fd: (calls.append(fd), real_fsync(fd))[1]
+    )
+    wal.append("POST", "/tail", b"y")  # still reaches the disk
+    assert calls
+    assert [r.path for r in wal.records(1)] == ["/tail"]
+    wal.close()
+
+
+def test_wal_concurrent_appends_survive_repeated_compaction(tmp_path):
+    """Hammer appends from several threads against back-to-back
+    compactions: no appender may ever crash (the old code could fsync
+    a closed/stale fd -> ValueError) and the file must stay
+    frame-parseable end to end."""
+    wal = WriteAheadLog(str(tmp_path / "w.wal"))
+    errs = []
+
+    def appender(k):
+        try:
+            for i in range(40):
+                wal.append("POST", f"/t{k}/{i}", b"z" * 128)
+        except Exception as e:  # noqa: BLE001 — asserted empty below
+            errs.append(e)
+
+    ts = [threading.Thread(target=appender, args=(k,)) for k in range(4)]
+    for t in ts:
+        t.start()
+    while any(t.is_alive() for t in ts):
+        wal.compact(wal.last_seq)
+    for t in ts:
+        t.join()
+    assert errs == []
+    assert wal.last_seq == 160
+    wal.close()
+    stats = ExpvarStatsClient()
+    reopened = WriteAheadLog(wal.path, stats=stats)  # clean recovery scan
+    assert stats.snapshot().get("wal.torn_tail", 0) == 0
+    reopened.close()
+
+
 # -- fault-injection seam -----------------------------------------------------
 
 
@@ -257,6 +345,28 @@ def test_note_applied_header_rules():
     note_applied_from_headers(a, {}, 200)  # no header: untouched
     note_applied_from_headers(a, {"x-pilosa-write-seq": "junk"}, 200)
     assert a.value == 7
+    # A shed expressed as a <500 status carrying Retry-After must not
+    # advance the mark either — same predicate as the router fan-out.
+    note_applied_from_headers(a, {"x-pilosa-write-seq": "8"}, 200,
+                              retry_after="0.250")
+    assert a.value == 7
+
+
+def test_write_not_applied_shared_predicate():
+    """ONE rule for 'did the write land?' across the fan-out, the
+    replay, and the group-side bookkeeping: 429, any 5xx, or any
+    answer carrying Retry-After is NOT applied; 2xx and deterministic
+    4xx are."""
+    assert write_not_applied(429, None)
+    assert write_not_applied(500, None)
+    assert write_not_applied(503, "1.000")
+    assert write_not_applied(200, "0.250")  # shed-shaped 2xx
+    assert write_not_applied(409, "0.250")  # shed-shaped 4xx
+    assert not write_not_applied(200, None)
+    assert not write_not_applied(204, "")
+    assert not write_not_applied(400, None)
+    assert not write_not_applied(404, None)
+    assert not write_not_applied(409, None)
 
 
 # -- three-group rig (real HTTP, restartable groups) --------------------------
@@ -516,6 +626,71 @@ def test_shed_before_any_commit_aborts_the_record(rig3, monkeypatch):
     assert rig3.direct_count(2) == rig3.direct_count(0) == 1  # columnID=3 only
 
 
+def test_transport_failure_keeps_record_replayable(rig3, monkeypatch):
+    """A transport OSError proves NOTHING about application — the
+    socket can die AFTER the group applied the write.  When every
+    group fails ambiguously the record must stay LIVE (502, no
+    tombstone) so catch-up re-delivers it; a tombstone here could hide
+    a write one group actually holds, leaving permanent cross-group
+    divergence."""
+    rig3.seed()
+    real = rig3.router._forward
+
+    def die_on_live_setbit(g, method, path_qs, body, headers, **kw):
+        if b"SetBit" in body and REPLAY_HEADER not in headers:
+            raise OSError("connection reset mid-exchange")
+        return real(g, method, path_qs, body, headers, **kw)
+
+    monkeypatch.setattr(rig3.router, "_forward", die_on_live_setbit)
+    st, body, _ = rig3.query('SetBit(rowID=1, frame="f", columnID=1)')
+    assert st == 502 and "partially applied" in json.loads(body)["error"]
+    seq = rig3.router.wal.last_seq
+    assert [r.seq for r in rig3.router.wal.records(seq)] == [seq]  # LIVE
+    # Every group was demoted; with the record live, catch-up delivers
+    # the write to ALL of them — at-least-once, never lost.
+    monkeypatch.setattr(rig3.router, "_forward", real)
+    for i in range(3):
+        rig3.wait_ready(f"g{i}")
+    assert (rig3.direct_count(0) == rig3.direct_count(1)
+            == rig3.direct_count(2) == 1)
+
+
+def test_shed_after_transport_failure_does_not_abort(rig3, monkeypatch):
+    """THE divergence ordering: g0 APPLIES the write but its socket
+    dies before the answer; g1/g2 then shed.  Tombstoning on the shed
+    (applied==0 from the router's view) would hide the write g0 holds
+    — replay could never deliver it to g1/g2.  The record must stay
+    live and converge everyone."""
+    rig3.seed()
+    real = rig3.router._forward
+    g0 = rig3.router.groups[0]
+    shed = (
+        429, "application/json",
+        json.dumps({"error": "shed"}).encode(), {"Retry-After": "0.250"},
+    )
+
+    def apply_then_die_then_shed(g, method, path_qs, body, headers, **kw):
+        if b"SetBit" in body and REPLAY_HEADER not in headers:
+            if g is g0:
+                real(g, method, path_qs, body, headers, **kw)  # g0 APPLIED
+                raise OSError("reset after apply")
+            return shed
+        return real(g, method, path_qs, body, headers, **kw)
+
+    monkeypatch.setattr(rig3.router, "_forward", apply_then_die_then_shed)
+    st, body, _ = rig3.query('SetBit(rowID=1, frame="f", columnID=1)')
+    assert st == 502  # ambiguous — NOT the shed passthrough, NOT an abort
+    seq = rig3.router.wal.last_seq
+    assert [r.seq for r in rig3.router.wal.records(seq)] == [seq]  # LIVE
+    assert rig3.direct_count(0) == 1  # g0 really does hold the write
+    monkeypatch.setattr(rig3.router, "_forward", real)
+    for i in range(3):
+        rig3.wait_ready(f"g{i}")
+    # Replay delivered g0's write to the siblings: no divergence.
+    assert (rig3.direct_count(0) == rig3.direct_count(1)
+            == rig3.direct_count(2) == 1)
+
+
 def test_wal_error_injection_refuses_write(rig3, monkeypatch):
     """An injected WAL append failure refuses the write 503 BEFORE any
     group is touched (durability-first ordering)."""
@@ -557,10 +732,61 @@ def test_router_restart_recovers_durable_wal(tmp_path):
             ).serve()
             rig.base = f"http://127.0.0.1:{rig.router.port}"
             assert rig.router.wal.last_seq == seq_before
+            # A restarted router TRUSTS NOTHING it cannot verify: every
+            # group starts OUT of the rotation until the first probe
+            # reads its persisted appliedSeq and replays any missed
+            # suffix — only then does it serve again.
+            assert all(not g.caught_up for g in rig.router.groups)
+            for i in range(3):
+                rig.wait_ready(f"g{i}")
             st, _, _ = rig.query('SetBit(rowID=1, frame="f", columnID=7)')
             assert st == 200
             assert rig.router.wal.last_seq == seq_before + 1
             assert rig.direct_count(0) == rig.direct_count(2) == 4
+        finally:
+            rig.close()
+
+
+def test_router_restart_replays_missed_suffix_to_laggard(tmp_path):
+    """A group that was LAGGING when the router died must not be
+    readmitted at face value by the replacement router: the first
+    probe reads its persisted appliedSeq authoritatively, replays the
+    suffix the dead router never delivered, and only then lets it
+    serve reads — otherwise the group silently serves reads that miss
+    committed writes forever."""
+    wal_path = str(tmp_path / "router.wal")
+    with tempfile.TemporaryDirectory() as tmp:
+        rig = _Rig3(tmp, wal=WriteAheadLog(wal_path))
+        try:
+            rig.seed()
+            assert rig.query('SetBit(rowID=1, frame="f", columnID=0)')[0] == 200
+            rig.servers[2].close()  # g2 dies...
+            for c in range(1, 4):  # ...and misses these three commits
+                assert rig.query(
+                    f'SetBit(rowID=1, frame="f", columnID={c})'
+                )[0] == 200
+            assert rig.direct_count(0) == 4
+            rig.router.close()  # ...and then the ROUTER dies too
+            rig.restart(2, epoch=2)  # g2 returns, still 3 writes behind
+            assert rig.direct_count(2) == 1
+            rig.router = ReplicaRouter(
+                [f"g{i}=127.0.0.1:{p}" for i, p in enumerate(rig.ports)],
+                probe_interval_s=0.05, wal=WriteAheadLog(wal_path),
+                stats=rig.stats,
+            ).serve()
+            rig.base = f"http://127.0.0.1:{rig.router.port}"
+            g2 = rig.wait_ready("g2")
+            # The new router REPLAYED the suffix its predecessor never
+            # delivered — it did not just take the group's currency on
+            # faith.
+            assert g2["appliedSeq"] == rig.router.wal.last_seq
+            assert rig.direct_count(2) == 4
+            assert rig.stats.snapshot().get("replica.replayed", 0) >= 3
+            # Every routed read now sees all four committed writes —
+            # read-your-writes holds across the router crash.
+            for _ in range(6):
+                st, body, _ = rig.query('Count(Bitmap(rowID=1, frame="f"))')
+                assert st == 200 and json.loads(body)["results"] == [4]
         finally:
             rig.close()
 
@@ -689,6 +915,45 @@ def test_catchup_epoch_guard_aborts_on_restart_mid_replay(rig3, monkeypatch):
     monkeypatch.setattr(rig3.router, "_forward", same_epoch)
     assert rig3.router.catchup._replay_one(g2, rec, start_epoch="g2@1") is True
     assert g2.applied_seq >= rec.seq
+
+
+def test_catchup_locked_drain_is_deadline_bounded(rig3, monkeypatch):
+    """The final drain holds the router's SEQUENCER lock: a group that
+    turns slow mid-drain must abort the round quickly (it keeps its
+    applied_seq progress; the next probe retries) instead of pinning
+    the lock for up to drain_batch x socket-timeout and stalling every
+    write cluster-wide."""
+    rig3.seed()
+    rig3.router.catchup.locked_drain_s = 0.15
+    rig3.servers[2].close()
+    for c in range(3):
+        assert rig3.query(f'SetBit(rowID=1, frame="f", columnID={c})')[0] == 200
+    rig3.restart(2, epoch=2)
+    real = rig3.router._forward
+
+    def crawling_replay(g, method, path_qs, body, headers, **kw):
+        if headers.get(REPLAY_HEADER):
+            time.sleep(0.5)  # far slower than the whole locked budget
+        return real(g, method, path_qs, body, headers, **kw)
+
+    monkeypatch.setattr(rig3.router, "_forward", crawling_replay)
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        if rig3.stats.snapshot().get("replica.catchup_stall", 0) >= 1:
+            break
+        time.sleep(0.02)
+    assert rig3.stats.snapshot().get("replica.catchup_stall", 0) >= 1
+    assert not rig3.router.groups[2].caught_up  # round aborted, stays out
+    # Writes were never starved: the sequencer stays responsive while
+    # the laggard crawls.
+    t0 = time.monotonic()
+    assert rig3.query('SetBit(rowID=1, frame="f", columnID=9)')[0] == 200
+    assert time.monotonic() - t0 < 5.0
+    # Un-throttle: the next probe round finishes the shorter remainder
+    # (progress was kept) and the group rejoins for real.
+    monkeypatch.setattr(rig3.router, "_forward", real)
+    rig3.wait_ready("g2")
+    assert rig3.direct_count(2) == rig3.direct_count(0) == 4
 
 
 # -- probe backoff (satellite) ------------------------------------------------
