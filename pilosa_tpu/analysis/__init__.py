@@ -1,0 +1,36 @@
+"""Project invariant linter + debug-mode runtime concurrency checker.
+
+``python -m pilosa_tpu.analysis`` runs five project-specific rules over
+the live tree and exits nonzero on NEW findings (a checked-in baseline
+grandfathers accepted pre-existing violations; ``# analysis-ok: <rule>:
+<reason>`` suppresses a site explicitly):
+
+1. lockstep-determinism — rank-local nondeterminism reachable from the
+   lockstep batch-execution entry points;
+2. lock-discipline — raw ``threading.Lock()``/``RLock()``/``Condition()``
+   instantiations that bypass the instrumented :mod:`.lockcheck`
+   factories (the runtime half of this rule is the
+   ``PILOSA_TPU_LOCK_CHECK=1`` checker);
+3. stats-registry — every stats name must appear in the generated
+   counters registry (COUNTERS.md), which must match the tree;
+4. exception-hygiene — ``except Exception`` must record a stat, use the
+   exception, re-raise, or carry a tag;
+5. deadline-propagation — functions holding a deadline that perform an
+   HTTP hop must forward the remaining budget.
+
+This module stays import-light: serving modules import
+``pilosa_tpu.analysis.lockcheck`` at startup, so nothing here may pull
+in the linter machinery (or anything heavy) at import time.
+"""
+
+from __future__ import annotations
+
+__all__ = ["run_analysis", "Finding", "RULES"]
+
+
+def __getattr__(name):
+    if name in __all__:
+        from pilosa_tpu.analysis import engine
+
+        return getattr(engine, name)
+    raise AttributeError(name)

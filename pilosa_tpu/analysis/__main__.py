@@ -1,0 +1,80 @@
+"""CLI: ``python -m pilosa_tpu.analysis`` — the CI gate.
+
+Exit 0 when every finding is suppressed or baselined; exit 1 on NEW
+findings (and print them).  ``--write-baseline`` grandfathers the
+current findings; ``--write-registry`` regenerates the counters
+registry (COUNTERS.md); ``--all`` lists every finding including the
+grandfathered ones.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from pilosa_tpu.analysis import engine, registry
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m pilosa_tpu.analysis",
+        description="Project invariant linter (see DEVELOPMENT.md).",
+    )
+    p.add_argument("--root", default=None, help="package dir to scan (default: installed pilosa_tpu)")
+    p.add_argument("--rules", default=None, help="comma-separated subset of: " + ",".join(engine.RULES))
+    p.add_argument("--baseline", default=None, help="baseline file (default: <root>/analysis/baseline.json)")
+    p.add_argument("--write-baseline", action="store_true", help="grandfather the current findings and exit")
+    p.add_argument("--write-registry", action="store_true", help="regenerate analysis/COUNTERS.md and exit")
+    p.add_argument("--all", action="store_true", help="also list suppressed/baselined findings")
+    args = p.parse_args(argv)
+
+    root = args.root or engine.package_root()
+
+    if args.write_registry:
+        text = registry.generate_counters_registry(root)
+        path = registry.registry_path(root)
+        with open(path, "w", encoding="utf-8") as f:
+            f.write(text)
+        print(f"wrote {path}")
+        return 0
+
+    rules = tuple(engine.RULES)
+    if args.rules:
+        wanted = tuple(r.strip() for r in args.rules.split(",") if r.strip())
+        unknown = [r for r in wanted if r not in engine.RULES]
+        if unknown:
+            print(f"unknown rule(s): {', '.join(unknown)}", file=sys.stderr)
+            return 2
+        rules = wanted
+
+    findings = engine.run_analysis(root=root, rules=rules, baseline=args.baseline)
+
+    if args.write_baseline:
+        path = args.baseline or engine.baseline_path(root)
+        engine.write_baseline(path, findings)
+        kept = sum(1 for f in findings if not f.suppressed)
+        print(f"wrote {path} ({kept} grandfathered finding(s))")
+        return 0
+
+    fresh = engine.new_findings(findings)
+    n_sup = sum(1 for f in findings if f.suppressed)
+    n_base = sum(1 for f in findings if f.baselined)
+    shown = findings if args.all else fresh
+    for f in shown:
+        print(f.render())
+    print(
+        f"analysis: {len(findings)} finding(s) over {len(rules)} rule(s) — "
+        f"{n_sup} suppressed, {n_base} baselined, {len(fresh)} NEW"
+    )
+    if fresh:
+        print(
+            "fix the new findings, tag them with `# analysis-ok: <rule>: "
+            "<reason>`, or (last resort) --write-baseline.",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,474 @@
+"""Debug-mode runtime concurrency checker for the project's named locks.
+
+The replica/WAL tier is lock-heavy threaded code where the last two
+review rounds each found hand-caught races (the PR 6 inflight-gauge
+race, the PR 7 fsync-under-compaction swap).  This module turns the
+conventions those fixes rely on into a checkable model, the way Go's
+race detector did for the reference Pilosa:
+
+- every interesting lock is created through :func:`named_lock` /
+  :func:`named_rlock` / :func:`named_condition` and carries a stable
+  NAME ("replica.router._seq_mu", "replica.wal._mu", ...);
+- with ``PILOSA_TPU_LOCK_CHECK=1`` (or an explicit :func:`enable`)
+  the factories return instrumented wrappers that feed a global
+  checker; otherwise they return plain ``threading`` primitives with
+  zero overhead;
+- the checker builds the cross-thread lock acquisition-order graph
+  (edges by lock NAME, so every fragment's ``_mu`` is one node) and
+  records a violation when a new acquisition closes a cycle — the
+  classic potential-deadlock witness, caught even when the interleaving
+  that would actually deadlock never happens in the run;
+- blocking calls (``os.fsync``, socket I/O, ``subprocess``) executed
+  while ANY checked lock is held are violations unless the (lock,
+  kind) pair is allowlisted — either in :data:`DEFAULT_ALLOW_PAIRS`
+  (documented by-design holds, e.g. the write sequencer fanning out
+  over HTTP) or via a code-local ``with allowed("fsync"):`` scope.
+
+Violations are RECORDED, not raised at the faulting site (raising
+inside a background probe thread would be swallowed by its own
+error handling); tests drain them with :func:`take_violations` or
+assert emptiness with :func:`check`.  tests/conftest.py enables the
+checker for the tier-1 concurrency/replica/qos suites and fails any
+test that recorded a violation.
+
+Re-entrant acquisition of the same named lock is tracked by depth and
+never creates a self-edge: instances sharing a name (every fragment's
+``_mu``) cannot be ordered against each other by name alone, so
+same-name nesting is out of the model's scope.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import threading
+import traceback
+
+ENV_VAR = "PILOSA_TPU_LOCK_CHECK"
+
+# (lock name, blocking kind) pairs that are BY DESIGN: holding the
+# named lock across this class of blocking call is the documented
+# serialization contract, not an accident.  Keep this list short and
+# justified — every entry is a place a slow syscall stalls every other
+# user of the lock.
+DEFAULT_ALLOW_PAIRS: frozenset[tuple[str, str]] = frozenset(
+    {
+        # The write sequencer IS the total order: the router holds
+        # _seq_mu across the whole HTTP fan-out so every group applies
+        # every write in the same sequence (replica/router.py), and
+        # catch-up's phase-2 locked drain replays the final records
+        # under the same lock so rejoin == fully-caught-up.  The WAL
+        # append + group-commit fsync sit inside the same hold: a
+        # write's durability point is part of its slot in the order.
+        ("replica.router._seq_mu", "socket"),
+        ("replica.router._seq_mu", "fsync"),
+        # _compact_mu exists ONLY to serialize whole compactions; the
+        # bulk copy + fsync run under it by construction, off the
+        # append path (appenders take _mu, which the bulk phase does
+        # NOT hold — that is the point of the split).
+        ("replica.wal._compact_mu", "fsync"),
+        # Lockstep rank 0 ships batch entries to the worker sockets
+        # while holding the order lock — the ship IS the point where
+        # the total order is fixed (parallel/service.py).
+        ("lockstep._order_mu", "socket"),
+        ("lockstep._q_cv", "socket"),
+    }
+)
+
+BLOCKING_KINDS = ("fsync", "socket", "subprocess")
+
+
+class LockCheckError(AssertionError):
+    """A recorded lock-discipline violation, raised by check()."""
+
+
+def _stack(skip: int = 2) -> str:
+    return "".join(traceback.format_stack()[:-skip][-8:])
+
+
+class Violation:
+    __slots__ = ("kind", "detail", "thread", "stack")
+
+    def __init__(self, kind: str, detail: str, stack: str):
+        self.kind = kind
+        self.detail = detail
+        self.thread = threading.current_thread().name
+        self.stack = stack
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"<Violation {self.kind}: {self.detail} [{self.thread}]>"
+
+    def describe(self) -> str:
+        return f"{self.kind}: {self.detail}\n  thread: {self.thread}\n{self.stack}"
+
+
+class _Checker:
+    """Global acquisition-order graph + held-lock bookkeeping."""
+
+    def __init__(self):
+        self._mu = threading.Lock()  # leaf lock: guards graph/violations only
+        # edge a -> b: lock named a was held while b was acquired;
+        # value = first-witness stack for the report.
+        self._edges: dict[str, dict[str, str]] = {}
+        self._violations: list[Violation] = []
+        self._seen_cycles: set[tuple[str, str]] = set()
+        self._seen_blocking: set[tuple[str, str]] = set()
+        self._tls = threading.local()
+        self.allow_pairs: set[tuple[str, str]] = set(DEFAULT_ALLOW_PAIRS)
+
+    # -- per-thread held stack -------------------------------------------
+
+    def _held(self) -> list[list]:
+        h = getattr(self._tls, "held", None)
+        if h is None:
+            h = self._tls.held = []  # [name, depth] entries, acquisition order
+        return h
+
+    def _scoped_allows(self) -> list[str]:
+        a = getattr(self._tls, "allows", None)
+        if a is None:
+            a = self._tls.allows = []
+        return a
+
+    def note_acquired(self, name: str) -> None:
+        held = self._held()
+        for e in held:
+            if e[0] == name:
+                e[1] += 1  # re-entrant: no new edge, no self-edge
+                return
+        if held:
+            holders = [e[0] for e in held if e[0] != name]
+            if holders:
+                with self._mu:
+                    for a in holders:
+                        fresh = name not in self._edges.get(a, ())
+                        self._edges.setdefault(a, {}).setdefault(name, _stack())
+                        if fresh:
+                            self._check_cycle(a, name)
+        held.append([name, 1])
+
+    def note_released(self, name: str) -> None:
+        held = self._held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i][0] == name:
+                held[i][1] -= 1
+                if held[i][1] == 0:
+                    del held[i]
+                return
+
+    def held_names(self) -> list[str]:
+        return [e[0] for e in self._held()]
+
+    # -- cycle detection ---------------------------------------------------
+
+    def _check_cycle(self, a: str, b: str) -> None:
+        """Adding edge a->b: a path b ->* a means a cycle through (a, b).
+        Called under self._mu."""
+        path = self._find_path(b, a)
+        if path is None:
+            return
+        key = (a, b) if a < b else (b, a)
+        if key in self._seen_cycles:
+            return
+        self._seen_cycles.add(key)
+        cycle = [a] + path
+        self._violations.append(
+            Violation(
+                "lock-order-cycle",
+                " -> ".join(cycle)
+                + f" (new edge {a} -> {b} closes the cycle; first-witness "
+                f"stacks in the acquisition-order graph)",
+                _stack(),
+            )
+        )
+
+    def _find_path(self, src: str, dst: str) -> list[str] | None:
+        """DFS src ->* dst over recorded edges; returns the node path."""
+        seen = {src}
+        stack = [(src, [src])]
+        while stack:
+            node, path = stack.pop()
+            if node == dst:
+                return path
+            for nxt in self._edges.get(node, ()):
+                if nxt not in seen:
+                    seen.add(nxt)
+                    stack.append((nxt, path + [nxt]))
+        return None
+
+    # -- blocking calls ----------------------------------------------------
+
+    def note_blocking(self, kind: str) -> None:
+        held = self._held()
+        if not held:
+            return
+        if kind in self._scoped_allows():
+            return
+        bad = [e[0] for e in held if (e[0], kind) not in self.allow_pairs]
+        if not bad:
+            return
+        key = (tuple(bad)[0], kind)
+        with self._mu:
+            if key in self._seen_blocking:
+                return
+            self._seen_blocking.add(key)
+            self._violations.append(
+                Violation(
+                    "blocking-under-lock",
+                    f"{kind} call while holding {', '.join(bad)}",
+                    _stack(),
+                )
+            )
+
+    # -- reporting ---------------------------------------------------------
+
+    def take_violations(self) -> list[Violation]:
+        with self._mu:
+            out = self._violations
+            self._violations = []
+            return out
+
+    def reset(self) -> None:
+        """Clear the graph and pending violations (per-test isolation:
+        two tests acquiring A->B and B->A respectively never interleave,
+        so cross-test edges would be false cycles)."""
+        with self._mu:
+            self._edges = {}
+            self._violations = []
+            self._seen_cycles = set()
+            self._seen_blocking = set()
+
+
+_checker = _Checker()
+_enabled = False
+_patched = False
+_orig: dict[str, object] = {}
+
+
+def checker() -> _Checker:
+    return _checker
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+# -- instrumented primitives ----------------------------------------------
+
+
+class CheckedLock:
+    """threading.Lock wrapper feeding the global checker."""
+
+    _reentrant = False
+
+    def __init__(self, name: str):
+        self.name = name
+        self._inner = self._make_inner()
+
+    def _make_inner(self):
+        return threading.Lock()
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            _checker.note_acquired(self.name)
+        return got
+
+    def release(self) -> None:
+        self._inner.release()
+        _checker.note_released(self.name)
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"<CheckedLock {self.name} {self._inner!r}>"
+
+
+class CheckedRLock(CheckedLock):
+    """threading.RLock wrapper; recursion tracked by depth, and the
+    Condition integration hooks (_release_save/_acquire_restore/
+    _is_owned) keep the held bookkeeping correct across cv.wait()."""
+
+    _reentrant = True
+
+    def _make_inner(self):
+        return threading.RLock()
+
+    def _release_save(self):
+        # Fully release the recursion for a cv.wait(): drop our
+        # bookkeeping entirely, remember nothing (the inner state
+        # carries the depth).
+        state = self._inner._release_save()
+        _checker.note_released(self.name)
+        held = _checker._held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i][0] == self.name:
+                del held[i]
+                break
+        return state
+
+    def _acquire_restore(self, state) -> None:
+        self._inner._acquire_restore(state)
+        _checker.note_acquired(self.name)
+
+    def _is_owned(self) -> bool:
+        return self._inner._is_owned()
+
+
+def named_lock(name: str):
+    """A mutex participating in the order/blocking checks when the
+    checker is enabled; a plain threading.Lock otherwise."""
+    if _enabled:
+        return CheckedLock(name)
+    return threading.Lock()
+
+
+def named_rlock(name: str):
+    if _enabled:
+        return CheckedRLock(name)
+    return threading.RLock()
+
+
+def named_condition(name: str, lock=None):
+    """A Condition whose underlying lock is checked when enabled.
+    ``lock`` reuses an existing (possibly checked) lock, as in
+    ``Condition(self._mu)``."""
+    if lock is not None:
+        return threading.Condition(lock)
+    if _enabled:
+        return threading.Condition(CheckedLock(name))
+    return threading.Condition()
+
+
+class allowed:
+    """Scoped, code-local allowlist entry: the blocking call inside is
+    a documented part of the holding lock's contract.
+
+    with lockcheck.allowed("fsync"):   # bounded delta fsync before swap
+        os.fsync(fd)
+    """
+
+    def __init__(self, *kinds: str):
+        self.kinds = kinds
+
+    def __enter__(self):
+        _checker._scoped_allows().extend(self.kinds)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        a = _checker._scoped_allows()
+        for k in self.kinds:
+            if k in a:
+                a.remove(k)
+
+
+# -- blocking-call patches -------------------------------------------------
+
+
+def _wrap_blocking(fn, kind):
+    def wrapper(*a, **kw):
+        _checker.note_blocking(kind)
+        return fn(*a, **kw)
+
+    wrapper.__lockcheck_orig__ = fn
+    return wrapper
+
+
+def _patch() -> None:
+    global _patched
+    if _patched:
+        return
+    _orig["os.fsync"] = os.fsync
+    os.fsync = _wrap_blocking(os.fsync, "fsync")
+    for meth in ("connect", "sendall", "send", "sendto", "recv", "recv_into", "accept"):
+        attr = getattr(socket.socket, meth, None)
+        if attr is None:  # pragma: no cover - platform variance
+            continue
+        _orig[f"socket.{meth}"] = attr
+        setattr(socket.socket, meth, _wrap_blocking(attr, "socket"))
+    _orig["subprocess.Popen.__init__"] = subprocess.Popen.__init__
+    subprocess.Popen.__init__ = _wrap_blocking(
+        subprocess.Popen.__init__, "subprocess"
+    )
+    _patched = True
+
+
+def _unpatch() -> None:
+    global _patched
+    if not _patched:
+        return
+    os.fsync = _orig.pop("os.fsync")
+    for meth in ("connect", "sendall", "send", "sendto", "recv", "recv_into", "accept"):
+        orig = _orig.pop(f"socket.{meth}", None)
+        if orig is not None:
+            setattr(socket.socket, meth, orig)
+    subprocess.Popen.__init__ = _orig.pop("subprocess.Popen.__init__")
+    _patched = False
+
+
+# -- lifecycle -------------------------------------------------------------
+
+
+def enable() -> None:
+    """Turn the checker on for locks created FROM NOW ON (existing
+    plain locks stay plain) and patch the blocking-call probes."""
+    global _enabled
+    _enabled = True
+    _patch()
+
+
+def disable() -> None:
+    global _enabled
+    _enabled = False
+    _unpatch()
+    _checker.reset()
+
+
+def reset() -> None:
+    _checker.reset()
+
+
+def take_violations() -> list[Violation]:
+    return _checker.take_violations()
+
+
+def check() -> None:
+    """Raise LockCheckError if any violation was recorded since the
+    last reset/take."""
+    vs = _checker.take_violations()
+    if vs:
+        raise LockCheckError(
+            f"{len(vs)} lock-discipline violation(s):\n\n"
+            + "\n\n".join(v.describe() for v in vs)
+        )
+
+
+def _env_enabled() -> bool:
+    return os.environ.get(ENV_VAR, "").lower() in ("1", "true", "yes")
+
+
+if _env_enabled():  # subprocess workers inherit the env and self-enable
+    enable()
+
+    import atexit
+
+    @atexit.register
+    def _report_at_exit() -> None:  # pragma: no cover - subprocess path
+        vs = _checker.take_violations()
+        if vs:
+            import sys
+
+            print(
+                f"[lockcheck] {len(vs)} violation(s) at exit:", file=sys.stderr
+            )
+            for v in vs:
+                print(v.describe(), file=sys.stderr)

@@ -1,0 +1,397 @@
+"""The five project-invariant rules.
+
+Each rule returns Finding objects; the engine applies suppressions,
+fingerprints, and the baseline.  See DEVELOPMENT.md ("Static analysis &
+concurrency checking") for the catalog and the rationale per rule.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from pilosa_tpu.analysis.callgraph import CallGraph
+from pilosa_tpu.analysis.engine import Finding
+from pilosa_tpu.analysis import registry as regmod
+
+LOCKSTEP_ENTRY_FILE = "parallel/service.py"
+LOCKSTEP_ENTRY_PREFIX = "_exec_batch"
+
+HOP_METHODS = ("execute_query", "execute_remote", "execute_remote_call")
+DEADLINE_PARAMS = ("deadline", "opt", "opts", "options")
+
+_LOG_METHODS = ("warning", "error", "exception", "critical", "info", "debug")
+
+
+def run_rule(rule: str, files, root: str) -> list[Finding]:
+    fn = {
+        "lockstep-determinism": rule_lockstep_determinism,
+        "lock-discipline": rule_lock_discipline,
+        "stats-registry": rule_stats_registry,
+        "exception-hygiene": rule_exception_hygiene,
+        "deadline-propagation": rule_deadline_propagation,
+    }[rule]
+    return fn(files, root)
+
+
+# -- 1. lockstep-determinism ------------------------------------------------
+#
+# Every rank must resolve every decision identically: rank 0 decides,
+# flags ride the wire (coalescing PR 2, expiry PR 3, sampling PR 5,
+# epochs PR 6).  Rank-local nondeterminism in code reachable from the
+# batch execution entry points is how that invariant silently breaks.
+
+
+def _unparse(node: ast.expr) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:  # pragma: no cover
+        return ""
+
+
+def _is_set_expr(node: ast.expr) -> bool:
+    if isinstance(node, (ast.Set, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+        return node.func.id in ("set", "frozenset")
+    return False
+
+
+class _DeterminismVisitor(ast.NodeVisitor):
+    """Scans ONE function body (nested defs are their own call-graph
+    nodes and scanned separately; lambdas are inlined here)."""
+
+    def __init__(self, rel: str, scope: str, out: list):
+        self.rel = rel
+        self.scope = scope
+        self.out = out
+        self._top = True
+
+    def _flag(self, node, msg: str) -> None:
+        self.out.append(
+            Finding("lockstep-determinism", self.rel, node.lineno, self.scope, msg)
+        )
+
+    def visit_FunctionDef(self, node):
+        if self._top:
+            self._top = False
+            self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_ClassDef(self, node):
+        pass
+
+    def visit_Call(self, node: ast.Call) -> None:
+        text = _unparse(node.func)
+        if text in ("time.time", "time.time_ns"):
+            self._flag(node, "rank-local wall clock (decide on rank 0, ship the flag)")
+        elif text.startswith("random.") or text.startswith(("np.random.", "numpy.random.")):
+            self._flag(node, f"unseeded module-level randomness ({text}) diverges across ranks")
+        elif text.startswith(("uuid.", "secrets.")) or text == "os.urandom":
+            self._flag(node, f"{text}() is rank-local entropy")
+        elif text in ("os.getenv", "os.environ.get"):
+            self._flag(node, "environment read: ranks may be launched with differing env")
+        self.generic_visit(node)
+
+    def visit_Subscript(self, node: ast.Subscript) -> None:
+        if _unparse(node.value) == "os.environ":
+            self._flag(node, "environment read: ranks may be launched with differing env")
+        self.generic_visit(node)
+
+    def _check_iter(self, it: ast.expr) -> None:
+        if _is_set_expr(it):
+            self._flag(
+                it,
+                "iteration over a set: order depends on PYTHONHASHSEED and "
+                "diverges across rank processes (sort it first)",
+            )
+        elif isinstance(it, ast.Call) and isinstance(it.func, ast.Name):
+            if it.func.id in ("list", "tuple", "enumerate", "iter") and it.args \
+                    and _is_set_expr(it.args[0]):
+                self._flag(
+                    it,
+                    "set materialized in iteration order: order depends on "
+                    "PYTHONHASHSEED across rank processes (sort it first)",
+                )
+
+    def visit_For(self, node: ast.For) -> None:
+        self._check_iter(node.iter)
+        self.generic_visit(node)
+
+    def visit_comprehension(self, node) -> None:
+        self._check_iter(node.iter)
+        self.generic_visit(node)
+
+
+def rule_lockstep_determinism(files, root: str) -> list[Finding]:
+    graph = CallGraph(files)
+    seeds = graph.seeds_matching(LOCKSTEP_ENTRY_FILE, LOCKSTEP_ENTRY_PREFIX)
+    if not seeds:
+        return []
+    reachable = graph.reachable_from(seeds)
+    out: list[Finding] = []
+    for key in sorted(reachable):
+        info = graph.funcs[key]
+        _DeterminismVisitor(info.rel, info.scope, out).visit(info.node)
+    return out
+
+
+# -- 2. lock-discipline (static half) --------------------------------------
+#
+# The runtime half is lockcheck.py (PILOSA_TPU_LOCK_CHECK=1).  This
+# half keeps its coverage honest: a raw threading primitive is a lock
+# the checker cannot see.
+
+_RAW_PRIMS = ("threading.Lock", "threading.RLock", "threading.Condition")
+_EXEMPT_FILES = ("analysis/lockcheck.py",)
+
+
+def rule_lock_discipline(files, root: str) -> list[Finding]:
+    out: list[Finding] = []
+    for sf in files:
+        if sf.rel in _EXEMPT_FILES:
+            continue
+
+        from pilosa_tpu.analysis.engine import ScopedVisitor
+
+        class V(ScopedVisitor):
+            def visit_Call(inner, node):
+                text = _unparse(node.func)
+                if text in _RAW_PRIMS:
+                    kind = text.rsplit(".", 1)[-1]
+                    factory = {
+                        "Lock": "named_lock",
+                        "RLock": "named_rlock",
+                        "Condition": "named_condition",
+                    }[kind]
+                    out.append(
+                        Finding(
+                            "lock-discipline", sf.rel, node.lineno,
+                            inner.scope_name(),
+                            f"raw threading.{kind}() invisible to the lock "
+                            f"checker; use lockcheck.{factory}(\"<name>\")",
+                        )
+                    )
+                inner.generic_visit(node)
+
+        V().visit(sf.tree)
+    return out
+
+
+# -- 3. stats-registry ------------------------------------------------------
+
+
+def rule_stats_registry(files, root: str) -> list[Finding]:
+    out: list[Finding] = []
+    sites, unresolved = regmod.collect_stat_sites(files)
+    rpath = regmod.registry_path(root)
+    rel_reg = "analysis/" + regmod.REGISTRY_NAME
+    if not os.path.exists(rpath):
+        out.append(
+            Finding(
+                "stats-registry", rel_reg, 1, "<registry>",
+                "counters registry missing; generate it with "
+                "`python -m pilosa_tpu.analysis --write-registry`",
+            )
+        )
+        return out
+    with open(rpath, encoding="utf-8") as f:
+        committed = f.read()
+    names = regmod.registered_names(committed)
+    for s in sites:
+        if s.name not in names:
+            out.append(
+                Finding(
+                    "stats-registry", s.rel, s.line, s.scope,
+                    f"stats name `{s.name}` not in the counters registry — "
+                    "typo, or regenerate with `python -m pilosa_tpu.analysis "
+                    "--write-registry`",
+                )
+            )
+    for rel, line, scope, kind in unresolved:
+        out.append(
+            Finding(
+                "stats-registry", rel, line, scope,
+                f"stats .{kind}() name is not statically recoverable; use a "
+                "literal or f-string so the registry can document it",
+            )
+        )
+    regenerated = regmod.render_registry(sites)
+    if regenerated != committed:
+        added = sorted(regmod.registered_names(regenerated) - names)
+        removed = sorted(names - regmod.registered_names(regenerated))
+        detail = []
+        if added:
+            detail.append(f"missing from registry: {', '.join(added[:6])}")
+        if removed:
+            detail.append(f"stale in registry: {', '.join(removed[:6])}")
+        out.append(
+            Finding(
+                "stats-registry", rel_reg, 1, "<registry>",
+                "counters registry is stale ("
+                + ("; ".join(detail) or "formatting drift")
+                + ") — regenerate with `python -m pilosa_tpu.analysis "
+                "--write-registry` and commit the diff",
+            )
+        )
+    return out
+
+
+# -- 4. exception-hygiene ---------------------------------------------------
+#
+# The syncer's five silent peer-skips were a PR 5 satellite; this rule
+# stops the pattern recurring: a broad handler must leave a trace — a
+# stat, a log line, a re-raise, USE of the caught exception (collected,
+# returned to the caller, ...), or an explicit analysis-ok tag.
+
+
+def _body_has_raise(body) -> bool:
+    for node in body:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Raise):
+                return True
+    return False
+
+
+def _body_uses_name(body, name: str) -> bool:
+    if not name:
+        return False
+    for node in body:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Name) and sub.id == name:
+                return True
+    return False
+
+
+def _body_records(body) -> bool:
+    """A stats emission, a logging-ish call, or a recording helper
+    (``self._note_peer_error(...)``-style ``_note_*`` methods, the
+    project idiom for counted skips) anywhere in the handler."""
+    for node in body:
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            fn = sub.func
+            if isinstance(fn, ast.Attribute):
+                if fn.attr in regmod.STAT_METHODS and regmod._receiver_is_stats(fn.value):
+                    return True
+                if fn.attr in _LOG_METHODS or fn.attr == "print_exc":
+                    return True
+                if fn.attr.startswith("_note"):
+                    return True
+            elif isinstance(fn, ast.Name) and fn.id == "print":
+                return True
+    return False
+
+
+def _is_broad_handler(h: ast.ExceptHandler) -> bool:
+    t = h.type
+    if t is None:
+        return True  # bare except:
+    if isinstance(t, ast.Name):
+        return t.id in ("Exception", "BaseException")
+    if isinstance(t, ast.Tuple):
+        return any(
+            isinstance(e, ast.Name) and e.id in ("Exception", "BaseException")
+            for e in t.elts
+        )
+    return False
+
+
+def rule_exception_hygiene(files, root: str) -> list[Finding]:
+    out: list[Finding] = []
+    for sf in files:
+        if sf.rel.startswith("analysis/"):
+            continue
+
+        from pilosa_tpu.analysis.engine import ScopedVisitor
+
+        class V(ScopedVisitor):
+            def visit_ExceptHandler(inner, node):
+                if _is_broad_handler(node) and not (
+                    _body_has_raise(node.body)
+                    or _body_uses_name(node.body, node.name)
+                    or _body_records(node.body)
+                ):
+                    out.append(
+                        Finding(
+                            "exception-hygiene", sf.rel, node.lineno,
+                            inner.scope_name(),
+                            "broad except swallows the error with no stat, "
+                            "log, re-raise, or use of the exception — count "
+                            "it or tag the site",
+                        )
+                    )
+                inner.generic_visit(node)
+
+        V().visit(sf.tree)
+    return out
+
+
+# -- 5. deadline-propagation ------------------------------------------------
+#
+# PR 3's contract: every hop forwards the REMAINING budget.  A function
+# that holds a deadline (parameter or ExecOptions) and performs an HTTP
+# hop without `deadline=` silently resets the budget on the peer.
+
+
+class _DeadlineVisitor(ast.NodeVisitor):
+    def __init__(self, rel: str, out: list):
+        self.rel = rel
+        self.out = out
+        self.scope: list[str] = []
+
+    def visit_ClassDef(self, node):
+        self.scope.append(node.name)
+        self.generic_visit(node)
+        self.scope.pop()
+
+    def visit_FunctionDef(self, node):
+        self.scope.append(node.name)
+        args = node.args
+        names = [
+            a.arg
+            for a in (
+                args.posonlyargs + args.args + args.kwonlyargs
+                + ([args.vararg] if args.vararg else [])
+                + ([args.kwarg] if args.kwarg else [])
+            )
+        ]
+        has_deadline = any(n in DEADLINE_PARAMS for n in names)
+        if not has_deadline:
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Attribute) and sub.attr == "deadline":
+                    has_deadline = True
+                    break
+        if has_deadline:
+            scope = ".".join(self.scope)
+            for sub in ast.walk(node):
+                if not isinstance(sub, ast.Call):
+                    continue
+                fn = sub.func
+                if not (isinstance(fn, ast.Attribute) and fn.attr in HOP_METHODS):
+                    continue
+                kw_names = {k.arg for k in sub.keywords}
+                if "deadline" not in kw_names and None not in kw_names:
+                    self.out.append(
+                        Finding(
+                            "deadline-propagation", self.rel, sub.lineno, scope,
+                            f".{fn.attr}(...) hop without deadline= — the peer "
+                            "restarts the budget instead of inheriting the "
+                            "remaining one",
+                        )
+                    )
+        self.generic_visit(node)
+        self.scope.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
+def rule_deadline_propagation(files, root: str) -> list[Finding]:
+    out: list[Finding] = []
+    for sf in files:
+        if sf.rel.startswith("analysis/"):
+            continue
+        _DeadlineVisitor(sf.rel, out).visit(sf.tree)
+    return out

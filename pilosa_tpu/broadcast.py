@@ -128,10 +128,15 @@ class HTTPBroadcaster:
     """POST the envelope to every peer's internal endpoint
     (httpbroadcast/messenger.go:45-121)."""
 
-    def __init__(self, internal_hosts: list[str], self_host: str = "", timeout: float = 10.0):
+    def __init__(self, internal_hosts: list[str], self_host: str = "",
+                 timeout: float = 10.0, stats=None):
+        from pilosa_tpu.stats import NOP_STATS
+
         self.internal_hosts = list(internal_hosts)
         self.self_host = self_host
         self.timeout = timeout
+        self.stats = stats if stats is not None else NOP_STATS
+        self.stat_send_errors = 0
 
     def send_sync(self, msg: bytes) -> None:
         import urllib.request
@@ -159,7 +164,10 @@ class HTTPBroadcaster:
         try:
             self.send_sync(msg)
         except Exception:
-            pass
+            # Async delivery is best-effort by contract; the drop is
+            # counted so a steadily failing peer shows on a dashboard.
+            self.stat_send_errors += 1
+            self.stats.count("broadcast.send_errors")
 
 
 class HTTPBroadcastReceiver:
@@ -186,6 +194,7 @@ class HTTPBroadcastReceiver:
                     handler(body)
                     code, payload = 200, b"{}"
                 except Exception as e:
+                    # error returns to the sender as the HTTP answer
                     code, payload = 400, str(e).encode()
                 self.send_response(code)
                 self.send_header("Content-Length", str(len(payload)))

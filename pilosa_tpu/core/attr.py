@@ -17,6 +17,8 @@ import json
 import os
 import sqlite3
 import threading
+
+from pilosa_tpu.analysis import lockcheck
 from typing import Optional
 
 ATTR_BLOCK_SIZE = 100
@@ -44,7 +46,7 @@ class AttrStore:
     def __init__(self, path: str):
         self.path = path
         self._cache: dict[int, dict] = {}
-        self._lock = threading.RLock()
+        self._lock = lockcheck.named_rlock("core.attrstore._lock")
         self._db: Optional[sqlite3.Connection] = None
 
     def open(self) -> None:

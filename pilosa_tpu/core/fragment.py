@@ -27,6 +27,8 @@ import math
 import os
 import tempfile
 import threading
+
+from pilosa_tpu.analysis import lockcheck
 from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Optional, Sequence
@@ -137,7 +139,7 @@ class Fragment:
 
         # Guards storage + caches against concurrent readers/writers
         # (fragment.go:69 mu analog).
-        self._mu = threading.RLock()
+        self._mu = lockcheck.named_rlock("core.fragment._mu")
         self.storage: roaring.Bitmap = roaring.Bitmap()
         self.cache = cache_mod.new_cache(cache_type, cache_size, ranking_debounce_s)
         self._wal = None  # append handle to the data file
@@ -238,6 +240,7 @@ class Fragment:
 
     @staticmethod
     def _mmap_enabled() -> bool:
+        # analysis-ok: lockstep-determinism: deployment config, launcher sets identical env on every rank
         return os.environ.get("PILOSA_TPU_MMAP", "1").lower() not in (
             "0", "false", "no",
         )
@@ -616,6 +619,7 @@ class Fragment:
         scale = self._max_opn_scale
         if scale is None:  # read once per fragment (env reads cost ~10us/op)
             scale = self._max_opn_scale = int(
+                # analysis-ok: lockstep-determinism: deployment config, launcher sets identical env on every rank
                 os.environ.get("PILOSA_TPU_MAX_OPN_SCALE", "8")
             )
         if scale <= 0:

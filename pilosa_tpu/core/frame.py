@@ -17,6 +17,8 @@ from __future__ import annotations
 import json
 import os
 import threading
+
+from pilosa_tpu.analysis import lockcheck
 from datetime import datetime
 from typing import Optional, Sequence
 
@@ -101,7 +103,7 @@ class Frame:
         self.time_quantum = ""
 
         # Guards view create against concurrent writers (frame.go mu analog).
-        self._mu = threading.RLock()
+        self._mu = lockcheck.named_rlock("core.frame._mu")
         self.views: dict[str, View] = {}
         self.row_attr_store = AttrStore(os.path.join(path, "row_attrs.db"))
 

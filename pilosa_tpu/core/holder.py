@@ -15,6 +15,8 @@ from __future__ import annotations
 import os
 import shutil
 import threading
+
+from pilosa_tpu.analysis import lockcheck
 from typing import Optional
 
 from pilosa_tpu.core.fragment import Fragment
@@ -37,7 +39,7 @@ class Holder:
         self.ranking_debounce_s = ranking_debounce_s
         # Guards index create/delete against concurrent schema merges
         # (gossip push/pull runs from two threads; holder.go:35 mu analog).
-        self._mu = threading.RLock()
+        self._mu = lockcheck.named_rlock("core.holder._mu")
         self.indexes: dict[str, Index] = {}
         # Hook invoked as (index, frame, view, slice) when a fragment for a
         # new max slice is created locally — the server broadcasts a

@@ -12,6 +12,8 @@ from __future__ import annotations
 import json
 import os
 import threading
+
+from pilosa_tpu.analysis import lockcheck
 from typing import Optional
 
 from pilosa_tpu.core import timequantum as tq
@@ -67,7 +69,7 @@ class Index:
 
         # Guards frame create/delete against concurrent schema merges
         # (index.go mu analog).
-        self._mu = threading.RLock()
+        self._mu = lockcheck.named_rlock("core.index._mu")
         self.frames: dict[str, Frame] = {}
         self.column_attr_store = AttrStore(os.path.join(path, "column_attrs.db"))
 

@@ -11,6 +11,8 @@ from __future__ import annotations
 
 import os
 import threading
+
+from pilosa_tpu.analysis import lockcheck
 from typing import Callable, Optional
 
 from pilosa_tpu.core import cache as cache_mod
@@ -58,7 +60,7 @@ class View:
         self.on_new_fragment = on_new_fragment  # broadcast hook (CreateSliceMessage)
         self.stats = stats if stats is not None else NOP_STATS
         # Guards fragment create against concurrent writers (view.go mu analog).
-        self._mu = threading.RLock()
+        self._mu = lockcheck.named_rlock("core.view._mu")
         self.fragments: dict[int, Fragment] = {}
 
     # -- lifecycle ------------------------------------------------------

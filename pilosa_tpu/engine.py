@@ -787,6 +787,7 @@ class MeshEngine(JaxEngine):
 
         if use_pallas():
             return "pallas"
+        # analysis-ok: lockstep-determinism: deployment config, launcher sets identical env on every rank
         if os.environ.get("PILOSA_TPU_PALLAS_INTERPRET", "").lower() in ("1", "true", "yes"):
             return "interpret"
         return ""
@@ -936,6 +937,7 @@ def new_engine(name: str = "auto"):
                 eng = JaxEngine()
                 eng.count(eng.asarray(np.zeros(8, dtype=np.uint32)))  # backend probe
                 return eng
+            # analysis-ok: exception-hygiene: backend probe; the numpy engine is the documented fallback
             except Exception:
                 return NumpyEngine()
         return JaxEngine()

@@ -31,6 +31,7 @@ from typing import Any, Optional, Sequence
 import numpy as np
 
 from pilosa_tpu import pql
+from pilosa_tpu.analysis import lockcheck
 from pilosa_tpu import qcache as qcache_mod
 from pilosa_tpu.core import cache as cache_mod
 from pilosa_tpu.core.fragment import TopOptions
@@ -250,7 +251,7 @@ class Executor:
         # Multi-view matrices for the fused Range path, keyed by
         # (index, frame, views, slices); validated the same way.
         self._multi_matrix_cache: "OrderedDict[tuple, tuple]" = OrderedDict()
-        self._matrix_mu = threading.Lock()
+        self._matrix_mu = lockcheck.named_lock("executor._matrix_mu")
         self._matrix_cache_entries = int(
             os.environ.get("PILOSA_TPU_MATRIX_CACHE_ENTRIES", "4")
         )
@@ -305,7 +306,7 @@ class Executor:
         # saturated (a burst blew past the budget; rebuild, don't walk
         # journals).
         self._dirty_rows: dict[tuple[str, str], Optional[set]] = {}
-        self._dirty_mu = threading.Lock()
+        self._dirty_mu = lockcheck.named_lock("executor._dirty_mu")
         self._gram_env_cache: Optional[tuple[bool, int]] = None  # lazy env read
         # Generation-keyed query result cache (qcache.QueryCache), the
         # whole-query memoization layer in front of every read path.
@@ -552,10 +553,7 @@ class Executor:
         for host, idxs in by_node.items():
             client = self.client_factory(host)
             q = pql.Query(calls=[calls[i] for i in idxs])
-            if opt.deadline is not None:
-                res = client.execute_remote(index, q, deadline=opt.deadline)
-            else:
-                res = client.execute_remote(index, q)
+            res = client.execute_remote(index, q, deadline=opt.deadline)
             for k, i in enumerate(idxs):
                 if res and res[k]:
                     changed[i] = True
@@ -679,6 +677,7 @@ class Executor:
         unusual args, parse errors — so the normal parse path keeps every
         behavior and error message.
         """
+        # analysis-ok: lockstep-determinism: deployment config, launcher sets identical env on every rank
         if os.environ.get("PILOSA_TPU_NO_FASTLANE", "").lower() in ("1", "true", "yes"):
             return None
         from pilosa_tpu import native
@@ -742,7 +741,7 @@ class Executor:
         # labels take the slow path, missing frames raise there too).
         frame_names = [b.decode("utf-8") for b in frames_b]
         key_names = [b.decode("utf-8") for b in keys_b]
-        for f_id, k_id in set(zip(frame_ids.tolist(), key_ids.tolist())):
+        for f_id, k_id in sorted(set(zip(frame_ids.tolist(), key_ids.tolist()))):
             fname = frame_names[f_id] if f_id >= 0 else DEFAULT_FRAME
             fr = self.holder.frame(index, fname)
             if fr is None or key_names[k_id] != fr.row_label:
@@ -1589,7 +1588,7 @@ class Executor:
         # a rebuild drops old views whose combos this batch no longer
         # references, and tracking their gens would invalidate the entry on
         # writes to rows it doesn't even hold.
-        store_gens = {v: gens[v] for v in {vv for vv, _ in combos}}
+        store_gens = {v: gens[v] for v in sorted({vv for vv, _ in combos})}
         if len(combos) <= self._matrix_rows_max:
             with self._matrix_mu:
                 self._multi_matrix_cache[key] = (store_gens, id_pos, matrix, memo)
@@ -1874,6 +1873,7 @@ class Executor:
 
     def _stream_bytes(self) -> int:
         """Per-chunk byte budget for slice-streaming transient matrices."""
+        # analysis-ok: lockstep-determinism: deployment config, launcher sets identical env on every rank
         return int(os.environ.get("PILOSA_TPU_STREAM_BYTES", str(1 << 31)))
 
     def _slice_chunk(self, n_rows: int) -> int:
@@ -1932,8 +1932,10 @@ class Executor:
         cached = self._gram_env_cache
         if cached is None:
             cached = self._gram_env_cache = (
+                # analysis-ok: lockstep-determinism: deployment config, launcher sets identical env on every rank
                 os.environ.get("PILOSA_TPU_NO_GRAM", "").lower() in ("1", "true", "yes"),
                 self._gram_rows_max_cfg
+                # analysis-ok: lockstep-determinism: deployment config, launcher sets identical env on every rank
                 or int(os.environ.get("PILOSA_TPU_GRAM_ROWS_MAX", "4096")),
             )
         return cached
@@ -2554,12 +2556,9 @@ class Executor:
                     changed = True
             else:
                 client = self.client_factory(node.host)
-                if opt.deadline is not None:
-                    res = client.execute_remote(
-                        index, pql.Query(calls=[c]), deadline=opt.deadline
-                    )
-                else:
-                    res = client.execute_remote(index, pql.Query(calls=[c]))
+                res = client.execute_remote(
+                    index, pql.Query(calls=[c]), deadline=opt.deadline
+                )
                 if res and res[0]:
                     changed = True
         return changed
@@ -2633,6 +2632,7 @@ class Executor:
             # reference's per-slice goroutine loop has no size limit
             # either (executor.go:1115-1244); this is its bounded-memory
             # analog.
+            # analysis-ok: lockstep-determinism: deployment config, launcher sets identical env on every rank
             chunk = int(os.environ.get("PILOSA_TPU_SLICE_CHUNK", "2048"))
             span = opt.span
             if len(node_slices) <= chunk:

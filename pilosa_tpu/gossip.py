@@ -35,6 +35,8 @@ import socket
 import socketserver
 import struct
 import threading
+
+from pilosa_tpu.analysis import lockcheck
 import time
 from dataclasses import dataclass, field
 from typing import Callable, Optional
@@ -143,7 +145,10 @@ class GossipNodeSet:
         suspect_timeout: float = 1.5,
         push_pull_interval: float = 2.0,
         retransmit_mult: int = 3,
+        stats=None,
     ):
+        from pilosa_tpu.stats import NOP_STATS
+
         self.name = name
         self.bind = bind
         self.seed = seed
@@ -153,9 +158,13 @@ class GossipNodeSet:
         self.suspect_timeout = suspect_timeout
         self.push_pull_interval = push_pull_interval
         self.retransmit_mult = retransmit_mult
+        self.stats = stats if stats is not None else NOP_STATS
+        # Process-lifetime total of swallowed errors (tests, embedders
+        # without an expvar sink); tagged counters live in the client.
+        self.stat_swallowed = 0
 
         self.handler: Optional[Callable[[bytes], None]] = None
-        self._lock = threading.RLock()
+        self._lock = lockcheck.named_rlock("gossip._lock")
         self._members: dict[str, Member] = {}
         self._incarnation = 0
         self._queue: list[_LimitedBroadcast] = []
@@ -194,7 +203,9 @@ class GossipNodeSet:
                     if resp is not None:
                         self.wfile.write(struct.pack("<BI", _PUSH_PULL, len(resp)) + resp)
                 except Exception:
-                    pass
+                    # A malformed or torn inbound frame must not kill the
+                    # accept loop, but it is never silent.
+                    nodeset._note_swallowed("tcp_handler")
 
         # Gossip needs the SAME port on UDP and TCP (memberlist does too).
         # With an ephemeral bind (":0") the kernel-chosen UDP port may be
@@ -310,11 +321,17 @@ class GossipNodeSet:
             return
         self._queue_broadcast(_PB_USER, msg)
 
+    def _note_swallowed(self, where: str) -> None:
+        """One intentionally-swallowed error on a best-effort path:
+        visible at /debug/vars instead of vanishing."""
+        self.stat_swallowed += 1
+        self.stats.count(f"gossip.swallowed.{where}")
+
     def _quiet_sync(self, msg: bytes) -> None:
         try:
             self.send_sync(msg)
         except Exception:
-            pass
+            self._note_swallowed("async_send")
 
     # -- internals: queue + piggyback -------------------------------------
 
@@ -420,7 +437,7 @@ class GossipNodeSet:
             try:
                 self._handle_udp(data, src)
             except Exception:
-                pass
+                self._note_swallowed("udp_handler")
 
     def _handle_udp(self, data: bytes, src) -> None:
         if len(data) < 5:
@@ -455,7 +472,7 @@ class GossipNodeSet:
                 try:
                     self.handler(body)
                 except Exception:
-                    pass
+                    self._note_swallowed("user_handler")
 
     def _probe_loop(self) -> None:
         while not self._closing.wait(self.probe_interval):
@@ -532,6 +549,7 @@ class GossipNodeSet:
             try:
                 status = self.status_handler.local_status() or b""
             except Exception:
+                self._note_swallowed("local_status")
                 status = b""
         head = json.dumps({"members": members}).encode()
         return struct.pack("<I", len(head)) + head + status
@@ -549,7 +567,7 @@ class GossipNodeSet:
             try:
                 self.status_handler.handle_remote_status(status)
             except Exception:
-                pass
+                self._note_swallowed("remote_status")
 
     def _push_pull(self, addr: str) -> None:
         resp = self._tcp_send(addr, _PUSH_PULL, self._encode_push_pull())

@@ -21,6 +21,8 @@ batch (transport-level failures; SetBit is idempotent, retries converge).
 from __future__ import annotations
 
 import threading
+
+from pilosa_tpu.analysis import lockcheck
 from typing import Callable, Sequence
 
 
@@ -30,8 +32,8 @@ class WriteQueue:
     def __init__(self, apply_batch: Callable[[Sequence], list], max_batch: int = 4096):
         self._apply = apply_batch
         self.max_batch = max_batch
-        self._mu = threading.Lock()
-        self._cv = threading.Condition(self._mu)
+        self._mu = lockcheck.named_lock("ingest._mu")
+        self._cv = lockcheck.named_condition("ingest._mu", self._mu)
         self._items: list = []  # [(item, slot)]
         self._committing = False
         # Telemetry: batches committed / items seen (bench + tests).

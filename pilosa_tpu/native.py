@@ -21,7 +21,9 @@ _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
 _LIB_PATH = os.path.join(_NATIVE_DIR, "libpilosa_native.so")
 
-_lock = threading.Lock()
+from pilosa_tpu.analysis import lockcheck
+
+_lock = lockcheck.named_lock("native._lock")
 _lib: Optional[ctypes.CDLL] = None
 _tried = False
 
@@ -35,6 +37,7 @@ def _build() -> bool:
             timeout=120,
         )
         return os.path.exists(_LIB_PATH)
+    # analysis-ok: exception-hygiene: toolchain probe; load() reports the miss and Python lanes take over
     except Exception:
         return False
 

@@ -50,11 +50,13 @@ def _rm3(row_matrix):
 def _backend_is_tpu() -> bool:
     try:
         return jax.default_backend() == "tpu"
+    # analysis-ok: exception-hygiene: backend feature probe; False routes to the portable lane
     except Exception:
         return False
 
 
 def use_pallas() -> bool:
+    # analysis-ok: lockstep-determinism: deployment config, launcher sets identical env on every rank
     if os.environ.get("PILOSA_TPU_NO_PALLAS", "").lower() in ("1", "true", "yes"):
         return False
     return _backend_is_tpu()
@@ -107,6 +109,7 @@ _GRAM_SLICES_MAX = 2047
 
 
 def _use_gram(n_slices: int, n_rows: int, w: int, batch: int) -> bool:
+    # analysis-ok: lockstep-determinism: deployment config, launcher sets identical env on every rank
     if os.environ.get("PILOSA_TPU_NO_GRAM", "").lower() in ("1", "true", "yes"):
         return False
     return (

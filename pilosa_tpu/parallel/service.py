@@ -78,6 +78,8 @@ import socket
 import struct
 import sys
 import threading
+
+from pilosa_tpu.analysis import lockcheck
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
@@ -271,9 +273,9 @@ class LockstepService:
         # sequence order by the _exec_cv gate, matching the workers'
         # socket-order replay.  _ack_mu[i]/_acked[i] track each worker's
         # ordered receipt-ack stream.
-        self._order_mu = threading.Lock()
+        self._order_mu = lockcheck.named_lock("lockstep._order_mu")
         self._next_seq = 1
-        self._exec_cv = threading.Condition()
+        self._exec_cv = lockcheck.named_condition("lockstep._exec_cv")
         self._exec_next = 1
         self._ack_mu: list[threading.Lock] = []
         self._acked: list[int] = []
@@ -291,7 +293,7 @@ class LockstepService:
         self.coalesce_max = max(
             1, int(os.environ.get("PILOSA_TPU_LOCKSTEP_COALESCE", "32"))
         )
-        self._q_cv = threading.Condition()
+        self._q_cv = lockcheck.named_condition("lockstep._q_cv")
         self._q: list = []  # [((index, query), slot)]
         self._shipping = False
         # Ship-ahead pipeline depth: while batch n executes, at most ONE
@@ -324,7 +326,7 @@ class LockstepService:
             conn, _ = srv.accept()
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             self._workers.append(conn)
-            self._ack_mu.append(threading.Lock())
+            self._ack_mu.append(lockcheck.named_lock("lockstep._ack_mu"))
             self._acked.append(0)
 
     def _degrade(self, e) -> "DegradedError":
@@ -568,6 +570,7 @@ class LockstepService:
                 q = pql.parse_cached(query)
                 n_calls = len(q.calls)
                 read_only = n_calls > 0 and q.write_call_n() == 0
+            # analysis-ok: exception-hygiene: unit-splitting probe; the solo execution raises the real parse error to its owner
             except Exception:  # noqa: BLE001 — parse error: solo raises it
                 pass
             if read_only:

@@ -492,6 +492,7 @@ class ReplicaMesh(SliceMesh):
                         (n_slice,), (n_replicas,), devices=devices,
                     )
                 ).reshape(n_replicas, n_slice).T
+            # analysis-ok: exception-hygiene: topology probe; the guarded fallback below is the point (mesh.hybrid records which was built)
             except Exception:  # noqa: BLE001 — no DCN topology on this host
                 # Hosts without a DCN topology (single-process CPU runs,
                 # one-host TPU boxes: every device is one granule, and
@@ -523,6 +524,7 @@ class ReplicaMesh(SliceMesh):
                     (n_replicas, n_slice), devices=devices
                 )
             ).T
+        # analysis-ok: exception-hygiene: topology probe; plain reshape is the documented fallback
         except Exception:  # noqa: BLE001 — virtual devices without topology
             return np.array(devices).reshape(n_replicas, n_slice).T
 

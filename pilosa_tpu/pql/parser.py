@@ -24,6 +24,8 @@ from __future__ import annotations
 
 import re
 import threading
+
+from pilosa_tpu.analysis import lockcheck
 from collections import OrderedDict
 from typing import Any, NamedTuple
 
@@ -318,7 +320,7 @@ def parse(src: str) -> Query:
 # because the executor never mutates a parsed AST in place (TopN phase 2
 # goes through Call.clone, executor analog of ast.go Clone).
 _PARSE_CACHE: "OrderedDict[str, Query]" = OrderedDict()
-_PARSE_MU = threading.Lock()
+_PARSE_MU = lockcheck.named_lock("pql._PARSE_MU")
 _PARSE_CACHE_ENTRIES = 512
 _PARSE_CACHE_MAX_LEN = 1 << 16  # don't pin megabyte import bodies
 

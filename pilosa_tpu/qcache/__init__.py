@@ -70,6 +70,8 @@ error isolation and expired-request drops).
 from __future__ import annotations
 
 import threading
+
+from pilosa_tpu.analysis import lockcheck
 import time
 from collections import OrderedDict
 from typing import Optional
@@ -210,7 +212,7 @@ class QueryCache:
         self.min_cost_ms = float(min_cost_ms)
         self.stats = stats if stats is not None else NOP_STATS
         self._clock = clock
-        self._mu = threading.Lock()
+        self._mu = lockcheck.named_lock("qcache._mu")
         self._store: "OrderedDict[tuple, _Entry]" = OrderedDict()
         # Raw request string -> (fingerprint, frames) for eligible
         # queries, or None for ineligible/unparseable ones; bounded LRU
@@ -242,6 +244,7 @@ class QueryCache:
 
             try:
                 q = pql.parse_cached(query_str)
+            # analysis-ok: exception-hygiene: fingerprint probe; the normal execution path raises the real parse error
             except Exception:  # noqa: BLE001 — normal path raises the real error
                 q = None
             if (

@@ -17,6 +17,8 @@ behavior, and the bench's QoS-off baseline).
 from __future__ import annotations
 
 import threading
+
+from pilosa_tpu.analysis import lockcheck
 from contextlib import contextmanager
 from typing import Optional
 
@@ -90,7 +92,7 @@ class AdmissionController:
         self.queue_wait_ms = queue_wait_ms
         self.retry_after = max(0.001, retry_after_ms / 1000.0)
         self.stats = stats if stats is not None else NOP_STATS
-        self._cv = threading.Condition()
+        self._cv = lockcheck.named_condition("qos.admission._cv")
         self._active = {c: 0 for c in CLASSES}
         self._waiting = {c: 0 for c in CLASSES}
         # Totals (also mirrored into stats counters for /debug/vars).

@@ -43,6 +43,8 @@ from __future__ import annotations
 
 import os
 import threading
+
+from pilosa_tpu.analysis import lockcheck
 import time
 from typing import Optional
 
@@ -59,7 +61,7 @@ class AppliedSeq:
 
     def __init__(self, path: Optional[str] = None):
         self.path = path
-        self._mu = threading.Lock()
+        self._mu = lockcheck.named_lock("replica.appliedseq._mu")
         self.value = 0
         if path and os.path.exists(path):
             try:

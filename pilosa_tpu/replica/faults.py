@@ -43,6 +43,8 @@ from __future__ import annotations
 import os
 import random
 import threading
+
+from pilosa_tpu.analysis import lockcheck
 import time
 from typing import Optional
 
@@ -115,7 +117,7 @@ class FaultInjector:
     def __init__(self, rules: list[_Rule], seed: int = 0):
         self.rules = rules
         self._rng = random.Random(seed)
-        self._mu = threading.Lock()
+        self._mu = lockcheck.named_lock("replica.faults._mu")
 
     @classmethod
     def from_spec(cls, spec: str) -> "FaultInjector":

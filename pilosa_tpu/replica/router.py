@@ -97,6 +97,8 @@ from __future__ import annotations
 import json
 import random
 import threading
+
+from pilosa_tpu.analysis import lockcheck
 import time
 import urllib.error
 import urllib.request
@@ -224,10 +226,10 @@ class ReplicaRouter:
             None, stats=self.stats, faults=self.faults
         )
         self.catchup = CatchupManager(self, self.wal, stats=self.stats)
-        self._mu = threading.Lock()  # group table (health/inflight/epoch)
+        self._mu = lockcheck.named_lock("replica.router._mu")  # group table (health/inflight/epoch)
         # The write sequencer: held for a write's WHOLE fan-out, so all
         # groups see all writes in one total order.
-        self._seq_mu = threading.Lock()
+        self._seq_mu = lockcheck.named_lock("replica.router._seq_mu")
         self.write_seq = self.wal.last_seq
         # A router (re)started over a NON-EMPTY log must not assume any
         # group is current: a group that was lagging when the previous
@@ -848,7 +850,7 @@ class ReplicaRouter:
             try:
                 self._probe_once()
             except Exception:  # noqa: BLE001 — the probe must never die
-                pass
+                self.stats.count("replica.probe_errors")
 
     # -- lifecycle --------------------------------------------------------
 

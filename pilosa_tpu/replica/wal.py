@@ -52,6 +52,8 @@ import json
 import os
 import struct
 import threading
+
+from pilosa_tpu.analysis import lockcheck
 import zlib
 from typing import NamedTuple, Optional
 
@@ -98,7 +100,7 @@ class WriteAheadLog:
         self.max_bytes = max_bytes
         self.stats = stats if stats is not None else NOP_STATS
         self.faults = faults if faults is not None else NOP_FAULTS
-        self._mu = threading.Lock()
+        self._mu = lockcheck.named_lock("replica.wal._mu")
         # seq -> (offset, frame_len) for live records; aborted seqs kept
         # separately so replay can skip them in O(1).
         self._offsets: dict[int, tuple[int, int]] = {}
@@ -112,10 +114,13 @@ class WriteAheadLog:
         # counts file swaps (compaction/close): offsets from different
         # generations are not comparable, so the leader pins the
         # generation with the fd and a swap invalidates both.
-        self._sync_cv = threading.Condition()
+        self._sync_cv = lockcheck.named_condition("replica.wal._sync_cv")
         self._synced_off = 0
         self._syncing = False
         self._file_gen = 0
+        # Serializes whole compactions (the bulk copy runs outside _mu,
+        # so two concurrent compact() calls would race on the tmp file).
+        self._compact_mu = lockcheck.named_lock("replica.wal._compact_mu")
         if path is not None:
             self._open_and_recover(path)
         self.stats.gauge("replica.wal_bytes", self.size_bytes)
@@ -280,30 +285,104 @@ class WriteAheadLog:
         """Drop records (and tombstones) with seq <= ``min_applied`` —
         every tracked group has applied them, so no replay can need
         them.  Atomic for the file-backed log (temp + rename).  Returns
-        bytes reclaimed."""
-        with self._mu:
-            keep = sorted(s for s in self._offsets if s > min_applied)
-            keep_aborted = {s for s in self._aborted if s > min_applied}
-            before = self._end_off
-            frames = []
-            for s in keep:
-                off, n = self._offsets[s]
-                frames.append((s, self._frame_at(off, n)))
-            for s in sorted(keep_aborted):
-                frames.append((s, _encode(s, {"x": True}, b"")))
-            if self._f is not None:
-                tmp = self.path + ".compact"
-                with open(tmp, "wb") as out:
-                    offsets = {}
-                    pos = 0
-                    for s, fr in frames:
-                        if s in self._offsets:  # live record (not a tombstone)
-                            offsets[s] = (pos, len(fr))
-                        out.write(fr)
-                        pos += len(fr)
-                    out.flush()
-                    if self.fsync:
+        bytes reclaimed.
+
+        The BULK of the work — copying every kept frame into the temp
+        file and fsyncing it — happens OUTSIDE ``_mu``, so appends keep
+        flowing to the old file during a large compaction instead of
+        stalling behind its disk I/O (the lock checker flags fsync under
+        a lock for exactly this reason).  The swap then re-takes ``_mu``,
+        appends the DELTA that landed meanwhile (records/tombstones past
+        the snapshot), fsyncs that bounded tail, and renames — so the
+        new file is durable end to end before the generation bump, which
+        preserves the group-commit contract: a bump observed by a
+        waiting appender means its record is durable (in bulk or delta)
+        or moot (compacted away because every group applied it)."""
+        if self._f is None:
+            with self._mu:
+                keep = sorted(s for s in self._offsets if s > min_applied)
+                keep_aborted = {s for s in self._aborted if s > min_applied}
+                before = self._end_off
+                mem = {}
+                offsets = {}
+                pos = 0
+                for s in keep:
+                    off, n = self._offsets[s]
+                    fr = self._frame_at(off, n)
+                    offsets[s] = (pos, len(fr))
+                    mem[pos] = fr
+                    pos += len(fr)
+                for s in sorted(keep_aborted):
+                    fr = _encode(s, {"x": True}, b"")
+                    mem[pos] = fr
+                    pos += len(fr)
+                self._mem_frames = mem
+                self._offsets = offsets
+                self._end_off = pos
+                self._aborted = keep_aborted
+                freed = before - self._end_off
+            self.stats.gauge("replica.wal_bytes", self.size_bytes)
+            if freed:
+                self.stats.count("wal.compactions")
+            return freed
+
+        with self._compact_mu:
+            # Phase 1 (under _mu): snapshot the kept frames.
+            with self._mu:
+                if self._f is None:  # closed mid-wait
+                    return 0
+                snap_last = self.last_seq
+                snap_aborted = {s for s in self._aborted if s > min_applied}
+                before = self._end_off
+                frames = []
+                for s in sorted(x for x in self._offsets if x > min_applied):
+                    off, n = self._offsets[s]
+                    frames.append((s, self._frame_at(off, n)))
+            # Phase 2 (no locks): bulk copy + fsync.  Appends land in
+            # the old file meanwhile and are carried over as the delta.
+            tmp = self.path + ".compact"
+            out = open(tmp, "wb")
+            offsets = {}
+            pos = 0
+            for s, fr in frames:
+                offsets[s] = (pos, len(fr))
+                out.write(fr)
+                pos += len(fr)
+            for s in sorted(snap_aborted):
+                fr = _encode(s, {"x": True}, b"")
+                out.write(fr)
+                pos += len(fr)
+            out.flush()
+            if self.fsync:
+                os.fsync(out.fileno())
+            # Phase 3 (under _mu): append the delta, make it durable,
+            # swap.  The delta is bounded by what arrived during phase
+            # 2, so this fsync never covers the whole log again.
+            with self._mu:
+                if self._f is None:  # closed mid-compaction: abandon
+                    out.close()
+                    os.unlink(tmp)
+                    return 0
+                for s in sorted(x for x in self._offsets if x > snap_last):
+                    off, n = self._offsets[s]
+                    fr = self._frame_at(off, n)
+                    offsets[s] = (pos, len(fr))
+                    out.write(fr)
+                    pos += len(fr)
+                new_aborts = {s for s in self._aborted if s > min_applied} - snap_aborted
+                for s in sorted(new_aborts):
+                    fr = _encode(s, {"x": True}, b"")
+                    out.write(fr)
+                    pos += len(fr)
+                    offsets.pop(s, None)  # aborted during phase 2
+                out.flush()
+                if self.fsync:
+                    # analysis-ok's runtime twin: bounded delta fsync
+                    # before the rename keeps "gen bump => durable or
+                    # moot" true for every waiting appender.
+                    with lockcheck.allowed("fsync"):
                         os.fsync(out.fileno())
+                out.close()
                 # Exclude the group-commit leader for the swap: an
                 # in-flight fsync must finish on the OLD fd before it
                 # closes, and no new leader may pin the fd mid-swap.
@@ -319,29 +398,17 @@ class WriteAheadLog:
                     self._end_off = pos
                 finally:
                     with self._sync_cv:
-                        # The tmp file was fsynced before the rename:
-                        # the new file is durable end to end, so the
-                        # synced frontier is exactly its end — never
-                        # the old file's (larger) offsets, which would
-                        # make later appends skip their fsync.
+                        # The new file was fsynced end to end (bulk in
+                        # phase 2, delta above) before the rename, so
+                        # the synced frontier is exactly its end —
+                        # never the old file's (larger) offsets, which
+                        # would make later appends skip their fsync.
                         self._file_gen += 1
                         self._synced_off = pos
                         self._syncing = False
                         self._sync_cv.notify_all()
-            else:
-                mem = {}
-                offsets = {}
-                pos = 0
-                for s, fr in frames:
-                    if s in self._offsets:
-                        offsets[s] = (pos, len(fr))
-                    mem[pos] = fr
-                    pos += len(fr)
-                self._mem_frames = mem
-                self._offsets = offsets
-                self._end_off = pos
-            self._aborted = keep_aborted
-            freed = before - self._end_off
+                self._aborted = {s for s in self._aborted if s > min_applied}
+                freed = before - self._end_off
         self.stats.gauge("replica.wal_bytes", self.size_bytes)
         if freed:
             self.stats.count("wal.compactions")

@@ -29,6 +29,8 @@ from __future__ import annotations
 
 import os
 import threading
+
+from pilosa_tpu.analysis import lockcheck
 from collections import OrderedDict
 from typing import Callable, Optional, Sequence
 
@@ -39,6 +41,7 @@ def _pool_bytes() -> int:
     tune it).  Total pool memory is bounded by this times the executor's
     matrix-cache entry count; transient peaks reach 2x one pool during a
     functional scatter (old + new array alive)."""
+    # analysis-ok: lockstep-determinism: deployment config, launcher sets identical env on every rank
     return int(os.environ.get("PILOSA_TPU_POOL_BYTES", str(2 * 1024 * 1024 * 1024)))
 
 
@@ -91,7 +94,7 @@ class DeviceRowPool:
         # PILOSA_TPU_POOL_BYTES applies to cached pools, keeping this in
         # lockstep with callers that consult pool_capacity() directly).
         self._cap_override = cap_max
-        self.mu = threading.RLock()
+        self.mu = lockcheck.named_rlock("rowpool.mu")
         self.gens: Optional[tuple] = None
         self.matrix = None  # engine array [n_slices, cap, W]
         self.cap = 0
@@ -133,7 +136,7 @@ class DeviceRowPool:
         # range in use so Gram builds can ignore free capacity tail.
         return {
             "hits": 0,
-            "mu": threading.Lock(),
+            "mu": lockcheck.named_lock("rowpool.entry_mu"),
             "id_pos": dict(self.slot_of),
             "n_used": max(self.slot_of.values(), default=-1) + 1,
         }

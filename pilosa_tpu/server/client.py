@@ -160,6 +160,7 @@ class Client:
             msg = payload.decode(errors="replace")
             try:
                 msg = json.loads(msg).get("error", msg)
+            # analysis-ok: exception-hygiene: best-effort decode of an error payload; the real error raises on the next line
             except Exception:
                 pass
             raise ClientError(status, msg)
@@ -229,6 +230,7 @@ class Client:
             except ValueError:
                 try:
                     msg = json.loads(msg).get("error", msg)
+                # analysis-ok: exception-hygiene: best-effort decode of an error payload; the real error raises below
                 except Exception:
                     pass
             raise ClientError(status, msg)

@@ -177,7 +177,7 @@ class Server:
             me = self.cluster.node_by_host(self.host)
             my_internal = me.internal_host if me else ""
             internal_hosts = [n.internal_host or n.host for n in self.cluster.nodes]
-            broadcaster = bc.HTTPBroadcaster(internal_hosts, self_host=my_internal)
+            broadcaster = bc.HTTPBroadcaster(internal_hosts, self_host=my_internal, stats=self.stats)
             port = 0
             if my_internal and ":" in my_internal:
                 port = int(my_internal.rsplit(":", 1)[1])
@@ -195,6 +195,7 @@ class Server:
                 bind=bind,
                 seed=self.config.cluster.gossip_seed,
                 status_handler=self,
+                stats=self.stats,
             )
             return nodeset, nodeset
         raise ValueError(f"unknown cluster type: {ctype}")
@@ -267,7 +268,9 @@ class Server:
                 try:
                     fn()
                 except Exception:
-                    pass
+                    # A failed monitor pass (anti-entropy, max-slice poll)
+                    # retries next tick; make the failures countable.
+                    self.stats.count("server.monitor_errors")
 
         t = threading.Thread(target=loop, daemon=True)
         t.start()
@@ -290,6 +293,7 @@ class Server:
                 maxes = client.max_slices()
                 inverse_maxes = client.max_slices(inverse=True)
             except Exception:
+                self.stats.count("server.monitor_peer_errors")
                 continue
             for index_name, max_slice in maxes.items():
                 idx = self.holder.index(index_name)
@@ -315,7 +319,7 @@ class Server:
                 bc.encode_create_slice(index, slice_i, is_inverse=(view == VIEW_INVERSE))
             )
         except Exception:
-            pass
+            self.stats.count("server.broadcast_errors")
 
     # -- StatusHandler (server.go:310-391, carried by gossip push/pull) -----
 
@@ -415,7 +419,9 @@ class Server:
             try:
                 self.holder.delete_index(msg["index"])
             except Exception:
-                pass
+                # Remote delete for an index this node never created:
+                # already converged, but keep the count honest.
+                self.stats.count("server.receive_message_errors")
         elif typ == bc.MESSAGE_TYPE_CREATE_FRAME:
             idx = self.holder.index(msg["index"])
             if idx is not None:
@@ -436,4 +442,4 @@ class Server:
                 try:
                     idx.delete_frame(msg["frame"])
                 except Exception:
-                    pass
+                    self.stats.count("server.receive_message_errors")

@@ -12,6 +12,8 @@ from __future__ import annotations
 import random
 import socket
 import threading
+
+from pilosa_tpu.analysis import lockcheck
 from collections import defaultdict
 from typing import Iterable
 
@@ -51,7 +53,7 @@ class ExpvarStatsClient:
     """In-process stats exposed at /debug/vars (stats.go:70-130)."""
 
     def __init__(self, tags: tuple[str, ...] = ()):
-        self._lock = threading.Lock()
+        self._lock = lockcheck.named_lock("stats._lock")
         self._counters: dict[str, int] = defaultdict(int)
         self._gauges: dict[str, float] = {}
         self._sets: dict[str, str] = {}

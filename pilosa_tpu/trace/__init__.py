@@ -62,6 +62,8 @@ import json
 import logging
 import random
 import threading
+
+from pilosa_tpu.analysis import lockcheck
 import time
 import uuid
 from collections import deque
@@ -225,7 +227,7 @@ class Tracer:
         self.slow_ms = max(0.0, float(slow_ms))
         self.stats = stats if stats is not None else NOP_STATS
         self._rng = rng if rng is not None else random.Random()
-        self._mu = threading.Lock()
+        self._mu = lockcheck.named_lock("trace._mu")
         self._ring: "deque[dict]" = deque(maxlen=max(1, int(ring)))
         self.stat_sampled = 0
         self.stat_slow = 0

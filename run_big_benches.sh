@@ -16,6 +16,14 @@ if ! flock -n 9; then
   exit 1
 fi
 OUT=big_bench_results.jsonl
+# PREFLIGHT: the invariant linter must be clean before burning bench
+# hours — a stale counters registry or a new untagged finding means the
+# tree is mid-change and the run's telemetry names may not match
+# COUNTERS.md.  Fails fast with the linter's own report.
+if ! python -m pilosa_tpu.analysis; then
+  echo "pilosa_tpu.analysis preflight failed; fix/tag findings first" >&2
+  exit 1
+fi
 run() {
   echo "=== $* $(date +%H:%M:%S)" >> $OUT
   timeout 3600 env "$@" python bench.py >> $OUT 2>>big_bench_errors.log

@@ -49,3 +49,49 @@ def pytest_addoption(parser):
 @pytest.fixture
 def rng():
     return np.random.default_rng(42)
+
+
+# -- runtime lock checker (pilosa_tpu/analysis/lockcheck.py) ----------------
+#
+# The tier-1 concurrency/replica/qos suites run with the lock checker
+# ON: every named lock created during these tests feeds the cross-thread
+# acquisition-order graph, blocking calls under a lock are caught, and a
+# test that recorded any violation FAILS with the checker's report.
+# Subprocess group workers inherit PILOSA_TPU_LOCK_CHECK=1 via the env
+# and self-enable at import (violations print to their stderr at exit).
+
+_LOCKCHECK_MODULES = ("test_concurrency", "test_replica", "test_qos")
+
+
+def _lockcheck_wanted(item) -> bool:
+    name = item.module.__name__ if item.module else ""
+    return any(name.startswith(m) for m in _LOCKCHECK_MODULES)
+
+
+@pytest.fixture(autouse=True)
+def _lockcheck_gate(request):
+    item = request.node
+    try:
+        wanted = _lockcheck_wanted(item)
+    except Exception:
+        wanted = False
+    if not wanted:
+        yield
+        return
+    from pilosa_tpu.analysis import lockcheck
+
+    os.environ[lockcheck.ENV_VAR] = "1"  # spawned group workers inherit
+    lockcheck.enable()
+    lockcheck.reset()
+    try:
+        yield
+    finally:
+        os.environ.pop(lockcheck.ENV_VAR, None)
+        violations = lockcheck.take_violations()
+        lockcheck.disable()
+        if violations:
+            pytest.fail(
+                f"lock checker recorded {len(violations)} violation(s):\n\n"
+                + "\n\n".join(v.describe() for v in violations),
+                pytrace=False,
+            )

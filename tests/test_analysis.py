@@ -1,0 +1,500 @@
+"""Tests for pilosa_tpu/analysis/: the five lint rules (golden firing +
+passing fixtures each), suppression-comment and baseline round-trips,
+the counters-registry generation/drift check, the runtime lock checker
+(seeded order inversion, seeded blocking-under-lock, allowlists), the
+CLI, and the LIVE-TREE GATE — the tier-1 test that runs every pass over
+the real package and fails on new findings (the in-suite half of the CI
+wiring; run_big_benches.sh runs the same gate as a preflight).
+"""
+
+import os
+import textwrap
+import threading
+
+import pytest
+
+from pilosa_tpu.analysis import engine, lockcheck, registry
+from pilosa_tpu.analysis.__main__ import main as analysis_main
+
+
+# -- fixture harness --------------------------------------------------------
+
+
+def _mkpkg(tmp_path, files: dict, registry_for=None):
+    """Materialize a fake package tree and return its root path.
+    ``registry_for`` writes a COUNTERS.md matching the given tree (or
+    an explicit text when a str is passed)."""
+    root = tmp_path / "pkg"
+    for rel, text in files.items():
+        p = root / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(text))
+    (root / "analysis").mkdir(exist_ok=True)
+    if registry_for is not None:
+        text = (
+            registry_for
+            if isinstance(registry_for, str)
+            else registry.generate_counters_registry(str(root))
+        )
+        (root / "analysis" / registry.REGISTRY_NAME).write_text(text)
+    return str(root)
+
+
+def _run(root, rules):
+    return engine.run_analysis(root=root, rules=rules)
+
+
+def _new(findings):
+    return engine.new_findings(findings)
+
+
+# -- rule 1: lockstep-determinism ------------------------------------------
+
+_DET_FIRING = {
+    "parallel/service.py": """
+    import os
+    import time
+
+    class Service:
+        def _exec_batch_entries(self, entries):
+            return det_helper(entries)
+
+    def det_helper(entries):
+        t = time.time()
+        for x in {1, 2, 3}:
+            t += x
+        mode = os.environ.get("SOME_VAR")
+        return t, mode
+    """,
+}
+
+
+def test_determinism_fires_on_reachable_nondeterminism(tmp_path):
+    root = _mkpkg(tmp_path, _DET_FIRING)
+    msgs = [f.message for f in _new(_run(root, ("lockstep-determinism",)))]
+    assert any("wall clock" in m for m in msgs)
+    assert any("iteration over a set" in m for m in msgs)
+    assert any("environment read" in m for m in msgs)
+
+
+def test_determinism_passes_unreachable_and_sorted(tmp_path):
+    files = {
+        "parallel/service.py": """
+        import time
+
+        class Service:
+            def _exec_batch_entries(self, entries):
+                for x in sorted({1, 2, 3}):
+                    pass
+                return len(entries)
+
+        def never_called_from_batch():
+            return time.time()
+        """,
+    }
+    root = _mkpkg(tmp_path, files)
+    assert _new(_run(root, ("lockstep-determinism",))) == []
+
+
+# -- rule 2: lock-discipline ------------------------------------------------
+
+
+def test_lock_discipline_fires_on_raw_primitive(tmp_path):
+    root = _mkpkg(
+        tmp_path,
+        {"mod.py": """
+        import threading
+
+        class T:
+            def __init__(self):
+                self.mu = threading.Lock()
+                self.cv = threading.Condition()
+        """},
+    )
+    fs = _new(_run(root, ("lock-discipline",)))
+    assert len(fs) == 2
+    assert "named_lock" in fs[0].message
+    assert "named_condition" in fs[1].message
+
+
+def test_lock_discipline_passes_factories(tmp_path):
+    root = _mkpkg(
+        tmp_path,
+        {"mod.py": """
+        from pilosa_tpu.analysis import lockcheck
+
+        class T:
+            def __init__(self):
+                self.mu = lockcheck.named_lock("t.mu")
+                self.cv = lockcheck.named_condition("t.cv")
+        """},
+    )
+    assert _new(_run(root, ("lock-discipline",))) == []
+
+
+# -- rule 3: stats-registry -------------------------------------------------
+
+_STATS_MOD = {
+    "mod.py": """
+    class T:
+        def __init__(self, stats):
+            self.stats = stats
+
+        def work(self, cls):
+            self.stats.count("t.known")
+            self.stats.gauge(f"t.by_class.{cls}", 1)
+    """,
+}
+
+
+def test_stats_registry_passes_when_registered(tmp_path):
+    root = _mkpkg(tmp_path, _STATS_MOD, registry_for=True)
+    text = (tmp_path / "pkg" / "analysis" / registry.REGISTRY_NAME).read_text()
+    # f-strings normalize to <x> patterns in the generated registry
+    assert "`t.by_class.<cls>`" in text
+    assert _new(_run(root, ("stats-registry",))) == []
+
+
+def test_stats_registry_fires_on_unknown_name_and_drift(tmp_path):
+    root = _mkpkg(tmp_path, _STATS_MOD, registry_for=True)
+    # a new emission lands without regenerating the registry
+    mod = tmp_path / "pkg" / "mod.py"
+    mod.write_text(mod.read_text().replace(
+        'self.stats.count("t.known")',
+        'self.stats.count("t.known")\n        self.stats.count("t.brand_new")',
+    ))
+    fs = _new(_run(root, ("stats-registry",)))
+    assert any("`t.brand_new` not in the counters registry" in f.message for f in fs)
+    assert any("registry is stale" in f.message and "--write-registry" in f.message
+               for f in fs)
+
+
+def test_stats_registry_fires_when_missing(tmp_path):
+    root = _mkpkg(tmp_path, _STATS_MOD)
+    fs = _new(_run(root, ("stats-registry",)))
+    assert len(fs) == 1 and "registry missing" in fs[0].message
+
+
+# -- rule 4: exception-hygiene ----------------------------------------------
+
+
+def test_exception_hygiene_fires_on_silent_swallow(tmp_path):
+    root = _mkpkg(
+        tmp_path,
+        {"mod.py": """
+        def f():
+            try:
+                g()
+            except Exception:
+                pass
+        """},
+    )
+    fs = _new(_run(root, ("exception-hygiene",)))
+    assert len(fs) == 1 and "broad except swallows" in fs[0].message
+
+
+def test_exception_hygiene_passes_stat_reraise_use(tmp_path):
+    root = _mkpkg(
+        tmp_path,
+        {"mod.py": """
+        def counted(stats):
+            try:
+                g()
+            except Exception:
+                stats.count("mod.errors")
+
+        def reraised():
+            try:
+                g()
+            except Exception:
+                raise RuntimeError("wrapped")
+
+        def used(errs):
+            try:
+                g()
+            except Exception as e:
+                errs.append(e)
+
+        def narrow():
+            try:
+                g()
+            except ValueError:
+                pass
+        """},
+    )
+    assert _new(_run(root, ("exception-hygiene",))) == []
+
+
+# -- rule 5: deadline-propagation ------------------------------------------
+
+
+def test_deadline_propagation_fires_on_dropped_budget(tmp_path):
+    root = _mkpkg(
+        tmp_path,
+        {"mod.py": """
+        def fan_out(client, index, q, deadline):
+            return client.execute_remote(index, q)
+        """},
+    )
+    fs = _new(_run(root, ("deadline-propagation",)))
+    assert len(fs) == 1 and "without deadline=" in fs[0].message
+
+
+def test_deadline_propagation_passes_forwarded(tmp_path):
+    root = _mkpkg(
+        tmp_path,
+        {"mod.py": """
+        def fan_out(client, index, q, deadline):
+            return client.execute_remote(index, q, deadline=deadline)
+
+        def via_opts(client, index, q, opt):
+            return client.execute_remote(index, q, deadline=opt.deadline)
+
+        def via_kwargs(client, index, q, deadline, kw):
+            return client.execute_remote(index, q, **kw)
+
+        def no_deadline_in_scope(client, index, q):
+            return client.execute_remote(index, q)
+        """},
+    )
+    assert _new(_run(root, ("deadline-propagation",))) == []
+
+
+# -- suppression + baseline round-trips ------------------------------------
+
+
+def test_suppression_comment_round_trip(tmp_path):
+    root = _mkpkg(
+        tmp_path,
+        {"mod.py": """
+        def f():
+            try:
+                g()
+            # analysis-ok: exception-hygiene: fixture reason
+            except Exception:
+                pass
+
+        def g():
+            try:
+                h()
+            # analysis-ok: exception-hygiene:
+            except Exception:
+                pass
+        """},
+    )
+    fs = _run(root, ("exception-hygiene",))
+    assert len(fs) == 2
+    by_scope = {f.scope: f for f in fs}
+    assert by_scope["f"].suppressed  # reason given
+    assert not by_scope["g"].suppressed  # empty reason does not suppress
+    assert [f.scope for f in _new(fs)] == ["g"]
+
+
+def test_baseline_round_trip(tmp_path):
+    files = {"mod.py": """
+    def f():
+        try:
+            g()
+        except Exception:
+            pass
+    """}
+    root = _mkpkg(tmp_path, files)
+    fs = _run(root, ("exception-hygiene",))
+    assert len(_new(fs)) == 1
+    engine.write_baseline(engine.baseline_path(root), fs)
+    fs2 = _run(root, ("exception-hygiene",))
+    assert len(fs2) == 1 and fs2[0].baselined
+    assert _new(fs2) == []
+    # a SECOND identical violation in the same scope is NEW (occurrence
+    # index keeps fingerprints distinct)
+    mod = tmp_path / "pkg" / "mod.py"
+    mod.write_text(mod.read_text() + textwrap.dedent("""
+    def f2():
+        try:
+            g()
+        except Exception:
+            pass
+    """))
+    fs3 = _run(root, ("exception-hygiene",))
+    assert len(_new(fs3)) == 1
+
+
+# -- CLI --------------------------------------------------------------------
+
+
+def test_cli_exit_codes_and_write_flows(tmp_path, capsys):
+    root = _mkpkg(tmp_path, _STATS_MOD)
+    # registry missing -> nonzero
+    assert analysis_main(["--root", root, "--rules", "stats-registry"]) == 1
+    assert analysis_main(["--root", root, "--write-registry"]) == 0
+    assert analysis_main(["--root", root, "--rules", "stats-registry"]) == 0
+    assert analysis_main(["--root", root, "--rules", "nope"]) == 2
+    out = capsys.readouterr().out
+    assert "0 NEW" in out
+
+
+# -- runtime lock checker ---------------------------------------------------
+
+
+@pytest.fixture
+def checker():
+    """Explicitly-enabled checker, restored afterwards (this module is
+    not in conftest's auto-enabled set)."""
+    lockcheck.enable()
+    lockcheck.reset()
+    try:
+        yield lockcheck.checker()
+    finally:
+        lockcheck.take_violations()
+        lockcheck.disable()
+
+
+def test_lockcheck_seeded_order_inversion(checker):
+    a = lockcheck.named_lock("t.a")
+    b = lockcheck.named_lock("t.b")
+
+    def ab():
+        with a:
+            with b:
+                pass
+
+    def ba():
+        with b:
+            with a:
+                pass
+
+    t1 = threading.Thread(target=ab)
+    t1.start()
+    t1.join()
+    t2 = threading.Thread(target=ba)
+    t2.start()
+    t2.join()
+    vs = lockcheck.take_violations()
+    assert len(vs) == 1 and vs[0].kind == "lock-order-cycle"
+    assert "t.a" in vs[0].detail and "t.b" in vs[0].detail
+
+
+def test_lockcheck_consistent_order_is_clean(checker):
+    a = lockcheck.named_lock("t.a")
+    b = lockcheck.named_lock("t.b")
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    assert lockcheck.take_violations() == []
+
+
+def test_lockcheck_rlock_reentry_no_self_edge(checker):
+    r = lockcheck.named_rlock("t.r")
+    with r:
+        with r:
+            pass
+    assert lockcheck.take_violations() == []
+
+
+def test_lockcheck_seeded_blocking_under_lock(checker, tmp_path):
+    mu = lockcheck.named_lock("t.mu")
+    f = open(tmp_path / "x", "wb")
+    try:
+        with mu:
+            os.fsync(f.fileno())
+        vs = lockcheck.take_violations()
+        assert len(vs) == 1 and vs[0].kind == "blocking-under-lock"
+        assert "fsync" in vs[0].detail and "t.mu" in vs[0].detail
+    finally:
+        f.close()
+
+
+def test_lockcheck_blocking_without_lock_is_clean(checker, tmp_path):
+    f = open(tmp_path / "x", "wb")
+    try:
+        os.fsync(f.fileno())
+    finally:
+        f.close()
+    assert lockcheck.take_violations() == []
+
+
+def test_lockcheck_scoped_allow(checker, tmp_path):
+    mu = lockcheck.named_lock("t.mu")
+    f = open(tmp_path / "x", "wb")
+    try:
+        with mu:
+            with lockcheck.allowed("fsync"):
+                os.fsync(f.fileno())
+    finally:
+        f.close()
+    assert lockcheck.take_violations() == []
+
+
+def test_lockcheck_allowlist_pair(checker, tmp_path):
+    mu = lockcheck.named_lock("t.allowed_mu")
+    checker.allow_pairs.add(("t.allowed_mu", "fsync"))
+    f = open(tmp_path / "x", "wb")
+    try:
+        with mu:
+            os.fsync(f.fileno())
+    finally:
+        f.close()
+        checker.allow_pairs.discard(("t.allowed_mu", "fsync"))
+    assert lockcheck.take_violations() == []
+
+
+def test_lockcheck_condition_wait_releases_held_state(checker):
+    cv = lockcheck.named_condition("t.cv")
+    other = lockcheck.named_lock("t.other")
+    woke = threading.Event()
+
+    def waiter():
+        with cv:
+            cv.wait(timeout=5.0)
+        # after the wait returned we re-held and released t.cv; taking
+        # another lock now must not see t.cv as held
+        with other:
+            pass
+        woke.set()
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    # let the waiter block, then wake it
+    import time
+
+    time.sleep(0.1)
+    with cv:
+        cv.notify_all()
+    t.join(timeout=5.0)
+    assert woke.is_set()
+    assert lockcheck.take_violations() == []
+
+
+def test_lockcheck_disabled_factories_are_plain():
+    assert not lockcheck.enabled()
+    assert type(lockcheck.named_lock("x")) is type(threading.Lock())
+    assert isinstance(lockcheck.named_rlock("x"), type(threading.RLock()))
+
+
+# -- the live-tree gate (CI smoke tier) ------------------------------------
+
+
+def test_live_tree_analysis_gate():
+    """`python -m pilosa_tpu.analysis` over the REAL package: every rule
+    runs and no new findings exist.  This is the tier-1 CI gate — a new
+    un-suppressed, un-baselined finding fails the suite with the same
+    report the CLI prints."""
+    findings = engine.run_analysis()
+    fresh = engine.new_findings(findings)
+    assert fresh == [], "new analysis findings:\n" + "\n".join(
+        f.render() for f in fresh
+    )
+
+
+def test_live_tree_registry_is_current():
+    """Committed COUNTERS.md must match regeneration exactly (the
+    stats-registry drift half of the gate, asserted directly so the
+    failure message carries the regenerate hint)."""
+    root = engine.package_root()
+    with open(registry.registry_path(root), encoding="utf-8") as f:
+        committed = f.read()
+    assert committed == registry.generate_counters_registry(root), (
+        "counters registry is stale — run "
+        "`python -m pilosa_tpu.analysis --write-registry` and commit"
+    )

@@ -192,7 +192,7 @@ def test_mapreduce_node_failure_retry(tmp_path):
         def __init__(self, host):
             self.host = host
 
-        def execute_remote_call(self, index, call, slices):
+        def execute_remote_call(self, index, call, slices, deadline=None):
             calls.append((self.host, list(slices)))
             raise ConnectionError("node down")
 
@@ -343,7 +343,7 @@ def test_set_bit_batch_remote_forwarding(tmp_path):
         def __init__(self, host):
             self.host = host
 
-        def execute_remote(self, index, query, slices=None):
+        def execute_remote(self, index, query, slices=None, deadline=None):
             requests.append((self.host, len(query.calls)))
             return [True] * len(query.calls)
 
@@ -518,7 +518,7 @@ def test_fused_batch_distributed_one_request_per_node(tmp_path):
         def __init__(self, host):
             self.host = host
 
-        def execute_remote(self, index, query, slices=None):
+        def execute_remote(self, index, query, slices=None, deadline=None):
             remote_batches.append((self.host, len(query.calls), list(slices)))
             # Answer from the same holder (stand-in for the peer's data).
             peer = Executor(h, engine="numpy")
@@ -543,7 +543,7 @@ def test_fused_batch_distributed_one_request_per_node(tmp_path):
 
     # Failover: a dying remote re-maps its slices locally; counts intact.
     class DyingClient(SpyClient):
-        def execute_remote(self, index, query, slices=None):
+        def execute_remote(self, index, query, slices=None, deadline=None):
             raise ConnectionError("node down")
 
     e2 = Executor(h, engine="numpy", cluster=cluster, client_factory=DyingClient, host="h0:1")
@@ -760,7 +760,7 @@ def test_fused_range_batch_distributed(tmp_path):
         def __init__(self, host):
             self.host = host
 
-        def execute_remote(self, index, query, slices=None):
+        def execute_remote(self, index, query, slices=None, deadline=None):
             remote_batches.append((self.host, len(query.calls), list(slices)))
             peer = Executor(h, engine="numpy")
             return peer.execute(index, query, slices=slices, opt=ExecOptions(remote=True))
@@ -777,7 +777,7 @@ def test_fused_range_batch_distributed(tmp_path):
     assert len(remote_batches) == 1 and remote_batches[0][1] == 3
 
     class DyingClient(SpyClient):
-        def execute_remote(self, index, query, slices=None):
+        def execute_remote(self, index, query, slices=None, deadline=None):
             raise ConnectionError("node down")
 
     e2 = Executor(h, engine="numpy", cluster=cluster, client_factory=DyingClient, host="h0:1")

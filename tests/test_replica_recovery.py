@@ -268,6 +268,63 @@ def test_wal_concurrent_appends_survive_repeated_compaction(tmp_path):
     reopened.close()
 
 
+def test_wal_hammer_under_lock_checker(tmp_path):
+    """4-thread hammer — appenders (group-commit fsync) vs a dedicated
+    compaction thread vs aborts — run UNDER the runtime lock checker
+    (conftest enables PILOSA_TPU_LOCK_CHECK for this module): the PR 7
+    fsync-generation fix must hold as a checkable discipline, i.e. no
+    lock-order cycle among wal._mu / _sync_cv / _compact_mu and no
+    fsync under a lock outside the documented allowlist (compaction's
+    bulk copy under _compact_mu; the bounded delta fsync is scope-
+    allowed in compact()).  Afterwards the log must recover cleanly
+    with every non-aborted record intact."""
+    from pilosa_tpu.analysis import lockcheck
+
+    assert lockcheck.enabled()  # the conftest gate is active for this file
+    lockcheck.reset()
+    wal = WriteAheadLog(str(tmp_path / "w.wal"))
+    errs: list = []
+    aborted: set[int] = set()
+    mu = threading.Lock()
+    stop = threading.Event()
+
+    def appender(k):
+        try:
+            for i in range(50):
+                s = wal.append("POST", f"/t{k}/{i}", b"h" * 96)
+                if i % 10 == 9:  # sprinkle tombstones into the stream
+                    wal.abort(s)
+                    with mu:
+                        aborted.add(s)
+        except Exception as e:  # noqa: BLE001 — asserted empty below
+            errs.append(e)
+
+    def compactor():
+        try:
+            while not stop.is_set():
+                wal.compact(0)  # keep everything live; exercise the swap
+        except Exception as e:  # noqa: BLE001 — asserted empty below
+            errs.append(e)
+
+    ts = [threading.Thread(target=appender, args=(k,)) for k in range(3)]
+    ts.append(threading.Thread(target=compactor))
+    for t in ts:
+        t.start()
+    for t in ts[:3]:
+        t.join()
+    stop.set()
+    ts[3].join()
+    assert errs == []
+    vs = lockcheck.take_violations()
+    assert vs == [], "\n\n".join(v.describe() for v in vs)
+    live = [r.seq for r in wal.records(1)]
+    assert sorted(live + sorted(aborted)) == list(range(1, 151))
+    wal.close()
+    reopened = WriteAheadLog(wal.path)
+    assert [r.seq for r in reopened.records(1)] == live  # clean recovery
+    reopened.close()
+
+
 # -- fault-injection seam -----------------------------------------------------
 
 
