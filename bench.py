@@ -2088,6 +2088,218 @@ def bench_recovery() -> dict:
     }
 
 
+def bench_resync() -> dict:
+    """Automated-resync tier: a BLANK group joins a loaded 2-group
+    cluster and self-heals with zero operator action.  3 group
+    subprocesses behind an out-of-process CLI router (durable WAL);
+    g2 is configured at the router but never started during the load:
+
+    - ``load``: writes build real fragment bulk on g0/g1 while g2's
+      backlog accumulates in the WAL;
+    - ``rejoin``: g2 starts on a BLANK data dir; the probe finds
+      applied_seq=0 over a non-empty sequence space and drives the
+      resync (digest diff -> fragment stream -> seed -> catch-up).
+      The tier measures TIME-TO-REJOIN, BYTES STREAMED vs the donor's
+      full fragment copy and vs the WAL's replay-it-all alternative,
+      asserts ZERO FAILED WRITES during the resync (a writer hammers
+      the router the whole time), and asserts digest-level
+      convergence in-run.
+
+    ``BENCH_RESYNC_WRITES`` sizes the load; ``BENCH_SMOKE=1`` shrinks
+    for CI."""
+    import shutil
+    import subprocess
+    import sys
+    import tempfile
+    import threading
+    import urllib.error
+    import urllib.request
+
+    from pilosa_tpu.server.client import Client
+
+    smoke = os.environ.get("BENCH_SMOKE", "").lower() in ("1", "true", "yes")
+    n_writes = int(os.environ.get("BENCH_RESYNC_WRITES", "80" if smoke else "800"))
+    batch = int(os.environ.get("BENCH_BATCH", "8"))
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    worker = os.path.join(repo, "tests", "replica_group_worker.py")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = repo
+    env.pop("PILOSA_TPU_QCACHE", None)
+
+    def free_port():
+        import socket
+
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        p = s.getsockname()[1]
+        s.close()
+        return p
+
+    root = tempfile.mkdtemp(prefix="pilosa_resync_")
+    errs = [open(os.path.join(root, f"err{i}.log"), "w+") for i in range(4)]
+    group_ports = [free_port() for _ in range(3)]
+
+    def spawn_group(i: int, epoch: int):
+        genv = dict(env)
+        genv["PILOSA_WORKER_DATA_DIR"] = os.path.join(root, f"g{i}")
+        genv["PILOSA_WORKER_HOST"] = f"127.0.0.1:{group_ports[i]}"
+        p = subprocess.Popen(
+            [sys.executable, worker, f"g{i}@{epoch}"],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE, stderr=errs[i],
+            cwd=repo, env=genv, text=True)
+        line = json.loads(p.stdout.readline())
+        assert line.get("ready"), line
+        return p, line["host"]
+
+    procs = []
+    tiers = []
+    try:
+        groups = [spawn_group(i, 1) for i in range(2)]  # g2 stays down
+        procs = [p for p, _ in groups]
+        hosts = [h for _, h in groups] + [f"127.0.0.1:{group_ports[2]}"]
+
+        router_port = free_port()
+        router = subprocess.Popen(
+            [sys.executable, "-m", "pilosa_tpu", "replica-router",
+             "--groups", ",".join(f"g{i}={h}" for i, h in enumerate(hosts)),
+             "--port", str(router_port),
+             "--wal-dir", os.path.join(root, "wal"),
+             "--probe-interval", "0.1"],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=errs[3], cwd=repo, env=env, text=True)
+        procs.append(router)
+        line = router.stdout.readline()
+        assert "replica-router" in line, line
+
+        rc = Client(f"127.0.0.1:{router_port}", timeout=60)
+        rc.create_index("r")
+        rc.create_frame("r", "f")
+
+        def rget(path: str) -> dict:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{router_port}{path}", timeout=10
+            ) as resp:
+                return json.loads(resp.read())
+
+        def gget(host: str, path: str) -> bytes:
+            with urllib.request.urlopen(f"http://{host}{path}", timeout=30) as r:
+                return r.read()
+
+        # LOAD: real fragment bulk across several rows/frames while
+        # g2's backlog grows in the WAL.
+        t0 = time.perf_counter()
+        for k in range(0, n_writes, batch):
+            q = " ".join(
+                f'SetBit(rowID={1 + (c % 5)}, frame="f", columnID={c})'
+                for c in range(k, min(k + batch, n_writes))
+            )
+            rc.execute_query("r", q)
+        load_s = time.perf_counter() - t0
+        wal_bytes = rget("/replica/status")["wal"]["bytes"]
+        donor_digest = json.loads(gget(hosts[0], "/replica/digest"))
+        full_copy_bytes = 0
+        for path in donor_digest["fragments"]:
+            idx, frame, view, slice_i = path.split("/")
+            full_copy_bytes += len(gget(
+                hosts[0],
+                f"/fragment/data?index={idx}&frame={frame}&view={view}&slice={slice_i}",
+            ))
+        tiers.append({
+            "tier": "load", "writes": n_writes, "batch": batch,
+            "load_s": round(load_s, 3), "wal_bytes": wal_bytes,
+            "full_copy_bytes": full_copy_bytes,
+        })
+
+        # REJOIN: start g2 blank; hammer writes the whole time (the
+        # tier's zero-failed-writes assertion) until it is back.
+        failed = [0]
+        extra = [0]
+        stop = threading.Event()
+
+        def writer():
+            k = n_writes
+            while not stop.is_set():
+                try:
+                    rc.execute_query(
+                        "r", f'SetBit(rowID=9, frame="f", columnID={k})'
+                    )
+                    extra[0] += 1
+                except Exception:  # noqa: BLE001 — counted, asserted zero
+                    failed[0] += 1
+                k += 1
+
+        wt = threading.Thread(target=writer)
+        wt.start()
+        t_join = time.perf_counter()
+        p2, h2 = spawn_group(2, 1)
+        procs.append(p2)
+        rejoin_s = None
+        deadline = time.monotonic() + (120 if smoke else 600)
+        while time.monotonic() < deadline:
+            g2 = next(g for g in rget("/replica/status")["groups"]
+                      if g["name"] == "g2")
+            if g2["healthy"] and g2["caughtUp"] and not g2["stale"]:
+                rejoin_s = round(time.perf_counter() - t_join, 3)
+                break
+            time.sleep(0.05)
+        stop.set()
+        wt.join()
+        assert rejoin_s is not None, "g2 never rejoined"
+        assert failed[0] == 0, f"{failed[0]} writes failed during resync"
+        snap = rget("/debug/vars")
+        streamed = snap.get("replica.resync_bytes", 0)
+        # CONVERGENCE, digest-level: byte-identical content everywhere.
+        digs = {h: json.loads(gget(h, "/replica/digest"))["digest"] for h in hosts}
+        assert len(set(digs.values())) == 1, digs
+        tiers.append({
+            "tier": "rejoin",
+            "rejoin_s": rejoin_s,
+            "bytes_streamed": streamed,
+            "full_copy_bytes": full_copy_bytes,
+            "wal_bytes": wal_bytes,
+            "resync_fragments": snap.get("replica.resync_fragments", 0),
+            "replayed": snap.get("replica.replayed", 0),
+            "writes_during_resync": extra[0],
+            "failed_writes_during_resync": failed[0],
+            "converged": True,
+        })
+    finally:
+        for p in procs:
+            try:
+                p.kill()
+            except Exception:  # noqa: BLE001
+                pass
+        for p in procs:
+            try:
+                p.wait(timeout=30)
+            except Exception:  # noqa: BLE001
+                pass
+        for f in errs:
+            f.close()
+        shutil.rmtree(root, ignore_errors=True)
+
+    by = {t["tier"]: t for t in tiers}
+    rj = by["rejoin"]
+    return {
+        "metric": "resync_rejoin_s",
+        "value": rj["rejoin_s"],
+        "unit": (
+            f"seconds for a BLANK group to rejoin a loaded 2-group cluster "
+            f"(streamed {rj['bytes_streamed']} B of roaring fragments vs "
+            f"{rj['wal_bytes']} B of WAL replay traffic; "
+            f"{rj['writes_during_resync']} writes committed during the "
+            f"resync with zero failures; digest convergence asserted in-run)"
+        ),
+        "bytes_streamed": rj["bytes_streamed"],
+        "full_copy_bytes": rj["full_copy_bytes"],
+        "wal_bytes": rj["wal_bytes"],
+        "cpus": os.cpu_count(),
+        "tiers": tiers,
+    }
+
+
 def bench_qcache() -> dict:
     """Query-result-cache tier: a Zipf-skewed repeated read mix (the
     dashboard steady state — the same few queries hit over and over)
@@ -2339,6 +2551,7 @@ def main() -> None:
             "qcache": bench_qcache,
             "replica": bench_replica,
             "recovery": bench_recovery,
+            "resync": bench_resync,
             "intersect_count_stream": bench_intersect_stream,
             "intersect_count_4krows": bench_intersect_4krows,
             "topn_p50": bench_topn_p50,

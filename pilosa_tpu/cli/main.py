@@ -177,6 +177,8 @@ def cmd_replica_router(args) -> int:
         cfg.replica_wal_dir = args.wal_dir
     if getattr(args, "probe_interval", None) is not None:
         cfg.replica_probe_interval = args.probe_interval
+    if getattr(args, "anti_entropy_interval", None) is not None:
+        cfg.replica_anti_entropy_interval = args.anti_entropy_interval
     if not cfg.replica_groups:
         print("error: no replica groups configured "
               "(--groups / [replica] groups / PILOSA_TPU_REPLICA_GROUPS)",
@@ -439,6 +441,11 @@ def build_parser() -> argparse.ArgumentParser:
         "--probe-interval", dest="probe_interval", type=float,
         help="base health-probe interval in seconds, doubled with jitter "
              "per failed probe ([replica] probe-interval)",
+    )
+    s.add_argument(
+        "--anti-entropy-interval", dest="anti_entropy_interval", type=float,
+        help="cross-group digest-compare sweep interval in seconds, "
+             "jittered; 0 disables ([replica] anti-entropy-interval)",
     )
     s.add_argument("--test-exit", action="store_true", help=argparse.SUPPRESS)
     s.set_defaults(fn=cmd_replica_router)

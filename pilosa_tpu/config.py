@@ -126,6 +126,16 @@ class Config:
     # declared stale (operator resync) instead of growing it unbounded.
     replica_wal_dir: str = ""
     replica_wal_max_bytes: int = 64 << 20
+    # Cross-group anti-entropy sweep interval in seconds (jittered;
+    # 0 = off, the default — tests and single-group rigs don't want a
+    # background digest walker).  Healthy groups' content digests are
+    # compared and any silently diverged fragment is repaired from the
+    # majority copy.
+    replica_anti_entropy_interval: float = 0.0
+    # Chunk size of the resync fragment stream (each chunk CRC-framed
+    # and individually acked, so a killed transfer resumes at the
+    # staged offset).
+    replica_resync_chunk_bytes: int = 256 << 10
     # -- HTTP client ([client] TOML section) ------------------------------
     # Retry budget for door sheds (429/503 — both issued BEFORE any
     # execution, so writes are safe to retry): total extra attempts per
@@ -205,6 +215,12 @@ class Config:
         cfg.replica_wal_dir = str(rep.get("wal-dir", cfg.replica_wal_dir))
         cfg.replica_wal_max_bytes = int(
             rep.get("wal-max-bytes", cfg.replica_wal_max_bytes)
+        )
+        cfg.replica_anti_entropy_interval = _interval(
+            rep.get("anti-entropy-interval"), cfg.replica_anti_entropy_interval
+        )
+        cfg.replica_resync_chunk_bytes = int(
+            rep.get("resync-chunk-bytes", cfg.replica_resync_chunk_bytes)
         )
         cli = raw.get("client", {})
         cfg.client_retry_budget = int(
@@ -304,6 +320,14 @@ class Config:
             self.replica_wal_dir = env["PILOSA_TPU_REPLICA_WAL_DIR"]
         if "PILOSA_TPU_REPLICA_WAL_MAX_BYTES" in env:
             self.replica_wal_max_bytes = int(env["PILOSA_TPU_REPLICA_WAL_MAX_BYTES"])
+        if "PILOSA_TPU_REPLICA_ANTI_ENTROPY_INTERVAL" in env:
+            self.replica_anti_entropy_interval = float(
+                env["PILOSA_TPU_REPLICA_ANTI_ENTROPY_INTERVAL"]
+            )
+        if "PILOSA_TPU_REPLICA_RESYNC_CHUNK_BYTES" in env:
+            self.replica_resync_chunk_bytes = int(
+                env["PILOSA_TPU_REPLICA_RESYNC_CHUNK_BYTES"]
+            )
         if "PILOSA_TPU_CLIENT_RETRY_BUDGET" in env:
             self.client_retry_budget = int(env["PILOSA_TPU_CLIENT_RETRY_BUDGET"])
         if "PILOSA_TPU_LOCKSTEP_ACK_TIMEOUT" in env:

@@ -156,6 +156,11 @@ class Fragment:
         self._row_dev_cache_max = 256
         self._row_dev_cache_arrays = 0
         self._checksums: dict[int, bytes] = {}
+        # Whole-fragment checksum memo keyed by write generation (the
+        # replica digest protocol hashes every fragment per sweep; an
+        # unwritten fragment answers from here without re-walking its
+        # blocks).  Generation-keyed, so no mutator needs to clear it.
+        self._checksum_cache: Optional[tuple[int, bytes]] = None
         # Incrementally-maintained per-row bit counts (LRU-bounded like the
         # other per-row caches): every guarded mutation knows its delta, so
         # the rank-cache update on the SetBit hot path avoids a count_range
@@ -906,11 +911,36 @@ class Fragment:
     # -- block checksums & merge (fragment.go:681-920) -------------------
 
     def checksum(self) -> bytes:
-        """Checksum of the whole fragment: hash of block checksums in order."""
-        h = hashlib.sha1()
-        for block_id, chk in self.blocks():
-            h.update(chk)
-        return h.digest()
+        """Checksum of the whole fragment: hash of (block id, block
+        checksum) pairs in block order.
+
+        POSITION-BOUND: the block id participates in the hash, so two
+        fragments whose blocks hold the same relative bit pattern at
+        DIFFERENT block ids cannot collide (block checksums are
+        relative to their block's base row by construction).  The
+        digest is a pure function of the logical bit set — identical
+        bits reached through any write order, the patch or rebuild
+        path, or a write_to/read_from round trip hash identically —
+        which is the property the replica digest protocol
+        (replica/digest.py) and anti-entropy repair rest on.
+
+        Cached per write generation: digest sweeps over an idle holder
+        re-hash nothing (every mutator bumps ``generation``, which
+        invalidates the cache by key, never by callback)."""
+        with self._mu:
+            self._assert_open()
+            self._flush_row_bookkeeping()
+            gen = self.generation
+            cached = self._checksum_cache
+            if cached is not None and cached[0] == gen:
+                return cached[1]
+            h = hashlib.sha1()
+            for block_id, chk in self._blocks():
+                h.update(block_id.to_bytes(8, "little"))
+                h.update(chk)
+            digest = h.digest()
+            self._checksum_cache = (gen, digest)
+            return digest
 
     def blocks(self) -> list[tuple[int, bytes]]:
         """(block id, sha1) for each non-empty block of HASH_BLOCK_SIZE rows."""

@@ -747,6 +747,19 @@ class LockstepService:
                     "appliedSeq": svc.applied_seq.value,
                     "state": "DEGRADED" if svc._degraded else "UP",
                 }).encode()
+            elif path == "/replica/digest":
+                # Content digest for the router's resync diff and
+                # anti-entropy sweep.  Rank 0 computes it over its own
+                # holder — the lockstep total order keeps every rank's
+                # holder identical, so the digest speaks for the whole
+                # group by construction (no cross-rank collective
+                # needed, and no rank-local nondeterminism: the walk is
+                # sorted and the checksums are pure functions of bits).
+                from pilosa_tpu.replica.digest import holder_digest
+
+                d = holder_digest(svc.holder)
+                d["appliedSeq"] = svc.applied_seq.value
+                body = json.dumps(d).encode()
             elif path == "/schema":
                 body = json.dumps({"indexes": svc.holder.schema()}).encode()
             elif path == "/status":

@@ -48,10 +48,23 @@ caught-up group serves reads.  Partial-failure orderings are
 reproducible through the deterministic fault seam
 (:mod:`pilosa_tpu.replica.faults`, ``PILOSA_TPU_FAULT_SPEC``).
 
+RESYNC & ANTI-ENTROPY (PR 9): stale and blank groups SELF-HEAL — the
+probe keeps visiting stale groups (at ``probe-max-interval``) and
+drives an automated resync round (:mod:`pilosa_tpu.replica.resync`):
+content-digest diff (:mod:`pilosa_tpu.replica.digest`, ``GET
+/replica/digest``) against a healthy donor, differing fragments
+streamed as serialized roaring payloads (chunked, CRC-framed,
+resumable), applied-sequence seeded under the sequencer lock, WAL
+catch-up for the final locked drain.  A background anti-entropy sweep
+(``[replica] anti-entropy-interval``, off by default) compares healthy
+groups' digests and repairs silent divergence from the majority copy
+(``replica.divergence.<g>``).
+
 Config: ``[replica] group / groups / router-port / failover /
-probe-interval / probe-max-interval / wal-dir / wal-max-bytes`` TOML
-keys with ``PILOSA_TPU_REPLICA_*`` env overrides, wired through
-``pilosa-tpu replica-router`` and the lockstep CLI.
+probe-interval / probe-max-interval / wal-dir / wal-max-bytes /
+anti-entropy-interval / resync-chunk-bytes`` TOML keys with
+``PILOSA_TPU_REPLICA_*`` env overrides, wired through ``pilosa-tpu
+replica-router`` and the lockstep CLI.
 """
 
 from __future__ import annotations
@@ -125,6 +138,15 @@ def __getattr__(name):
         from pilosa_tpu.replica import faults as _faults
 
         return getattr(_faults, name)
+    if name in ("ResyncManager", "ResyncAbort", "ResyncUnsupported"):
+        from pilosa_tpu.replica import resync as _resync
+
+        return getattr(_resync, name)
+    if name in ("holder_digest", "diff_digests", "majority_plan",
+                "fragment_path", "parse_fragment_path"):
+        from pilosa_tpu.replica import digest as _digest
+
+        return getattr(_digest, name)
     if name == "build_group_mesh":
         from pilosa_tpu.replica.mesh import build_group_mesh
 

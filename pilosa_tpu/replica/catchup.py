@@ -37,6 +37,14 @@ cross-group read-your-writes invariant.  That locked hold is
 DEADLINE-BOUND (``locked_drain_s``; ``replica.catchup_stall`` counted
 on expiry): a group that turns slow or hangs mid-drain aborts the
 round instead of stalling every write cluster-wide.
+
+RESYNC HANDOFF (PR 9): the automated resync (replica/resync.py) uses
+this manager as its final leg — after streaming a stale or blank group
+the donor's fragments it seeds the group's ``AppliedSeq`` to the
+donor's sequence (``POST /replica/seed-seq``, monotonic via
+:meth:`AppliedSeq.note`) and calls :meth:`CatchupManager.catch_up` to
+replay the short remainder, so "rejoined" always means byte-identical
+AND caught up regardless of which path brought the group back.
 """
 
 from __future__ import annotations

@@ -15,12 +15,22 @@ Spec grammar (``;``-separated rules)::
     rule   := 'seed=' INT
             | site ['/' key] ':' action ['@' nth] ['~' prob]
     site   := 'forward' | 'wal.append' | 'catchup'
+            | 'resync.digest' | 'resync.fetch' | 'resync.chunk'
+            | 'resync.seed'
     action := 'drop' | 'crash' | 'delay=' MS | 'error=' STATUS
 
 - ``site`` is the crossing: ``forward`` fires inside the router's
-  per-group HTTP exchange (reads, write fan-out, AND catch-up replays
-  all cross it), ``wal.append`` inside the log append (before the
-  record is durable), ``catchup`` at the top of each replay round.
+  per-group HTTP exchange (reads, write fan-out, catch-up replays, AND
+  resync streams all cross it), ``wal.append`` inside the log append
+  (before the record is durable), ``catchup`` at the top of each
+  replay round.  The ``resync.*`` sites cover the automated-resync
+  round (replica/resync.py): ``resync.digest`` before each digest
+  fetch (key = the group asked), ``resync.fetch`` before each donor
+  fragment fetch (key = donor), ``resync.chunk`` before each chunk
+  push — including the resume probe — (key = laggard), and
+  ``resync.seed`` inside the sequencer-locked seed-seq exchange (key =
+  laggard), so torn-transfer, donor-death-mid-stream, and
+  crash-before-seed orderings replay deterministically.
 - ``key`` scopes a rule to one group name (``forward/g2:...``); no key
   matches every hit of the site.
 - ``@nth`` fires on exactly the nth matching hit (1-based) — the

@@ -76,8 +76,20 @@ Failure semantics (the durable-log upgrade of PR 6's full-set rule):
   can't absorb a replay paced against its predecessor) and only
   rejoins the read rotation once FULLY caught up.  A laggard whose
   backlog would grow the WAL past ``wal-max-bytes`` is declared STALE
-  (``replica.stale.<g>``): the log compacts past it and it can only
-  rejoin via operator resync.
+  (``replica.stale.<g>``): the log compacts past it, and the probe —
+  which keeps visiting stale groups at ``probe-max-interval`` — drives
+  an AUTOMATED RESYNC (``replica/resync.py``): digest diff against a
+  healthy donor, differing fragments streamed as serialized roaring
+  payloads, applied-sequence seeded under the sequencer lock, WAL
+  catch-up for the final drain — no human in the loop.  A group
+  reporting ``applied_seq=0`` over a non-empty sequence space (blank
+  data dir) takes the same path.
+- ANTI-ENTROPY: an optional background sweep (``[replica]
+  anti-entropy-interval``, jittered, off by default) compares healthy
+  groups' content digests under the sequencer lock and repairs any
+  silently diverged fragment from the majority copy
+  (``replica.divergence.<g>`` + one structured
+  ``pilosa_tpu.divergence`` log line per divergent sweep).
 
 Observability: ``replica.routed.<group>`` / ``replica.failover`` /
 ``replica.write_fanout`` (+ refused/error/shed), per-group
@@ -95,6 +107,7 @@ append, so partial-failure orderings are reproducible in tests.
 from __future__ import annotations
 
 import json
+import logging
 import random
 import threading
 
@@ -116,10 +129,17 @@ from pilosa_tpu.replica import (
     write_not_applied,
 )
 from pilosa_tpu.replica.catchup import CatchupManager
+from pilosa_tpu.replica.digest import majority_plan
 from pilosa_tpu.replica.faults import FaultInjector, InjectedStatus, NOP_FAULTS
+from pilosa_tpu.replica.resync import ResyncAbort, ResyncManager
 from pilosa_tpu.replica.wal import WriteAheadLog
 from pilosa_tpu.stats import NOP_STATS
 from pilosa_tpu.trace import TRACE_HEADER, TRACE_SPANS_HEADER
+
+# Structured divergence log: one line per anti-entropy sweep that found
+# healthy groups disagreeing (the slowquery-logger pattern) — counted
+# AND logged because divergence is a correctness event, not load noise.
+_divergence_logger = logging.getLogger("pilosa_tpu.divergence")
 
 # Headers never forwarded on a hop: ownership is per-connection, the
 # router recomputes lengths, deadline/trace headers are REWRITTEN
@@ -137,7 +157,8 @@ class GroupState:
     """Router-side record of one serving group."""
 
     __slots__ = ("name", "base", "healthy", "inflight", "routed", "epoch",
-                 "applied_seq", "caught_up", "stale", "probe_delay", "probe_at")
+                 "applied_seq", "caught_up", "stale", "suspect",
+                 "probe_delay", "probe_at")
 
     def __init__(self, name: str, base: str):
         self.name = name
@@ -158,6 +179,12 @@ class GroupState:
         self.applied_seq = 0
         self.caught_up = True
         self.stale = False
+        # Content-suspect: the group answered a write with a 4xx a
+        # sibling 2xx'd — for IDENTICAL replicated state that is
+        # impossible, so its content is presumed diverged (blank data
+        # dir, lost index) until a digest check against a healthy donor
+        # clears it (or a resync round repairs it).
+        self.suspect = False
         # Probe backoff (jittered exponential, per group).
         self.probe_delay = 0.0
         self.probe_at = 0.0
@@ -173,6 +200,7 @@ class GroupState:
             "appliedSeq": self.applied_seq,
             "caughtUp": self.caught_up,
             "stale": self.stale,
+            "suspect": self.suspect,
         }
 
 
@@ -202,6 +230,8 @@ class ReplicaRouter:
         faults: Optional[FaultInjector] = None,
         stats=None,
         tracer=None,
+        anti_entropy_interval_s: float = 0.0,
+        resync_chunk_bytes: int = 256 << 10,
     ):
         if not groups:
             raise ValueError("replica router needs at least one group")
@@ -226,7 +256,20 @@ class ReplicaRouter:
             None, stats=self.stats, faults=self.faults
         )
         self.catchup = CatchupManager(self, self.wal, stats=self.stats)
+        self.resync = ResyncManager(
+            self, self.wal, stats=self.stats, chunk_bytes=resync_chunk_bytes
+        )
+        # Cross-group anti-entropy sweep cadence (0 = off, the test
+        # default): healthy groups' digests compared, divergence counted
+        # + logged + repaired from the majority copy.
+        self.anti_entropy_interval_s = anti_entropy_interval_s
+        # Bound on one sweep's repair work under the sequencer lock.
+        self.anti_entropy_budget_s = 30.0
         self._mu = lockcheck.named_lock("replica.router._mu")  # group table (health/inflight/epoch)
+        # Per-group compaction floors for in-flight resync rounds: the
+        # handoff suffix past a round's seed sequence must stay
+        # replayable until the round completes (guarded by _mu).
+        self._resync_floor: dict[str, int] = {}
         # The write sequencer: held for a write's WHOLE fan-out, so all
         # groups see all writes in one total order.
         self._seq_mu = lockcheck.named_lock("replica.router._seq_mu")
@@ -537,6 +580,7 @@ class ReplicaRouter:
             first_out = None  # first answer of any kind
             first_ok = None  # first 2xx — the committed write's answer
             deterministic_4xx = None
+            det4xx_groups: list = []  # groups that answered it
             applied = 0
             # Ambiguous failure: a transport error (or 5xx) proves
             # NOTHING about application — the group may have applied
@@ -614,10 +658,33 @@ class ReplicaRouter:
                     # mutating call that DID apply elsewhere stays
                     # aligned; the group's applied mark still advances
                     # (replaying it would just re-answer the same 4xx).
+                    # If a SIBLING 2xx'd this very write the premise is
+                    # broken — see the suspect check below the loop.
                     if deterministic_4xx is None:
                         deterministic_4xx = out
+                    det4xx_groups.append(g)
                 if first_out is None:
                     first_out = out
+            if applied > 0 and det4xx_groups:
+                # A 4xx is only "deterministic" while every replica
+                # answers it.  One group 4xx-ing a write a sibling
+                # APPLIED means its content diverged (a blank data dir
+                # 404s the index every sibling holds; a half-applied
+                # create 409s) — silently counting it applied is
+                # exactly the latent divergence this tier exists to
+                # kill.  Mark it SUSPECT and pull it from rotation: the
+                # probe digest-checks it against a healthy donor and
+                # either clears the flag (retried creates legitimately
+                # answer 409 on the groups that already applied them)
+                # or drives a resync round that repairs it.
+                for sg in det4xx_groups:
+                    with self._mu:
+                        sg.suspect = True
+                        sg.caught_up = False
+                    self.stats.count(f"replica.suspect.{sg.name}")
+                    self._mark_unhealthy(
+                        sg, f"divergent answer on write {seq}"
+                    )
             if applied >= self.quorum:
                 # COMMITTED: a majority holds the write; any laggard
                 # re-converges from the log.
@@ -666,13 +733,21 @@ class ReplicaRouter:
             {"Retry-After": "1.000"},
         )
 
-    @staticmethod
-    def _shed(status: int, message: str, retry_after: float = 1.0):
+    def _shed(self, status: int, message: str, retry_after: float = 1.0):
+        """A router-door refusal (non-quorate write, no healthy group,
+        WAL failure).  The Retry-After hint carries DECORRELATED JITTER
+        (mirroring the client-side retry budget's jitter, PR 7): a
+        fixed hint makes a synchronized client herd retry in lockstep
+        against a recovering cluster — the exact moment it can least
+        absorb a coordinated burst.  Jitter here spreads even clients
+        that obey the hint literally."""
+        jittered = max(0.05, self._rng.uniform(retry_after * 0.5,
+                                               retry_after * 1.5))
         return (
             status,
             "application/json",
             json.dumps({"error": message}).encode(),
-            {"Retry-After": f"{retry_after:.3f}"},
+            {"Retry-After": f"{jittered:.3f}"},
         )
 
     # -- WAL compaction / backlog bound -----------------------------------
@@ -680,17 +755,23 @@ class ReplicaRouter:
     def _maybe_compact(self) -> None:
         """Advance the log past the min-applied watermark once it has
         grown past a quarter of its bound; a laggard that would pin it
-        past the bound goes STALE (replay can no longer rescue it —
-        operator resync required) so the backlog stays bounded."""
+        past the bound goes STALE (replay alone can no longer rescue it
+        — the automated resync streams it fragments instead) so the
+        backlog stays bounded.  In-flight resync rounds FLOOR the
+        watermark at their seed sequence: the handoff suffix a stale
+        group is about to adopt must stay replayable."""
         if self.wal.size_bytes <= max(self.wal.max_bytes // 4, 1 << 16):
             return
         while True:
             with self._mu:
                 tracked = [g for g in self.groups if not g.stale]
-            if not tracked:
+                floors = list(self._resync_floor.values())
+            if not tracked and not floors:
                 self.wal.compact(self.wal.last_seq)
                 return
-            min_applied = min(g.applied_seq for g in tracked)
+            min_applied = min(
+                [g.applied_seq for g in tracked] + floors
+            )
             self.wal.compact(min_applied)
             if self.wal.size_bytes <= self.wal.max_bytes:
                 return
@@ -701,15 +782,21 @@ class ReplicaRouter:
             if not laggards:
                 return  # the head itself exceeds the bound; nothing to drop
             for g in laggards:
-                with self._mu:
-                    g.stale = True
                 self.stats.count(f"replica.stale.{g.name}")
                 self.stats.set(
                     "replica.last_failure",
                     f"{g.name}: lag exceeded wal-max-bytes; marked stale "
-                    "(resync required)",
+                    "(automated resync scheduled)",
                 )
                 self._mark_unhealthy(g, "stale: WAL compacted past its lag")
+                with self._mu:
+                    # Stale groups stay in the probe rotation at the MAX
+                    # interval — the automated resync's (and a hand-
+                    # resynced group's) live door back in; PR 7 dropped
+                    # them from probing forever.
+                    g.stale = True
+                    g.probe_delay = self.probe_max_interval_s
+                    g.probe_at = time.monotonic() + g.probe_delay * self._rng.uniform(0.5, 1.0)
 
     # -- dispatch ---------------------------------------------------------
 
@@ -797,9 +884,13 @@ class ReplicaRouter:
     def _probe_once(self) -> None:
         now = time.monotonic()
         with self._mu:
+            # STALE groups stay in the rotation (at probe-max-interval
+            # cadence, armed when they went stale): the automated
+            # resync needs a live door back in, and so does an
+            # operator-resynced group — PR 7 excluded them forever.
             due = [
                 g for g in self.groups
-                if (not g.healthy or not g.caught_up) and not g.stale
+                if (not g.healthy or not g.caught_up or g.stale)
                 and g.probe_at <= now
             ]
         for g in due:
@@ -832,7 +923,23 @@ class ReplicaRouter:
                     f"replica.lag.{g.name}",
                     max(0, self.wal.last_seq - g.applied_seq),
                 )
-            if reported is not None and self.catchup.needed(g):
+            if g.suspect:
+                # The group 4xx'd a write a sibling applied: content
+                # presumed diverged until a digest check against a
+                # donor clears it (resyncing on mismatch).
+                if not self.resync.verify(g):
+                    self._backoff(g)
+                    continue
+            if self.resync.needed(g):
+                # Stale (the WAL compacted past its lag), blank
+                # (applied_seq=0 over a non-empty sequence space), or
+                # an uncovered gap: replay alone cannot (or should not,
+                # write by write) converge it — drive a fragment-level
+                # RESYNC round instead of parking it for an operator.
+                if not self.resync.resync(g):
+                    self._backoff(g)
+                    continue
+            elif reported is not None and self.catchup.needed(g):
                 if not self.catchup.catch_up(g):
                     self._backoff(g)
                     continue
@@ -851,6 +958,79 @@ class ReplicaRouter:
                 self._probe_once()
             except Exception:  # noqa: BLE001 — the probe must never die
                 self.stats.count("replica.probe_errors")
+
+    # -- anti-entropy sweep -----------------------------------------------
+
+    def _anti_entropy_once(self) -> None:
+        """One cross-group divergence sweep: fetch every in-rotation
+        group's content digest under the sequencer lock (a CONSISTENT
+        CUT — no write can be sequenced between the fetches, so a
+        mid-sweep write cannot masquerade as divergence), compare, and
+        repair any mismatched fragment from the majority copy via the
+        resync fragment stream.  Divergence is counted per group
+        (``replica.divergence.<g>``) and logged as one structured
+        ``pilosa_tpu.divergence`` line naming the first differing
+        (index, frame, view, slice) path — a correctness event, never
+        silent.  The repair work under the lock is budget-bounded
+        (``anti_entropy_budget_s``); an over-budget sweep stops and the
+        next sweep finishes."""
+        ready = self._ready_groups()
+        if len(ready) < 2:
+            return
+        self.stats.count("replica.antientropy_rounds")
+        by_name = {g.name: g for g in ready}
+        with self._seq_mu:
+            digests: dict[str, dict] = {}
+            for g in ready:
+                try:
+                    digests[g.name] = self.resync._digest(g)
+                except (OSError, ResyncAbort):
+                    # A group that cannot answer is the probe's problem,
+                    # not this sweep's — compare whoever answered.
+                    self.stats.count("replica.antientropy_abort")
+                    return
+            if len({d.get("digest") for d in digests.values()}) == 1:
+                return  # the common case: one string compare, no walk
+            plan = majority_plan(digests)
+            if not plan.divergent:
+                # Digests differ only in schema (an empty index one
+                # group lacks): no fragment carries different bits, so
+                # nothing to repair — still worth a counter.
+                self.stats.count("replica.antientropy_schema_only")
+                return
+            for name in sorted(plan.divergent):
+                self.stats.count(f"replica.divergence.{name}")
+            _divergence_logger.warning(
+                "divergence %s",
+                json.dumps({
+                    "groups": sorted(plan.divergent),
+                    "first_path": plan.first_path,
+                    "paths": sum(len(p) for p in plan.divergent.values()),
+                    "write_seq": self.write_seq,
+                }, separators=(",", ":")),
+            )
+            deadline = time.monotonic() + self.anti_entropy_budget_s
+            for name in sorted(plan.divergent):
+                g = by_name[name]
+                for path in plan.divergent[name]:
+                    if time.monotonic() > deadline:
+                        self.stats.count("replica.antientropy_stall")
+                        return
+                    donor = by_name[plan.donor[path]]
+                    try:
+                        self.resync._stream_fragment(donor, g, path, g.epoch)
+                    except (OSError, ResyncAbort):
+                        self.stats.count("replica.antientropy_abort")
+                        return
+                    self.stats.count("replica.divergence_repaired")
+
+    def _anti_entropy_loop(self) -> None:
+        base = self.anti_entropy_interval_s
+        while not self._stop.wait(base * self._rng.uniform(0.75, 1.25)):
+            try:
+                self._anti_entropy_once()
+            except Exception:  # noqa: BLE001 — the sweep must never die
+                self.stats.count("replica.antientropy_errors")
 
     # -- lifecycle --------------------------------------------------------
 
@@ -898,6 +1078,10 @@ class ReplicaRouter:
         t.start()
         self._probe_thread = threading.Thread(target=self._probe_loop, daemon=True)
         self._probe_thread.start()
+        if self.anti_entropy_interval_s > 0:
+            threading.Thread(
+                target=self._anti_entropy_loop, daemon=True
+            ).start()
         return self
 
     def close(self) -> None:
@@ -936,4 +1120,6 @@ def router_from_config(cfg, stats=None, tracer=None) -> ReplicaRouter:
         faults=faults,
         stats=stats,
         tracer=tracer,
+        anti_entropy_interval_s=cfg.replica_anti_entropy_interval,
+        resync_chunk_bytes=cfg.replica_resync_chunk_bytes,
     )

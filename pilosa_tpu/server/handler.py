@@ -47,6 +47,7 @@ import re
 import threading
 import time
 import traceback
+import zlib
 from datetime import datetime
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
@@ -54,6 +55,7 @@ from urllib.parse import parse_qs, urlparse
 import numpy as np
 
 from pilosa_tpu import pilosa as errors
+from pilosa_tpu.analysis import lockcheck
 from pilosa_tpu import pql, qcache as qcache_mod, qos, trace as trace_mod, wire
 from pilosa_tpu.core.cache import Pair
 from pilosa_tpu.core.frame import FrameOptions
@@ -122,6 +124,13 @@ class Handler:
 
             applied_seq = AppliedSeq()
         self.applied_seq = applied_seq
+        # Resync chunk staging (POST /fragment/import-roaring): one
+        # in-progress transfer buffer per fragment path, keyed with the
+        # whole payload's (total, crc) so a resumed transfer continues
+        # and a different payload restarts cleanly.  Memory only — a
+        # crashed group simply restarts the transfer.
+        self._resync_mu = lockcheck.named_lock("server.handler._resync_mu")
+        self._resync_staging: dict[tuple, dict] = {}
         self.version = VERSION
         self._routes = self._build_routes()
 
@@ -145,6 +154,9 @@ class Handler:
             ("GET", re.compile(r"^/index/(?P<index>[^/]+)/frame/(?P<frame>[^/]+)/views$"), self.get_frame_views),
             ("PATCH", re.compile(r"^/index/(?P<index>[^/]+)/time-quantum$"), self.patch_index_time_quantum),
             ("GET", re.compile(r"^/replica/health$"), self.get_replica_health),
+            ("GET", re.compile(r"^/replica/digest$"), self.get_replica_digest),
+            ("POST", re.compile(r"^/replica/seed-seq$"), self.post_replica_seed_seq),
+            ("POST", re.compile(r"^/fragment/import-roaring$"), self.post_fragment_import_roaring),
             ("GET", re.compile(r"^/debug/vars$"), self.get_expvar),
             ("GET", re.compile(r"^/debug/traces$"), self.get_debug_traces),
             ("GET", re.compile(r"^/debug/pprof(?:/(?P<path>.*))?$"), self.get_pprof),
@@ -415,6 +427,117 @@ class Handler:
         if self.applied_seq is not None:
             out["appliedSeq"] = self.applied_seq.value
         return self._json(out)
+
+    def get_replica_digest(self, **kw):
+        """The group's content digest (replica/digest.py): schema plus a
+        per-(index, frame, view, slice) fragment-checksum tree — what
+        the router's resync diff and the anti-entropy sweep compare.
+        Pure function of (schema, logical bits), so two groups that
+        applied the same writes answer byte-identically."""
+        from pilosa_tpu.replica.digest import holder_digest
+
+        out = holder_digest(self.holder)
+        if self.applied_seq is not None:
+            out["appliedSeq"] = self.applied_seq.value
+        return self._json(out)
+
+    def post_replica_seed_seq(self, body=b"", **kw):
+        """Resync handoff: adopt the donor's applied sequence after a
+        fragment-level resync made this group's bytes match the donor's
+        as of that sequence.  Monotonic (AppliedSeq.note never
+        regresses), so a stray replayed seed is harmless."""
+        try:
+            seq = int((json.loads(body or b"{}") or {}).get("seq", 0))
+        except (ValueError, TypeError):
+            raise HTTPError(400, "bad seq")
+        if seq <= 0:
+            raise HTTPError(400, "seq must be positive")
+        if self.applied_seq is None:
+            raise HTTPError(409, "group has no applied-sequence tracking")
+        self.applied_seq.note(seq)
+        return self._json({"appliedSeq": self.applied_seq.value})
+
+    def post_fragment_import_roaring(self, params=None, body=b"", **kw):
+        """Receiving half of the resync fragment stream: replace one
+        fragment wholesale from a serialized roaring payload, delivered
+        in CRC-framed chunks so a killed transfer RESUMES instead of
+        restarting.
+
+        Protocol (query params): ``index/frame/view/slice`` name the
+        fragment, ``total`` and ``crc`` (crc32 of the complete payload)
+        identify the transfer, ``off`` is this chunk's byte offset.  A
+        chunk whose ``off`` does not match the staged size answers 409
+        with ``{"staged": n}`` so the sender resumes from ``n`` (an
+        idempotent re-send of an already-staged chunk included);
+        ``probe=1`` asks where the transfer stands without sending
+        bytes.  A different (total, crc) for the same fragment restarts
+        the transfer.  Once the staged bytes reach ``total`` and the
+        CRC matches, the fragment (created along with its index, frame,
+        and view when missing — the blank-group path) is replaced via
+        ``read_from``, which bumps its generation so qcache entries and
+        warm serve state invalidate exactly like any other write.
+        ``total=0`` clears the fragment (the donor no longer holds it).
+        Applying the same payload twice converges to the same bytes —
+        the whole stream is idempotent."""
+        params = params or {}
+        index = self._param(params, "index")
+        frame_name = self._param(params, "frame")
+        view_name = self._param(params, "view", VIEW_STANDARD)
+        slice_i = int(self._param(params, "slice", 0))
+        off = int(self._param(params, "off", 0))
+        total = int(self._param(params, "total", 0))
+        crc = int(self._param(params, "crc", 0))
+        probe = self._param(params, "probe") == "1"
+        if not index or not frame_name:
+            raise HTTPError(400, "index and frame required")
+        if total < 0 or off < 0:
+            raise HTTPError(400, "bad off/total")
+        key = (index, frame_name, view_name, slice_i)
+        with self._resync_mu:
+            st = self._resync_staging.get(key)
+            if st is not None and (st["total"] != total or st["crc"] != crc):
+                # A different payload for this fragment: the previous
+                # transfer is dead — restart.
+                self._resync_staging.pop(key, None)
+                st = None
+            if probe:
+                return self._json({"staged": len(st["buf"]) if st else 0})
+            if st is None:
+                if off != 0:
+                    return self._json({"staged": 0}, status=409)
+                st = {"total": total, "crc": crc, "buf": bytearray()}
+                self._resync_staging[key] = st
+            buf = st["buf"]
+            if off != len(buf):
+                return self._json({"staged": len(buf)}, status=409)
+            buf += body
+            if len(buf) > total:
+                self._resync_staging.pop(key, None)
+                raise HTTPError(409, "chunk overruns declared total")
+            if len(buf) < total:
+                return self._json({"staged": len(buf)})
+            self._resync_staging.pop(key, None)
+            data = bytes(buf)
+        if zlib.crc32(data) != crc:
+            raise HTTPError(409, "payload crc mismatch; transfer restarted")
+        idx = self.holder.create_index_if_not_exists(index)
+        frame = idx.create_frame_if_not_exists(frame_name)
+        view = frame.create_view_if_not_exists(view_name)
+        frag = view.create_fragment_if_not_exists(slice_i)
+        if total == 0:
+            # Clear: replace with an empty bitmap's serialized form.
+            from pilosa_tpu import roaring
+
+            empty = io.BytesIO()
+            roaring.Bitmap().write_to(empty)
+            data = empty.getvalue()
+        frag.read_from(data)
+        if self.executor is not None:
+            # Warm device state for the frame predates the restore.
+            self.executor.drop_frame_state(index, frame_name)
+        if self.stats is not None:
+            self.stats.count("replica.fragment_restores")
+        return self._json({"applied": True, "checksum": frag.checksum().hex()})
 
     def get_expvar(self, **kw):
         stats = {}

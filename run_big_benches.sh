@@ -95,4 +95,12 @@ run BENCH_CONFIG=replica BENCH_GROUPS=4 BENCH_THREADS=32
 #    backlog so the replay phase dominates.
 run BENCH_CONFIG=recovery
 run BENCH_CONFIG=recovery BENCH_RECOVERY_WRITES=4000 BENCH_BATCH=16
+# 13) Automated resync: a BLANK group joins a loaded 2-group cluster
+#    and self-heals (digest diff -> roaring fragment stream -> seed ->
+#    catch-up) — time-to-rejoin, bytes streamed vs WAL-replay traffic,
+#    zero failed writes during the resync and digest convergence both
+#    asserted in-run; the second line loads enough fragment bulk that
+#    the stream phase dominates.
+run BENCH_CONFIG=resync
+run BENCH_CONFIG=resync BENCH_RESYNC_WRITES=8000 BENCH_BATCH=16
 echo "ALL DONE $(date +%H:%M:%S)" >> $OUT

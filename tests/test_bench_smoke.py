@@ -189,6 +189,25 @@ def test_bench_recovery_emits_json():
     assert result["catchup_s"] > 0 and result["cpus"] >= 1
 
 
+def test_bench_resync_emits_json():
+    """The automated-resync bench: a BLANK group joins a loaded
+    2-group cluster behind a durable-WAL CLI router, self-heals via
+    the digest-diff fragment stream, and rejoins with zero failed
+    writes during the resync and digest convergence asserted in-run."""
+    stdout = _run({"BENCH_CONFIG": "resync", "BENCH_SMOKE": "1"}, timeout=300)
+    result = json.loads(stdout.strip().splitlines()[-1])
+    assert result["metric"] == "resync_rejoin_s" and result["value"] > 0
+    names = [t["tier"] for t in result["tiers"]]
+    assert names == ["load", "rejoin"]
+    by = {t["tier"]: t for t in result["tiers"]}
+    assert by["rejoin"]["failed_writes_during_resync"] == 0
+    assert by["rejoin"]["writes_during_resync"] > 0
+    assert by["rejoin"]["converged"] is True
+    assert by["rejoin"]["bytes_streamed"] > 0
+    assert by["rejoin"]["resync_fragments"] >= 1
+    assert result["cpus"] >= 1
+
+
 def test_star_trace_example_runs():
     stdout = _run({}, script=os.path.join("examples", "star_trace.py"))
     assert "top stargazers:" in stdout and "user 1 attrs:" in stdout

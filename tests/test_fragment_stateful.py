@@ -109,3 +109,122 @@ TestFragmentModel = FragmentMachine.TestCase
 TestFragmentModel.settings = settings(
     max_examples=25, stateful_step_count=40, deadline=None
 )
+
+
+# -- checksum stability (replica anti-entropy rests on it) -------------------
+#
+# Fragment.checksum() must be a pure function of the LOGICAL BIT SET:
+# the replica digest protocol (replica/digest.py) compares checksums
+# across groups that built the same bits through different paths —
+# different write orders, scalar vs batched writes, patch vs wholesale
+# rebuild (write_to/read_from), set-then-clear detours — and declares
+# divergence on any mismatch.  A path-dependent checksum would turn
+# every resync into a false divergence.
+
+from hypothesis import given  # noqa: E402
+
+_BITS = st.lists(
+    st.tuples(_ROW, _COL), min_size=1, max_size=50, unique=True
+)
+
+
+def _fresh_fragment(tmpdir, name):
+    f = Fragment(os.path.join(tmpdir, name), "i", "f", "standard", 0)
+    f.open()
+    return f
+
+
+@settings(max_examples=20, deadline=None)
+@given(bits=_BITS, seed=st.integers(0, 2**32 - 1))
+def test_checksum_stable_across_write_orders(bits, seed):
+    """Same logical bits via (a) insertion order, (b) a shuffled order,
+    (c) one bulk import must produce identical whole-fragment digests."""
+    import random as _random
+    import shutil
+    import tempfile
+
+    tmp = tempfile.mkdtemp()
+    try:
+        a = _fresh_fragment(tmp, "a")
+        for r, c in bits:
+            a.set_bit(r, c)
+        shuffled = list(bits)
+        _random.Random(seed).shuffle(shuffled)
+        b = _fresh_fragment(tmp, "b")
+        for r, c in shuffled:
+            b.set_bit(r, c)
+        c_frag = _fresh_fragment(tmp, "c")
+        c_frag.import_bits(
+            np.asarray([x[0] for x in bits], dtype=np.uint64),
+            np.asarray([x[1] for x in bits], dtype=np.uint64),
+        )
+        assert a.checksum() == b.checksum() == c_frag.checksum()
+        for f in (a, b, c_frag):
+            f.close()
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+@settings(max_examples=20, deadline=None)
+@given(bits=_BITS, extra=st.tuples(_ROW, _COL))
+def test_checksum_stable_across_repair_and_replay_paths(bits, extra):
+    """The write -> repair -> replay lifecycle: a fragment restored
+    wholesale from another's serialized payload (the resync stream
+    path), then written further, digests identically to the original
+    taking the same writes through its patch path; a set+clear detour
+    leaves the digest unchanged."""
+    import io
+    import shutil
+    import tempfile
+
+    tmp = tempfile.mkdtemp()
+    try:
+        a = _fresh_fragment(tmp, "a")
+        for r, c in bits:
+            a.set_bit(r, c)
+        buf = io.BytesIO()
+        a.write_to(buf)
+        b = _fresh_fragment(tmp, "b")
+        b.read_from(buf.getvalue())
+        assert a.checksum() == b.checksum()
+        # Diverge-and-return: a detour through extra bits on one side
+        # only must cancel out of the digest.
+        r, c = extra
+        had = a.storage.contains(int(r) * SLICE_WIDTH + int(c))
+        a.set_bit(r, c)
+        if not had:
+            assert a.checksum() != b.checksum()
+            a.clear_bit(r, c)
+        assert a.checksum() == b.checksum()
+        # Same further writes on both paths keep them digest-equal.
+        for r2, c2 in bits[: len(bits) // 2]:
+            a.clear_bit(r2, c2)
+            b.clear_bit(r2, c2)
+        assert a.checksum() == b.checksum()
+        a.close()
+        b.close()
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def test_checksum_position_bound():
+    """Identical relative bit patterns at DIFFERENT block ids must not
+    collide: the block id participates in the whole-fragment hash (two
+    groups disagreeing only on WHERE the rows sit would otherwise
+    digest as equal and anti-entropy would never repair them)."""
+    import shutil
+    import tempfile
+
+    from pilosa_tpu.core.fragment import HASH_BLOCK_SIZE
+
+    tmp = tempfile.mkdtemp()
+    try:
+        a = _fresh_fragment(tmp, "a")
+        a.set_bit(0, 5)
+        b = _fresh_fragment(tmp, "b")
+        b.set_bit(HASH_BLOCK_SIZE, 5)  # same offset inside block 1
+        assert a.checksum() != b.checksum()
+        a.close()
+        b.close()
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)

@@ -563,6 +563,12 @@ def test_lockstep_front_end_serves_admin_gets(tmp_path):
         st, tr, _ = get("/debug/traces")
         assert st == 200 and tr["traces"] == []
         assert get("/replica/health")[0] == 200
+        # Content digest (PR 9): rank 0 computes over replicated state,
+        # shape matches the full server's handler.
+        st, dig, _ = get("/replica/digest")
+        assert st == 200 and "g/f/standard/0" in dig["fragments"]
+        assert dig["appliedSeq"] == 0 and dig["digest"]
+        assert [x["name"] for x in dig["schema"]] == ["g"]
         assert get("/nope")[0] == 404
         # Through the router: admin GETs route like reads and now
         # answer over a lockstep group instead of 404ing.

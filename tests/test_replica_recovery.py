@@ -850,8 +850,10 @@ def test_router_restart_replays_missed_suffix_to_laggard(tmp_path):
 
 def test_laggard_past_wal_bound_goes_stale(tmp_path):
     """A dead group whose backlog would pin the WAL past wal-max-bytes
-    is declared STALE: the log compacts past it (bounded backlog) and
-    the probe stops trying to rescue it by replay."""
+    is declared STALE: the log compacts past it (bounded backlog), so
+    replay alone can never rescue it — once it comes back alive, the
+    AUTOMATED RESYNC (PR 9) streams it the donor's fragments and it
+    rejoins converged (PR 7 parked it for an operator here)."""
     with tempfile.TemporaryDirectory() as tmp:
         wal = WriteAheadLog(None, max_bytes=4096)
         rig = _Rig3(tmp, wal=wal)
@@ -880,12 +882,17 @@ def test_laggard_past_wal_bound_goes_stale(tmp_path):
             assert first == 0 or first > g2["appliedSeq"]
             assert rig.router.wal.last_seq > g2["appliedSeq"]
             assert rig.router.wal.size_bytes <= 4096
-            # A stale group does NOT rejoin by replay, even alive.
+            # A stale group cannot rejoin by replay (the records are
+            # gone from the log) — the automated resync brings it back:
+            # digest diff against a donor, fragment stream, seed,
+            # catch-up.  Zero operator action.
             rig.restart(2, epoch=2)
-            time.sleep(0.5)
-            assert rig.group_status("g2")["stale"] is True
-            assert not rig.group_status("g2")["healthy"]
-            # And the majority keeps serving writes.
+            g2 = rig.wait_ready("g2")
+            assert g2["stale"] is False
+            snap = rig.stats.snapshot()
+            assert snap.get("replica.resync.g2", 0) >= 1
+            assert rig.direct_count(2) == rig.direct_count(0)
+            # And the majority keeps serving writes throughout.
             assert rig.query('SetBit(rowID=2, frame="f", columnID=1)')[0] == 200
         finally:
             rig.close()
