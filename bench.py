@@ -171,6 +171,242 @@ def bench_setbit() -> dict:
     }
 
 
+def bench_writelane() -> dict:
+    """Config: native write request lane (pn_write_batch) + streaming
+    columnar ingest door.
+
+    Tiers (native vs Python A/B asserted in-run):
+
+    - ``singleton``: canonical singleton SetBit requests through the
+      NATIVE lane (``PILOSA_TPU_NO_FASTWRITE=1`` so the regex fast
+      lane steps aside) vs the Python GENERAL lane (both fast lanes
+      off, full parse path) — the native lane must win
+      (``singleton_native_vs_general``); the default-config fast-lane
+      rate rides along for context (for n=1 the regex + fused
+      ``pn_array_add_logged`` crossing is already one native call, so
+      the batch lane is not expected to beat it).
+    - ``batched``: B-call SetBit bodies, native lane on vs off — one
+      fused parse+insert+WAL crossing vs parse + vectorized batch
+      (``batched_native_vs_python`` asserted > 1).
+    - ``streaming``: a REAL HTTP server ingesting a packed-uint64
+      column stream through ``POST .../ingest`` while concurrent read
+      clients keep serving under QoS — ZERO read starvation asserted
+      (no read-class sheds, every reader progresses) plus the
+      sustained ingest pair rate.
+
+    A differential gate runs in-band: the native and general lanes
+    applied to the same op stream must leave byte-identical fragments.
+    """
+    import io
+    import tempfile
+    from concurrent.futures import ThreadPoolExecutor
+
+    from pilosa_tpu.core.frame import FrameOptions
+    from pilosa_tpu.core.holder import Holder
+    from pilosa_tpu.executor import Executor
+
+    smoke = os.environ.get("BENCH_SMOKE", "").lower() in ("1", "true", "yes")
+    n = int(os.environ.get("BENCH_OPS", "4000" if smoke else "20000"))
+    batch = int(os.environ.get("BENCH_BATCH", "64"))
+    n_rows = int(os.environ.get("BENCH_ROWS", "64"))
+    stream_pairs = int(
+        os.environ.get("BENCH_STREAM_PAIRS", "40000" if smoke else "400000")
+    )
+    n_readers = int(os.environ.get("BENCH_THREADS", "2" if smoke else "4"))
+
+    rng = np.random.default_rng(7)
+    rows = rng.integers(0, n_rows, size=n)
+    cols = rng.integers(0, 1 << 20, size=n)
+    rl, cl = rows.tolist(), cols.tolist()
+
+    _ENVS = ("PILOSA_TPU_NO_WRITELANE", "PILOSA_TPU_NO_FASTWRITE")
+
+    def with_env(env: dict):
+        for k in _ENVS:
+            os.environ.pop(k, None)
+        os.environ.update(env)
+
+    def run_ops(env: dict, queries: list, seed_qs: list, ops: int) -> tuple[float, bytes]:
+        """Fresh holder + executor under ``env``; a seed pass (same
+        containers, sibling bits: c^1) pre-creates the container set so
+        the timed pass measures the steady-state lane, not first-touch
+        container churn.  Returns (op/s, final fragment bytes)."""
+        with_env(env)
+        with tempfile.TemporaryDirectory() as d:
+            h = Holder(d)
+            h.open()
+            h.create_index("b").create_frame("f", FrameOptions())
+            ex = Executor(h, engine="numpy", qcache=None)
+            for q in seed_qs:
+                ex.execute("b", q)
+            t0 = time.perf_counter()
+            for q in queries:
+                ex.execute("b", q)
+            dt = time.perf_counter() - t0
+            frag = h.fragment("b", "f", "standard", 0)
+            buf = io.BytesIO()
+            frag.write_to(buf)
+            h.close()
+        for k in _ENVS:
+            os.environ.pop(k, None)
+        return ops / dt, buf.getvalue()
+
+    def mk_qs(rlist, clist, b):
+        if b == 1:
+            return [
+                f'SetBit(rowID={r}, frame="f", columnID={c})'
+                for r, c in zip(rlist, clist)
+            ]
+        return [
+            "".join(
+                f'SetBit(rowID={r}, frame="f", columnID={c})'
+                for r, c in zip(rlist[i : i + b], clist[i : i + b])
+            )
+            for i in range(0, len(rlist), b)
+        ]
+
+    seed_cols = [c ^ 1 for c in cl]
+    singleton_qs = mk_qs(rl, cl, 1)
+    singleton_seed = mk_qs(rl, seed_cols, batch)  # fast batched seeding
+    batched_qs = mk_qs(rl, cl, batch)
+    batched_seed = singleton_seed
+
+    s_native, bytes_native = run_ops(
+        {"PILOSA_TPU_NO_FASTWRITE": "1"}, singleton_qs, singleton_seed, n
+    )
+    s_general, bytes_general = run_ops(
+        {"PILOSA_TPU_NO_FASTWRITE": "1", "PILOSA_TPU_NO_WRITELANE": "1"},
+        singleton_qs, singleton_seed, n,
+    )
+    s_fast, bytes_fast = run_ops({}, singleton_qs, singleton_seed, n)
+    # Differential gate: identical op stream -> byte-identical storage,
+    # whichever lane served it.
+    differential_ok = bytes_native == bytes_general == bytes_fast
+    assert differential_ok, "write lanes diverged: fragment bytes differ"
+
+    b_native, bb_native = run_ops({}, batched_qs, batched_seed, n)
+    b_python, bb_python = run_ops(
+        {"PILOSA_TPU_NO_WRITELANE": "1"}, batched_qs, batched_seed, n
+    )
+    assert bb_native == bb_python, "batched lanes diverged: fragment bytes differ"
+
+    sn_ratio = s_native / s_general
+    bt_ratio = b_native / b_python
+    # In-run contract: the fused native crossing must beat the Python
+    # general lane on singletons and the parse+vectorized path on
+    # batches.
+    assert sn_ratio > 1.0, (
+        f"native singleton lane did not beat the general lane: {sn_ratio:.2f}"
+    )
+    assert bt_ratio > 1.0, (
+        f"native batch lane did not beat the python batch path: {bt_ratio:.2f}"
+    )
+
+    # -- streaming tier: ingest vs concurrent reads under QoS ------------
+    import json as _json
+    import urllib.error
+    import urllib.request
+
+    from pilosa_tpu.config import Config
+    from pilosa_tpu.server.client import Client
+    from pilosa_tpu.server.server import Server
+
+    s_rows = rng.integers(0, n_rows, size=stream_pairs).astype(np.uint64)
+    s_cols = rng.integers(0, 1 << 20, size=stream_pairs).astype(np.uint64)
+    with tempfile.TemporaryDirectory() as d:
+        cfg = Config(
+            data_dir=d, host="127.0.0.1:0", engine="numpy", stats="expvar",
+            qcache_enabled=False,
+        )
+        # Small write door: ingest chunks must queue behind it rather
+        # than monopolize the server; reads keep their own door.
+        cfg.qos_write_depth = 2
+        cfg.qos_read_depth = max(4, n_readers * 2)
+        srv = Server(cfg)
+        srv.open()
+        try:
+            client = Client(srv.host)
+            client.create_index("s")
+            client.create_frame("s", "f")
+            # Seed a few bits so readers have something to count.
+            client.ingest_stream("s", "f", [1, 2, 3], [1, 2, 3])
+            stop = [False]
+
+            def reader(i: int) -> dict:
+                out = {"served": 0, "shed": 0, "errors": 0}
+                k = i
+                while not stop[0]:
+                    q = f'Count(Bitmap(rowID={k % n_rows}, frame="f"))'
+                    k += 1
+                    req = urllib.request.Request(
+                        f"http://{srv.host}/index/s/query",
+                        data=q.encode(), method="POST",
+                    )
+                    try:
+                        with urllib.request.urlopen(req, timeout=30) as resp:
+                            resp.read()
+                        out["served"] += 1
+                    except urllib.error.HTTPError as e:
+                        e.read()
+                        if e.code in (429, 503):
+                            out["shed"] += 1
+                        else:
+                            out["errors"] += 1
+                    except OSError:
+                        out["errors"] += 1
+                return out
+
+            with ThreadPoolExecutor(n_readers + 1) as pool:
+                futs = [pool.submit(reader, i) for i in range(n_readers)]
+                t0 = time.perf_counter()
+                res = client.ingest_stream(
+                    "s", "f", s_rows, s_cols, chunk_pairs=16384
+                )
+                ingest_dt = time.perf_counter() - t0
+                stop[0] = True
+                reads = [f.result() for f in futs]
+            assert res["done"], "streamed ingest did not complete"
+            v = _json.loads(
+                urllib.request.urlopen(f"http://{srv.host}/debug/vars").read()
+            )
+            read_sheds = int(v.get("qos.shed.read", 0))
+            # Zero read starvation: ingest backpressure lands on the
+            # WRITE door; every reader kept serving and no read shed.
+            assert read_sheds == 0, f"reads shed during ingest: {read_sheds}"
+            assert all(r["served"] > 0 for r in reads), (
+                f"a reader starved during ingest: {reads}"
+            )
+            stream_rate = stream_pairs / ingest_dt
+            reads_served = sum(r["served"] for r in reads)
+        finally:
+            srv.close()
+
+    return {
+        "metric": "writelane_batched_native_vs_python",
+        "value": round(bt_ratio, 2),
+        "unit": (
+            f"x vs python batch path (B={batch}; singleton native "
+            f"{s_native:,.0f}/s vs general {s_general:,.0f}/s = "
+            f"x{sn_ratio:.2f}, fast lane {s_fast:,.0f}/s; streaming "
+            f"{stream_rate:,.0f} pairs/s with {reads_served} concurrent "
+            f"reads, 0 read sheds)"
+        ),
+        "tiers": {
+            "singleton_native_ops": round(s_native, 1),
+            "singleton_general_ops": round(s_general, 1),
+            "singleton_fast_ops": round(s_fast, 1),
+            "singleton_native_vs_general": round(sn_ratio, 2),
+            "batched_native_ops": round(b_native, 1),
+            "batched_python_ops": round(b_python, 1),
+            "batched_native_vs_python": round(bt_ratio, 2),
+            "stream_pairs_per_s": round(stream_rate, 1),
+            "stream_reads_served": reads_served,
+            "stream_read_sheds": 0,
+            "differential_ok": True,
+        },
+    }
+
+
 def bench_topn() -> dict:
     """Config 3: TopN over a ranked frame — candidate scoring via the
     batched intersection-count kernel (fragment.go:493-625 analog)."""
@@ -2547,6 +2783,7 @@ def main() -> None:
             "executor_gather": bench_executor_gather,
             "range_executor": bench_range_executor,
             "mixed": bench_mixed,
+            "writelane": bench_writelane,
             "overload": bench_overload,
             "qcache": bench_qcache,
             "replica": bench_replica,

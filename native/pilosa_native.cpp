@@ -14,6 +14,7 @@
 #include <cstddef>
 #include <cstdint>
 #include <cstring>
+#include <string>
 #include <unistd.h>
 
 extern "C" {
@@ -759,6 +760,225 @@ int64_t pn_serve_pairs(const char* src, int64_t len,
         return PN_PQL_FALLBACK;
     return n;
 }
+
+// ---------------------------------------------------------------------------
+// Native write request lane (the write-side twin of pn_serve_pairs):
+// parse a canonical all-SetBit/ClearBit request body, validate every op
+// against the caller's per-container table (sorted keys -> capacity-
+// slack array buffers), apply the sorted inserts/removes SEQUENTIALLY
+// (in-batch duplicate and set-then-clear semantics identical to issuing
+// the calls one by one), and append ONE group-committed WAL write(2) of
+// the 13-byte op records — all in a single GIL-released crossing.
+//
+// Parse shape per call (the canonical client/bench shape, the batched
+// generalization of executor.py's _SINGLETON_WRITE_RX):
+//
+//   SetBit(<rowkey>=INT, frame="<frame>", <colkey>=INT)
+//   ClearBit(<rowkey>=INT, frame='<frame>', <colkey>=INT)
+//
+// frame may be quoted or a bare identifier but must equal the armed
+// frame; rowkey/colkey must equal the armed labels.  ANY deviation
+// (other calls, timestamps, reordered args, other frames) returns
+// PN_PQL_FALLBACK with nothing parsed and nothing mutated — the Python
+// general lane keeps every behavior and error message.
+//
+// Outcomes:
+//   ret >= 1, *applied = 1   ops applied + WAL written; changed[] valid.
+//   ret >= 1, *applied = 0   parsed only (structural decline: container
+//                            missing/bitmap/no slack, op outside the
+//                            armed slice, would empty on clear, huge
+//                            batch).  types/rows/cols arrays are valid;
+//                            NOTHING was mutated — the caller applies
+//                            through the Python batch path using the
+//                            parse (still skipping the Python tokenizer).
+//   PN_PQL_FALLBACK          parse mismatch; nothing touched.
+//   -3                       WAL write failed AFTER mutation (matching
+//                            the Python batch lane's apply-then-log
+//                            order); caller raises.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+// Binary search over the sorted container-key table; -1 when absent.
+static inline int64_t pn_tab_pos(const uint64_t* keys, int64_t n, uint64_t v) {
+    int64_t lo = 0, hi = n;
+    while (lo < hi) {
+        int64_t mid = (lo + hi) >> 1;
+        if (keys[mid] < v) lo = mid + 1; else hi = mid;
+    }
+    if (lo >= n || keys[lo] != v) return -1;
+    return lo;
+}
+
+}  // namespace
+
+int64_t pn_write_batch(const char* src, int64_t len,
+                       const char* frame, int64_t flen,
+                       const char* rowkey, int64_t klen,
+                       const char* colkey, int64_t clen,
+                       uint64_t slice_i, uint64_t slice_width,
+                       const uint64_t* keys_sorted, uint64_t* buf_addrs,
+                       int64_t* ns, const int64_t* caps, int64_t n_containers,
+                       int64_t array_max, int32_t wal_fd,
+                       uint8_t* types_out, uint64_t* rows_out, uint64_t* cols_out,
+                       uint8_t* changed_out, int64_t cap, int64_t* applied) {
+    *applied = 0;
+    if (slice_width == 0) return PN_PQL_FALLBACK;
+    PairMatcher p = {src, len, 0};
+    int64_t n = 0;
+    while (p.ws()) {
+        if (n >= cap) return PN_PQL_FALLBACK;
+        uint8_t typ;
+        if (p.lit("SetBit", 6)) typ = 0;
+        else if (p.lit("ClearBit", 8)) typ = 1;
+        else return PN_PQL_FALLBACK;
+        if (!p.ws() || !p.ch('(')) return PN_PQL_FALLBACK;
+        // arg 1: <rowkey>=INT
+        int32_t ks, ke;
+        int64_t row = -1, col = -1;
+        if (!p.ws() || !p.ident(&ks, &ke)) return PN_PQL_FALLBACK;
+        if (ke - ks != klen || memcmp(src + ks, rowkey, (size_t)klen) != 0)
+            return PN_PQL_FALLBACK;
+        if (!p.ws() || !p.ch('=')) return PN_PQL_FALLBACK;
+        if (!p.ws() || !p.integer(&row)) return PN_PQL_FALLBACK;
+        if (!p.ws() || !p.ch(',')) return PN_PQL_FALLBACK;
+        // arg 2: frame="<frame>" (quoted or bare, content must match)
+        if (!p.ws() || !p.lit("frame", 5)) return PN_PQL_FALLBACK;
+        if (!p.ws() || !p.ch('=')) return PN_PQL_FALLBACK;
+        if (!p.ws()) return PN_PQL_FALLBACK;
+        {
+            int32_t fs, fe;
+            char q = src[p.i];
+            if (q == '"' || q == '\'') {
+                p.i++;
+                fs = (int32_t)p.i;
+                while (p.i < len && src[p.i] != q) {
+                    if (src[p.i] == '\\') return PN_PQL_FALLBACK;
+                    p.i++;
+                }
+                if (p.i >= len) return PN_PQL_FALLBACK;
+                fe = (int32_t)p.i;
+                p.i++;
+            } else if (!p.ident(&fs, &fe)) {
+                return PN_PQL_FALLBACK;
+            }
+            if (fe - fs != flen || memcmp(src + fs, frame, (size_t)flen) != 0)
+                return PN_PQL_FALLBACK;
+        }
+        if (!p.ws() || !p.ch(',')) return PN_PQL_FALLBACK;
+        // arg 3: <colkey>=INT
+        if (!p.ws() || !p.ident(&ks, &ke)) return PN_PQL_FALLBACK;
+        if (ke - ks != clen || memcmp(src + ks, colkey, (size_t)clen) != 0)
+            return PN_PQL_FALLBACK;
+        if (!p.ws() || !p.ch('=')) return PN_PQL_FALLBACK;
+        if (!p.ws() || !p.integer(&col)) return PN_PQL_FALLBACK;
+        if (!p.ws() || !p.ch(')')) return PN_PQL_FALLBACK;
+        // pos = row*W + col%W, overflow-guarded (integer() bounds each
+        // value to < 10^18, but the product can still exceed uint64).
+        uint64_t r = (uint64_t)row, c = (uint64_t)col;
+        if (r > (0xFFFFFFFFFFFFFFFFULL - c % slice_width) / slice_width)
+            return PN_PQL_FALLBACK;
+        if (c / slice_width != slice_i) {
+            // Outside the armed fragment's slice: keep parsing (the
+            // parse is still reusable) but never apply natively.
+            n_containers = -1;
+        }
+        types_out[n] = typ;
+        rows_out[n] = r;
+        cols_out[n] = c;
+        n++;
+    }
+    if (n < 1) return PN_PQL_FALLBACK;
+    if (n_containers < 0) return n;  // cross-slice batch: parsed only
+    // Huge batches: pass 1's O(n^2) per-container op counting stops
+    // paying; hand the parse to the vectorized Python batch path.
+    if (n > 1024) return n;
+
+    // Pass 1 — conservative structural validation with NO mutation:
+    // every op's container must be an array with enough slack for every
+    // op that might land in it (adds), and enough occupancy that clears
+    // can never empty it.  Anything else: parsed-only.
+    for (int64_t i = 0; i < n; i++) {
+        uint64_t pos_i = rows_out[i] * slice_width + cols_out[i] % slice_width;
+        int64_t t = pn_tab_pos(keys_sorted, n_containers, pos_i >> 16);
+        if (t < 0) return n;  // absent or non-array container
+        // Count ops targeting this container (n is small; O(n^2) over a
+        // request batch beats allocating a side table).  Sets and
+        // clears bound different hazards: sets the capacity/conversion
+        // ceiling, clears the could-empty floor.
+        int64_t set_hits = 0, clear_hits = 0;
+        for (int64_t j = 0; j < n; j++) {
+            uint64_t pos_j = rows_out[j] * slice_width + cols_out[j] % slice_width;
+            if ((pos_j >> 16) == (pos_i >> 16)) {
+                if (types_out[j] == 0) set_hits++; else clear_hits++;
+            }
+        }
+        if (ns[t] + set_hits > caps[t] || ns[t] + set_hits > array_max) return n;
+        if (clear_hits > 0 && ns[t] - clear_hits < 1) return n;  // could empty
+    }
+
+    // Pass 2 — sequential apply (identical to issuing the calls one by
+    // one, including in-batch duplicates and set-then-clear pairs),
+    // collecting WAL records for the ops that actually changed state.
+    enum { WAL_STACK = 256 };
+    uint8_t wal_stack[WAL_STACK * 13];
+    uint8_t* wal_buf = wal_stack;
+    std::string wal_heap;
+    if (n > WAL_STACK) {
+        wal_heap.resize((size_t)n * 13);
+        wal_buf = reinterpret_cast<uint8_t*>(&wal_heap[0]);
+    }
+    int64_t n_wal = 0;
+    for (int64_t i = 0; i < n; i++) {
+        uint64_t pos = rows_out[i] * slice_width + cols_out[i] % slice_width;
+        int64_t t = pn_tab_pos(keys_sorted, n_containers, pos >> 16);
+        uint32_t* arr = reinterpret_cast<uint32_t*>(buf_addrs[t]);
+        uint32_t low = (uint32_t)(pos & 0xFFFF);
+        int64_t cn = ns[t];
+        int64_t lo = 0, hi = cn;
+        while (lo < hi) {
+            int64_t mid = (lo + hi) >> 1;
+            if (arr[mid] < low) lo = mid + 1; else hi = mid;
+        }
+        bool present = (lo < cn && arr[lo] == low);
+        if (types_out[i] == 0) {  // SetBit
+            if (present) {
+                changed_out[i] = 0;
+                continue;
+            }
+            memmove(arr + lo + 1, arr + lo, (size_t)(cn - lo) * sizeof(uint32_t));
+            arr[lo] = low;
+            ns[t] = cn + 1;
+        } else {  // ClearBit
+            if (!present) {
+                changed_out[i] = 0;
+                continue;
+            }
+            memmove(arr + lo, arr + lo + 1, (size_t)(cn - lo - 1) * sizeof(uint32_t));
+            ns[t] = cn - 1;
+        }
+        changed_out[i] = 1;
+        pn_oplog_encode(&types_out[i], &pos, 1, wal_buf + n_wal * 13);
+        n_wal++;
+    }
+    if (wal_fd >= 0 && n_wal) {
+        size_t total = (size_t)n_wal * 13, off = 0;
+        while (off < total) {
+            ssize_t w = write(wal_fd, wal_buf + off, total - off);
+            if (w < 0) {
+                if (errno == EINTR) continue;
+                return -3;  // mutated but not durable: caller raises
+            }
+            off += (size_t)w;
+        }
+    }
+    *applied = 1;
+    return n;
+}
+
+}  // extern "C"
+
+extern "C" {
 
 // Returns the number of calls parsed (preorder), or PN_PQL_FALLBACK when
 // the source needs the full Python parser.  n_args_out gets the total

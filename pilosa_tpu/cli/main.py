@@ -232,6 +232,26 @@ def cmd_import(args) -> int:
     return 0
 
 
+def cmd_ingest(args) -> int:
+    """Client half of the streaming columnar bulk-ingest door: parse
+    CSV with the native parser, stream packed-uint64 chunks, resume at
+    the server's staged frontier if interrupted and re-run."""
+    from pilosa_tpu import native
+    from pilosa_tpu.server.client import Client
+
+    client = Client(args.host)
+    total = 0
+    for path in args.paths:
+        data = sys.stdin.buffer.read() if path == "-" else open(path, "rb").read()
+        rows, cols, _ts = native.parse_csv(data)
+        client.ingest_stream(
+            args.index, args.frame, rows, cols, chunk_pairs=args.chunk_pairs
+        )
+        total += len(rows)
+    print(f"streamed {total} bits into {args.index}/{args.frame} via /ingest")
+    return 0
+
+
 def cmd_export(args) -> int:
     from pilosa_tpu.server.client import Client, ClientError
 
@@ -449,6 +469,21 @@ def build_parser() -> argparse.ArgumentParser:
     )
     s.add_argument("--test-exit", action="store_true", help=argparse.SUPPRESS)
     s.set_defaults(fn=cmd_replica_router)
+
+    s = sub.add_parser(
+        "ingest",
+        help="stream CSV row,col bits through the columnar /ingest door "
+             "(resumable packed-uint64 chunks; QoS write-class backpressure)",
+    )
+    s.add_argument("--host", default="localhost:10101")
+    s.add_argument("--index", required=True)
+    s.add_argument("--frame", required=True)
+    s.add_argument(
+        "--chunk-pairs", type=int, default=65536,
+        help="(row, col) pairs per streamed chunk (chunk bytes = 8 + 16*pairs)",
+    )
+    s.add_argument("paths", nargs="+")
+    s.set_defaults(fn=cmd_ingest)
 
     s = sub.add_parser("import", help="bulk-import CSV row,col[,timestamp] bits")
     s.add_argument("--host", default="localhost:10101")

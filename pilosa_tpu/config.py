@@ -136,6 +136,11 @@ class Config:
     # and individually acked, so a killed transfer resumes at the
     # staged offset).
     replica_resync_chunk_bytes: int = 256 << 10
+    # -- streaming columnar ingest ([ingest] TOML section) ----------------
+    # Per-chunk byte ceiling at the streaming bulk-ingest door
+    # (POST /index/<i>/frame/<f>/ingest): a chunk past it answers 413
+    # instead of buffering an unbounded request body.
+    ingest_chunk_bytes: int = 4 << 20
     # -- HTTP client ([client] TOML section) ------------------------------
     # Retry budget for door sheds (429/503 — both issued BEFORE any
     # execution, so writes are safe to retry): total extra attempts per
@@ -222,6 +227,8 @@ class Config:
         cfg.replica_resync_chunk_bytes = int(
             rep.get("resync-chunk-bytes", cfg.replica_resync_chunk_bytes)
         )
+        ing = raw.get("ingest", {})
+        cfg.ingest_chunk_bytes = int(ing.get("chunk-bytes", cfg.ingest_chunk_bytes))
         cli = raw.get("client", {})
         cfg.client_retry_budget = int(
             cli.get("retry-budget", cfg.client_retry_budget)
@@ -328,6 +335,8 @@ class Config:
             self.replica_resync_chunk_bytes = int(
                 env["PILOSA_TPU_REPLICA_RESYNC_CHUNK_BYTES"]
             )
+        if "PILOSA_TPU_INGEST_CHUNK_BYTES" in env:
+            self.ingest_chunk_bytes = int(env["PILOSA_TPU_INGEST_CHUNK_BYTES"])
         if "PILOSA_TPU_CLIENT_RETRY_BUDGET" in env:
             self.client_retry_budget = int(env["PILOSA_TPU_CLIENT_RETRY_BUDGET"])
         if "PILOSA_TPU_LOCKSTEP_ACK_TIMEOUT" in env:

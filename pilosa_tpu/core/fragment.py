@@ -35,6 +35,7 @@ from typing import Optional, Sequence
 
 import numpy as np
 
+from pilosa_tpu import native as native_mod
 from pilosa_tpu import roaring
 from pilosa_tpu.core import cache as cache_mod
 from pilosa_tpu.ops import bitwise as bw
@@ -61,6 +62,13 @@ _WORDS = SLICE_WIDTH // 32
 
 # Process-global write-generation source (see Fragment.generation).
 _generation_counter = itertools.count(1)
+
+# Read-only singleton changed-vectors for the scalar write-lane path
+# (np.full costs ~0.7 us per singleton request).
+_CH_TRUE = np.full(1, True, dtype=bool)
+_CH_TRUE.setflags(write=False)
+_CH_FALSE = np.full(1, False, dtype=bool)
+_CH_FALSE.setflags(write=False)
 
 # Dirty-row journal length (entries, one per generation bump).  Past this
 # the oldest entries are dropped and deltas reaching back that far become
@@ -191,6 +199,20 @@ class Fragment:
         # generation can never enumerate a delta against this one.
         self._dirty_log: "list[tuple[int, Optional[tuple[int, ...]]]]" = []
         self._dirty_floor = self.generation
+        # Armed container table for the native write request lane
+        # (write_batch): sorted container keys + slack-buffer addresses/
+        # counts/capacities handed to pn_write_batch so one GIL-released
+        # crossing can do parse + insert + WAL for a whole batch.  Valid
+        # only while (storage identity, generation) match — any foreign
+        # writer or snapshot swap invalidates it by construction.
+        self._writelane: Optional[dict] = None
+        # Adaptive disarm: when structural declines dominate (cold
+        # uniform workloads where most ops first-touch a container),
+        # the native crossing is pure overhead — idle the lane for a
+        # stretch and let the plain Python lanes serve, re-probing
+        # periodically.
+        self._writelane_streak = 0
+        self._writelane_cooldown = 0
 
     # -- lifecycle (fragment.go:151-274) --------------------------------
 
@@ -556,6 +578,293 @@ class Fragment:
         with self._mu:
             self._assert_open()
             return self.storage.contains(self.pos(row_id, column_id))
+
+    # -- native write request lane (write-side twin of pn_serve_pairs) ---
+
+    def _writelane_state(self) -> Optional[dict]:
+        """Build (or revalidate) the armed container table handed to
+        ``pn_write_batch`` — call with the lock held.  The table covers
+        every ARRAY container, each with a writable slack buffer
+        (``_ensure_slack``), so the native crossing can memmove-insert
+        in place; bitmap containers simply aren't in the table and ops
+        touching them decline to the Python path.  Validity = storage
+        identity (a snapshot re-attach swaps storage and strands the
+        buffers) + write generation (any foreign writer may have
+        restructured containers or reallocated a buffer)."""
+        st = self._writelane
+        storage = self.storage
+        if (
+            st is not None
+            and st["storage"] is storage
+            and st["gen"] == self.generation
+        ):
+            return st
+        keys_l: list[int] = []
+        objs: list = []
+        addrs: list[int] = []
+        ns_l: list[int] = []
+        caps: list[int] = []
+        for key in sorted(storage.containers):
+            c = storage.containers[key]
+            arr = c.array
+            if arr is None:
+                continue  # bitmap container: not natively insertable
+            n = len(arr)
+            c._ensure_slack(n)
+            keys_l.append(key)
+            objs.append(c)
+            addrs.append(c._buf_addr)
+            ns_l.append(n)
+            caps.append(len(c._buf))
+        keys_a = np.array(keys_l, dtype=np.uint64)
+        addrs_a = np.array(addrs, dtype=np.uint64)
+        ns_a = np.array(ns_l, dtype=np.int64)
+        caps_a = np.array(caps, dtype=np.int64)
+        st = {
+            "storage": storage,
+            "gen": self.generation,
+            "keys": keys_a,
+            "addrs": addrs_a,
+            "ns": ns_a,
+            "caps": caps_a,
+            "objs": objs,
+            # Raw base addresses, cached once per rebuild: .ctypes.data
+            # costs ~1.4 us per access — 4 accesses per request would
+            # dominate the singleton crossing.  In-place updates
+            # (touch/apply) never move these buffers.
+            "ptrs": (
+                keys_a.ctypes.data, addrs_a.ctypes.data,
+                ns_a.ctypes.data, caps_a.ctypes.data,
+            ),
+            "n": len(keys_a),
+        }
+        self._writelane = st
+        return st
+
+    def write_batch(self, src: bytes, frame_b: bytes, rowkey_b: bytes,
+                    colkey_b: bytes):
+        """One-crossing native write lane: parse a canonical
+        all-SetBit/ClearBit request body, apply the sorted container
+        inserts/removes, and group-commit the WAL records — all inside
+        a single GIL-released ``pn_write_batch`` call against this
+        fragment's armed container table.
+
+        Returns:
+
+        - ``(changed bool-array, types, rows, cols)`` — applied
+          natively (WAL written, caches/journals/generation maintained
+          here);
+        - ``(None, types, rows, cols)`` — the body PARSED natively but
+          a structural case (new/bitmap container, out-of-slice op, no
+          slack) declined the apply; the caller pushes the parsed
+          arrays through the Python batch path, still skipping the
+          Python tokenizer;
+        - ``None`` — full fallback (native unavailable, non-canonical
+          body, buffered WAL writer): the caller runs the general lane.
+        """
+        W = np.uint64(SLICE_WIDTH)
+        with self._mu:
+            self._assert_open()
+            if self._writelane_cooldown > 0 and len(src) < 192:
+                # SINGLETON structural declines dominated recently: the
+                # per-op crossing is pure overhead on cold first-touch
+                # streams — let the Python lanes serve for a stretch.
+                # Batch bodies (a crossing amortized over many ops) are
+                # never cooled down; 192 bytes ~ two canonical calls.
+                self._writelane_cooldown -= 1
+                return None
+            storage = self.storage
+            fd = -1 if storage.op_writer is None else storage._wal_fd()
+            if fd == -2:
+                return None  # buffered writer: C write(2) would reorder
+            st = self._writelane_state()
+            kp, ap, np_, cp = st["ptrs"]
+            res = native_mod.write_batch(
+                src, frame_b, rowkey_b, colkey_b,
+                self.slice, SLICE_WIDTH,
+                kp, ap, np_, cp, st["n"],
+                fd, roaring.ARRAY_MAX_SIZE,
+            )
+            if res is None:
+                return None
+            types, rows, cols, changed = res
+            native_apply = changed is not None
+            if native_apply:
+                self._writelane_streak = 0
+            elif len(types) == 1:
+                # Only singleton declines feed the cooldown: a batch's
+                # scalar fallback already amortizes its crossing.
+                self._writelane_streak += 1
+                if self._writelane_streak >= 32:
+                    self._writelane_streak = 0
+                    self._writelane_cooldown = 512
+            # Singleton scalar path: the n==1 request is THE hot shape;
+            # numpy masking/unique/bincount machinery costs more than
+            # the whole op there.
+            if len(types) == 1:
+                return self._write_batch_one(
+                    st, storage, fd, native_apply, types, rows, cols, changed
+                )
+            if native_apply:
+                self.stats.count("writelane.native_batches", 1)
+                pos = rows * W + cols % W
+            else:
+                # Structural decline (new container, no slack, bitmap
+                # container, clear-would-empty...).  An in-slice batch
+                # of modest size still applies HERE through the scalar
+                # storage lane (which creates containers and slack
+                # buffers), with the armed table maintained
+                # INCREMENTALLY — a full O(containers) rebuild per
+                # first-touch op would be quadratic on uniform write
+                # mixes.  Bigger or cross-slice batches hand the parse
+                # back for the vectorized frame-level path.
+                n = len(types)
+                if n > 256 or not (cols // W == np.uint64(self.slice)).all():
+                    self.stats.count("writelane.parsed_only", 1)
+                    return None, types, rows, cols
+                pos = rows * W + cols % W
+                changed = np.zeros(n, dtype=bool)
+                for i, (t, p_) in enumerate(zip(types.tolist(), pos.tolist())):
+                    changed[i] = (
+                        storage.add(p_) if t == 0 else storage.remove(p_)
+                    )
+                self.stats.count("writelane.scalar_batches", 1)
+                # Refresh EVERY touched container (even unchanged ops
+                # can reallocate slack buffers — see _write_batch_one).
+                self._writelane_touch(
+                    st, storage, np.unique(pos >> np.uint64(16))
+                )
+            n_changed = int(changed.sum())
+            if n_changed:
+                cpos = pos[changed]
+                ctyp = types[changed]
+                tkeys = np.unique(cpos >> np.uint64(16))
+                if native_apply:
+                    # Re-point the touched containers at their new
+                    # extents (the crossing updated st["ns"] in place);
+                    # op-log count and snapshot-mirror dirt are ours to
+                    # record (the scalar lane did its own inside
+                    # storage.add/remove).
+                    for ti in st["keys"].searchsorted(tkeys).tolist():
+                        c = st["objs"][ti]
+                        c.array = c._buf[: int(st["ns"][ti])]
+                        c._ser = None
+                    if storage._snap_dirty is not None:
+                        storage._snap_dirty.update(int(k) for k in tkeys.tolist())
+                    if fd >= 0:
+                        storage.op_n += n_changed
+                n_set = int((ctyp == 0).sum())
+                if n_set:
+                    self.stats.count("setN", n_set)
+                if n_changed - n_set:
+                    self.stats.count("clearN", n_changed - n_set)
+                # Same deferred bookkeeping as the scalar mutators: bump
+                # the generation eagerly, journal the touched rows, and
+                # leave rank/row-cache updates to the next reader.
+                self.generation = next(_generation_counter)
+                crow = (cpos // W).astype(np.int64)
+                deltas = np.where(ctyp == 0, 1, -1)
+                uro, inv = np.unique(crow, return_inverse=True)
+                per_row = np.bincount(inv, weights=deltas).astype(np.int64)
+                self._log_dirty(uro.tolist())
+                p = self._pending_rows
+                for r, dlt in zip(uro.tolist(), per_row.tolist()):
+                    p[r] = p.get(r, 0) + int(dlt)
+                if self._writelane is st:
+                    st["gen"] = self.generation
+                self._increment_opn()
+                if self.storage is not storage:
+                    # The opn trigger snapshotted and re-attached: the
+                    # armed table points into the replaced containers.
+                    self._writelane = None
+            return changed, types, rows, cols
+
+    def _write_batch_one(self, st, storage, fd, native_apply,
+                         types, rows, cols, changed):
+        """Singleton-request bookkeeping for write_batch (lock held):
+        the exact work of set_bit/clear_bit, minus the numpy batch
+        machinery the n==1 shape cannot amortize."""
+        t0 = int(types[0])
+        row0 = int(rows[0])
+        col0 = int(cols[0])
+        pos0 = row0 * SLICE_WIDTH + col0 % SLICE_WIDTH
+        if native_apply:
+            self.stats.count("writelane.native_batches", 1)
+            ch = bool(changed[0])
+        else:
+            if col0 // SLICE_WIDTH != self.slice:
+                self.stats.count("writelane.parsed_only", 1)
+                return None, types, rows, cols
+            ch = storage.add(pos0) if t0 == 0 else storage.remove(pos0)
+            self.stats.count("writelane.scalar_batches", 1)
+            changed = _CH_TRUE if ch else _CH_FALSE
+            # Refresh even when unchanged: a duplicate add can still
+            # reallocate the slack buffer (ensure-slack runs before the
+            # duplicate check), which would strand a stale address in
+            # the armed table.
+            self._writelane_touch(st, storage, (pos0 >> 16,))
+        if ch:
+            key0 = pos0 >> 16
+            if native_apply:
+                ti = int(st["keys"].searchsorted(key0))
+                c = st["objs"][ti]
+                c.array = c._buf[: int(st["ns"][ti])]
+                c._ser = None
+                if storage._snap_dirty is not None:
+                    storage._snap_dirty.add(key0)
+                if fd >= 0:
+                    storage.op_n += 1
+            if t0 == 0:
+                self.stats.count("setN", 1)
+            else:
+                self.stats.count("clearN", 1)
+            self.generation = next(_generation_counter)
+            self._log_dirty((row0,))
+            p = self._pending_rows
+            p[row0] = p.get(row0, 0) + (1 if t0 == 0 else -1)
+            if self._writelane is st:
+                st["gen"] = self.generation
+            self._increment_opn()
+            if self.storage is not storage:
+                self._writelane = None
+        return changed, types, rows, cols
+
+    def _writelane_touch(self, st: dict, storage, tkeys) -> None:
+        """Incrementally reconcile the armed table after a scalar-lane
+        apply touched ``tkeys`` (call with the lock held).  Containers
+        already in the table get their (addr, n, cap) refreshed (the
+        scalar add may have reallocated the slack buffer); NEW
+        containers accumulate in a side set served by the scalar lane
+        until a bounded rebuild folds them in; a table entry whose
+        container vanished (emptied by a clear) or densified to bitmap
+        invalidates the state — the native crossing must never see a
+        stale buffer address."""
+        dead = False
+        extra = st.setdefault("extra", set())
+        keys = st["keys"]
+        nkeys = len(keys)
+        if isinstance(tkeys, np.ndarray):
+            tkeys = tkeys.tolist()
+        for k in tkeys:
+            c = storage.containers.get(k)
+            ti = int(keys.searchsorted(k))
+            in_tab = ti < nkeys and int(keys[ti]) == k
+            if c is None or c.array is None:
+                if in_tab:
+                    dead = True
+                    break
+                extra.discard(k)
+                continue
+            if in_tab:
+                c._ensure_slack(len(c.array))
+                st["addrs"][ti] = c._buf_addr
+                st["ns"][ti] = len(c.array)
+                st["caps"][ti] = len(c._buf)
+                st["objs"][ti] = c
+            else:
+                extra.add(k)
+        if dead or len(extra) > max(64, nkeys // 4):
+            self._writelane = None
 
     def _flush_row_bookkeeping(self) -> None:
         """Apply deferred per-row cache invalidations + rank updates.

@@ -30,6 +30,7 @@ from typing import Any, Optional, Sequence
 
 import numpy as np
 
+from pilosa_tpu import native as native_mod
 from pilosa_tpu import pql
 from pilosa_tpu.analysis import lockcheck
 from pilosa_tpu import qcache as qcache_mod
@@ -271,6 +272,15 @@ class Executor:
         # fast lane; validated by object identity per request (frame
         # deletion/recreation yields new objects).
         self._fastwrite_cache: dict[tuple[str, str], tuple] = {}
+        # (index, frame) -> armed request state for the NATIVE write lane
+        # (_write_fast_lane): pre-encoded frame/label bytes + the armed
+        # fragment whose container table pn_write_batch mutates.  Object
+        # identities are revalidated per request (same rule as the
+        # fast-write cache); the per-fragment table's own validity lives
+        # in Fragment._writelane.
+        self._writelane_arm: dict[tuple[str, str], dict] = {}
+        self._writelane_env: Optional[bool] = None  # lazy env-gate read
+        self._fastwrite_env: Optional[bool] = None  # lazy env-gate read
         # Cached serve states for the single-call native read lane
         # (_flat_fast_path), keyed (index, frame) in a small LRU so a
         # workload alternating between a few frames' dashboards doesn't
@@ -353,6 +363,15 @@ class Executor:
                     self.qcache.note_bypass()
                     if span is not None:
                         span.tags["qcache"] = "bypass"
+                elif query[:64].lstrip()[:9].startswith(("SetBit(", "ClearBit(")):
+                    # Cheap write sniff: a body whose first call mutates
+                    # is write-bearing and can never be cached — skip
+                    # the eligibility probe's memoized parse so the
+                    # write lanes never pay it (every write body is a
+                    # distinct string, so the memo never hits for them).
+                    self.qcache.note_ineligible()
+                    if span is not None:
+                        span.tags["qcache"] = "ineligible"
                 elif self.cluster is not None and not remote:
                     # Multi-node coordinator scope: the answer covers
                     # remotely-owned slices, but cluster writes apply
@@ -383,10 +402,20 @@ class Executor:
                         )
                     if cached is not None:
                         return cached
+            # Singleton lane first: for n=1 the regex + fused
+            # pn_array_add_logged path is already one crossing and
+            # beats pn_write_batch's 22-arg marshalling; the native
+            # batch lane owns everything the singleton shape declines
+            # (multi-call bodies, ClearBit batches, NO_FASTWRITE A/B).
             w = self._singleton_write_fast(index, query, slices, opt)
             if w is not None:
                 if span is not None:
                     span.tags["lane"] = "write_fast"
+                return w
+            w = self._write_fast_lane(index, query, slices, opt)
+            if w is not None:
+                if span is not None:
+                    span.tags["lane"] = "write_native"
                 return w
             fast = self._flat_fast_path(index, query, slices, opt)
             if fast is not None:
@@ -620,6 +649,135 @@ class Executor:
         r'\s*([A-Za-z_][A-Za-z0-9_]*)\s*=\s*(\d+)\s*\)\s*$'
     )
 
+    # First frame= reference in a canonical write body (quoted or bare).
+    _WRITE_FRAME_SNIFF_RX = re.compile(
+        r'frame\s*=\s*(?:"([^"\\]*)"|\'([^\'\\]*)\'|([A-Za-z][A-Za-z0-9._-]*))'
+    )
+
+    def _write_fast_lane(self, index: str, src: str, slices, opt) -> Optional[list]:
+        """Native write request lane: a canonical all-SetBit/ClearBit
+        request body — singleton or batch — runs parse + sorted
+        container inserts + WAL group commit in ONE GIL-released
+        ``pn_write_batch`` crossing against the armed fragment
+        (Fragment.write_batch), the write-side twin of the
+        ``pn_serve_pairs`` read lane.  A structurally-declined batch
+        still reuses the native PARSE: the ops apply through the
+        vectorized Python batch path without ever touching the Python
+        tokenizer.  Returns None for anything outside the exact shape —
+        clusters, explicit slices, inverse frames, multi-slice frames,
+        non-canonical bodies — so the general lane keeps every behavior
+        and error message (it is also the differential-test oracle:
+        both lanes must produce identical fragment bytes, WAL frames,
+        and changed vectors).
+        """
+        if self.cluster is not None or slices:
+            return None
+        no_lane = self._writelane_env
+        if no_lane is None:
+            # Read once per executor (~2 us/op otherwise); tests that
+            # toggle the env construct a fresh Executor (or reset
+            # _writelane_env to None).
+            # analysis-ok: lockstep-determinism: deployment config, launcher sets identical env on every rank
+            no_lane = self._writelane_env = os.environ.get(
+                "PILOSA_TPU_NO_WRITELANE", ""
+            ).lower() in ("1", "true", "yes")
+        if no_lane:
+            return None
+        head = src[:64].lstrip()[:9]
+        if not head.startswith(("SetBit(", "ClearBit(")):
+            return None
+        if native_mod.load() is None:
+            return None
+        if self.max_writes_per_request:
+            # Exact per canonical shape (one "Bit(" per call); checked
+            # BEFORE any mutation so the over-limit error keeps the
+            # general path's raise-before-write semantics.
+            if src.count("Bit(") > self.max_writes_per_request:
+                return None  # general path raises ErrTooManyWrites
+        m = self._WRITE_FRAME_SNIFF_RX.search(src, 0, 256)
+        if m is None:
+            return None
+        fname = m.group(1) or m.group(2) or m.group(3)
+        st = self._writelane_arm.get((index, fname))
+        if st is None or self.holder.index(index) is not st["idx_obj"]:
+            self._writelane_arm.pop((index, fname), None)
+            idx_obj = self.holder.index(index)
+            if idx_obj is None:
+                return None  # general path raises in canonical order
+            frame = idx_obj.frame(fname)
+            if frame is None:
+                return None
+            try:
+                st = {
+                    "idx_obj": idx_obj,
+                    "frame": frame,
+                    "frame_b": fname.encode("utf-8"),
+                    "rowkey_b": frame.row_label.encode("utf-8"),
+                    "colkey_b": idx_obj.column_label.encode("utf-8"),
+                    "frag": None,
+                }
+            except UnicodeEncodeError:
+                return None
+            self._writelane_arm[(index, fname)] = st
+        idx_obj, frame = st["idx_obj"], st["frame"]
+        if idx_obj.frame(fname) is not frame:
+            self._writelane_arm.pop((index, fname), None)
+            return None
+        if frame.inverse_enabled:
+            return None  # dual-view writes: general path
+        view = frame.view(VIEW_STANDARD)
+        frags = view.fragments if view is not None else {}
+        frag = st["frag"]
+        if frag is None or frags.get(frag.slice) is not frag:
+            # Arm the fragment: the lane serves the canonical single-
+            # slice shape (one standard-view fragment); multi-slice
+            # frames take the general path.
+            if len(frags) != 1:
+                st["frag"] = None
+                return None
+            frag = next(iter(frags.values()))
+            st["frag"] = frag
+        try:
+            raw = src.encode("utf-8")
+        except UnicodeEncodeError:
+            return None
+        res = frag.write_batch(raw, st["frame_b"], st["rowkey_b"], st["colkey_b"])
+        if res is None:
+            return None
+        changed, types, rows, cols = res
+        if changed is not None:
+            if len(changed) == 1:  # singleton hot path: no numpy work
+                ch = bool(changed[0])
+                if ch:
+                    self._note_dirty_rows(index, fname, (int(rows[0]),))
+                return [ch]
+            if changed.any():
+                self._note_dirty_rows(
+                    index, fname, np.unique(rows[changed]).tolist()
+                )
+            return changed.tolist()
+        # Parsed-only: apply through the vectorized Python batch path
+        # (sequential scalar path for mixed set/clear bodies, whose
+        # in-batch ordering matters).
+        if (types == 0).all():
+            ch = frame.set_bits(VIEW_STANDARD, rows, cols)
+            if ch.any():
+                self._note_dirty_rows(index, fname, rows[ch].tolist())
+            return ch.tolist()
+        out: list[bool] = []
+        touched: list[int] = []
+        for t, r, c in zip(types.tolist(), rows.tolist(), cols.tolist()):
+            if t == 0:
+                ok = frame.set_bit(VIEW_STANDARD, r, c)
+            else:
+                ok = frame.clear_bit(VIEW_STANDARD, r, c)
+            if ok:
+                touched.append(r)
+            out.append(ok)
+        if touched:
+            self._note_dirty_rows(index, fname, touched)
+        return out
+
     def _singleton_write_fast(self, index: str, src: str, slices, opt) -> Optional[list]:
         """Durable singleton SetBit/ClearBit with minimal per-request
         Python: one regex + cached (index, frame) resolution + the scalar
@@ -633,6 +791,17 @@ class Executor:
         writes), non-canonical arg names/order, timestamps, remote opts.
         """
         if self.cluster is not None or slices:
+            return None
+        no_fast = self._fastwrite_env
+        if no_fast is None:
+            # A/B lever (BENCH_CONFIG=writelane): disable the regex
+            # singleton lane so singletons flow to the native batch
+            # lane / general path.  Read once per executor.
+            # analysis-ok: lockstep-determinism: deployment config, launcher sets identical env on every rank
+            no_fast = self._fastwrite_env = os.environ.get(
+                "PILOSA_TPU_NO_FASTWRITE", ""
+            ).lower() in ("1", "true", "yes")
+        if no_fast:
             return None
         m = self._SINGLETON_WRITE_RX.match(src)
         if m is None:
@@ -846,6 +1015,12 @@ class Executor:
             cur.update(int(r) for r in rows)
             if len(cur) > cap:
                 self._dirty_rows[key] = None
+
+    def note_external_write(self, index: str, fname: str, rows) -> None:
+        """Public hook for non-executor write paths (the streaming
+        ingest door) to feed the dirty-row ledger, so warm serve state
+        patches instead of rebuilding after an ingest burst."""
+        self._note_dirty_rows(index, fname, rows)
 
     def _journal_dirty_rows(self, frags, old_gens, new_gens) -> Optional[dict]:
         """The EXACT per-(row, slice) delta written between two generation

@@ -139,6 +139,21 @@ def load() -> Optional[ctypes.CDLL]:
             i32p, i32p, i32p, i32p, i32p, i32p,
             ctypes.c_int32,
         ]
+        lib.pn_write_batch.restype = ctypes.c_int64
+        lib.pn_write_batch.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64,        # src
+            ctypes.c_char_p, ctypes.c_int64,        # frame
+            ctypes.c_char_p, ctypes.c_int64,        # rowkey
+            ctypes.c_char_p, ctypes.c_int64,        # colkey
+            ctypes.c_uint64, ctypes.c_uint64,       # slice_i, slice_width
+            ctypes.c_void_p, ctypes.c_void_p,       # keys_sorted, buf_addrs
+            ctypes.c_void_p, ctypes.c_void_p,       # ns, caps
+            ctypes.c_int64,                         # n_containers
+            ctypes.c_int64, ctypes.c_int32,         # array_max, wal_fd
+            ctypes.c_void_p, ctypes.c_void_p,       # types_out, rows_out
+            ctypes.c_void_p, ctypes.c_void_p,       # cols_out, changed_out
+            ctypes.c_int64, ctypes.POINTER(ctypes.c_int64),  # cap, applied
+        ]
         _lib = lib
         return _lib
 
@@ -211,6 +226,7 @@ def varint_decode(data: bytes) -> np.ndarray:
 
 
 _op1_local = threading.local()
+_wb_local = threading.local()
 
 
 def op_encode1(typ: int, value: int) -> bytes:
@@ -510,6 +526,80 @@ def serve_pairs(raw, frame_b, allow_default, rowkey_b, rows_sorted, pos, gram):
     if n < 0:
         return None
     return out[:n]
+
+
+def write_batch(src, frame_b, rowkey_b, colkey_b, slice_i, slice_width,
+                keys_p, addrs_p, ns_p, caps_p, n_containers,
+                wal_fd, array_max):
+    """Native write request lane (``pn_write_batch``): parse + container
+    insert + WAL append for a canonical all-SetBit/ClearBit request body
+    in ONE GIL-released crossing (the write-side twin of serve_pairs).
+
+    ``keys_p/addrs_p/ns_p/caps_p`` are RAW base-address ints of the
+    fragment's container-table arrays (sorted keys, slack-buffer
+    addresses, element counts — updated IN PLACE on apply — and buffer
+    capacities); raw ints because ``.ctypes.data`` costs ~1.4 us per
+    access and this is the singleton hot path — the caller caches them
+    alongside the table.  ``wal_fd`` is the raw fragment WAL fd (-1 =
+    no WAL attached).
+
+    Returns None when the library is unavailable or the body needs the
+    full Python path (parse mismatch), else
+    ``(types u8[N], rows u64[N], cols u64[N], changed)`` where
+    ``changed`` is a bool array when the ops were APPLIED natively (WAL
+    written, ns[] updated) or None when the batch was only PARSED
+    (structural decline — the caller applies through the Python batch
+    path using the parse).  The returned arrays are views into
+    thread-local buffers, valid until the same thread's next call.
+    Raises OSError when the WAL write failed after mutation (matching
+    the Python batch lane's apply-then-log ordering).
+    """
+    lib = load()
+    if lib is None or not src:
+        return None
+    # Exact bound: every canonical call contains one "Bit(".
+    cap = src.count(b"Bit(")
+    if cap <= 0:
+        return None
+    # Thread-local reused out buffers (pointers cached with them): the
+    # singleton hot path would otherwise pay four allocations plus four
+    # .ctypes.data accesses per request.
+    tl = _wb_local
+    arrs = getattr(tl, "arrs", None)
+    if arrs is None or len(arrs[0]) < cap:
+        size = max(64, cap)
+        arrs = tl.arrs = (
+            np.empty(size, dtype=np.uint8),
+            np.empty(size, dtype=np.uint64),
+            np.empty(size, dtype=np.uint64),
+            np.empty(size, dtype=np.uint8),
+        )
+        tl.ptrs = tuple(a.ctypes.data for a in arrs)
+        tl.applied = ctypes.c_int64(0)
+        tl.applied_ref = ctypes.byref(tl.applied)
+    types, rows, cols, changed = arrs
+    tp, rp, cp, chp = tl.ptrs
+    applied = tl.applied
+    applied.value = 0
+    n = lib.pn_write_batch(
+        src, len(src),
+        frame_b, len(frame_b),
+        rowkey_b, len(rowkey_b),
+        colkey_b, len(colkey_b),
+        slice_i, slice_width,
+        keys_p, addrs_p, ns_p, caps_p,
+        n_containers,
+        array_max, wal_fd,
+        tp, rp, cp, chp, cap, tl.applied_ref,
+    )
+    if n == -3:
+        raise OSError("WAL write failed")
+    if n < 0:
+        return None
+    return (
+        types[:n], rows[:n], cols[:n],
+        changed[:n].view(bool) if applied.value else None,
+    )
 
 
 def fnv1a64(data: bytes) -> int:

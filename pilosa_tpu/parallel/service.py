@@ -89,11 +89,18 @@ _now = time.perf_counter
 
 from pilosa_tpu.engine import MeshEngine
 from pilosa_tpu.executor import Executor
-from pilosa_tpu.pilosa import PilosaError
+from pilosa_tpu.pilosa import ErrFrameNotFound, ErrIndexNotFound, PilosaError
 from pilosa_tpu.qos import DeadlineExceeded, ShedError, deadline_from_headers
 from pilosa_tpu.server.handler import result_to_json
 
 _LEN = struct.Struct("<I")
+
+# Reserved internal entry for the streaming-ingest completion hook: the
+# front end ships it through the normal total order and EVERY rank
+# executes the rank-cache recalculation identically (import parity).
+# The NUL bytes keep it outside any parseable PQL; a client posting the
+# sentinel directly just triggers a harmless recalc.
+INGEST_RECALC_PREFIX = "\x00ingest-recalc\x00"
 
 
 class DegradedError(PilosaError):
@@ -312,6 +319,19 @@ class LockstepService:
         # counts the SAME number (the flag rides the wire, decided once
         # on rank 0) — the lockstep determinism probe for sampling.
         self.stat_traced = 0
+        # Streaming columnar ingest on the lockstep front end: chunks
+        # decode on rank 0 and replay as canonical batched SetBit
+        # bodies through the normal total order (every rank applies
+        # them — via the native write lane when armed); the completion
+        # hook ships the INGEST_RECALC_PREFIX sentinel so every rank
+        # recalculates rank caches identically.  Staging state
+        # (offsets, running CRC) is rank-0-local: a restarted job
+        # re-streams, which is idempotent.
+        from pilosa_tpu import ingest as ingest_mod
+
+        self._ingestor = ingest_mod.StreamIngestor(
+            self._ingest_apply, complete=self._ingest_complete,
+        )
 
     # -- rank 0 ----------------------------------------------------------
 
@@ -419,6 +439,53 @@ class LockstepService:
         if isinstance(slot[1], BaseException):
             raise slot[1]
         return slot[1]
+
+    # -- streaming ingest (front-end half) --------------------------------
+
+    # Pairs per replicated SetBit body: bounds the control-plane entry
+    # size and keeps each replayed body inside the native write lane's
+    # sweet spot.
+    _INGEST_SUBBATCH = 4096
+
+    def _ingest_apply(self, key, rows, cols, deadline) -> int:
+        """One decoded chunk -> canonical batched SetBit bodies through
+        the replicated total order.  The translation keeps the wire
+        JSON-clean and deterministic; each rank's executor applies the
+        body through its own native batch lane."""
+        index, fname = key
+        idx = self.holder.index(index)
+        if idx is None:
+            raise ErrIndexNotFound(index)
+        fr = idx.frame(fname)
+        if fr is None:
+            raise ErrFrameNotFound(fname)
+        rl, cl = fr.row_label, idx.column_label
+        rlist, clist = rows.tolist(), cols.tolist()
+        for i in range(0, len(rlist), self._INGEST_SUBBATCH):
+            body = "".join(
+                f'SetBit({rl}={r}, frame="{fname}", {cl}={c})'
+                for r, c in zip(
+                    rlist[i : i + self._INGEST_SUBBATCH],
+                    clist[i : i + self._INGEST_SUBBATCH],
+                )
+            )
+            self._execute(index, body, deadline=deadline)
+        return len(rlist)
+
+    def _ingest_complete(self, key) -> None:
+        index, fname = key
+        self._execute(index, INGEST_RECALC_PREFIX + fname)
+
+    def _do_ingest_recalc(self, index: str, fname: str) -> bool:
+        """Executed identically on every rank (sorted iteration inside
+        recalc_frame_caches): import-parity rank-cache freshness after
+        a streamed ingest."""
+        from pilosa_tpu import ingest as ingest_mod
+
+        fr = self.holder.frame(index, fname)
+        if fr is not None:
+            ingest_mod.recalc_frame_caches(fr)
+        return True
 
     def _ship_batch(self, items) -> tuple[int, list[bool], list]:
         """Assign the batch's slot in the total order and replicate it:
@@ -599,6 +666,13 @@ class LockstepService:
         for unit in self._batch_units(items):
             if unit[0] == "solo":
                 _, pos, index, query = unit
+                if query.startswith(INGEST_RECALC_PREFIX):
+                    # Reserved ingest-completion entry: recalc is a
+                    # deterministic function of replicated state.
+                    deliver(pos, self._do_ingest_recalc(
+                        index, query[len(INGEST_RECALC_PREFIX):]
+                    ))
+                    continue
                 try:
                     deliver(pos, self.executor.execute(index, query))
                 except PilosaError as e:
@@ -805,8 +879,94 @@ class LockstepService:
             self.end_headers()
             self.wfile.write(body)
 
+        def _do_ingest(self, index: str, frame: str, params: dict) -> None:
+            """Streaming columnar ingest through the lockstep front
+            end: same wire contract as the full server's route (off/
+            total/crc/ccrc/probe params, packed-uint64 or Arrow chunk
+            bodies); chunks replay on every rank as batched SetBit
+            bodies and the completion recalc ships through the same
+            total order."""
+            from pilosa_tpu.ingest import IngestError
+            from pilosa_tpu.replica.catchup import note_applied_from_headers
+
+            n = int(self.headers.get("Content-Length", 0))
+            body = self.rfile.read(n) if n else b""
+            headers = {k.lower(): v for k, v in self.headers.items()}
+            deadline = deadline_from_headers(
+                headers, self.service.default_deadline_ms
+            )
+
+            def p(name, default=None):
+                v = params.get(name)
+                return v[0] if v else default
+
+            status = 200
+            retry_after = None
+            key = (index, frame)
+            try:
+                off = int(p("off", 0))
+                total = int(p("total", 0))
+                crc = int(p("crc", 0))
+                ccrc_s = p("ccrc")
+                ccrc = int(ccrc_s) if ccrc_s is not None else None
+                if p("probe") == "1":
+                    out = self.service._ingestor.probe(key, total, crc)
+                else:
+                    arrow = "arrow" in (self.headers.get("Content-Type") or "")
+                    out = self.service._ingestor.chunk(
+                        key, off, total, crc, body, chunk_crc=ccrc,
+                        arrow=arrow, deadline=deadline,
+                    )
+                body_out = json.dumps(out).encode()
+            except (ValueError, TypeError):
+                status = 400
+                body_out = json.dumps({"error": "bad off/total/crc/ccrc"}).encode()
+            except IngestError as e:
+                status = e.status
+                body_out = json.dumps(
+                    {"error": str(e), "staged": e.staged}
+                ).encode()
+            except DeadlineExceeded as e:
+                status = 504
+                body_out = json.dumps({"error": str(e)}).encode()
+            except ShedError as e:
+                status = e.status
+                retry_after = e.retry_after
+                body_out = json.dumps({"error": str(e)}).encode()
+            except DegradedError as e:
+                status = 503
+                retry_after = e.retry_after
+                body_out = json.dumps({"error": str(e)}).encode()
+            except PilosaError as e:
+                status = 400
+                body_out = json.dumps({"error": str(e)}).encode()
+            except Exception as e:  # noqa: BLE001 — surface as 5xx
+                body_out = json.dumps({"error": f"internal: {e}"}).encode()
+                status = 500
+            note_applied_from_headers(
+                self.service.applied_seq, headers, status,
+                retry_after=retry_after,
+            )
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body_out)))
+            if retry_after is not None:
+                self.send_header("Retry-After", f"{retry_after:.3f}")
+            self._group_header()
+            self.end_headers()
+            self.wfile.write(body_out)
+
         def do_POST(self):
-            parts = self.path.strip("/").split("/")
+            parsed_url = urlparse(self.path)
+            parts = parsed_url.path.strip("/").split("/")
+            if (
+                len(parts) == 5
+                and parts[0] == "index"
+                and parts[2] == "frame"
+                and parts[4] == "ingest"
+            ):
+                self._do_ingest(parts[1], parts[3], parse_qs(parsed_url.query))
+                return
             if len(parts) != 3 or parts[0] != "index" or parts[2] != "query":
                 self.send_error(404)
                 return

@@ -62,8 +62,15 @@ def classify_request(method: str, path: str, body: bytes = b"") -> str:
         return CLASS_READ
     if path == "/import" or (
         method == "POST"
-        and (path in ("/fragment/data", "/fragment/block/diff") or path.endswith("/restore"))
+        and (
+            path in ("/fragment/data", "/fragment/block/diff")
+            or path.endswith("/restore")
+            or path.endswith("/ingest")
+        )
     ):
+        # /ingest: the streaming columnar bulk-ingest door — a write,
+        # so the admission bound backpressures each chunk and the
+        # replica router sequences + WAL-logs it like any other write.
         return CLASS_WRITE
     if path == "/export" or path.startswith("/fragment/") or path.endswith("/attr/diff"):
         return CLASS_READ

@@ -351,6 +351,78 @@ class Client:
 
     # -- export / backup / restore (client.go:463-676) ----------------------
 
+    # -- streaming columnar ingest (POST .../ingest) ------------------------
+
+    def ingest_chunk(self, index: str, frame: str, off: int, total: int,
+                     crc: int, body: bytes, ccrc: Optional[int] = None,
+                     probe: bool = False, deadline=None):
+        """One chunk of a streaming ingest transfer; returns
+        ``(status, parsed-json)`` — 409 answers (offset gaps / resume
+        hints) come back as data, not exceptions, so the streamer can
+        adopt the server's ``staged`` frontier."""
+        q = f"/index/{index}/frame/{frame}/ingest?off={off}&total={total}&crc={crc}"
+        if ccrc is not None:
+            q += f"&ccrc={ccrc}"
+        if probe:
+            q += "&probe=1"
+        status, payload = self._request(
+            "POST", q, body=body, content_type="application/octet-stream",
+            deadline=deadline,
+        )
+        try:
+            out = json.loads(payload) if payload else {}
+        except ValueError:
+            out = {}
+        if status >= 400 and status != 409:
+            raise ClientError(status, out.get("error", payload.decode(errors="replace")))
+        return status, out
+
+    def ingest_stream(self, index: str, frame: str, rows, cols,
+                      chunk_pairs: int = 65536, deadline=None) -> dict:
+        """Stream (row, col) columns through the bulk-ingest door as
+        packed-uint64 chunks, resuming at the server's staged frontier
+        on offset gaps (a restarted transfer probes first).  Chunk
+        boundaries are a pure function of (rows, cols, chunk_pairs), so
+        a resumed stream re-frames identically."""
+        import zlib as _zlib
+
+        from pilosa_tpu.ingest import encode_packed
+
+        frames = [
+            encode_packed(rows[i : i + chunk_pairs], cols[i : i + chunk_pairs])
+            for i in range(0, len(rows), chunk_pairs)
+        ] or [encode_packed([], [])]
+        total = sum(len(f) for f in frames)
+        crc = 0
+        for f in frames:
+            crc = _zlib.crc32(f, crc)
+        _, out = self.ingest_chunk(index, frame, 0, total, crc, b"", probe=True,
+                                   deadline=deadline)
+        staged = int(out.get("staged", 0))
+        cur = 0
+        result: dict = {"staged": staged, "done": False}
+        for fb in frames:
+            if cur + len(fb) <= staged:
+                cur += len(fb)  # already applied before a restart
+                continue
+            status, result = self.ingest_chunk(
+                index, frame, cur, total, crc, fb,
+                ccrc=_zlib.crc32(fb), deadline=deadline,
+            )
+            if status == 409:
+                # Adopt the server's frontier once; anything else
+                # (shrinking frontier, repeat gap) is a real error.
+                srv = int(result.get("staged", -1))
+                if srv <= cur:
+                    raise ClientError(409, result.get("error", "ingest gap"))
+                staged = srv
+                if cur + len(fb) <= staged:
+                    cur += len(fb)
+                    continue
+                raise ClientError(409, result.get("error", "ingest gap"))
+            cur += len(fb)
+        return result
+
     def export_csv(self, index: str, frame: str, view: str, slice_i: int) -> str:
         status, payload = self._request(
             "GET", f"/export?index={index}&frame={frame}&view={view}&slice={slice_i}"

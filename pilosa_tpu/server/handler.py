@@ -89,7 +89,8 @@ class Handler:
 
     def __init__(self, holder, executor, cluster=None, host="", broadcaster=None, stats=None, client_factory=None,
                  admission=None, default_deadline_ms: float = 0.0, tracer=None,
-                 group: str = "", applied_seq=None):
+                 group: str = "", applied_seq=None,
+                 ingest_chunk_bytes: int = 4 << 20):
         self.holder = holder
         self.executor = executor
         self.cluster = cluster
@@ -131,6 +132,17 @@ class Handler:
         # crashed group simply restarts the transfer.
         self._resync_mu = lockcheck.named_lock("server.handler._resync_mu")
         self._resync_staging: dict[tuple, dict] = {}
+        # Streaming columnar bulk-ingest door (POST .../ingest): chunks
+        # apply as they arrive through the batched set_bits path; the
+        # stager holds offsets + running CRC only, never payloads.
+        from pilosa_tpu import ingest as ingest_mod
+
+        self._ingestor = ingest_mod.StreamIngestor(
+            self._ingest_apply,
+            complete=self._ingest_complete,
+            stats=stats,
+            max_chunk_bytes=ingest_chunk_bytes,
+        )
         self.version = VERSION
         self._routes = self._build_routes()
 
@@ -148,6 +160,7 @@ class Handler:
             ("POST", re.compile(r"^/index/(?P<index>[^/]+)/frame/(?P<frame>[^/]+)$"), self.post_frame),
             ("DELETE", re.compile(r"^/index/(?P<index>[^/]+)/frame/(?P<frame>[^/]+)$"), self.delete_frame),
             ("POST", re.compile(r"^/index/(?P<index>[^/]+)/query$"), self.post_query),
+            ("POST", re.compile(r"^/index/(?P<index>[^/]+)/frame/(?P<frame>[^/]+)/ingest$"), self.post_frame_ingest),
             ("POST", re.compile(r"^/index/(?P<index>[^/]+)/frame/(?P<frame>[^/]+)/attr/diff$"), self.post_frame_attr_diff),
             ("POST", re.compile(r"^/index/(?P<index>[^/]+)/frame/(?P<frame>[^/]+)/restore$"), self.post_frame_restore),
             ("PATCH", re.compile(r"^/index/(?P<index>[^/]+)/frame/(?P<frame>[^/]+)/time-quantum$"), self.patch_frame_time_quantum),
@@ -766,6 +779,86 @@ class Handler:
             out["columnAttrSets"] = [
                 {"id": id, "attrs": attrs} for id, attrs in column_attr_sets
             ]
+        return self._json(out)
+
+    # -- streaming columnar ingest (the bulk-write front door) --------------
+
+    def _ingest_apply(self, key, rows, cols, deadline):
+        """One decoded chunk -> the batched set_bits path (+ executor
+        dirty-row notes so warm serve state patches, not rebuilds)."""
+        from pilosa_tpu import ingest as ingest_mod
+
+        index, fname = key
+        frame = self.holder.frame(index, fname)
+        if frame is None:
+            # Deleted mid-transfer: deterministic 404 for this chunk.
+            raise errors.ErrFrameNotFound(fname)
+        return ingest_mod.apply_columnar(
+            frame, rows, cols, executor=self.executor, index=index,
+            deadline=deadline,
+        )
+
+    def _ingest_complete(self, key) -> None:
+        """Import-parity hook: transfer done -> rank caches fresh NOW."""
+        from pilosa_tpu import ingest as ingest_mod
+
+        index, fname = key
+        frame = self.holder.frame(index, fname)
+        if frame is not None:
+            ingest_mod.recalc_frame_caches(frame)
+
+    def post_frame_ingest(self, index=None, frame=None, params=None, body=b"",
+                          headers=None, deadline=None, **kw):
+        """Streaming columnar bulk ingest: ``(row, col)`` column chunks
+        applied straight into the batched write path.
+
+        Wire: each POST carries one chunk of a transfer identified by
+        query params ``total`` (whole payload bytes) + ``crc`` (crc32
+        of the whole payload); ``off`` is this chunk's byte offset and
+        must equal the applied frontier (a re-send below it acks
+        idempotently, a gap answers 409 + ``{"staged": n}`` so the
+        sender resumes); ``ccrc`` is the chunk's own crc32, verified
+        before any bit is touched; ``probe=1`` asks where the transfer
+        stands.  Chunk payloads are packed-uint64 frames
+        (``PI64 | u32 n | rows | cols``) or — with an Arrow content
+        type and pyarrow importable — Arrow IPC record batches with
+        uint64 ``row``/``col`` columns.  QoS classifies the route as a
+        write, so each chunk passes the write-class admission door
+        (ingest bursts backpressure instead of starving reads) and the
+        replica router sequences + WAL-logs chunks like any other
+        write — replay is idempotent.  On completion the frame's rank
+        caches recalculate immediately (import parity)."""
+        headers = headers or {}
+        params = params or {}
+        idx = self.holder.index(index)
+        if idx is None:
+            raise errors.ErrIndexNotFound(index)
+        f = idx.frame(frame)
+        if f is None:
+            raise errors.ErrFrameNotFound(frame)
+        try:
+            off = int(self._param(params, "off", 0))
+            total = int(self._param(params, "total", 0))
+            crc = int(self._param(params, "crc", 0))
+            ccrc_s = self._param(params, "ccrc")
+            ccrc = int(ccrc_s) if ccrc_s is not None else None
+        except (TypeError, ValueError):
+            raise HTTPError(400, "bad off/total/crc/ccrc")
+        from pilosa_tpu import ingest as ingest_mod
+
+        key = (index, frame)
+        if self._param(params, "probe") == "1":
+            return self._json(self._ingestor.probe(key, total, crc))
+        arrow = "arrow" in (headers.get("content-type") or "")
+        try:
+            out = self._ingestor.chunk(
+                key, off, total, crc, body, chunk_crc=ccrc, arrow=arrow,
+                deadline=deadline,
+            )
+        except ingest_mod.IngestError as e:
+            return self._json(
+                {"error": str(e), "staged": e.staged}, status=e.status
+            )
         return self._json(out)
 
     # -- import (handler.go:900-978) ---------------------------------------

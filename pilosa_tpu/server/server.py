@@ -142,6 +142,9 @@ class Server:
             # behind the replica router (X-Pilosa-Group on responses).
             group=self.config.replica_group,
             applied_seq=self.applied_seq,
+            # [ingest] chunk-bytes: the streaming bulk-ingest door's
+            # per-chunk ceiling.
+            ingest_chunk_bytes=self.config.ingest_chunk_bytes,
         )
         self.syncer = HolderSyncer(
             self.holder, self.cluster, self.host, self.client_factory, stats=stats

@@ -63,6 +63,15 @@ run BENCH_CONFIG=mixed BENCH_ROWS=256 BENCH_SLICES=8
 #    replay vs one control-plane entry per request.
 run BENCH_CONFIG=lockstep_coalesce
 run BENCH_CONFIG=lockstep_coalesce BENCH_THREADS=32
+# 8b) Native write request lane + streaming columnar ingest: singleton
+#    native-vs-general and batched native-vs-python A/B (both asserted
+#    in-run), plus the /ingest streaming tier sustaining a column
+#    stream against concurrent QoS-doored reads (zero read sheds
+#    asserted).  The second line sizes bigger batches; the third a
+#    bigger stream with more readers.
+run BENCH_CONFIG=writelane
+run BENCH_CONFIG=writelane BENCH_BATCH=256
+run BENCH_CONFIG=writelane BENCH_STREAM_PAIRS=2000000 BENCH_THREADS=8
 # 9) Generation-keyed query result cache: Zipf-skewed repeated read mix
 #    with interleaved writes, cache-on vs cache-off tiers in the JSON
 #    (hit rate + ms/request; read-your-writes asserted in-run); the

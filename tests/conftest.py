@@ -51,6 +51,32 @@ def rng():
     return np.random.default_rng(42)
 
 
+# -- native library build (native/Makefile) ---------------------------------
+#
+# Tier-1 builds native/libpilosa_native.so BEFORE the suite runs so every
+# test exercises the same lanes CI ships (native.load() auto-builds via
+# the Makefile on first use).  Without a compiler the Python fallbacks
+# serve and the native-only tests (test_writelane) skip with a reason.
+
+@pytest.fixture(scope="session", autouse=True)
+def _native_library_build():
+    import shutil
+
+    from pilosa_tpu import native
+
+    if native.load() is None and not os.environ.get("PILOSA_TPU_NO_NATIVE"):
+        missing = [t for t in ("make", "g++") if shutil.which(t) is None]
+        reason = (
+            f"toolchain missing: {', '.join(missing)}" if missing
+            else "make -C native failed"
+        )
+        sys.stderr.write(
+            f"\n[conftest] native library unavailable ({reason}); "
+            "Python fallbacks serve, native-only tests skip\n"
+        )
+    yield
+
+
 # -- runtime lock checker (pilosa_tpu/analysis/lockcheck.py) ----------------
 #
 # The tier-1 concurrency/replica/qos suites run with the lock checker

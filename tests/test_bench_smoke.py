@@ -94,6 +94,25 @@ def test_bench_config_emits_json(cfg, extra):
         assert by["mixed_50_50"]["patch_planes"] > 0
 
 
+def test_bench_writelane_emits_json():
+    """The native write lane + streaming ingest bench: the in-run A/B
+    contract (native beats the Python general lane on singletons, the
+    parse+vectorized path on batches; the streaming tier sustains
+    ingest with zero read-class sheds) is asserted INSIDE the bench —
+    a nonzero exit would fail _run — so this smoke checks the JSON
+    shape and re-states the headline invariants."""
+    stdout = _run({"BENCH_CONFIG": "writelane", "BENCH_SMOKE": "1"}, timeout=300)
+    result = json.loads(stdout.strip().splitlines()[-1])
+    assert result["metric"] == "writelane_batched_native_vs_python"
+    assert result["value"] > 1.0
+    t = result["tiers"]
+    assert t["singleton_native_vs_general"] > 1.0
+    assert t["batched_native_vs_python"] > 1.0
+    assert t["differential_ok"] is True
+    assert t["stream_read_sheds"] == 0 and t["stream_reads_served"] > 0
+    assert t["stream_pairs_per_s"] > 0
+
+
 def test_bench_qcache_emits_json():
     """The query-result-cache bench must keep working: a Zipf-skewed
     repeated read mix with interleaved writes, cache on vs off on the

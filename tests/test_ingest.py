@@ -1,0 +1,420 @@
+"""Streaming columnar bulk-ingest front door (POST .../ingest).
+
+Pins the wire contract (packed-uint64 framing, per-chunk CRC, resumable
+offsets, idempotent re-sends), the apply semantics (batched set_bits,
+inverse-view parity, executor dirty notes), the import-parity rule
+(rank caches fresh IMMEDIATELY at completion — TopN right after a
+streamed ingest must not be ranking-debounce stale), QoS classification,
+and the lockstep front end's replicated translation of the same wire.
+"""
+
+import json
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+import zlib
+
+import numpy as np
+import pytest
+
+from pilosa_tpu import ingest
+from pilosa_tpu.config import Config
+from pilosa_tpu.qos import CLASS_WRITE, classify_request
+from pilosa_tpu.server.client import Client, ClientError
+from pilosa_tpu.server.server import Server
+
+
+# -- wire format units -------------------------------------------------------
+
+def test_packed_roundtrip():
+    rows = np.array([1, 2, 3], dtype=np.uint64)
+    cols = np.array([10, 20, 1 << 40], dtype=np.uint64)
+    body = ingest.encode_packed(rows, cols)
+    r2, c2 = ingest.decode_packed(body)
+    assert r2.tolist() == rows.tolist() and c2.tolist() == cols.tolist()
+
+
+@pytest.mark.parametrize(
+    "body",
+    [b"", b"PI64", b"XXXX" + b"\x00" * 20,
+     ingest.encode_packed([1], [2])[:-1],  # truncated payload
+     ingest.PACKED_MAGIC + (99).to_bytes(4, "little") + b"\x00" * 8],
+)
+def test_packed_malformed_rejected(body):
+    with pytest.raises(ingest.IngestError) as ei:
+        ingest.decode_packed(body)
+    assert ei.value.status == 400
+
+
+def test_arrow_unavailable_is_415():
+    if ingest.arrow_available():
+        pytest.skip("pyarrow importable: the 415 path is for hosts without it")
+    with pytest.raises(ingest.IngestError) as ei:
+        ingest.decode_arrow(b"whatever")
+    assert ei.value.status == 415
+
+
+@pytest.mark.skipif(not ingest.arrow_available(), reason="pyarrow unavailable")
+def test_arrow_roundtrip():
+    import pyarrow as pa
+
+    rows = np.arange(5, dtype=np.uint64)
+    cols = rows * 7
+    table = pa.table({"row": rows, "col": cols})
+    import io as _io
+
+    sink = _io.BytesIO()
+    with pa.ipc.new_stream(sink, table.schema) as w:
+        w.write_table(table)
+    r2, c2 = ingest.decode_arrow(sink.getvalue())
+    assert r2.tolist() == rows.tolist() and c2.tolist() == cols.tolist()
+
+
+def test_ingest_route_classifies_as_write():
+    assert classify_request("POST", "/index/i/frame/f/ingest", b"") == CLASS_WRITE
+
+
+# -- StreamIngestor units ----------------------------------------------------
+
+class _Sink:
+    def __init__(self):
+        self.chunks = []
+        self.completed = []
+
+    def apply(self, key, rows, cols, deadline):
+        self.chunks.append((key, rows.tolist(), cols.tolist()))
+        return len(rows)
+
+    def complete(self, key):
+        self.completed.append(key)
+
+
+def _frames(rows, cols, per=4):
+    return [
+        ingest.encode_packed(rows[i : i + per], cols[i : i + per])
+        for i in range(0, len(rows), per)
+    ]
+
+
+def _transfer(frames):
+    total = sum(len(f) for f in frames)
+    crc = 0
+    for f in frames:
+        crc = zlib.crc32(f, crc)
+    return total, crc
+
+
+def test_stream_resume_dup_gap_and_completion():
+    sink = _Sink()
+    ing = ingest.StreamIngestor(sink.apply, complete=sink.complete)
+    rows = list(range(10))
+    cols = [c * 3 for c in rows]
+    frames = _frames(rows, cols)
+    total, crc = _transfer(frames)
+    key = ("i", "f")
+    # probe before anything: staged 0
+    assert ing.probe(key, total, crc) == {"staged": 0, "done": False}
+    off = 0
+    out = None
+    for fb in frames[:-1]:
+        out = ing.chunk(key, off, total, crc, fb, chunk_crc=zlib.crc32(fb))
+        off += len(fb)
+        assert out["staged"] == off and not out["done"]
+    # duplicate re-send of the first chunk: idempotent ack, no re-apply
+    n_applied = len(sink.chunks)
+    dup = ing.chunk(key, 0, total, crc, frames[0])
+    assert dup["staged"] == off and len(sink.chunks) == n_applied
+    # gap: skipping past the frontier answers 409 with the frontier
+    with pytest.raises(ingest.IngestError) as ei:
+        ing.chunk(key, off + len(frames[-1]) + 4, total, crc, frames[-1])
+    assert ei.value.status == 409 and ei.value.staged == off
+    # resume probe mid-transfer
+    assert ing.probe(key, total, crc)["staged"] == off
+    # final chunk completes; completion hook fired once
+    out = ing.chunk(key, off, total, crc, frames[-1], chunk_crc=zlib.crc32(frames[-1]))
+    assert out["done"] and sink.completed == [key]
+    # all pairs applied exactly once, in order
+    seen = [p for _, rs, cs in sink.chunks for p in zip(rs, cs)]
+    assert seen == list(zip(rows, cols))
+
+
+def test_chunk_crc_mismatch_rejected_before_apply():
+    sink = _Sink()
+    ing = ingest.StreamIngestor(sink.apply)
+    fb = ingest.encode_packed([1], [2])
+    with pytest.raises(ingest.IngestError) as ei:
+        ing.chunk(("i", "f"), 0, len(fb), zlib.crc32(fb), fb,
+                  chunk_crc=zlib.crc32(fb) ^ 1)
+    assert ei.value.status == 400 and not sink.chunks
+    # the offset did not advance: the SAME chunk retries cleanly
+    out = ing.chunk(("i", "f"), 0, len(fb), zlib.crc32(fb), fb,
+                    chunk_crc=zlib.crc32(fb))
+    assert out["done"] and len(sink.chunks) == 1
+
+
+def test_payload_crc_mismatch_at_completion_surfaces():
+    sink = _Sink()
+    ing = ingest.StreamIngestor(sink.apply)
+    fb = ingest.encode_packed([1], [2])
+    with pytest.raises(ingest.IngestError) as ei:
+        ing.chunk(("i", "f"), 0, len(fb), zlib.crc32(fb) ^ 5, fb)
+    assert ei.value.status == 409
+    # transfer state dropped: a clean re-stream starts at 0
+    assert ing.probe(("i", "f"), len(fb), zlib.crc32(fb))["staged"] == 0
+
+
+def test_oversized_chunk_answers_413():
+    ing = ingest.StreamIngestor(_Sink().apply, max_chunk_bytes=64)
+    fb = ingest.encode_packed(list(range(32)), list(range(32)))
+    with pytest.raises(ingest.IngestError) as ei:
+        ing.chunk(("i", "f"), 0, len(fb), zlib.crc32(fb), fb)
+    assert ei.value.status == 413
+
+
+def test_new_payload_restarts_transfer():
+    sink = _Sink()
+    ing = ingest.StreamIngestor(sink.apply)
+    frames = _frames(list(range(8)), list(range(8)))
+    total, crc = _transfer(frames)
+    ing.chunk(("i", "f"), 0, total, crc, frames[0])
+    # different (total, crc): old transfer dies, off must restart at 0
+    frames2 = _frames([9], [9])
+    t2, c2 = _transfer(frames2)
+    out = ing.chunk(("i", "f"), 0, t2, c2, frames2[0])
+    assert out["done"]
+
+
+def test_failed_apply_keeps_chunk_retryable():
+    calls = {"n": 0}
+
+    def flaky(key, rows, cols, deadline):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise OSError("transient")
+        return len(rows)
+
+    ing = ingest.StreamIngestor(flaky)
+    fb = ingest.encode_packed([1, 2], [3, 4])
+    with pytest.raises(OSError):
+        ing.chunk(("i", "f"), 0, len(fb), zlib.crc32(fb), fb)
+    out = ing.chunk(("i", "f"), 0, len(fb), zlib.crc32(fb), fb)
+    assert out["done"] and calls["n"] == 2
+
+
+# -- end to end over a real server ------------------------------------------
+
+@pytest.fixture
+def srv():
+    with tempfile.TemporaryDirectory() as d:
+        cfg = Config(data_dir=d, host="127.0.0.1:0", engine="numpy",
+                     stats="expvar", qcache_enabled=False)
+        s = Server(cfg)
+        s.open()
+        try:
+            c = Client(s.host)
+            c.create_index("i")
+            c.create_frame("i", "f")
+            yield s, c
+        finally:
+            s.close()
+
+
+def test_ingest_end_to_end(srv):
+    s, c = srv
+    rng = np.random.default_rng(1)
+    rows = rng.integers(0, 50, size=20000).astype(np.uint64)
+    cols = rng.integers(0, 1 << 20, size=20000).astype(np.uint64)
+    out = c.ingest_stream("i", "f", rows, cols, chunk_pairs=4096)
+    assert out["done"] and out["ops"] == 20000
+    r = c.execute_query("i", 'Count(Bitmap(rowID=7, frame="f"))')
+    assert r["results"][0]["n"] == len(np.unique(cols[rows == 7]))
+    # idempotent re-stream converges (router WAL replay shape)
+    out2 = c.ingest_stream("i", "f", rows, cols, chunk_pairs=4096)
+    assert out2["done"]
+    assert c.execute_query("i", 'Count(Bitmap(rowID=7, frame="f"))')[
+        "results"
+    ][0]["n"] == len(np.unique(cols[rows == 7]))
+    v = json.loads(
+        urllib.request.urlopen(f"http://{s.host}/debug/vars").read()
+    )
+    assert v["ingest.completed"] >= 2 and v["ingest.ops"] >= 40000
+
+
+def test_topn_fresh_immediately_after_ingest(srv):
+    """Import-parity regression: the rank cache recalculates AT
+    completion — a TopN on the very next request reflects the streamed
+    rows, not the 10 s-debounced pre-ingest ranking."""
+    s, c = srv
+    # Pre-ingest state: row 1 leads.
+    c.execute_query("i", "".join(
+        f'SetBit(rowID=1, frame="f", columnID={k})' for k in range(5)
+    ))
+    r = c.execute_query("i", 'TopN(frame="f", n=1)')
+    assert r["results"][0]["pairs"][0]["id"] == 1
+    # Stream a NEW dominant row; TopN immediately after must lead with it.
+    rows = np.full(500, 9, dtype=np.uint64)
+    cols = np.arange(500, dtype=np.uint64)
+    assert c.ingest_stream("i", "f", rows, cols)["done"]
+    r = c.execute_query("i", 'TopN(frame="f", n=2)')
+    pairs = r["results"][0]["pairs"]
+    assert pairs[0] == {"id": 9, "count": 500}, pairs
+
+
+def test_ingest_inverse_view_parity(srv):
+    """Inverse-enabled frames get the transposed pairs, like import."""
+    s, c = srv
+    c.create_frame("i", "inv", {"inverseEnabled": True})
+    assert c.ingest_stream("i", "inv", [3], [44])["done"]
+    frag = s.holder.fragment("i", "inv", "inverse", 0)
+    assert frag is not None and frag.row_count(44) == 1
+
+
+def test_ingest_unknown_frame_404(srv):
+    s, c = srv
+    fb = ingest.encode_packed([1], [2])
+    with pytest.raises(ClientError) as ei:
+        c.ingest_chunk("i", "nope", 0, len(fb), zlib.crc32(fb), fb)
+    assert ei.value.status == 404
+
+
+def test_ingest_resume_after_interrupt(srv):
+    """A sender killed mid-transfer probes and resumes from the staged
+    frontier; only the missing suffix streams."""
+    s, c = srv
+    rows = np.arange(1000, dtype=np.uint64) % 10
+    cols = np.arange(1000, dtype=np.uint64)
+    frames = _frames(rows, cols, per=256)
+    total, crc = _transfer(frames)
+    st, out = c.ingest_chunk("i", "f", 0, total, crc, frames[0],
+                             ccrc=zlib.crc32(frames[0]))
+    assert st == 200 and out["staged"] == len(frames[0])
+    # "restart": ingest_stream probes, skips chunk 0, streams the rest
+    out = c.ingest_stream("i", "f", rows, cols, chunk_pairs=256)
+    assert out["done"]
+    r = c.execute_query("i", 'Count(Bitmap(rowID=3, frame="f"))')
+    assert r["results"][0]["n"] == 100
+
+
+def test_cli_ingest_streams_csv(srv, tmp_path, capsys):
+    from pilosa_tpu.cli.main import main
+
+    s, c = srv
+    csv = tmp_path / "bits.csv"
+    csv.write_text("".join(f"{r},{r * 7}\n" for r in range(200)))
+    assert main([
+        "ingest", "--host", s.host, "--index", "i", "--frame", "f",
+        "--chunk-pairs", "64", str(csv),
+    ]) == 0
+    assert "streamed 200 bits" in capsys.readouterr().out
+    r = c.execute_query("i", 'Count(Bitmap(rowID=5, frame="f"))')
+    assert r["results"][0]["n"] == 1
+
+
+def test_ingest_backpressure_never_sheds_reads():
+    """Chunks classify as writes: a saturating ingest stream queues at
+    the WRITE door while reads keep their own door — no read sheds."""
+    with tempfile.TemporaryDirectory() as d:
+        cfg = Config(data_dir=d, host="127.0.0.1:0", engine="numpy",
+                     stats="expvar", qcache_enabled=False)
+        cfg.qos_write_depth = 1
+        cfg.qos_read_depth = 8
+        s = Server(cfg)
+        s.open()
+        try:
+            c = Client(s.host)
+            c.create_index("i")
+            c.create_frame("i", "f")
+            c.ingest_stream("i", "f", [1], [1])
+            stop = [False]
+            served = [0]
+
+            def reader():
+                while not stop[0]:
+                    rq = urllib.request.Request(
+                        f"http://{s.host}/index/i/query",
+                        data=b'Count(Bitmap(rowID=1, frame="f"))',
+                        method="POST",
+                    )
+                    with urllib.request.urlopen(rq, timeout=30) as resp:
+                        resp.read()
+                    served[0] += 1
+
+            t = threading.Thread(target=reader, daemon=True)
+            t.start()
+            rng = np.random.default_rng(3)
+            rows = rng.integers(0, 20, size=60000).astype(np.uint64)
+            cols = rng.integers(0, 1 << 20, size=60000).astype(np.uint64)
+            assert c.ingest_stream("i", "f", rows, cols, chunk_pairs=8192)["done"]
+            stop[0] = True
+            t.join(timeout=30)
+            v = json.loads(
+                urllib.request.urlopen(f"http://{s.host}/debug/vars").read()
+            )
+            assert int(v.get("qos.shed.read", 0)) == 0
+            assert served[0] > 0
+        finally:
+            s.close()
+
+
+# -- lockstep front end ------------------------------------------------------
+
+def test_lockstep_front_end_ingest(tmp_path):
+    """The lockstep front end serves the SAME ingest wire: chunks
+    replay as batched SetBit bodies through the replicated total order
+    and the completion recalc rides a reserved entry — TopN right after
+    is fresh on the serving rank."""
+    from pilosa_tpu.core.frame import FrameOptions
+    from pilosa_tpu.core.holder import Holder
+    from pilosa_tpu.parallel.service import LockstepService
+
+    h = Holder(str(tmp_path / "d"))
+    h.open()
+    idx = h.create_index("i")
+    idx.create_frame("f", FrameOptions())
+    svc = LockstepService(
+        h, control_addr=("127.0.0.1", 0), http_addr=("127.0.0.1", 0)
+    )
+    threading.Thread(target=svc.serve_forever, daemon=True).start()
+    deadline = time.monotonic() + 10
+    while svc._httpd is None and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert svc._httpd is not None
+    base = f"http://{svc.http_addr[0]}:{svc.http_addr[1]}"
+    try:
+        rng = np.random.default_rng(4)
+        rows = rng.integers(0, 10, size=3000).astype(np.uint64)
+        cols = rng.integers(0, 1 << 20, size=3000).astype(np.uint64)
+        frames = _frames(rows, cols, per=1024)
+        total, crc = _transfer(frames)
+        off = 0
+        for fb in frames:
+            rq = urllib.request.Request(
+                f"{base}/index/i/frame/f/ingest?off={off}&total={total}"
+                f"&crc={crc}&ccrc={zlib.crc32(fb)}",
+                data=fb, method="POST",
+            )
+            with urllib.request.urlopen(rq, timeout=30) as resp:
+                out = json.loads(resp.read())
+            off += len(fb)
+            assert out["staged"] == off
+        assert out["done"]
+        # served through the replicated executor: counts + fresh TopN
+        rq = urllib.request.Request(
+            f"{base}/index/i/query",
+            data=b'Count(Bitmap(rowID=3, frame="f"))', method="POST",
+        )
+        with urllib.request.urlopen(rq, timeout=30) as resp:
+            got = json.loads(resp.read())["results"][0]
+        assert got == len(np.unique(cols[rows == 3]))
+        rq = urllib.request.Request(
+            f"{base}/index/i/query", data=b'TopN(frame="f", n=1)', method="POST",
+        )
+        with urllib.request.urlopen(rq, timeout=30) as resp:
+            pairs = json.loads(resp.read())["results"][0]
+        uniq = {int(x): len(np.unique(cols[rows == x])) for x in np.unique(rows)}
+        assert pairs[0]["count"] == max(uniq.values())
+    finally:
+        svc.shutdown()
+        h.close()
