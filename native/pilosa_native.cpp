@@ -17,6 +17,17 @@
 #include <string>
 #include <unistd.h>
 
+// The ctypes bridge (pilosa_tpu/native.py) and the native-abi
+// conformance rule (pilosa_tpu/analysis/abi.py) reduce every extern "C"
+// signature to width classes under the LP64 model: size_t and long are
+// 64-bit, int is 32-bit, pointers are 64-bit.  A target where that does
+// not hold would make the hand-declared argtypes marshal into the wrong
+// registers — fail the BUILD, not the first corrupted write batch.
+static_assert(sizeof(size_t) == 8, "LP64 expected: size_t must be 64-bit");
+static_assert(sizeof(long) == 8, "LP64 expected: long must be 64-bit");
+static_assert(sizeof(int) == 4, "LP64 expected: int must be 32-bit");
+static_assert(sizeof(void*) == 8, "LP64 expected: pointers must be 64-bit");
+
 extern "C" {
 
 // ---------------------------------------------------------------------------

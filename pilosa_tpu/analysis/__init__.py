@@ -1,9 +1,9 @@
 """Project invariant linter + debug-mode runtime concurrency checker.
 
-``python -m pilosa_tpu.analysis`` runs five project-specific rules over
-the live tree and exits nonzero on NEW findings (a checked-in baseline
-grandfathers accepted pre-existing violations; ``# analysis-ok: <rule>:
-<reason>`` suppresses a site explicitly):
+``python -m pilosa_tpu.analysis`` runs eight project-specific rules
+over the live tree and exits nonzero on NEW findings (a checked-in
+baseline grandfathers accepted pre-existing violations; ``# analysis-ok:
+<rule>: <reason>`` suppresses a site explicitly):
 
 1. lockstep-determinism — rank-local nondeterminism reachable from the
    lockstep batch-execution entry points;
@@ -15,8 +15,17 @@ grandfathers accepted pre-existing violations; ``# analysis-ok: <rule>:
    counters registry (COUNTERS.md), which must match the tree;
 4. exception-hygiene — ``except Exception`` must record a stat, use the
    exception, re-raise, or carry a tag;
-5. deadline-propagation — functions holding a deadline that perform an
-   HTTP hop must forward the remaining budget.
+5. deadline-propagation — functions holding a deadline that perform a
+   budget-carrying hop (executor→client, or the replica forward paths)
+   must forward the remaining budget (``deadline=`` / ``timeout_s=``);
+6. guarded-fields — fields declared in a class's ``_guarded_by_`` map
+   mutated in methods with no named-lock acquisition on any call path
+   (the static half of lockcheck's Eraser-style lockset race detector);
+7. native-abi — the ctypes bridge vs the ``extern "C"`` definitions vs
+   the built .so's exports: missing symbols, arity and integer-width
+   mismatches (:mod:`.abi`);
+8. stale-suppression — ``analysis-ok`` tags whose rule no longer fires
+   at their site (the suppression set must not rot as code moves).
 
 This module stays import-light: serving modules import
 ``pilosa_tpu.analysis.lockcheck`` at startup, so nothing here may pull

@@ -28,7 +28,14 @@ RULES = (
     "stats-registry",
     "exception-hygiene",
     "deadline-propagation",
+    "guarded-fields",
+    "native-abi",
+    "stale-suppression",
 )
+
+# stale-suppression is engine-resident (it needs the post-suppression
+# state of every other rule), not a rules.run_rule entry.
+_ENGINE_RULES = ("stale-suppression",)
 
 _SUPPRESS_RE = re.compile(r"#\s*analysis-ok:\s*([a-z-]+)\s*:\s*(.*)")
 
@@ -120,19 +127,68 @@ class ScopedVisitor(ast.NodeVisitor):
     visit_AsyncFunctionDef = visit_FunctionDef
 
 
-def apply_suppressions(findings: list[Finding], files: dict[str, SourceFile]) -> None:
+def apply_suppressions(findings: list[Finding], files: dict[str, SourceFile]) -> set:
     """Mark findings silenced by an ``analysis-ok`` comment on the same
     or the preceding line.  A matching comment with an EMPTY reason
-    does not suppress (the reason is the point)."""
+    does not suppress (the reason is the point).  Returns the set of
+    (path, comment line) suppressions that actually silenced something
+    — the stale-suppression pass flags the rest."""
+    used: set[tuple[str, int]] = set()
     for f in findings:
         sf = files.get(f.path)
         if sf is None:
             continue
         for line in (f.line, f.line - 1):
             sup = sf.suppressions.get(line)
-            if sup and sup[0] == f.rule and sup[1]:
-                f.suppressed = True
+            if sup and sup[0] == f.rule:
+                # An empty-reason comment doesn't suppress, but it IS
+                # attached to a live finding — stale-suppression must
+                # not double-report what the empty reason already
+                # surfaces as an unsuppressed finding.
+                used.add((f.path, line))
+                if sup[1]:
+                    f.suppressed = True
                 break
+    return used
+
+
+def stale_suppressions(
+    files, used: set, active_rules: tuple
+) -> list[Finding]:
+    """Suppression comments whose rule fired nothing at their site: the
+    tagged hazard was fixed or the code moved, and the rotting tag
+    would silence the NEXT real finding there.  Only comments naming a
+    rule in the active run are considered (a subset run must not call
+    another rule's live tags stale); a comment naming an UNKNOWN rule
+    is always a finding — it can never suppress anything."""
+    out: list[Finding] = []
+    for sf in files:
+        for line, (rule, _reason) in sorted(sf.suppressions.items()):
+            if rule == "stale-suppression":
+                continue  # a meta-tag never fires "at" its own site
+            known = rule in RULES
+            if known and rule not in active_rules:
+                continue
+            if known and (sf.rel, line) in used:
+                continue
+            if known:
+                msg = (
+                    f"suppression `# analysis-ok: {rule}: ...` no longer "
+                    "matches any finding at this site — the tagged hazard "
+                    "was fixed or the code moved; delete the comment "
+                    "(left in place it would silence the next real "
+                    "finding here)"
+                )
+            else:
+                msg = (
+                    f"suppression names unknown rule `{rule}` — it can "
+                    "never silence anything; fix the rule name or delete "
+                    "the comment"
+                )
+            out.append(
+                Finding("stale-suppression", sf.rel, line, "<suppression>", msg)
+            )
+    return out
 
 
 def fingerprint_findings(findings: list[Finding]) -> None:
@@ -218,8 +274,17 @@ def run_analysis(
     by_rel = {sf.rel: sf for sf in files}
     findings: list[Finding] = []
     for rule in rules:
+        if rule in _ENGINE_RULES:
+            continue
         findings.extend(rulemod.run_rule(rule, files, root))
-    apply_suppressions(findings, by_rel)
+    used = apply_suppressions(findings, by_rel)
+    if "stale-suppression" in rules:
+        stale = stale_suppressions(files, used, rules)
+        # Stale-suppression findings are themselves suppressible (the
+        # one legitimate case: a tag kept for a flapping, platform-
+        # dependent rule) — run the normal pass over just them.
+        apply_suppressions(stale, by_rel)
+        findings.extend(stale)
     fingerprint_findings(findings)
     bpath = baseline or baseline_path(root)
     apply_baseline(findings, load_baseline(bpath))

@@ -22,7 +22,26 @@ race detector did for the reference Pilosa:
   while ANY checked lock is held are violations unless the (lock,
   kind) pair is allowlisted — either in :data:`DEFAULT_ALLOW_PAIRS`
   (documented by-design holds, e.g. the write sequencer fanning out
-  over HTTP) or via a code-local ``with allowed("fsync"):`` scope.
+  over HTTP) or via a code-local ``with allowed("fsync"):`` scope;
+- GENERATION 2 — an Eraser-style LOCKSET RACE DETECTOR over declared
+  guarded state: classes carry ``_guarded_by_ = {"field": "lock.name"}``
+  and register with :func:`guarded_class` (or individual objects via
+  :func:`guarded`); while the checker is enabled their ``__setattr__``
+  is instrumented, and every write to a declared field refines a
+  per-(object, field) CANDIDATE LOCKSET — the intersection of the
+  named locks held at each write.  Writes by the first (and only)
+  accessing thread are exempt (the init-phase single-thread state:
+  construction and ``open()`` predate sharing); the lockset
+  initializes at the first write from a SECOND thread and shrinks by
+  intersection from there.  An empty lockset with >= 2 observed
+  threads is a ``lockset-race`` violation carrying the first shared
+  write's stack and the emptying write's stack — the data-race analog
+  of the order graph's first-witness cycles, and the safety net the
+  free-threaded multi-core refactor needs (lock-order checking alone
+  only catches deadlocks, ROADMAP item 2).  Only attribute REBINDS are
+  seen (``self.f = ...``, ``self.f += ...``); in-place container
+  mutation is covered by the static ``guarded-fields`` companion rule
+  (analysis/rules.py) instead.
 
 Violations are RECORDED, not raised at the faulting site (raising
 inside a background probe thread would be swallowed by its own
@@ -44,6 +63,7 @@ import socket
 import subprocess
 import threading
 import traceback
+import weakref
 
 ENV_VAR = "PILOSA_TPU_LOCK_CHECK"
 
@@ -103,6 +123,26 @@ class Violation:
         return f"{self.kind}: {self.detail}\n  thread: {self.thread}\n{self.stack}"
 
 
+class _FieldRecord:
+    """Eraser state for one (object, field) location.
+
+    ``lockset`` is None while the location is still in its exclusive
+    (single-thread init) phase; it initializes to the held-lock set of
+    the first write from a SECOND thread and only ever shrinks by
+    intersection afterwards."""
+
+    __slots__ = ("ref", "first_tid", "threads", "lockset", "first_stack",
+                 "reported")
+
+    def __init__(self, ref, tid: int, stack: str):
+        self.ref = ref  # weakref to the owning object (stale-id guard)
+        self.first_tid = tid
+        self.threads = {tid}
+        self.lockset = None
+        self.first_stack = stack
+        self.reported = False
+
+
 class _Checker:
     """Global acquisition-order graph + held-lock bookkeeping."""
 
@@ -114,6 +154,8 @@ class _Checker:
         self._violations: list[Violation] = []
         self._seen_cycles: set[tuple[str, str]] = set()
         self._seen_blocking: set[tuple[str, str]] = set()
+        # (id(obj), field) -> _FieldRecord for the lockset race detector.
+        self._fields: dict[tuple[int, str], _FieldRecord] = {}
         self._tls = threading.local()
         self.allow_pairs: set[tuple[str, str]] = set(DEFAULT_ALLOW_PAIRS)
 
@@ -221,6 +263,52 @@ class _Checker:
                 )
             )
 
+    # -- lockset race detection (declared guarded fields) -----------------
+
+    def note_field_write(self, obj, cls_name: str, field: str,
+                         lockname: str) -> None:
+        """One write to a declared-guarded field: refine the location's
+        candidate lockset (Eraser's C(v) &= locks_held), with the
+        init-phase single-thread exemption."""
+        tid = threading.get_ident()
+        key = (id(obj), field)
+        held = None
+        with self._mu:
+            rec = self._fields.get(key)
+            if rec is not None and rec.ref() is not obj:
+                rec = None  # id was recycled by a dead object: fresh record
+            if rec is None:
+                try:
+                    ref = weakref.ref(obj)
+                except TypeError:  # pragma: no cover - no __weakref__ slot
+                    ref = lambda _o=None: obj  # noqa: E731 — pins obj; rare
+                self._fields[key] = _FieldRecord(ref, tid, _stack())
+                return
+            rec.threads.add(tid)
+            if len(rec.threads) == 1:
+                return  # exclusive phase: only the first thread has written
+            held = set(self.held_names())
+            if rec.lockset is None:
+                # First write after the location became shared: the
+                # candidate set starts as exactly what this write holds.
+                rec.lockset = held
+            else:
+                rec.lockset &= held
+            if not rec.lockset and not rec.reported:
+                rec.reported = True
+                self._violations.append(
+                    Violation(
+                        "lockset-race",
+                        f"{cls_name}.{field} (declared guarded by "
+                        f"{lockname}): write with EMPTY candidate lockset — "
+                        f"{len(rec.threads)} threads observed, no common "
+                        "named lock across their writes\n"
+                        "  first-witness (earliest recorded write):\n"
+                        + rec.first_stack,
+                        _stack(),
+                    )
+                )
+
     # -- reporting ---------------------------------------------------------
 
     def take_violations(self) -> list[Violation]:
@@ -238,6 +326,7 @@ class _Checker:
             self._violations = []
             self._seen_cycles = set()
             self._seen_blocking = set()
+            self._fields = {}
 
 
 _checker = _Checker()
@@ -371,6 +460,90 @@ class allowed:
                 a.remove(k)
 
 
+# -- guarded-state declarations (lockset race detector) ---------------------
+#
+# Classes declare which named lock guards which field:
+#
+#     @lockcheck.guarded_class
+#     class Fragment:
+#         _guarded_by_ = {"storage": "core.fragment._mu", ...}
+#
+# With the checker enabled, the class's __setattr__ is wrapped so every
+# write to a declared field feeds note_field_write(); disabled, the
+# class is left untouched (zero overhead).  guarded(obj, attr, lock=..)
+# registers a single object's field instead (ad-hoc shared state that
+# has no class-level contract).
+
+_GUARDED_CLASSES: list = []
+# Classes with at least one per-instance guarded() registration; the
+# wrapper only consults the instance table for these.
+_INSTANCE_GUARDED_TYPES: set = set()
+_instance_guards: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+_SETATTR_SENTINEL = "__lockcheck_wrapped_setattr__"
+
+
+def _patch_guarded_class(cls) -> None:
+    if _SETATTR_SENTINEL in cls.__dict__:
+        return
+    own = cls.__dict__.get("__setattr__")  # restore target (None = inherited)
+    base_setattr = cls.__setattr__
+    decl = dict(getattr(cls, "_guarded_by_", ()) or ())
+    cls_name = cls.__name__
+
+    def checked_setattr(self, name, value):
+        base_setattr(self, name, value)
+        lock = decl.get(name)
+        if lock is None and type(self) in _INSTANCE_GUARDED_TYPES:
+            ig = _instance_guards.get(self)
+            if ig is not None:
+                lock = ig.get(name)
+        if lock is not None:
+            _checker.note_field_write(self, cls_name, name, lock)
+
+    checked_setattr.__lockcheck_orig__ = own
+    setattr(cls, "__setattr__", checked_setattr)
+    setattr(cls, _SETATTR_SENTINEL, True)
+
+
+def _unpatch_guarded_class(cls) -> None:
+    wrapped = cls.__dict__.get("__setattr__")
+    if _SETATTR_SENTINEL not in cls.__dict__ or wrapped is None:
+        return
+    orig = getattr(wrapped, "__lockcheck_orig__", None)
+    if orig is None:
+        delattr(cls, "__setattr__")  # was inherited (object.__setattr__)
+    else:
+        setattr(cls, "__setattr__", orig)
+    delattr(cls, _SETATTR_SENTINEL)
+
+
+def guarded_class(cls):
+    """Class decorator registering ``cls._guarded_by_`` declarations
+    with the lockset race detector.  A no-op marker while the checker
+    is disabled; instrumented from :func:`enable` on (including classes
+    defined after enable — subprocess workers self-enable at import,
+    before the guarded modules load)."""
+    if cls not in _GUARDED_CLASSES:
+        _GUARDED_CLASSES.append(cls)
+    if _enabled:
+        _patch_guarded_class(cls)
+    return cls
+
+
+def guarded(obj, attr: str, lock: str) -> None:
+    """Register ONE object's field as guarded by the named lock — the
+    ad-hoc twin of a class-level ``_guarded_by_`` entry.  The object's
+    class joins the instrumentation set (its declared dict, if any,
+    still applies)."""
+    cls = type(obj)
+    _INSTANCE_GUARDED_TYPES.add(cls)
+    ig = _instance_guards.get(obj)
+    if ig is None:
+        ig = _instance_guards[obj] = {}
+    ig[attr] = lock
+    guarded_class(cls)
+
+
 # -- blocking-call patches -------------------------------------------------
 
 
@@ -420,16 +593,21 @@ def _unpatch() -> None:
 
 def enable() -> None:
     """Turn the checker on for locks created FROM NOW ON (existing
-    plain locks stay plain) and patch the blocking-call probes."""
+    plain locks stay plain), patch the blocking-call probes, and
+    instrument every registered guarded class's __setattr__."""
     global _enabled
     _enabled = True
     _patch()
+    for cls in _GUARDED_CLASSES:
+        _patch_guarded_class(cls)
 
 
 def disable() -> None:
     global _enabled
     _enabled = False
     _unpatch()
+    for cls in _GUARDED_CLASSES:
+        _unpatch_guarded_class(cls)
     _checker.reset()
 
 

@@ -1,8 +1,11 @@
-"""The five project-invariant rules.
+"""The project-invariant rules (generation 2: eight of them).
 
 Each rule returns Finding objects; the engine applies suppressions,
 fingerprints, and the baseline.  See DEVELOPMENT.md ("Static analysis &
-concurrency checking") for the catalog and the rationale per rule.
+concurrency checking" and "Race detection & native conformance") for
+the catalog and the rationale per rule.  (The ninth check,
+``stale-suppression``, lives in the engine itself: it needs the
+post-suppression state of every other rule's findings.)
 """
 
 from __future__ import annotations
@@ -17,8 +20,16 @@ from pilosa_tpu.analysis import registry as regmod
 LOCKSTEP_ENTRY_FILE = "parallel/service.py"
 LOCKSTEP_ENTRY_PREFIX = "_exec_batch"
 
-HOP_METHODS = ("execute_query", "execute_remote", "execute_remote_call")
-DEADLINE_PARAMS = ("deadline", "opt", "opts", "options")
+# Budget-carrying hops: the executor→client edges forward a Deadline;
+# the replica tier's forward paths (router._forward, the catch-up
+# replay) forward either the remaining Deadline or an explicit socket
+# bound (timeout_s) — a hop with neither resets the budget on the peer
+# (or holds the sequencer lock for the full 30 s default timeout).
+HOP_METHODS = ("execute_query", "execute_remote", "execute_remote_call",
+               "_forward", "_replay_one")
+DEADLINE_PARAMS = ("deadline", "opt", "opts", "options", "timeout_s")
+# Keywords that count as forwarding the budget on a hop.
+_BUDGET_KWARGS = ("deadline", "timeout_s")
 
 _LOG_METHODS = ("warning", "error", "exception", "critical", "info", "debug")
 
@@ -30,6 +41,8 @@ def run_rule(rule: str, files, root: str) -> list[Finding]:
         "stats-registry": rule_stats_registry,
         "exception-hygiene": rule_exception_hygiene,
         "deadline-propagation": rule_deadline_propagation,
+        "guarded-fields": rule_guarded_fields,
+        "native-abi": rule_native_abi,
     }[rule]
     return fn(files, root)
 
@@ -373,13 +386,14 @@ class _DeadlineVisitor(ast.NodeVisitor):
                 if not (isinstance(fn, ast.Attribute) and fn.attr in HOP_METHODS):
                     continue
                 kw_names = {k.arg for k in sub.keywords}
-                if "deadline" not in kw_names and None not in kw_names:
+                if not kw_names.intersection(_BUDGET_KWARGS) and None not in kw_names:
                     self.out.append(
                         Finding(
                             "deadline-propagation", self.rel, sub.lineno, scope,
-                            f".{fn.attr}(...) hop without deadline= — the peer "
-                            "restarts the budget instead of inheriting the "
-                            "remaining one",
+                            f".{fn.attr}(...) hop without deadline= (or "
+                            "timeout_s= on the replica forward paths) — the "
+                            "peer restarts the budget instead of inheriting "
+                            "the remaining one",
                         )
                     )
         self.generic_visit(node)
@@ -394,4 +408,250 @@ def rule_deadline_propagation(files, root: str) -> list[Finding]:
         if sf.rel.startswith("analysis/"):
             continue
         _DeadlineVisitor(sf.rel, out).visit(sf.tree)
+    return out
+
+
+# -- 6. guarded-fields (static half of the lockset race detector) ------------
+#
+# lockcheck's runtime half sees attribute REBINDS under the enabled
+# checker; this half covers what setattr interception cannot — in-place
+# container mutation (`self._store.pop(...)`, `self._transfers[k] = v`)
+# — and what a test run may never execute.  A field declared in
+# ``_guarded_by_`` that is mutated in a method with NO named-lock
+# acquisition anywhere on its intra-package call paths is a finding.
+#
+# Over-approximation notes (both directions documented): lock
+# acquisition is matched by NAME SHAPE (`with self.<lock-ish attr>` /
+# `.acquire()` where the attribute looks like a lock: contains "mu",
+# "lock", "cv", or "cond"), not by lock identity — a caller holding a
+# DIFFERENT `_mu` shadows a real miss (fewer findings, same honesty
+# trade as the callgraph stoplist); reachability is the same name-based
+# call graph, so an unreachable-looking mutator errs toward MORE
+# findings, absorbed by suppressions.  Lifecycle methods (`__init__`,
+# `open`, `close`, context-manager plumbing) are exempt — the static
+# analog of the runtime init-phase single-thread exemption.
+
+_LIFECYCLE_EXEMPT = ("__init__", "__new__", "__enter__", "__exit__",
+                     "open", "close")
+
+# Method names that mutate their receiver in place.
+_MUTATOR_METHODS = frozenset({
+    "pop", "popitem", "clear", "update", "setdefault", "append", "extend",
+    "insert", "remove", "discard", "add", "move_to_end", "sort", "reverse",
+})
+
+_LOCKISH_RE = None  # compiled lazily (module import cost)
+
+
+def _is_lockish_name(name: str) -> bool:
+    global _LOCKISH_RE
+    if _LOCKISH_RE is None:
+        import re
+
+        _LOCKISH_RE = re.compile(r"mu|lock|cv|cond", re.IGNORECASE)
+    return bool(_LOCKISH_RE.search(name))
+
+
+def _acquires_lock(fn_node: ast.AST) -> bool:
+    """Does this function body acquire something lock-shaped — a
+    ``with`` over a lock-ish attribute/name (conditions included) or an
+    explicit ``.acquire()`` call?"""
+    for sub in ast.walk(fn_node):
+        if isinstance(sub, (ast.With, ast.AsyncWith)):
+            for item in sub.items:
+                expr = item.context_expr
+                if isinstance(expr, ast.Call):
+                    expr = expr.func
+                last = None
+                if isinstance(expr, ast.Attribute):
+                    last = expr.attr
+                elif isinstance(expr, ast.Name):
+                    last = expr.id
+                if last and _is_lockish_name(last):
+                    return True
+        elif isinstance(sub, ast.Call):
+            fn = sub.func
+            if isinstance(fn, ast.Attribute) and fn.attr == "acquire":
+                return True
+    return False
+
+
+def _collect_guarded_decls(sf) -> list[tuple[str, dict]]:
+    """(class name, {field: lockname}) for every class in the file with
+    a literal ``_guarded_by_`` dict."""
+    out = []
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        for stmt in node.body:
+            if (
+                isinstance(stmt, ast.Assign)
+                and len(stmt.targets) == 1
+                and isinstance(stmt.targets[0], ast.Name)
+                and stmt.targets[0].id == "_guarded_by_"
+                and isinstance(stmt.value, ast.Dict)
+            ):
+                decl = {}
+                for k, v in zip(stmt.value.keys, stmt.value.values):
+                    if (
+                        isinstance(k, ast.Constant) and isinstance(k.value, str)
+                        and isinstance(v, ast.Constant) and isinstance(v.value, str)
+                    ):
+                        decl[k.value] = v.value
+                if decl:
+                    out.append((node.name, decl))
+    return out
+
+
+def _guarded_mutations(cls_node: ast.ClassDef, fields):
+    """(method node, field, kind, lineno) for every mutation of a
+    declared field inside the class body.  ``kind`` is 'rebind' /
+    'item' / 'call'."""
+    hits = []
+
+    def field_of(expr) -> str | None:
+        # self.<field>  or  self.<field>[...]
+        if isinstance(expr, ast.Subscript):
+            expr = expr.value
+        if (
+            isinstance(expr, ast.Attribute)
+            and isinstance(expr.value, ast.Name)
+            and expr.value.id == "self"
+            and expr.attr in fields
+        ):
+            return expr.attr
+        return None
+
+    for stmt in cls_node.body:
+        if not isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for sub in ast.walk(stmt):
+            if isinstance(sub, ast.Assign):
+                for tgt in sub.targets:
+                    f = field_of(tgt)
+                    if f:
+                        kind = "item" if isinstance(tgt, ast.Subscript) else "rebind"
+                        hits.append((stmt, f, kind, sub.lineno))
+            elif isinstance(sub, (ast.AugAssign, ast.AnnAssign)):
+                f = field_of(sub.target)
+                if f:
+                    kind = "item" if isinstance(sub.target, ast.Subscript) else "rebind"
+                    hits.append((stmt, f, kind, sub.lineno))
+            elif isinstance(sub, ast.Delete):
+                for tgt in sub.targets:
+                    f = field_of(tgt)
+                    if f:
+                        hits.append((stmt, f, "item", sub.lineno))
+            elif isinstance(sub, ast.Call):
+                fn = sub.func
+                if (
+                    isinstance(fn, ast.Attribute)
+                    and fn.attr in _MUTATOR_METHODS
+                ):
+                    f = field_of(fn.value)
+                    if f:
+                        hits.append((stmt, f, "call", sub.lineno))
+    return hits
+
+
+def rule_guarded_fields(files, root: str) -> list[Finding]:
+    graph = CallGraph(files)
+    # Functions (by callgraph key) that acquire a lock-shaped object.
+    locked: set[tuple] = set()
+    for key, info in graph.funcs.items():
+        if _acquires_lock(info.node):
+            locked.add(key)
+    # Reverse name-based edges: callee key -> caller keys.
+    rev: dict[tuple, set] = {}
+    for key, info in graph.funcs.items():
+        for bare in info.calls:
+            for callee in graph._resolve(info, bare):
+                rev.setdefault(callee.key, set()).add(key)
+
+    def any_locked_path(key: tuple) -> bool:
+        """True when the method, or ANY transitive caller chain within
+        the package, acquires a lock — or when a chain originates in a
+        lifecycle method (`__init__`/`open`/...): the static analog of
+        the runtime detector's init-phase single-thread exemption."""
+        seen = {key}
+        work = [key]
+        while work:
+            cur = work.pop()
+            if cur in locked:
+                return True
+            info = graph.funcs.get(cur)
+            if info is not None and cur != key and info.bare in _LIFECYCLE_EXEMPT:
+                return True
+            for caller in rev.get(cur, ()):
+                if caller not in seen:
+                    seen.add(caller)
+                    work.append(caller)
+        return False
+
+    out: list[Finding] = []
+    for sf in files:
+        if sf.rel.startswith("analysis/"):
+            continue
+        decls = _collect_guarded_decls(sf)
+        if not decls:
+            continue
+        by_name = {d[0]: d[1] for d in decls}
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.ClassDef) or node.name not in by_name:
+                continue
+            fields = by_name[node.name]
+            for meth, field, kind, lineno in _guarded_mutations(node, fields):
+                if meth.name in _LIFECYCLE_EXEMPT:
+                    continue
+                key = (sf.rel, f"{node.name}.{meth.name}")
+                if key not in graph.funcs:
+                    continue  # nested beyond the graph's scope
+                if any_locked_path(key):
+                    continue
+                out.append(
+                    Finding(
+                        "guarded-fields", sf.rel, lineno,
+                        f"{node.name}.{meth.name}",
+                        f"`self.{field}` is declared guarded by "
+                        f"`{fields[field]}` but this {kind} mutation has no "
+                        "named-lock acquisition on any call path — take the "
+                        "lock (or document why the site is exempt)",
+                    )
+                )
+    return out
+
+
+# -- 7. native-abi -----------------------------------------------------------
+#
+# The ctypes bridge is ~30 hand-declared signatures where drift is
+# memory corruption, not an exception (the 22-argument pn_write_batch
+# being the worst case).  analysis/abi.py reduces the extern "C"
+# definitions, the argtypes/restype table, and the .so's export list to
+# width-class tuples and fails on any missing symbol, arity mismatch,
+# or width mismatch.  Findings anchor at the native.py declaration.
+
+NATIVE_PY_REL = "native.py"
+NATIVE_CPP_NAME = "pilosa_native.cpp"
+NATIVE_SO_NAME = "libpilosa_native.so"
+
+
+def rule_native_abi(files, root: str) -> list[Finding]:
+    from pilosa_tpu.analysis import abi
+
+    if not any(sf.rel == NATIVE_PY_REL for sf in files):
+        return []  # tree without a native bridge (fixture packages)
+    native_dir = os.path.join(os.path.dirname(os.path.abspath(root)), "native")
+    cpp = os.path.join(native_dir, NATIVE_CPP_NAME)
+    if not os.path.exists(cpp):
+        return []  # source-only install: nothing to conform against
+    so = os.path.join(native_dir, NATIVE_SO_NAME)
+    out: list[Finding] = []
+    for issue in abi.check_abi(cpp, os.path.join(root, NATIVE_PY_REL),
+                               so_path=so):
+        out.append(
+            Finding(
+                "native-abi", NATIVE_PY_REL, issue.line, issue.name,
+                issue.message,
+            )
+        )
     return out

@@ -114,8 +114,30 @@ def _batch_intersection_counts(rows: np.ndarray, src: np.ndarray) -> np.ndarray:
     return bw.np_popcount(rows & src).reshape(rows.shape[0], -1).sum(axis=1)
 
 
+@lockcheck.guarded_class
 class Fragment:
     """One slice of one view's row-major bitmap matrix."""
+
+    # Lockset race detector declarations (PILOSA_TPU_LOCK_CHECK=1):
+    # every post-init REBIND of these fields must hold the fragment
+    # lock.  Storage identity and the write generation are the validity
+    # tokens every warm cache (serve states, row pools, qcache vectors,
+    # armed write-lane tables) hangs off — an unguarded write here is
+    # how a free-threaded host serves stale or torn state.
+    _guarded_by_ = {
+        "storage": "core.fragment._mu",
+        "generation": "core.fragment._mu",
+        "_wal": "core.fragment._mu",
+        "_open": "core.fragment._mu",
+        "_storage_map": "core.fragment._mu",
+        "_writelane": "core.fragment._mu",
+        "_writelane_streak": "core.fragment._mu",
+        "_writelane_cooldown": "core.fragment._mu",
+        "_pending_rows": "core.fragment._mu",
+        "_checksum_cache": "core.fragment._mu",
+        "_opn_trigger": "core.fragment._mu",
+        "_dirty_floor": "core.fragment._mu",
+    }
 
     def __init__(
         self,

@@ -223,7 +223,19 @@ def needs_slices(calls: Sequence[pql.Call]) -> bool:
     return any(c.name in BITMAP_CALLS or c.name in ("Count", "TopN") for c in calls)
 
 
+@lockcheck.guarded_class
 class Executor:
+    # Lockset race detector declarations: the device-state pools move
+    # under their dedicated leaf locks.  These fields are containers
+    # mutated in place, so the static guarded-fields rule carries most
+    # of the enforcement (the runtime half sees rebinds only).
+    _guarded_by_ = {
+        "_matrix_cache": "executor._matrix_mu",
+        "_multi_matrix_cache": "executor._matrix_mu",
+        "_serve_states": "executor._matrix_mu",
+        "_dirty_rows": "executor._dirty_mu",
+    }
+
     def __init__(
         self,
         holder,
@@ -888,7 +900,8 @@ class Executor:
                 # and pay the full rebuild through the general lane.
                 st = self._serve_state_repair((index, fname), st)
                 if st is None:
-                    self._serve_states.pop((index, fname), None)
+                    with self._matrix_mu:
+                        self._serve_states.pop((index, fname), None)
             if st is not None:
                 counts = native.serve_pairs(
                     raw, st["frame_b"], st["allow_default"], st["rowkey_b"],
@@ -897,8 +910,12 @@ class Executor:
                 if counts is not None:
                     # Guard: a concurrent invalidation/eviction during
                     # the GIL-released call may have removed the key.
-                    if (index, fname) in self._serve_states:
-                        self._serve_states.move_to_end((index, fname))
+                    # LRU maintenance under _matrix_mu like every other
+                    # serve-state mutation (guarded-fields declaration);
+                    # the native call above runs outside any lock.
+                    with self._matrix_mu:
+                        if (index, fname) in self._serve_states:
+                            self._serve_states.move_to_end((index, fname))
                     return counts.tolist()
         m = native.pql_match_pairs(raw)
         if m is None:
@@ -1120,7 +1137,7 @@ class Executor:
                 k for k in self._multi_matrix_cache if k[0] == index and k[1] == frame
             ]:
                 del self._multi_matrix_cache[k]
-        self._serve_states.pop((index, frame), None)
+            self._serve_states.pop((index, frame), None)
         self._fastwrite_cache.pop((index, frame), None)
         with self._dirty_mu:
             self._dirty_rows.pop((index, frame), None)
@@ -1137,8 +1154,8 @@ class Executor:
                 del self._matrix_cache[k]
             for k in [k for k in self._multi_matrix_cache if k[0] == index]:
                 del self._multi_matrix_cache[k]
-        for k in [k for k in list(self._serve_states) if k[0] == index]:
-            self._serve_states.pop(k, None)
+            for k in [k for k in list(self._serve_states) if k[0] == index]:
+                self._serve_states.pop(k, None)
         for k in [k for k in list(self._fastwrite_cache) if k[0] == index]:
             self._fastwrite_cache.pop(k, None)
         with self._dirty_mu:
@@ -1183,7 +1200,7 @@ class Executor:
         for s, g in zip(slices, gens):
             f = self.holder.fragment(index, fname, VIEW_STANDARD, s)
             slots.append((s, f, g))
-        self._serve_states[(index, fname)] = {
+        st = {
             "index": index,
             "fname": fname,
             "idx_obj": idx_obj,
@@ -1197,9 +1214,11 @@ class Executor:
             "gram": glut[1],
             "ps": glut[2],
         }
-        self._serve_states.move_to_end((index, fname))
-        while len(self._serve_states) > self._serve_states_max:
-            self._serve_states.popitem(last=False)
+        with self._matrix_mu:
+            self._serve_states[(index, fname)] = st
+            self._serve_states.move_to_end((index, fname))
+            while len(self._serve_states) > self._serve_states_max:
+                self._serve_states.popitem(last=False)
         # The fresh tokens make older ledger entries moot for THIS frame's
         # precheck; the journals stay authoritative for any other state.
         with self._dirty_mu:

@@ -165,6 +165,7 @@ def recalc_frame_caches(frame) -> None:
             view.fragments[s].recalculate_cache()
 
 
+@lockcheck.guarded_class
 class StreamIngestor:
     """Staged, resumable columnar streaming ingest (transport-agnostic).
 
@@ -180,6 +181,12 @@ class StreamIngestor:
     CRC is checked against the declared one and the ``complete`` hook
     runs (rank-cache recalculation).
     """
+
+    # Lockset race detector declaration: the transfer table (offsets,
+    # running CRCs, busy flags) is written by concurrent chunk uploads;
+    # the in-place dict mutations are covered by the static
+    # guarded-fields rule, a rebind by the runtime lockset check.
+    _guarded_by_ = {"_transfers": "ingest.stream._mu"}
 
     def __init__(self, apply: Callable, complete: Optional[Callable] = None,
                  stats=None, max_transfers: int = 256,
@@ -304,8 +311,18 @@ class StreamIngestor:
         return {"staged": st["off"], "done": done, "ops": st["ops"]}
 
 
+@lockcheck.guarded_class
 class WriteQueue:
     """Rotating-leader group commit (no dedicated thread, no idle timer)."""
+
+    # Lockset race detector declarations: leadership rotation state and
+    # the batch telemetry move under the queue lock (the `_cv` wraps
+    # the same ``ingest._mu`` lock object).
+    _guarded_by_ = {
+        "_committing": "ingest._mu",
+        "stat_batches": "ingest._mu",
+        "stat_items": "ingest._mu",
+    }
 
     def __init__(self, apply_batch: Callable[[Sequence], list], max_batch: int = 4096):
         self._apply = apply_batch

@@ -25,6 +25,7 @@ from pilosa_tpu.analysis import lockcheck
 
 _lock = lockcheck.named_lock("native._lock")
 _lib: Optional[ctypes.CDLL] = None
+_lib_path_loaded: Optional[str] = None
 _tried = False
 
 
@@ -42,8 +43,17 @@ def _build() -> bool:
         return False
 
 
+def loaded_path() -> Optional[str]:
+    """Absolute path of the .so actually loaded (None = Python lanes).
+    The sanitizer gate asserts this matches the ASAN build it pointed
+    PILOSA_TPU_NATIVE_LIB at — a silent fallback would pass the suites
+    without sanitizing anything."""
+    load()
+    return _lib_path_loaded
+
+
 def load() -> Optional[ctypes.CDLL]:
-    global _lib, _tried
+    global _lib, _lib_path_loaded, _tried
     # Lock-free fast path: both fields are only ever set under _lock and
     # transition once (None -> value), so a stale read at worst takes the
     # locked slow path.  Per-op WAL encodes call this on the hot path.
@@ -55,12 +65,25 @@ def load() -> Optional[ctypes.CDLL]:
         _tried = True
         if os.environ.get("PILOSA_TPU_NO_NATIVE", "").lower() in ("1", "true", "yes"):
             return None
-        if not os.path.exists(_LIB_PATH) and not _build():
-            return None
+        # PILOSA_TPU_NATIVE_LIB points the bridge at an alternate build
+        # of the same ABI — the sanitizer gate runs the differential
+        # suites against the ASAN/UBSAN .so this way (native/Makefile
+        # `asan`/`ubsan` targets; tests/test_native_sanitized.py).  An
+        # explicit path is never auto-built: a missing file is a
+        # misconfiguration, not a cue to compile the default flavor.
+        lib_path = os.environ.get("PILOSA_TPU_NATIVE_LIB", "")
+        if lib_path:
+            if not os.path.exists(lib_path):
+                return None
+        else:
+            lib_path = _LIB_PATH
+            if not os.path.exists(lib_path) and not _build():
+                return None
         try:
-            lib = ctypes.CDLL(_LIB_PATH)
+            lib = ctypes.CDLL(lib_path)
         except OSError:
             return None
+        _lib_path_loaded = os.path.abspath(lib_path)
         u8p = ctypes.POINTER(ctypes.c_uint8)
         u32p = ctypes.POINTER(ctypes.c_uint32)
         u64p = ctypes.POINTER(ctypes.c_uint64)

@@ -187,6 +187,7 @@ class _Entry:
         self.nbytes = nbytes
 
 
+@lockcheck.guarded_class
 class QueryCache:
     """The byte-accounted, generation-validated query result LRU.
 
@@ -198,6 +199,22 @@ class QueryCache:
     denominator stays clean; writes, unparseable queries, and
     cluster-scope requests count as ``ineligible``.
     """
+
+    # Lockset race detector declarations: the store/canon LRUs and the
+    # byte/hit accounting all move under ``_mu`` — the request path is
+    # every HTTP handler thread at once, and a lost `bytes -=` is a
+    # permanently wrong eviction budget.
+    _guarded_by_ = {
+        "_store": "qcache._mu",
+        "_canon": "qcache._mu",
+        "bytes": "qcache._mu",
+        "hits": "qcache._mu",
+        "misses": "qcache._mu",
+        "bypasses": "qcache._mu",
+        "ineligible": "qcache._mu",
+        "evictions": "qcache._mu",
+        "stores": "qcache._mu",
+    }
 
     def __init__(
         self,

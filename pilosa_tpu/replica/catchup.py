@@ -180,7 +180,12 @@ class CatchupManager:
         # counts the identical answer as not applied.
         if write_not_applied(status, rheaders.get("Retry-After")):
             return False
-        g.applied_seq = max(g.applied_seq, rec.seq)
+        # Monotonic-max under the router's table lock: replay runs on
+        # the probe thread while handler threads note applied marks off
+        # live responses — an unguarded read-modify-write here can drop
+        # the higher mark (lockset-race declared on GroupState).
+        with self.router._mu:
+            g.applied_seq = max(g.applied_seq, rec.seq)
         self.stats.count("replica.replayed")
         return True
 

@@ -358,7 +358,11 @@ class ResyncManager:
                     json.dumps({"seq": seed_seq}).encode(), start_epoch,
                     timeout_s=self.locked_seed_s,
                 )
-                g.applied_seq = max(g.applied_seq, seed_seq)
+                # The sequencer lock serializes the seed against new
+                # writes, but applied_seq is TABLE state read by handler
+                # threads — the mark itself moves under router._mu.
+                with router._mu:
+                    g.applied_seq = max(g.applied_seq, seed_seq)
             with router._mu:
                 g.stale = False
             self.stats.count(f"replica.resync.{g.name}")

@@ -153,12 +153,31 @@ _HOP_HEADERS = frozenset(
 )
 
 
+@lockcheck.guarded_class
 class GroupState:
     """Router-side record of one serving group."""
 
     __slots__ = ("name", "base", "healthy", "inflight", "routed", "epoch",
                  "applied_seq", "caught_up", "stale", "suspect",
-                 "probe_delay", "probe_at")
+                 "probe_delay", "probe_at", "__weakref__")
+
+    # Lockset race detector declarations: the group table is written by
+    # HTTP handler threads (reads, writes), the probe thread, and the
+    # catch-up/resync/anti-entropy paths concurrently — every post-init
+    # write must hold the router's table lock.  (The sequencer lock
+    # alone is NOT enough: reads route off this state without it.)
+    _guarded_by_ = {
+        "healthy": "replica.router._mu",
+        "inflight": "replica.router._mu",
+        "routed": "replica.router._mu",
+        "epoch": "replica.router._mu",
+        "applied_seq": "replica.router._mu",
+        "caught_up": "replica.router._mu",
+        "stale": "replica.router._mu",
+        "suspect": "replica.router._mu",
+        "probe_delay": "replica.router._mu",
+        "probe_at": "replica.router._mu",
+    }
 
     def __init__(self, name: str, base: str):
         self.name = name
@@ -213,8 +232,13 @@ def _parse_group_spec(i: int, spec: str) -> GroupState:
     return GroupState(f"g{i}", spec)
 
 
+@lockcheck.guarded_class
 class ReplicaRouter:
     """HTTP front door fanning reads over replica serving groups."""
+
+    # The write-sequence high-water mark is part of the total order the
+    # sequencer lock defines; it must never be advanced outside it.
+    _guarded_by_ = {"write_seq": "replica.router._seq_mu"}
 
     def __init__(
         self,
@@ -383,25 +407,34 @@ class ReplicaRouter:
     def _note_epoch(self, g: GroupState, hdr: Optional[str]) -> None:
         """Track the group identity header; a changed epoch means the
         group restarted (in-memory generation vectors rebuilt) — counted
-        so dashboards can correlate it with that group's cold caches."""
+        so dashboards can correlate it with that group's cold caches.
+        Called from every forward path (handler threads, probe thread),
+        so the epoch write takes the table lock like any other
+        GroupState mutation."""
         if not hdr:
             return
-        if g.epoch is not None and g.epoch != hdr:
+        with self._mu:
+            bumped = g.epoch is not None and g.epoch != hdr
+            g.epoch = hdr
+        if bumped:
             self.stats.count("replica.epoch_bump")
-        g.epoch = hdr
 
     def _note_applied(self, g: GroupState, hdr: Optional[str]) -> None:
         """Passive lag tracking: every group response reports its
-        applied sequence high-water mark."""
+        applied sequence high-water mark.  The monotonic-max update is
+        a read-modify-write, so it must hold the table lock — two
+        concurrent responses would otherwise drop the higher mark."""
         if not hdr:
             return
         try:
             seq = int(hdr)
         except ValueError:
             return
-        g.applied_seq = max(g.applied_seq, seq)
+        with self._mu:
+            g.applied_seq = max(g.applied_seq, seq)
+            applied = g.applied_seq
         self.stats.gauge(
-            f"replica.lag.{g.name}", max(0, self.wal.last_seq - g.applied_seq)
+            f"replica.lag.{g.name}", max(0, self.wal.last_seq - applied)
         )
 
     def healthy_count(self) -> int:
@@ -646,7 +679,8 @@ class ReplicaRouter:
                     if out[0] >= 500:
                         ambiguous = True
                     continue
-                g.applied_seq = max(g.applied_seq, seq)
+                with self._mu:
+                    g.applied_seq = max(g.applied_seq, seq)
                 if out[0] < 300:
                     applied += 1
                     if first_ok is None:
@@ -918,10 +952,11 @@ class ReplicaRouter:
                 # fresh incarnation reports where its persisted state
                 # actually stands, which may be BEHIND what the router
                 # remembered of its predecessor.
-                g.applied_seq = int(reported)
+                with self._mu:
+                    g.applied_seq = int(reported)
                 self.stats.gauge(
                     f"replica.lag.{g.name}",
-                    max(0, self.wal.last_seq - g.applied_seq),
+                    max(0, self.wal.last_seq - int(reported)),
                 )
             if g.suspect:
                 # The group 4xx'd a write a sibling applied: content

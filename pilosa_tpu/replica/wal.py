@@ -84,6 +84,7 @@ def _decode_payload(payload: bytes) -> tuple[int, dict, bytes]:
     return seq, meta, payload[_HEAD.size + meta_len :]
 
 
+@lockcheck.guarded_class
 class WriteAheadLog:
     """Append-only, checksummed, compactable write log.
 
@@ -92,6 +93,24 @@ class WriteAheadLog:
     for routers configured without ``[replica] wal-dir`` (and the unit
     the tests exercise without touching disk).
     """
+
+    # Lockset race detector declarations: the record index and the file
+    # handle move under ``_mu`` (appenders, abort, compaction swap,
+    # close); the group-commit frontier state moves under the
+    # ``_sync_cv`` condition's lock (leader election, generation bumps).
+    # The compaction/fsync interplay here is exactly where the PR 7/8
+    # reviews found hand-caught races — now machine-checked.
+    _guarded_by_ = {
+        "last_seq": "replica.wal._mu",
+        "_offsets": "replica.wal._mu",
+        "_aborted": "replica.wal._mu",
+        "_mem_frames": "replica.wal._mu",
+        "_end_off": "replica.wal._mu",
+        "_f": "replica.wal._mu",
+        "_synced_off": "replica.wal._sync_cv",
+        "_syncing": "replica.wal._sync_cv",
+        "_file_gen": "replica.wal._sync_cv",
+    }
 
     def __init__(self, path: Optional[str] = None, fsync: bool = True,
                  max_bytes: int = 64 << 20, stats=None, faults=None):

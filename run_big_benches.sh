@@ -19,9 +19,18 @@ OUT=big_bench_results.jsonl
 # PREFLIGHT: the invariant linter must be clean before burning bench
 # hours — a stale counters registry or a new untagged finding means the
 # tree is mid-change and the run's telemetry names may not match
-# COUNTERS.md.  Fails fast with the linter's own report.
+# COUNTERS.md.  Covers all generation-2 rules too (guarded-fields,
+# native-abi, stale-suppression).  Fails fast with the linter's report.
 if ! python -m pilosa_tpu.analysis; then
   echo "pilosa_tpu.analysis preflight failed; fix/tag findings first" >&2
+  exit 1
+fi
+# PREFLIGHT 2: the native boundary must be sanitizer-clean before the
+# writelane/ingest configs hammer it for an hour — build the ASAN+UBSAN
+# flavor and re-run the differential suites against it (the same leg
+# tier-1 runs; skips itself with a logged reason when no toolchain).
+if ! python -m pytest tests/test_native_sanitized.py -q -p no:cacheprovider; then
+  echo "sanitized native leg failed; fix the sanitizer findings first" >&2
   exit 1
 fi
 run() {

@@ -79,14 +79,17 @@ def _native_library_build():
 
 # -- runtime lock checker (pilosa_tpu/analysis/lockcheck.py) ----------------
 #
-# The tier-1 concurrency/replica/qos suites run with the lock checker
-# ON: every named lock created during these tests feeds the cross-thread
-# acquisition-order graph, blocking calls under a lock are caught, and a
-# test that recorded any violation FAILS with the checker's report.
-# Subprocess group workers inherit PILOSA_TPU_LOCK_CHECK=1 via the env
-# and self-enable at import (violations print to their stderr at exit).
+# The tier-1 concurrency/replica/qos/writelane suites run with the lock
+# checker ON: every named lock created during these tests feeds the
+# cross-thread acquisition-order graph, blocking calls under a lock are
+# caught, declared guarded fields (`_guarded_by_`) refine per-field
+# candidate locksets (the Eraser-style race detector), and a test that
+# recorded any violation FAILS with the checker's report.  Subprocess
+# group workers inherit PILOSA_TPU_LOCK_CHECK=1 via the env and
+# self-enable at import (violations print to their stderr at exit).
 
-_LOCKCHECK_MODULES = ("test_concurrency", "test_replica", "test_qos")
+_LOCKCHECK_MODULES = ("test_concurrency", "test_replica", "test_qos",
+                      "test_writelane")
 
 
 def _lockcheck_wanted(item) -> bool:

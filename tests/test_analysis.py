@@ -1,10 +1,12 @@
-"""Tests for pilosa_tpu/analysis/: the five lint rules (golden firing +
+"""Tests for pilosa_tpu/analysis/: the lint rules (golden firing +
 passing fixtures each), suppression-comment and baseline round-trips,
 the counters-registry generation/drift check, the runtime lock checker
-(seeded order inversion, seeded blocking-under-lock, allowlists), the
-CLI, and the LIVE-TREE GATE — the tier-1 test that runs every pass over
-the real package and fails on new findings (the in-suite half of the CI
-wiring; run_big_benches.sh runs the same gate as a preflight).
+(seeded order inversion, seeded blocking-under-lock, allowlists, the
+generation-2 lockset race detector), the native-abi conformance gate,
+the stale-suppression sweep, the CLI, and the LIVE-TREE GATE — the
+tier-1 test that runs every pass over the real package and fails on new
+findings (the in-suite half of the CI wiring; run_big_benches.sh runs
+the same gate as a preflight).
 """
 
 import os
@@ -260,6 +262,291 @@ def test_deadline_propagation_passes_forwarded(tmp_path):
     assert _new(_run(root, ("deadline-propagation",))) == []
 
 
+# -- rule 6: guarded-fields -------------------------------------------------
+
+
+_GUARDED_FIRING = {
+    "mod.py": """
+    from pilosa_tpu.analysis import lockcheck
+
+    class Store:
+        _guarded_by_ = {"table": "store._mu", "count": "store._mu"}
+
+        def __init__(self):
+            self._mu = lockcheck.named_lock("store._mu")
+            self.table = {}
+            self.count = 0
+
+        def racy_rebind(self):
+            self.count = self.count + 1
+
+        def racy_item(self, k, v):
+            self.table[k] = v
+
+        def racy_call(self, k):
+            self.table.pop(k, None)
+    """,
+}
+
+
+def test_guarded_fields_fires_on_unlocked_mutations(tmp_path):
+    root = _mkpkg(tmp_path, _GUARDED_FIRING)
+    fs = _new(_run(root, ("guarded-fields",)))
+    kinds = {(f.scope, f.message.split("this ")[1].split(" mutation")[0]) for f in fs}
+    assert ("Store.racy_rebind", "rebind") in kinds
+    assert ("Store.racy_item", "item") in kinds
+    assert ("Store.racy_call", "call") in kinds
+    assert len(fs) == 3  # __init__ writes are lifecycle-exempt
+
+
+def test_guarded_fields_passes_locked_and_locked_call_paths(tmp_path):
+    files = {
+        "mod.py": """
+        from pilosa_tpu.analysis import lockcheck
+
+        class Store:
+            _guarded_by_ = {"table": "store._mu"}
+
+            def __init__(self):
+                self._mu = lockcheck.named_lock("store._mu")
+                self.table = {}
+
+            def put(self, k, v):
+                with self._mu:
+                    self.table[k] = v
+
+            def _drop_locked(self, k):
+                # no acquisition here, but every caller path holds one
+                self.table.pop(k, None)
+
+            def drop(self, k):
+                with self._mu:
+                    self._drop_locked(k)
+
+            def open(self):
+                self.table = {}  # lifecycle-exempt
+
+            def _reset_from_open(self):
+                self.table = {}  # only reachable from open(): init phase
+
+        class NotDeclared:
+            def free(self):
+                self.table = {}
+        """,
+    }
+    root = _mkpkg(tmp_path, files)
+    # open() calls _reset_from_open through a non-stoplisted name
+    p = tmp_path / "pkg" / "mod.py"
+    p.write_text(p.read_text().replace(
+        "self.table = {}  # lifecycle-exempt",
+        "self.table = {}  # lifecycle-exempt\n        self._reset_from_open()",
+    ))
+    assert _new(_run(root, ("guarded-fields",))) == []
+
+
+# -- rule 7: native-abi ------------------------------------------------------
+
+
+_ABI_CPP_OK = """
+#include <cstdint>
+extern "C" {
+
+int64_t pn_write_batch(const char* src, int64_t len,
+                       const uint64_t* keys, int64_t* ns, int32_t wal_fd,
+                       int64_t* applied) {
+    (void)src; (void)keys; (void)ns; (void)applied; (void)wal_fd;
+    return len;
+}
+
+uint64_t pn_fnv1a64(const uint8_t* data, size_t len) { (void)data; return len; }
+
+}  // extern "C"
+
+// outside extern "C": never considered
+int64_t pn_internal_helper(int64_t x) { return x; }
+"""
+
+_ABI_PY_OK = """
+import ctypes
+
+def load():
+    lib = ctypes.CDLL("x.so")
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    lib.pn_write_batch.restype = ctypes.c_int64
+    lib.pn_write_batch.argtypes = [
+        ctypes.c_char_p, ctypes.c_int64,
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int32,
+        ctypes.POINTER(ctypes.c_int64),
+    ]
+    lib.pn_fnv1a64.restype = ctypes.c_uint64
+    lib.pn_fnv1a64.argtypes = [u8p, ctypes.c_size_t]
+    return lib
+"""
+
+
+def _mk_abi_tree(tmp_path, py_src=_ABI_PY_OK, cpp_src=_ABI_CPP_OK):
+    root = _mkpkg(tmp_path, {"native.py": py_src})
+    native_dir = tmp_path / "native"
+    native_dir.mkdir(exist_ok=True)
+    (native_dir / "pilosa_native.cpp").write_text(textwrap.dedent(cpp_src))
+    return root
+
+
+def test_native_abi_passes_conformant_fixture(tmp_path):
+    root = _mk_abi_tree(tmp_path)
+    assert _new(_run(root, ("native-abi",))) == []
+
+
+def test_native_abi_fails_mutated_write_batch_signature(tmp_path):
+    # The C side grows an argument (parse flags) — the Python table was
+    # not updated: the classic silent-drift-into-memory-corruption case.
+    mutated = _ABI_CPP_OK.replace(
+        "int64_t* ns, int32_t wal_fd,",
+        "int64_t* ns, int32_t wal_fd, int32_t flags,",
+    )
+    root = _mk_abi_tree(tmp_path, cpp_src=mutated)
+    fs = _new(_run(root, ("native-abi",)))
+    assert len(fs) == 1 and "arity mismatch" in fs[0].message
+    assert fs[0].scope == "pn_write_batch" and fs[0].path == "native.py"
+
+
+def test_native_abi_fails_width_mismatch_and_missing_symbol(tmp_path):
+    # wal_fd narrows to int32 on the C side while Python says 64-bit,
+    # and a declared function vanishes from the source entirely.
+    py = _ABI_PY_OK.replace("ctypes.c_int32,", "ctypes.c_int64,")
+    py = py.replace(
+        "    return lib",
+        "    lib.pn_vanished.restype = None\n"
+        "    lib.pn_vanished.argtypes = []\n"
+        "    return lib",
+    )
+    root = _mk_abi_tree(tmp_path, py_src=py)
+    msgs = [f.message for f in _new(_run(root, ("native-abi",)))]
+    assert any("width mismatch" in m and "pn_write_batch" in m for m in msgs)
+    assert any("missing symbol" in m and "pn_vanished" in m for m in msgs)
+
+
+def test_native_abi_real_tree_is_conformant():
+    """The real bridge (30 signatures incl. the 22-arg pn_write_batch)
+    against the real C++ and the built .so: zero issues.  Part of the
+    live gate too; asserted directly so a drift names the function."""
+    from pilosa_tpu.analysis import abi, rules
+
+    root = engine.package_root()
+    native_dir = os.path.join(os.path.dirname(root), "native")
+    cpp = os.path.join(native_dir, rules.NATIVE_CPP_NAME)
+    if not os.path.exists(cpp):
+        pytest.skip("no native source next to the package")
+    issues = abi.check_abi(
+        cpp, os.path.join(root, "native.py"),
+        so_path=os.path.join(native_dir, rules.NATIVE_SO_NAME),
+    )
+    assert issues == [], "\n".join(i.message for i in issues)
+    # The parser really covered the bridge (a regression that parses
+    # nothing would vacuously pass): every declared pn_* was matched.
+    decls = abi.parse_ctypes_decls(os.path.join(root, "native.py"))
+    assert len(decls) >= 20
+    assert "pn_write_batch" in decls and len(decls["pn_write_batch"][1]) == 23
+
+
+# -- rule 8: stale-suppression ----------------------------------------------
+
+
+def test_stale_suppression_fires_on_dead_and_unknown_tags(tmp_path):
+    root = _mkpkg(
+        tmp_path,
+        {"mod.py": """
+        def f():
+            try:
+                g()
+            # analysis-ok: exception-hygiene: live tag, still fires below
+            except Exception:
+                pass
+
+        # analysis-ok: exception-hygiene: nothing fires at this site
+        X = 1
+        # analysis-ok: no-such-rule: bogus rule name
+        Y = 2
+        """},
+    )
+    fs = _new(_run(root, ("exception-hygiene", "stale-suppression")))
+    assert len(fs) == 2
+    assert all(f.rule == "stale-suppression" for f in fs)
+    assert any("no longer matches any finding" in f.message for f in fs)
+    assert any("unknown rule `no-such-rule`" in f.message for f in fs)
+
+
+def test_stale_suppression_subset_run_spares_other_rules_tags(tmp_path):
+    # A lock-discipline-only run must not call a live exception-hygiene
+    # tag stale just because that rule didn't run.
+    root = _mkpkg(
+        tmp_path,
+        {"mod.py": """
+        def f():
+            try:
+                g()
+            # analysis-ok: exception-hygiene: live tag
+            except Exception:
+                pass
+        """},
+    )
+    assert _new(_run(root, ("lock-discipline", "stale-suppression"))) == []
+    # ...but the full run keeps it counted as USED, not stale.
+    assert _new(_run(root, ("exception-hygiene", "stale-suppression"))) == []
+
+
+def test_stale_suppression_empty_reason_tag_is_not_double_reported(tmp_path):
+    # An empty-reason tag does not suppress (the finding stays NEW) —
+    # but it is attached to a live finding, so the sweep must not ALSO
+    # call it stale.
+    root = _mkpkg(
+        tmp_path,
+        {"mod.py": """
+        def f():
+            try:
+                g()
+            # analysis-ok: exception-hygiene:
+            except Exception:
+                pass
+        """},
+    )
+    fs = _new(_run(root, ("exception-hygiene", "stale-suppression")))
+    assert [f.rule for f in fs] == ["exception-hygiene"]
+
+
+# -- deadline-propagation: replica forward paths ----------------------------
+
+
+def test_deadline_propagation_covers_replica_forwards(tmp_path):
+    root = _mkpkg(
+        tmp_path,
+        {"replica/router.py": """
+        class Router:
+            def route(self, g, body, deadline):
+                return self._forward(g, "POST", "/q", body, {})
+        """},
+    )
+    fs = _new(_run(root, ("deadline-propagation",)))
+    assert len(fs) == 1 and "._forward(...)" in fs[0].message
+
+
+def test_deadline_propagation_accepts_timeout_s_budget(tmp_path):
+    root = _mkpkg(
+        tmp_path,
+        {"replica/catchup.py": """
+        class Catchup:
+            def _replay_one(self, g, rec, timeout_s=None):
+                return self.router._forward(
+                    g, rec.method, rec.path, rec.body, {}, timeout_s=timeout_s
+                )
+
+            def drain(self, g, deadline):
+                return self._replay_one(g, None, timeout_s=deadline.remaining_s())
+        """},
+    )
+    assert _new(_run(root, ("deadline-propagation",))) == []
+
+
 # -- suppression + baseline round-trips ------------------------------------
 
 
@@ -470,6 +757,212 @@ def test_lockcheck_disabled_factories_are_plain():
     assert not lockcheck.enabled()
     assert type(lockcheck.named_lock("x")) is type(threading.Lock())
     assert isinstance(lockcheck.named_rlock("x"), type(threading.RLock()))
+
+
+# -- runtime lockset race detector (generation 2) ---------------------------
+
+
+def _spawn(fn):
+    t = threading.Thread(target=fn)
+    t.start()
+    t.join(timeout=10.0)
+    assert not t.is_alive()
+
+
+def test_lockset_detects_two_thread_unguarded_mutation(checker):
+    """The seeded race fixture: one thread writes under the declared
+    lock, a second writes with no lock — empty intersection, violation
+    with BOTH witness stacks."""
+
+    @lockcheck.guarded_class
+    class Shared:
+        _guarded_by_ = {"val": "t.mu"}
+
+        def __init__(self):
+            self.val = 0
+
+    mu = lockcheck.named_lock("t.mu")
+    s = Shared()
+
+    def locked_writer():
+        with mu:
+            s.val = 1
+
+    _spawn(locked_writer)
+    s.val = 2  # main thread, no lock held: the race
+    vs = lockcheck.take_violations()
+    assert len(vs) == 1 and vs[0].kind == "lockset-race"
+    assert "Shared.val" in vs[0].detail and "t.mu" in vs[0].detail
+    assert "first-witness" in vs[0].detail  # earliest recorded write stack
+    assert vs[0].stack  # the emptying write's stack
+
+
+def test_lockset_clean_when_every_write_holds_the_lock(checker):
+    @lockcheck.guarded_class
+    class Shared:
+        _guarded_by_ = {"val": "t.mu"}
+
+        def __init__(self):
+            self.val = 0
+
+    mu = lockcheck.named_lock("t.mu")
+    s = Shared()
+
+    def w():
+        with mu:
+            s.val += 1
+
+    for _ in range(3):
+        _spawn(w)
+    with mu:
+        s.val = 99
+    assert lockcheck.take_violations() == []
+
+
+def test_lockset_any_common_lock_suffices(checker):
+    """Eraser semantics: the candidate set is the INTERSECTION of held
+    locks — a consistent lock other than the declared one still means
+    no race (the declaration names the intent, the model checks mutual
+    exclusion)."""
+
+    @lockcheck.guarded_class
+    class Shared:
+        _guarded_by_ = {"val": "t.mu"}
+
+        def __init__(self):
+            self.val = 0
+
+    other = lockcheck.named_lock("t.other")
+    s = Shared()
+
+    def w():
+        with other:
+            s.val += 1
+
+    _spawn(w)
+    _spawn(w)
+    assert lockcheck.take_violations() == []
+
+
+def test_lockset_init_phase_single_thread_exempt(checker):
+    """Unlocked writes BEFORE the object is shared are the normal
+    construction pattern, never a violation; the lockset only starts
+    refining at the first second-thread write."""
+
+    @lockcheck.guarded_class
+    class Shared:
+        _guarded_by_ = {"val": "t.mu"}
+
+        def __init__(self):
+            self.val = 0
+
+    mu = lockcheck.named_lock("t.mu")
+    s = Shared()
+    s.val = 1  # still exclusive: fine without the lock
+    s.val = 2
+
+    def w():
+        with mu:
+            s.val = 3
+
+    _spawn(w)
+    with mu:
+        s.val = 4  # post-sharing writes hold the lock
+    assert lockcheck.take_violations() == []
+
+
+def test_lockset_post_sharing_unlocked_write_by_creator_is_caught(checker):
+    """The inverse of the init exemption: once a second thread writes,
+    the CREATOR loses its free pass too."""
+
+    @lockcheck.guarded_class
+    class Shared:
+        _guarded_by_ = {"val": "t.mu"}
+
+        def __init__(self):
+            self.val = 0
+
+    mu = lockcheck.named_lock("t.mu")
+    s = Shared()
+
+    def w():
+        with mu:
+            s.val = 1
+
+    _spawn(w)
+    _spawn(w)
+    s.val = 2  # creator, no lock, object is shared now
+    vs = lockcheck.take_violations()
+    assert len(vs) == 1 and vs[0].kind == "lockset-race"
+    # thread idents can be recycled between the two spawns, so only the
+    # floor is stable: the creator plus at least one worker
+    assert "threads observed" in vs[0].detail
+
+
+def test_lockset_instance_level_guarded_registration(checker):
+    class Plain:
+        pass
+
+    p = Plain()
+    lockcheck.guarded(p, "x", lock="t.mu")
+    p.x = 0
+
+    def w():
+        p.x = 1  # second thread, no lock
+
+    _spawn(w)
+    vs = lockcheck.take_violations()
+    assert len(vs) == 1 and "Plain.x" in vs[0].detail
+    # undeclared attributes on the same object stay untracked
+    lockcheck.reset()
+    p.y = 0
+    _spawn(lambda: setattr(p, "y", 1))
+    assert lockcheck.take_violations() == []
+
+
+def test_lockset_undeclared_fields_untracked_and_disable_restores(checker):
+    @lockcheck.guarded_class
+    class Shared:
+        _guarded_by_ = {"val": "t.mu"}
+
+        def __init__(self):
+            self.val = 0
+            self.free = 0
+
+    s = Shared()
+    _spawn(lambda: setattr(s, "free", 1))
+    s.free = 2
+    assert lockcheck.take_violations() == []
+    assert "__lockcheck_wrapped_setattr__" in Shared.__dict__
+    lockcheck.disable()
+    try:
+        assert "__lockcheck_wrapped_setattr__" not in Shared.__dict__
+        s.val = 5  # plain setattr again, nothing recorded
+        assert lockcheck.take_violations() == []
+    finally:
+        lockcheck.enable()  # the fixture's finally expects enabled state
+
+
+def test_lockset_real_tree_fragment_declares_guarded_state():
+    """The declarations this PR ships: the hot shared structures carry
+    _guarded_by_ maps naming their real locks (spot-check the contract
+    the conftest-gated suites run under)."""
+    from pilosa_tpu.core.fragment import Fragment
+    from pilosa_tpu.replica.router import GroupState, ReplicaRouter
+    from pilosa_tpu.replica.wal import WriteAheadLog
+    from pilosa_tpu.qcache import QueryCache
+    from pilosa_tpu.ingest import StreamIngestor, WriteQueue
+    from pilosa_tpu.executor import Executor
+
+    assert Fragment._guarded_by_["storage"] == "core.fragment._mu"
+    assert Fragment._guarded_by_["generation"] == "core.fragment._mu"
+    assert GroupState._guarded_by_["applied_seq"] == "replica.router._mu"
+    assert ReplicaRouter._guarded_by_["write_seq"] == "replica.router._seq_mu"
+    assert WriteAheadLog._guarded_by_["_synced_off"] == "replica.wal._sync_cv"
+    assert QueryCache._guarded_by_["_store"] == "qcache._mu"
+    assert StreamIngestor._guarded_by_["_transfers"] == "ingest.stream._mu"
+    assert WriteQueue._guarded_by_["_committing"] == "ingest._mu"
+    assert Executor._guarded_by_["_serve_states"] == "executor._matrix_mu"
 
 
 # -- the live-tree gate (CI smoke tier) ------------------------------------

@@ -1,0 +1,133 @@
+"""Sanitizer leg of the native-boundary conformance gate.
+
+Builds the ASAN+UBSAN flavor of the native library (``make -C native
+asan`` → ``libpilosa_native-asan.so``) and re-runs the differential
+suites — writelane, the native bridge (serve-pairs matcher included),
+streaming ingest, roaring, and the executor serve-lane tests — in a
+SUBPROCESS against it: ``PILOSA_TPU_NATIVE_LIB`` points the ctypes
+bridge at the sanitized build, and ``LD_PRELOAD`` puts the ASAN runtime
+first (plus ``libstdc++`` so the ``__cxa_throw`` interceptor can
+resolve before jaxlib's pybind modules load — gcc's libasan aborts
+otherwise).  A heap overflow, use-after-free, or UB in any
+pointer-arithmetic container path then fails this test with the
+sanitizer report instead of corrupting memory silently.
+
+Mirrors the conftest native-build contract: without a toolchain (or an
+ASAN runtime) the leg SKIPS with the reason logged, it never fails for
+environmental reasons.  ``PILOSA_TPU_NO_SAN_LEG=1`` opts out explicitly
+(e.g. inside the sanitized subprocess itself, or on memory-tight rigs).
+"""
+
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_NATIVE = os.path.join(_REPO, "native")
+_ASAN_SO = os.path.join(_NATIVE, "libpilosa_native-asan.so")
+
+# The differential selection re-run under the sanitizer.  Kept to the
+# suites that drive the native kernels hard but run in seconds: the
+# whole leg must fit tier-1's budget even at ASAN's ~2-4x slowdown.
+_SUITES = [
+    "tests/test_writelane.py",
+    "tests/test_native.py",
+    "tests/test_roaring.py",
+    "tests/test_ingest.py",
+    "tests/test_executor.py", "-k", "serve or flat",
+]
+
+
+def _skip(reason: str) -> None:
+    sys.stderr.write(f"\n[test_native_sanitized] skipping: {reason}\n")
+    pytest.skip(reason)
+
+
+def _resolve_runtime(lib: str) -> str:
+    """Real path of a gcc runtime library (``libasan.so`` prints as a
+    linker-script/symlink path; LD_PRELOAD needs the actual DSO)."""
+    out = subprocess.run(
+        ["g++", f"-print-file-name={lib}"], capture_output=True, text=True,
+        timeout=30,
+    )
+    path = out.stdout.strip()
+    if not path or path == lib or not os.path.exists(path):
+        return ""
+    return os.path.realpath(path)
+
+
+def test_differential_suites_pass_against_sanitized_so():
+    if os.environ.get("PILOSA_TPU_NO_SAN_LEG"):
+        _skip("PILOSA_TPU_NO_SAN_LEG set")
+    if os.environ.get("PILOSA_TPU_NO_NATIVE"):
+        _skip("PILOSA_TPU_NO_NATIVE set; nothing native to sanitize")
+    missing = [t for t in ("make", "g++", "nm") if shutil.which(t) is None]
+    if missing:
+        _skip(f"toolchain missing: {', '.join(missing)}")
+
+    # Build (or refresh) the sanitized flavor.
+    build = subprocess.run(
+        ["make", "-C", _NATIVE, "asan"],
+        capture_output=True, text=True, timeout=240,
+    )
+    if build.returncode != 0 or not os.path.exists(_ASAN_SO):
+        _skip(
+            "make asan failed (no ASAN-capable toolchain?): "
+            + (build.stderr or build.stdout)[-400:]
+        )
+
+    asan_rt = _resolve_runtime("libasan.so")
+    stdcxx_rt = _resolve_runtime("libstdc++.so.6")
+    if not asan_rt or not stdcxx_rt:
+        _skip("libasan/libstdc++ runtime not resolvable for LD_PRELOAD")
+
+    env = dict(os.environ)
+    env.update(
+        {
+            "PILOSA_TPU_NATIVE_LIB": _ASAN_SO,
+            "PILOSA_TPU_NO_SAN_LEG": "1",  # no recursion if selection grows
+            # libstdc++ first-loaded so ASAN's __cxa_throw interceptor
+            # resolves at init (jaxlib pybind throws during import).
+            "LD_PRELOAD": f"{asan_rt} {stdcxx_rt}",
+            # Python "leaks" by design; leak checking would drown real
+            # reports.  halt_on_error stays default-on for ASAN errors.
+            "ASAN_OPTIONS": "detect_leaks=0",
+            "UBSAN_OPTIONS": "print_stacktrace=1",
+            "JAX_PLATFORMS": "cpu",
+        }
+    )
+
+    # Preamble: prove the subprocess really serves from the sanitized
+    # .so — a silent fallback to the Python lanes (bad env path, load
+    # failure) would pass every suite while sanitizing nothing.
+    probe = subprocess.run(
+        [
+            sys.executable, "-c",
+            "from pilosa_tpu import native; p = native.loaded_path(); "
+            f"assert p == {_ASAN_SO!r}, f'loaded {{p}}'; print('sanitized-lib-ok')",
+        ],
+        capture_output=True, text=True, timeout=120, env=env, cwd=_REPO,
+    )
+    assert probe.returncode == 0 and "sanitized-lib-ok" in probe.stdout, (
+        "sanitized .so did not load in the subprocess:\n"
+        + probe.stdout[-800:] + probe.stderr[-1600:]
+    )
+
+    res = subprocess.run(
+        [
+            sys.executable, "-m", "pytest", *_SUITES,
+            "-q", "-m", "not slow",
+            "-p", "no:cacheprovider", "-p", "no:xdist", "-p", "no:randomly",
+        ],
+        capture_output=True, text=True, timeout=480, env=env, cwd=_REPO,
+    )
+    if res.returncode != 0:
+        tail = (res.stdout or "")[-4000:] + "\n" + (res.stderr or "")[-4000:]
+        pytest.fail(
+            "differential suites FAILED against the ASAN+UBSAN build "
+            f"(exit {res.returncode}) — sanitizer report / failures:\n{tail}",
+            pytrace=False,
+        )
