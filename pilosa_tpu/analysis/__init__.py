@@ -27,9 +27,18 @@ baseline grandfathers accepted pre-existing violations; ``# analysis-ok:
 8. stale-suppression — ``analysis-ok`` tags whose rule no longer fires
    at their site (the suppression set must not rot as code moves).
 
+Beside the lint rules, the DYNAMIC analysis lane (generation 3):
+``--explore`` drives the deterministic interleaving explorer
+(:mod:`.sched` + the scenario registry in :mod:`.scenarios`) —
+cooperative schedule control over real project code, exhaustive under
+a preemption bound, every failure replayable from a printed schedule
+string — and the replica write-protocol model / trace-conformance /
+linearizability checkers (:mod:`.spec`).
+
 This module stays import-light: serving modules import
-``pilosa_tpu.analysis.lockcheck`` at startup, so nothing here may pull
-in the linter machinery (or anything heavy) at import time.
+``pilosa_tpu.analysis.lockcheck`` (and the zero-cost
+``pilosa_tpu.analysis.spec`` event seam) at startup, so nothing here
+may pull in the linter machinery (or anything heavy) at import time.
 """
 
 from __future__ import annotations

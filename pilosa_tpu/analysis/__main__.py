@@ -5,6 +5,16 @@ findings (and print them).  ``--write-baseline`` grandfathers the
 current findings; ``--write-registry`` regenerates the counters
 registry (COUNTERS.md); ``--all`` lists every finding including the
 grandfathered ones.
+
+The interleaving-explorer lane (analysis/sched.py + spec.py):
+
+- ``--explore`` lists the scenario registry;
+- ``--explore <name|all>`` exhaustively explores one scenario (or the
+  whole live suite plus the protocol model check) under the preemption
+  bound (``--bound``) — exit 1 with printed schedule strings on any
+  violation;
+- ``--explore <name> --schedule <string>`` replays one serialized
+  schedule (the deterministic repro for a failure CI printed).
 """
 
 from __future__ import annotations
@@ -13,6 +23,57 @@ import argparse
 import sys
 
 from pilosa_tpu.analysis import engine, registry
+
+
+def _run_explore(name, schedule, bound) -> int:
+    from pilosa_tpu.analysis import sched, scenarios, spec
+
+    if not name:
+        print("explorer scenarios (see DEVELOPMENT.md):")
+        for sname, s in sorted(scenarios.SCENARIOS.items()):
+            tag = " [known-bug fixture]" if s.known_bug else ""
+            print(f"  {sname}{tag}")
+            if s.description:
+                first = s.description.strip().splitlines()[0].strip()
+                print(f"      {first}")
+        return 0
+
+    if schedule:
+        s = scenarios.get(name)
+        outcomes = sched.replay(s, schedule)
+        for o in outcomes:
+            print(o.describe())
+        if outcomes:
+            return 1
+        print(f"{name}: schedule {schedule} replayed clean")
+        return 0
+
+    targets = (
+        scenarios.live_scenarios() if name == "all" else [scenarios.get(name)]
+    )
+    rc = 0
+    for s in targets:
+        res = sched.explore(s, bound=bound)
+        print(res.describe())
+        if not res.ok:
+            rc = 1
+    if name == "all":
+        model = spec.model_check(n_groups=3, max_writes=2)
+        print(
+            f"replica-protocol model: {model.states} states explored, "
+            f"{len(model.violations)} violation(s)"
+        )
+        for v in model.violations:
+            print("  " + v)
+        if not model.ok:
+            rc = 1
+    if rc:
+        print(
+            "replay a failing schedule with: python -m pilosa_tpu.analysis "
+            "--explore <scenario> --schedule <string>",
+            file=sys.stderr,
+        )
+    return rc
 
 
 def main(argv=None) -> int:
@@ -26,9 +87,21 @@ def main(argv=None) -> int:
     p.add_argument("--write-baseline", action="store_true", help="grandfather the current findings and exit")
     p.add_argument("--write-registry", action="store_true", help="regenerate analysis/COUNTERS.md and exit")
     p.add_argument("--all", action="store_true", help="also list suppressed/baselined findings")
+    p.add_argument("--explore", nargs="?", const="", default=None,
+                   metavar="SCENARIO",
+                   help="interleaving explorer: list scenarios (no value), "
+                        "run one, or `all` for the live suite + model check")
+    p.add_argument("--schedule", default=None,
+                   help="with --explore <scenario>: replay this serialized "
+                        "schedule string")
+    p.add_argument("--bound", type=int, default=None,
+                   help="preemption bound for --explore (default: per-scenario)")
     args = p.parse_args(argv)
 
     root = args.root or engine.package_root()
+
+    if args.explore is not None:
+        return _run_explore(args.explore, args.schedule, args.bound)
 
     if args.write_registry:
         text = registry.generate_counters_registry(root)

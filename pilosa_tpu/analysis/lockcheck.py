@@ -334,6 +334,25 @@ _enabled = False
 _patched = False
 _orig: dict[str, object] = {}
 
+# Cooperative-scheduler seam (analysis/sched.py): while an exploration
+# run is active, the named factories delegate primitive construction to
+# the scheduler (so every lock/condition a scenario builds is a yield
+# point), guarded-field writes yield BEFORE the write lands (the
+# interleaving that loses an unlocked read-modify-write only exists if
+# control can change hands between the read and the write), and the
+# blocking-call patches yield at each crossing.  None = zero overhead.
+_sched = None
+
+
+def set_sched(hook) -> None:
+    """Install (or clear, with None) the active exploration scheduler."""
+    global _sched
+    _sched = hook
+
+
+def sched_hook():
+    return _sched
+
 
 def checker() -> _Checker:
     return _checker
@@ -415,13 +434,21 @@ class CheckedRLock(CheckedLock):
 
 def named_lock(name: str):
     """A mutex participating in the order/blocking checks when the
-    checker is enabled; a plain threading.Lock otherwise."""
+    checker is enabled; a plain threading.Lock otherwise.  Under an
+    active exploration run (analysis/sched.py) the scheduler supplies
+    the primitive so every acquisition is a controlled yield point."""
+    s = _sched
+    if s is not None:
+        return s.make_lock(name)
     if _enabled:
         return CheckedLock(name)
     return threading.Lock()
 
 
 def named_rlock(name: str):
+    s = _sched
+    if s is not None:
+        return s.make_rlock(name)
     if _enabled:
         return CheckedRLock(name)
     return threading.RLock()
@@ -431,6 +458,9 @@ def named_condition(name: str, lock=None):
     """A Condition whose underlying lock is checked when enabled.
     ``lock`` reuses an existing (possibly checked) lock, as in
     ``Condition(self._mu)``."""
+    s = _sched
+    if s is not None:
+        return s.make_condition(name, lock)
     if lock is not None:
         return threading.Condition(lock)
     if _enabled:
@@ -491,13 +521,21 @@ def _patch_guarded_class(cls) -> None:
     cls_name = cls.__name__
 
     def checked_setattr(self, name, value):
-        base_setattr(self, name, value)
         lock = decl.get(name)
         if lock is None and type(self) in _INSTANCE_GUARDED_TYPES:
             ig = _instance_guards.get(self)
             if ig is not None:
                 lock = ig.get(name)
         if lock is not None:
+            s = _sched
+            if s is not None:
+                # Exploration yield point BEFORE the write lands: the
+                # schedule that loses an unlocked read-modify-write
+                # needs a context switch between the read (already
+                # evaluated into ``value``) and this store.
+                s.field_write(self, cls_name, name)
+        base_setattr(self, name, value)
+        if lock is not None and _enabled:
             _checker.note_field_write(self, cls_name, name, lock)
 
     checked_setattr.__lockcheck_orig__ = own
@@ -525,7 +563,7 @@ def guarded_class(cls):
     before the guarded modules load)."""
     if cls not in _GUARDED_CLASSES:
         _GUARDED_CLASSES.append(cls)
-    if _enabled:
+    if _enabled or _sched is not None:
         _patch_guarded_class(cls)
     return cls
 
@@ -549,6 +587,9 @@ def guarded(obj, attr: str, lock: str) -> None:
 
 def _wrap_blocking(fn, kind):
     def wrapper(*a, **kw):
+        s = _sched
+        if s is not None:
+            s.blocking_point(kind)
         _checker.note_blocking(kind)
         return fn(*a, **kw)
 
@@ -586,6 +627,25 @@ def _unpatch() -> None:
             setattr(socket.socket, meth, orig)
     subprocess.Popen.__init__ = _orig.pop("subprocess.Popen.__init__")
     _patched = False
+
+
+def sched_instrument() -> None:
+    """Arm the seams an exploration run needs beyond the factories:
+    guarded-class __setattr__ interception (field-write yield points)
+    and the blocking-call patches.  Idempotent; shared with enable()."""
+    _patch()
+    for cls in _GUARDED_CLASSES:
+        _patch_guarded_class(cls)
+
+
+def sched_uninstrument() -> None:
+    """Undo sched_instrument() UNLESS the full checker holds the same
+    patches (enable() owns them then)."""
+    if _enabled:
+        return
+    _unpatch()
+    for cls in _GUARDED_CLASSES:
+        _unpatch_guarded_class(cls)
 
 
 # -- lifecycle -------------------------------------------------------------

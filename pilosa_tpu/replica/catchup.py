@@ -184,8 +184,12 @@ class CatchupManager:
         # the probe thread while handler threads note applied marks off
         # live responses — an unguarded read-modify-write here can drop
         # the higher mark (lockset-race declared on GroupState).
+        from pilosa_tpu.analysis import spec
+
         with self.router._mu:
             g.applied_seq = max(g.applied_seq, rec.seq)
+            spec.emit("apply", src=id(self.wal), group=g.name, seq=rec.seq,
+                      ok=status < 300, replay=True)
         self.stats.count("replica.replayed")
         return True
 

@@ -361,8 +361,12 @@ class ResyncManager:
                 # The sequencer lock serializes the seed against new
                 # writes, but applied_seq is TABLE state read by handler
                 # threads — the mark itself moves under router._mu.
+                from pilosa_tpu.analysis import spec
+
                 with router._mu:
                     g.applied_seq = max(g.applied_seq, seed_seq)
+                    spec.emit("seed", src=id(router.wal), group=g.name,
+                              epoch=g.epoch, value=g.applied_seq)
             with router._mu:
                 g.stale = False
             self.stats.count(f"replica.resync.{g.name}")

@@ -120,6 +120,7 @@ from typing import Optional
 from urllib.parse import parse_qs, urlparse
 
 from pilosa_tpu import qos
+from pilosa_tpu.analysis import spec
 from pilosa_tpu.qos import DEADLINE_HEADER
 from pilosa_tpu.replica import (
     APPLIED_SEQ_HEADER,
@@ -320,6 +321,11 @@ class ReplicaRouter:
             self.stats.gauge(f"replica.healthy.{g.name}", 1)
             self.stats.gauge(f"replica.inflight.{g.name}", 0)
             self.stats.gauge(f"replica.lag.{g.name}", 0)
+        # Protocol-trace conformance (analysis/spec.py): one event when
+        # a collector is installed, a None test otherwise.  The WAL's
+        # identity keys this router's sequence space in the trace.
+        spec.emit("config", src=id(self.wal),
+                  groups=[g.name for g in self.groups], quorum=self.quorum)
 
     # -- group table ------------------------------------------------------
 
@@ -353,6 +359,10 @@ class ReplicaRouter:
             g.routed += 1
             g.inflight += 1
             self.stats.gauge(f"replica.inflight.{g.name}", g.inflight)
+            # Emitted under _mu so the (group, applied) observation is
+            # consistent with the pick itself.
+            spec.emit("read", src=id(self.wal), group=g.name,
+                      applied=g.applied_seq)
         self.stats.count(f"replica.routed.{g.name}")
         return g
 
@@ -433,6 +443,8 @@ class ReplicaRouter:
         with self._mu:
             g.applied_seq = max(g.applied_seq, seq)
             applied = g.applied_seq
+            spec.emit("mark", src=id(self.wal), group=g.name,
+                      epoch=g.epoch, value=applied)
         self.stats.gauge(
             f"replica.lag.{g.name}", max(0, self.wal.last_seq - applied)
         )
@@ -660,6 +672,8 @@ class ReplicaRouter:
                     # group is loaded, not broken); the client retries.
                     self.wal.abort(seq)
                     self.stats.count("replica.write_shed")
+                    spec.emit("ack", src=id(self.wal), seq=seq,
+                              status=out[0], applied=0)
                     extra = {GROUP_HEADER: g.name}
                     ra = out[3].get("Retry-After")
                     if ra:
@@ -681,6 +695,8 @@ class ReplicaRouter:
                     continue
                 with self._mu:
                     g.applied_seq = max(g.applied_seq, seq)
+                spec.emit("apply", src=id(self.wal), group=g.name, seq=seq,
+                          ok=out[0] < 300)
                 if out[0] < 300:
                     applied += 1
                     if first_ok is None:
@@ -724,6 +740,8 @@ class ReplicaRouter:
                 # re-converges from the log.
                 self.stats.count("replica.write_fanout")
                 status, ctype, payload, _rh = first_ok or first_out
+                spec.emit("ack", src=id(self.wal), seq=seq, status=status,
+                          applied=applied)
                 result = (status, ctype, payload, {GROUP_HEADER: "all"})
             elif applied == 0 and deterministic_4xx is not None and not ambiguous:
                 # Every in-rotation group answered the same
@@ -732,6 +750,8 @@ class ReplicaRouter:
                 # answer.
                 self.wal.abort(seq)
                 status, ctype, payload, _rh = deterministic_4xx
+                spec.emit("ack", src=id(self.wal), seq=seq, status=status,
+                          applied=0)
                 result = (status, ctype, payload, {GROUP_HEADER: "all"})
             else:
                 # Reached some group but not a majority — or applied
@@ -748,6 +768,8 @@ class ReplicaRouter:
                 failed_names = ", ".join(
                     g.name for g in ready if g.applied_seq < seq
                 )
+                spec.emit("ack", src=id(self.wal), seq=seq, status=502,
+                          applied=applied)
                 result = self._partial_write(failed_names or "unknown")
         self._maybe_compact()
         return result
@@ -800,12 +822,17 @@ class ReplicaRouter:
             with self._mu:
                 tracked = [g for g in self.groups if not g.stale]
                 floors = list(self._resync_floor.values())
+                snapshot = {g.name: g.applied_seq for g in tracked}
             if not tracked and not floors:
+                spec.emit("compact_plan", src=id(self.wal),
+                          floor=self.wal.last_seq, tracked={}, floors=[])
                 self.wal.compact(self.wal.last_seq)
                 return
             min_applied = min(
                 [g.applied_seq for g in tracked] + floors
             )
+            spec.emit("compact_plan", src=id(self.wal), floor=min_applied,
+                      tracked=snapshot, floors=floors)
             self.wal.compact(min_applied)
             if self.wal.size_bytes <= self.wal.max_bytes:
                 return
@@ -954,6 +981,8 @@ class ReplicaRouter:
                 # remembered of its predecessor.
                 with self._mu:
                     g.applied_seq = int(reported)
+                    spec.emit("probe_mark", src=id(self.wal), group=g.name,
+                              epoch=g.epoch, value=int(reported))
                 self.stats.gauge(
                     f"replica.lag.{g.name}",
                     max(0, self.wal.last_seq - int(reported)),

@@ -57,6 +57,7 @@ from pilosa_tpu.analysis import lockcheck
 import zlib
 from typing import NamedTuple, Optional
 
+from pilosa_tpu.analysis import spec
 from pilosa_tpu.replica.faults import NOP_FAULTS
 from pilosa_tpu.stats import NOP_STATS
 
@@ -191,6 +192,13 @@ class WriteAheadLog:
         (the caller must refuse the write — nothing was sequenced)."""
         with self._mu:
             self.faults.hit("wal.append")
+            if self.path is not None and self._f is None:
+                # A file-backed log that was close()d must REFUSE, not
+                # silently buffer to memory: an append that returns a
+                # sequence promises a durable, replayable record (the
+                # interleaving explorer's append-vs-close scenario
+                # found the old fall-through losing the record).
+                raise OSError("write log is closed")
             seq = self.last_seq + 1
             frame = _encode(seq, {"m": method, "p": path_qs, "t": ctype}, body)
             off = self._end_off
@@ -203,6 +211,7 @@ class WriteAheadLog:
             self._offsets[seq] = (off, len(frame))
             self._end_off = off + len(frame)
             self.last_seq = seq
+            spec.emit("append", src=id(self), seq=seq)
         self._fsync_batched()
         self.stats.gauge("replica.wal_bytes", self.size_bytes)
         return seq
@@ -212,6 +221,8 @@ class WriteAheadLog:
         any commit, or failed on every group): replay skips it, so a
         recovering group converges to exactly what the live groups hold."""
         with self._mu:
+            if self.path is not None and self._f is None:
+                raise OSError("write log is closed")
             frame = _encode(seq, {"x": True}, b"")
             off = self._end_off
             if self._f is not None:
@@ -223,6 +234,7 @@ class WriteAheadLog:
             self._aborted.add(seq)
             self._offsets.pop(seq, None)
             self._end_off = off + len(frame)
+            spec.emit("abort", src=id(self), seq=seq)
         self._fsync_batched()
         self.stats.count("wal.aborted")
 
@@ -340,6 +352,7 @@ class WriteAheadLog:
                 self._end_off = pos
                 self._aborted = keep_aborted
                 freed = before - self._end_off
+                spec.emit("wal_compact", src=id(self), floor=min_applied)
             self.stats.gauge("replica.wal_bytes", self.size_bytes)
             if freed:
                 self.stats.count("wal.compactions")
@@ -428,6 +441,7 @@ class WriteAheadLog:
                         self._sync_cv.notify_all()
                 self._aborted = {s for s in self._aborted if s > min_applied}
                 freed = before - self._end_off
+                spec.emit("wal_compact", src=id(self), floor=min_applied)
         self.stats.gauge("replica.wal_bytes", self.size_bytes)
         if freed:
             self.stats.count("wal.compactions")

@@ -33,6 +33,17 @@ if ! python -m pytest tests/test_native_sanitized.py -q -p no:cacheprovider; the
   echo "sanitized native leg failed; fix the sanitizer findings first" >&2
   exit 1
 fi
+# PREFLIGHT 3: the interleaving-explorer scenario suite + the replica
+# write-protocol model check must pass before any bench run — the
+# recovery/resync/writelane configs hammer exactly the sequencer/WAL/
+# catch-up orderings the explorer covers, and a schedule-dependent bug
+# should fail HERE with a replayable schedule string, not corrupt an
+# hour of bench telemetry.  (Same lane as tier-1's test_sched gate.)
+if ! python -m pilosa_tpu.analysis --explore all; then
+  echo "interleaving explorer / protocol model preflight failed;" >&2
+  echo "replay the printed schedule: python -m pilosa_tpu.analysis --explore <scenario> --schedule <string>" >&2
+  exit 1
+fi
 run() {
   echo "=== $* $(date +%H:%M:%S)" >> $OUT
   timeout 3600 env "$@" python bench.py >> $OUT 2>>big_bench_errors.log

@@ -37,6 +37,13 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running sweep excluded from tier-1 (-m 'not slow')",
+    )
+
+
 def pytest_addoption(parser):
     parser.addoption(
         "--run-tpu",
@@ -79,17 +86,17 @@ def _native_library_build():
 
 # -- runtime lock checker (pilosa_tpu/analysis/lockcheck.py) ----------------
 #
-# The tier-1 concurrency/replica/qos/writelane suites run with the lock
-# checker ON: every named lock created during these tests feeds the
-# cross-thread acquisition-order graph, blocking calls under a lock are
-# caught, declared guarded fields (`_guarded_by_`) refine per-field
-# candidate locksets (the Eraser-style race detector), and a test that
-# recorded any violation FAILS with the checker's report.  Subprocess
-# group workers inherit PILOSA_TPU_LOCK_CHECK=1 via the env and
-# self-enable at import (violations print to their stderr at exit).
+# The tier-1 concurrency/replica/qos/writelane/ingest/qcache suites run
+# with the lock checker ON: every named lock created during these tests
+# feeds the cross-thread acquisition-order graph, blocking calls under a
+# lock are caught, declared guarded fields (`_guarded_by_`) refine
+# per-field candidate locksets (the Eraser-style race detector), and a
+# test that recorded any violation FAILS with the checker's report.
+# Subprocess group workers inherit PILOSA_TPU_LOCK_CHECK=1 via the env
+# and self-enable at import (violations print to their stderr at exit).
 
 _LOCKCHECK_MODULES = ("test_concurrency", "test_replica", "test_qos",
-                      "test_writelane")
+                      "test_writelane", "test_ingest", "test_qcache")
 
 
 def _lockcheck_wanted(item) -> bool:
@@ -122,5 +129,48 @@ def _lockcheck_gate(request):
             pytest.fail(
                 f"lock checker recorded {len(violations)} violation(s):\n\n"
                 + "\n\n".join(v.describe() for v in violations),
+                pytrace=False,
+            )
+
+
+# -- replica-protocol trace conformance (pilosa_tpu/analysis/spec.py) -------
+#
+# The fault-seam e2e suite (test_replica_recovery) runs with the
+# protocol event collector installed: every router/WAL/catch-up/resync
+# transition emits an event record (zero cost when the collector is
+# off), and at test teardown the recorded trace is validated against
+# the executable write-protocol model — sequence monotonicity, quorum
+# commits, tombstone/apply exclusion, per-epoch applied-mark
+# monotonic-max, compaction floors, read-your-writes.  A reordering bug
+# the assertions missed still fails the test with the exact protocol
+# violation.  (Subprocess group events are invisible — the trace covers
+# the in-process router side, which owns every invariant checked.)
+
+_SPEC_TRACE_MODULES = ("test_replica_recovery",)
+
+
+@pytest.fixture(autouse=True)
+def _spec_trace_gate(request):
+    item = request.node
+    try:
+        name = item.module.__name__ if item.module else ""
+    except Exception:
+        name = ""
+    if not any(name.startswith(m) for m in _SPEC_TRACE_MODULES):
+        yield
+        return
+    from pilosa_tpu.analysis import spec
+
+    events = spec.install_collector()
+    try:
+        yield
+    finally:
+        spec.uninstall_collector()
+        problems = spec.check_trace(events)
+        if problems:
+            pytest.fail(
+                "replica-protocol trace conformance: "
+                f"{len(problems)} violation(s) over {len(events)} event(s):\n"
+                + "\n".join("  " + p for p in problems),
                 pytrace=False,
             )
