@@ -2737,6 +2737,83 @@ def bench_qcache() -> dict:
         return {"trace_overhead": round(overhead, 4), "trace_ok": ok,
                 "trace_sampled": tracer.stat_sampled}
 
+    def costs_overhead_check() -> dict:
+        """In-run guard for the observability plane (PR 14): serving
+        with the dispatch meter + cost ledger armed AND a Prometheus
+        scrape every n/4 requests (a far harsher cadence than a real
+        15 s scrape interval) must cost <= 5% vs all of it disabled.
+        Same best-of-N / absolute-escape-hatch shape as the trace
+        check above."""
+        import tempfile
+
+        from pilosa_tpu import metrics as metrics_mod
+        from pilosa_tpu.costs import CostLedger
+        from pilosa_tpu.executor import ExecOptions
+        from pilosa_tpu.stats import ExpvarStatsClient
+        from pilosa_tpu.trace import Tracer
+
+        n = int(os.environ.get("BENCH_COSTS_ITERS", "1500" if smoke else "6000"))
+        scrape_every = max(1, n // 4)
+        with tempfile.TemporaryDirectory() as d:
+            h = Holder(d)
+            h.open()
+            h.create_index("q").create_frame("f", FrameOptions())
+            fr = h.index("q").frame("f")
+            rows = np.repeat(np.arange(8, dtype=np.uint64), 50)
+            fr.import_bits(rows, rng.integers(0, SLICE_WIDTH, size=len(rows)).astype(np.uint64))
+            q = pool[0]
+
+            ex_off = Executor(h, qcache=QueryCache(min_cost_ms=0.0))
+            stats = ExpvarStatsClient()
+            ledger = CostLedger(stats=stats)
+            tracer = Tracer(sample_rate=0.01, stats=stats, costs=ledger)
+            ex_on = Executor(h, qcache=QueryCache(min_cost_ms=0.0), stats=stats)
+            for _ in range(3):
+                ex_off.execute("q", q)
+                ex_on.execute("q", q)
+
+            def loop(metered: bool) -> float:
+                best = float("inf")
+                for _ in range(3):
+                    t0 = time.perf_counter()
+                    if metered:
+                        for _i in range(n):
+                            tr = tracer.begin(None)
+                            if tr is None:
+                                ex_on.execute("q", q)
+                            else:
+                                ex_on.execute(
+                                    "q", q, opt=ExecOptions(span=tr.root)
+                                )
+                                tracer.finish_request(
+                                    tr, name="bench", dt_ms=tr.root.finish().ms,
+                                    body=q.encode(),
+                                )
+                            if _i % scrape_every == scrape_every - 1:
+                                metrics_mod.parse_exposition(
+                                    metrics_mod.render(stats)
+                                )
+                    else:
+                        for _i in range(n):
+                            ex_off.execute("q", q)
+                    best = min(best, time.perf_counter() - t0)
+                return best
+
+            t_off = loop(False)
+            t_on = loop(True)
+            entries = len(ledger)
+            h.close()
+        overhead = t_on / t_off - 1.0
+        ok = overhead <= 0.05 or (t_on - t_off) / n <= 20e-6
+        assert ok, (
+            f"cost ledger + exposition cost {overhead * 100:.1f}% vs disabled "
+            f"(off {t_off / n * 1e6:.1f} us/req, on {t_on / n * 1e6:.1f} us/req) — "
+            "metering must stay a branch + a couple of dict ops per dispatch"
+        )
+        assert entries > 0, "cost ledger folded no traced requests"
+        return {"costs_overhead": round(overhead, 4), "costs_ok": ok,
+                "costs_entries": entries}
+
     # Two alternating passes per tier, best-of by ms/request: jit and
     # allocator caches are process-wide, so whichever tier runs first
     # pays residual one-time costs — best-of-two with alternation keeps
@@ -2748,8 +2825,9 @@ def bench_qcache() -> dict:
     on = min(ons, key=lambda r: r["ms_per_request"])
     off = min(offs, key=lambda r: r["ms_per_request"])
     trace_ab = trace_overhead_check()
+    costs_ab = costs_overhead_check()
     tiers = [
-        {"tier": "qcache_on", **{k: (round(v, 3) if isinstance(v, float) else v) for k, v in on.items()}, **trace_ab},
+        {"tier": "qcache_on", **{k: (round(v, 3) if isinstance(v, float) else v) for k, v in on.items()}, **trace_ab, **costs_ab},
         {"tier": "qcache_off", **{k: (round(v, 3) if isinstance(v, float) else v) for k, v in off.items()}},
     ]
     speedup = off["ms_per_request"] / on["ms_per_request"]

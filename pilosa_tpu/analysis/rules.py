@@ -235,6 +235,38 @@ def rule_stats_registry(files, root: str) -> list[Finding]:
                 "literal or f-string so the registry can document it",
             )
         )
+    # Exposition drift gate: /metrics names derive MECHANICALLY from
+    # these registry names (metrics.prom_name), so the only ways the
+    # exposition can drift from the registry are a registered series
+    # whose mangled form is not a valid Prometheus metric name, or two
+    # DISTINCT registered series colliding onto one mangled name.
+    from pilosa_tpu import metrics as metrics_mod
+
+    kinds_by_name: dict[str, str] = {}
+    for s in sites:
+        k = "counter" if s.kind == "count" else s.kind
+        # A name emitted as both a counter and something else maps with
+        # its counter suffix (_total widens the namespace, so prefer it
+        # for the collision check).
+        if kinds_by_name.get(s.name) != "counter":
+            kinds_by_name[s.name] = k
+    for a, b, prom in metrics_mod.registry_collisions(kinds_by_name):
+        if not b:
+            out.append(
+                Finding(
+                    "stats-registry", rel_reg, 1, "<exposition>",
+                    f"stats name `{a}` renders an invalid Prometheus "
+                    f"metric name `{prom}` at /metrics — rename the series",
+                )
+            )
+        else:
+            out.append(
+                Finding(
+                    "stats-registry", rel_reg, 1, "<exposition>",
+                    f"stats names `{a}` and `{b}` collide at /metrics as "
+                    f"`{prom}` — rename one of them",
+                )
+            )
     regenerated = regmod.render_registry(sites)
     if regenerated != committed:
         added = sorted(regmod.registered_names(regenerated) - names)

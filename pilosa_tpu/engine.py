@@ -45,10 +45,28 @@ _TREE_NP_OPS = {
 }
 
 
+def nbytes(*arrays) -> int:
+    """Total byte size of the given arrays (None entries skipped) — the
+    dispatch meter's operand/transfer accounting.  Works for numpy and
+    jax arrays alike (both expose .nbytes)."""
+    total = 0
+    for a in arrays:
+        if a is None:
+            continue
+        n = getattr(a, "nbytes", None)
+        if n is None:
+            n = getattr(a, "size", 0) * getattr(a, "itemsize", 0)
+        total += int(n)
+    return total
+
+
 class NumpyEngine:
     name = "numpy"
     # No jit: callers may use exact (ragged) dispatch shapes freely.
     wants_static_shapes = False
+    # Host == device on numpy: nothing ever crosses a transfer boundary,
+    # so the upload ledger stays at zero (class attr, never mutated).
+    stat_upload_bytes = 0
 
     def stack(self, rows: list[np.ndarray]) -> np.ndarray:
         return np.stack(rows) if rows else np.zeros((0, 0), dtype=np.uint32)
@@ -298,6 +316,11 @@ class JaxEngine:
 
         self._jnp = jnp
         self._dispatch = dispatch
+        # Running host->device transfer ledger (bytes), bumped at every
+        # upload seam (matrix/block/src uploads).  A plain int under the
+        # GIL; the executor's dispatch meter reads deltas around engine
+        # calls to attribute transfer bytes per dispatch.
+        self.stat_upload_bytes = 0
 
     def stack(self, rows: list[np.ndarray]):
         return self._jnp.asarray(np.stack(rows)) if rows else self._jnp.zeros((0, 0), dtype=self._jnp.uint32)
@@ -331,6 +354,7 @@ class JaxEngine:
     def matrix(self, host_matrix: np.ndarray):
         """One host→device transfer for an assembled row matrix, stored in
         canonical tiled form uint32[S, R, W/128, 128]."""
+        self.stat_upload_bytes += host_matrix.nbytes
         return self._jnp.asarray(self._tile_host(host_matrix))
 
     def gather_count_and(self, row_matrix, pairs) -> np.ndarray:
@@ -375,6 +399,7 @@ class JaxEngine:
         """Upload a ROW-MAJOR [R, S, W] host block in tiled form — the
         layout whose per-row bytes are one contiguous DMA descriptor
         (dispatch.gather_count_rowmajor)."""
+        self.stat_upload_bytes += host_matrix.nbytes
         return self._jnp.asarray(self._tile_host(host_matrix))
 
     def rowmajor_ok(self, n_slices: int, words: int, k: int = 2) -> bool:
@@ -448,7 +473,9 @@ class JaxEngine:
 
     def prepare_topn_src(self, src_stack: np.ndarray):
         """Upload a host [S, W] src stack once per TopN query (tiled)."""
-        return self._jnp.asarray(self._tile_host(np.ascontiguousarray(src_stack)))
+        src = np.ascontiguousarray(src_stack)
+        self.stat_upload_bytes += src.nbytes
+        return self._jnp.asarray(self._tile_host(src))
 
     def topn_scorer_counts(self, matrix, pos, src_dev) -> np.ndarray:
         """int32[S, K] candidate counts in one dispatch (fused Pallas
@@ -504,12 +531,15 @@ class JaxEngine:
     def tile_src(self, src_dense: np.ndarray):
         """Upload a dense [W] operand in the matrix-compatible tiled form
         (so kernels can pair it with rows sliced from a 4D matrix)."""
-        return self._jnp.asarray(self._tile_host(np.asarray(src_dense)))
+        src = np.asarray(src_dense)
+        self.stat_upload_bytes += src.nbytes
+        return self._jnp.asarray(self._tile_host(src))
 
     def _match_block(self, matrix, block):
         """Reshape a host [.., .., W] block to the matrix's storage form
         (tiled 4D matrices take [.., .., W/128, 128] blocks)."""
         block = np.asarray(block)
+        self.stat_upload_bytes += block.nbytes
         if matrix.ndim == block.ndim + 1:
             block = self._tile_host(block)
         return self._jnp.asarray(block)
@@ -722,6 +752,8 @@ class MeshEngine(JaxEngine):
         # even shards); ragged slice counts stay unsharded — correctness
         # first, placement when the shapes allow it.  Only stack_slices
         # routes here, so the leading axis is always the slice axis.
+        if isinstance(x, np.ndarray):
+            self.stat_upload_bytes += x.nbytes
         if x.ndim < 2 or x.shape[0] < 2 or x.shape[0] % self.mesh.n_devices:
             return self._jnp.asarray(x)
         from jax.sharding import NamedSharding, PartitionSpec as P

@@ -249,6 +249,7 @@ class Executor:
         repair_rows_max: Optional[int] = None,
         gram_rows_max: int = 0,
         qcache: Any = "env",
+        stats=None,
     ):
         self.holder = holder
         self.engine = new_engine(engine) if isinstance(engine, str) else engine
@@ -339,6 +340,17 @@ class Executor:
         if qcache == "env":
             qcache = qcache_mod.from_env()
         self.qcache = qcache
+        # Device-side cost attribution (costs.DispatchMeter): the engine
+        # dispatch seams — gram / gather / stream / native — emit
+        # per-dispatch wall time + transfer bytes as tagged histograms
+        # and, for traced requests, "device" child spans.  None (the
+        # default for directly-constructed executors) keeps every seam a
+        # single ``meter is None`` branch, the same contract as tracing.
+        self.meter = None
+        if stats is not None:
+            from pilosa_tpu import costs as costs_mod
+
+            self.meter = costs_mod.DispatchMeter(stats, engine=self.engine)
         if write_queue:
             from pilosa_tpu.ingest import WriteQueue
 
@@ -753,7 +765,17 @@ class Executor:
             raw = src.encode("utf-8")
         except UnicodeEncodeError:
             return None
-        res = frag.write_batch(raw, st["frame_b"], st["rowkey_b"], st["colkey_b"])
+        if self.meter is not None:
+            span = opt.span if opt is not None else None
+            with self.meter.measure("native", span) as d:
+                res = frag.write_batch(
+                    raw, st["frame_b"], st["rowkey_b"], st["colkey_b"]
+                )
+                d.add_bytes(len(raw))
+        else:
+            res = frag.write_batch(
+                raw, st["frame_b"], st["rowkey_b"], st["colkey_b"]
+            )
         if res is None:
             return None
         changed, types, rows, cols = res
@@ -903,11 +925,23 @@ class Executor:
                     with self._matrix_mu:
                         self._serve_states.pop((index, fname), None)
             if st is not None:
-                counts = native.serve_pairs(
-                    raw, st["frame_b"], st["allow_default"], st["rowkey_b"],
-                    st["rs"], st["ps"], st["gram"],
-                )
+                if self.meter is not None:
+                    with self.meter.measure("native", opt.span) as d:
+                        counts = native.serve_pairs(
+                            raw, st["frame_b"], st["allow_default"],
+                            st["rowkey_b"], st["rs"], st["ps"], st["gram"],
+                        )
+                        d.add_bytes(len(raw))
+                else:
+                    counts = native.serve_pairs(
+                        raw, st["frame_b"], st["allow_default"], st["rowkey_b"],
+                        st["rs"], st["ps"], st["gram"],
+                    )
                 if counts is not None:
+                    if opt.span is not None:
+                        # Frame attribution for the cost ledger: the
+                        # serve lane is single-frame by construction.
+                        opt.span.tags["frame"] = fname
                     # Guard: a concurrent invalidation/eviction during
                     # the GIL-released call may have removed the key.
                     # LRU maintenance under _matrix_mu like every other
@@ -2019,7 +2053,23 @@ class Executor:
         self, gk, op_idxs, matched, id_pos, matrix, static, gram, row_major=False
     ):
         """One fused dispatch for an (op, arity-bucket) call group; returns
-        the engine-native count array (fetch deferred to the caller)."""
+        the engine-native count array (fetch deferred to the caller).
+        Metered as the "gather" lane (cost attribution): dispatch wall
+        time + any host->device operand bytes the engine ledger sees."""
+        if self.meter is not None:
+            with self.meter.measure("gather"):
+                return self._group_counts_inner(
+                    gk, op_idxs, matched, id_pos, matrix, static, gram,
+                    row_major=row_major,
+                )
+        return self._group_counts_inner(
+            gk, op_idxs, matched, id_pos, matrix, static, gram,
+            row_major=row_major,
+        )
+
+    def _group_counts_inner(
+        self, gk, op_idxs, matched, id_pos, matrix, static, gram, row_major=False
+    ):
         op, kb = gk
         if isinstance(op, tuple):  # ("tree", K): nested expression trees
             k = op[1]
@@ -2114,6 +2164,13 @@ class Executor:
         block = self._densify_block(
             index, frame, view, chunk_slices, rows_sorted, row_major=row_major
         )
+        if self.meter is not None:
+            # Streaming lane: the chunk upload is the cost (the chunk's
+            # dispatches meter separately as "gather").
+            with self.meter.measure("stream"):
+                if row_major:
+                    return self.engine.matrix_rows(block)
+                return self.engine.matrix(block)
         if row_major:
             return self.engine.matrix_rows(block)
         return self.engine.matrix(block)
@@ -2202,7 +2259,14 @@ class Executor:
             gram = box.get("gram")
             if gram is None:
                 m = matrix if bucket == shape[1] else matrix[:, :bucket, :]
-                gram = self.engine.pair_gram(m)
+                if self.meter is not None:
+                    with self.meter.measure("gram") as d:
+                        gram = self.engine.pair_gram(m)
+                        if gram is not None:
+                            # The R^2 count matrix fetched to host.
+                            d.add_bytes(int(gram.nbytes))
+                else:
+                    gram = self.engine.pair_gram(m)
                 if gram is None:
                     box["hits"] = -(1 << 30)  # engine can't: stop re-checking
                     return None
@@ -2293,7 +2357,33 @@ class Executor:
         pool_gens = pool.gens
         if pool_gens is not None and pool_gens != gens:
             dirty = self._journal_dirty_rows(frags, pool_gens, gens)
-        return pool.acquire(sorted(want), gens, dirty_rows=dirty)
+        out = pool.acquire(sorted(want), gens, dirty_rows=dirty)
+        if self.meter is not None:
+            self._note_resident()
+        return out
+
+    def _note_resident(self) -> None:
+        """Gauge the HBM-resident working set (engine.hbm_bytes): the
+        pooled row matrices plus their cached Grams.  An estimate — a
+        concurrent eviction between snapshot and sum is acceptable for
+        a gauge."""
+        from pilosa_tpu.engine import nbytes as _nbytes
+
+        with self._matrix_mu:
+            pools = list(self._matrix_cache.values()) + list(
+                self._multi_matrix_cache.values()
+            )
+        total = 0
+        for p in pools:
+            m = getattr(p, "matrix", None)
+            if m is None and isinstance(p, tuple):
+                total += _nbytes(*[x for x in p if hasattr(x, "nbytes")])
+                continue
+            total += _nbytes(m)
+            box = getattr(p, "box", None)
+            if isinstance(box, dict):
+                total += _nbytes(box.get("gram"))
+        self.meter.resident(total)
 
     # -- call dispatch (executor.go:156-179) ------------------------------
 

@@ -201,6 +201,21 @@ class LockstepService:
             else None
         )
         self.engine = MeshEngine(devices if devices is not None else jax.devices())
+        # Observability plane: a real expvar registry (rank 0 serves it
+        # at /debug/vars and /metrics) plus the dispatch meter + cost
+        # ledger the full server carries, gated by PILOSA_TPU_COSTS like
+        # there.  Stats are rank-local TELEMETRY — never read back into
+        # control flow — so recording them on every rank cannot skew the
+        # SPMD total order.
+        from pilosa_tpu import costs as costs_mod
+        from pilosa_tpu.stats import ExpvarStatsClient
+
+        self.stats = ExpvarStatsClient()
+        self.costs = (
+            costs_mod.CostLedger(stats=self.stats)
+            if costs_mod.enabled_from_env()
+            else None
+        )
         # Query result cache, DETERMINISTIC variant: hit/miss must be a
         # pure function of replicated state (request strings + the
         # lockstep total order of writes), so every rank hits or misses
@@ -225,7 +240,10 @@ class LockstepService:
             if qcache_enabled
             else None
         )
-        self.executor = Executor(holder, engine=self.engine, qcache=qc)
+        self.executor = Executor(
+            holder, engine=self.engine, qcache=qc,
+            stats=self.stats if self.costs is not None else None,
+        )
         self.control_addr = control_addr
         self.http_addr = http_addr
         self._workers: list[socket.socket] = []
@@ -265,12 +283,13 @@ class LockstepService:
         # spans; workers count the flags (stat_traced).  Ctor args (the
         # CLI passes [trace] config) > env > off.
         if trace_sample_rate is None and trace_slow_ms is None:
-            self.tracer = trace_mod.from_env()
+            self.tracer = trace_mod.from_env(stats=self.stats, costs=self.costs)
         else:
             rate = trace_sample_rate if trace_sample_rate is not None else 0.0
             slow = trace_slow_ms if trace_slow_ms is not None else 0.0
             self.tracer = (
-                trace_mod.Tracer(sample_rate=rate, slow_ms=slow)
+                trace_mod.Tracer(sample_rate=rate, slow_ms=slow,
+                                 stats=self.stats, costs=self.costs)
                 if (rate > 0 or slow > 0)
                 else None
             )
@@ -852,23 +871,48 @@ class LockstepService:
 
                 body = json.dumps({"version": __version__}).encode()
             elif path == "/debug/vars":
-                # No expvar registry on the lockstep shell — the empty
-                # snapshot a stats-less full server would serve.
-                body = b"{}"
-            elif path == "/debug/traces":
+                body = json.dumps(svc.stats.snapshot()).encode()
+            elif path == "/metrics":
+                from pilosa_tpu import metrics as metrics_mod
+
+                body = metrics_mod.render(svc.stats).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", metrics_mod.CONTENT_TYPE)
+                self.send_header("Content-Length", str(len(body)))
+                self._group_header()
+                self.end_headers()
+                self.wfile.write(body)
+                return
+            elif path == "/debug/costs":
+                from pilosa_tpu import metrics as metrics_mod
+
                 params = parse_qs(parsed.query)
-                try:
-                    min_ms = float((params.get("min-ms") or ["0"])[0] or 0)
-                    limit = int((params.get("limit") or ["64"])[0] or 64)
-                except ValueError:
-                    status, body = 400, b'{"error": "bad min-ms/limit"}'
-                else:
-                    traces = (
-                        svc.tracer.traces_json(min_ms=min_ms, limit=limit)
-                        if svc.tracer is not None
-                        else []
-                    )
-                    body = json.dumps({"traces": traces}).encode()
+                limit = metrics_mod.clamp_int(
+                    (params.get("limit") or [None])[0], 0
+                )
+                body = json.dumps(
+                    svc.costs.snapshot(limit=limit)
+                    if svc.costs is not None
+                    else {"cap": 0, "alpha": 0.0, "entries": []}
+                ).encode()
+            elif path == "/debug/traces":
+                from pilosa_tpu import metrics as metrics_mod
+
+                params = parse_qs(parsed.query)
+                # Clamp instead of 400 — same contract as the full
+                # server's handler and the replica router.
+                min_ms = metrics_mod.clamp_float(
+                    (params.get("min-ms") or [None])[0], 0.0
+                )
+                limit = metrics_mod.clamp_int(
+                    (params.get("limit") or [None])[0], 64
+                )
+                traces = (
+                    svc.tracer.traces_json(min_ms=min_ms, limit=limit)
+                    if svc.tracer is not None
+                    else []
+                )
+                body = json.dumps({"traces": traces}).encode()
             else:
                 self.send_error(404)
                 return

@@ -119,6 +119,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 from urllib.parse import parse_qs, urlparse
 
+from pilosa_tpu import metrics as metrics_mod
 from pilosa_tpu import qos
 from pilosa_tpu.analysis import spec
 from pilosa_tpu.qos import DEADLINE_HEADER
@@ -239,7 +240,10 @@ class ReplicaRouter:
 
     # The write-sequence high-water mark is part of the total order the
     # sequencer lock defines; it must never be advanced outside it.
-    _guarded_by_ = {"write_seq": "replica.router._seq_mu"}
+    _guarded_by_ = {
+        "write_seq": "replica.router._seq_mu",
+        "_fleet_cache": "replica.router._fleet_mu",
+    }
 
     def __init__(
         self,
@@ -291,6 +295,12 @@ class ReplicaRouter:
         # Bound on one sweep's repair work under the sequencer lock.
         self.anti_entropy_budget_s = 30.0
         self._mu = lockcheck.named_lock("replica.router._mu")  # group table (health/inflight/epoch)
+        # /debug/fleet scrape cache: the last SUCCESSFUL per-group scrape
+        # keeps serving (stamped stale, with its age) while a group is
+        # down, so the fleet view degrades to partial instead of losing
+        # the dead group entirely.
+        self._fleet_mu = lockcheck.named_lock("replica.router._fleet_mu")
+        self._fleet_cache: dict[str, dict] = {}
         # Per-group compaction floors for in-flight resync rounds: the
         # handoff suffix past a round's seed sequence must stay
         # replayable until the round completes (guarded by _mu).
@@ -869,8 +879,15 @@ class ReplicaRouter:
         if method == "GET" and path == "/debug/vars":
             snap = self.stats.snapshot() if hasattr(self.stats, "snapshot") else {}
             return 200, "application/json", (json.dumps(snap) + "\n").encode(), {}
+        if method == "GET" and path == "/metrics":
+            return (
+                200, metrics_mod.CONTENT_TYPE,
+                metrics_mod.render(self.stats).encode(), {},
+            )
         if method == "GET" and path == "/debug/traces":
             return self._debug_traces(parse_qs(parsed.query))
+        if method == "GET" and path == "/debug/fleet":
+            return self._debug_fleet(parse_qs(parsed.query))
         if method == "GET" and path == "/replica/status":
             with self._mu:
                 table = [g.to_json() for g in self.groups]
@@ -930,15 +947,116 @@ class ReplicaRouter:
     def _debug_traces(self, params: dict):
         if self.tracer is None:
             return 200, "application/json", b'{"traces": []}\n', {}
-        try:
-            min_ms = float((params.get("min-ms") or ["0"])[0] or 0)
-            limit = int((params.get("limit") or ["64"])[0] or 64)
-        except ValueError:
-            return 400, "application/json", b'{"error": "bad min-ms/limit"}', {}
+        # Malformed/out-of-range filters clamp to defaults — a debug
+        # endpoint must answer, not 400 (same contract as the handler).
+        min_ms = metrics_mod.clamp_float((params.get("min-ms") or [None])[0], 0.0)
+        limit = metrics_mod.clamp_int((params.get("limit") or [None])[0], 64)
         payload = json.dumps(
             {"traces": self.tracer.traces_json(min_ms=min_ms, limit=limit)}
         ).encode()
         return 200, "application/json", payload, {}
+
+    # -- /debug/fleet: the cluster-wide observability view ----------------
+
+    def _scrape_group(self, base: str, timeout_s: float):
+        """One group scrape: /replica/health (authoritative liveness +
+        applied sequence) and /debug/vars (the group's own stats
+        snapshot).  Returns (scrape dict, None) on success or
+        (None, error string) when the health probe fails; a vars
+        failure degrades to health-only rather than failing the
+        scrape."""
+        out: dict = {}
+        try:
+            req = urllib.request.Request(base + "/replica/health", method="GET")
+            with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+                out["health"] = json.loads(resp.read() or b"{}")
+        except (urllib.error.URLError, OSError, ValueError) as e:
+            return None, f"health: {e}"
+        try:
+            req = urllib.request.Request(base + "/debug/vars", method="GET")
+            with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+                vars_snap = json.loads(resp.read() or b"{}")
+        except (urllib.error.URLError, OSError, ValueError) as e:
+            vars_snap = {}
+            out["varsError"] = str(e)
+        out["appliedSeq"] = out["health"].get("appliedSeq")
+        # Latency percentiles ride the group's qos.latency_ms.<class>
+        # histograms; the rest of the snapshot is served verbatim.
+        out["latencyMs"] = {
+            key.split("qos.latency_ms.", 1)[1]: val
+            for key, val in vars_snap.items()
+            if key.startswith("qos.latency_ms.") and isinstance(val, dict)
+        }
+        out["vars"] = vars_snap
+        return out, None
+
+    def _debug_fleet(self, params: dict):
+        """Aggregate every group's stats/health/applied-seq plus the
+        router's own WAL + resync/anti-entropy progress into one
+        cluster-wide JSON view.  A down group yields a PARTIAL entry:
+        the router-side table row, the error, and the last successful
+        scrape (if any) stamped with its age."""
+        timeout_s = metrics_mod.clamp_float(
+            (params.get("timeout-ms") or [None])[0], 750.0, lo=50.0, hi=10_000.0
+        ) / 1e3
+        now = time.time()
+        with self._mu:
+            table = {g.name: g.to_json() for g in self.groups}
+            floors = dict(self._resync_floor)
+        last = self.wal.last_seq
+        groups_out = []
+        scraped_ok = 0
+        for name, row in table.items():
+            entry = dict(row)
+            # Per-group WAL depth: committed records this group has not
+            # applied yet (what catch-up will replay to it).
+            entry["walDepth"] = max(0, last - entry["appliedSeq"])
+            scrape, err = self._scrape_group(entry["base"], timeout_s)
+            if scrape is not None:
+                scrape["scrapedAt"] = round(now, 3)
+                with self._fleet_mu:
+                    self._fleet_cache[name] = scrape
+                scraped_ok += 1
+            else:
+                entry["error"] = err
+                with self._fleet_mu:
+                    scrape = self._fleet_cache.get(name)
+            if scrape is not None:
+                entry["scrape"] = scrape
+                entry["scrapedAt"] = scrape["scrapedAt"]
+                entry["ageMs"] = round(max(0.0, (now - scrape["scrapedAt"]) * 1e3), 1)
+            else:
+                entry["scrape"] = None
+                entry["scrapedAt"] = None
+                entry["ageMs"] = None
+            entry["staleScrape"] = "error" in entry
+            groups_out.append(entry)
+        router_stats = (
+            self.stats.snapshot() if hasattr(self.stats, "snapshot") else {}
+        )
+        payload = {
+            "ts": round(now, 3),
+            "quorum": self.quorum,
+            "quorate": self.quorate(),
+            "writeSeq": self.write_seq,
+            "wal": {
+                "firstSeq": self.wal.first_seq,
+                "lastSeq": last,
+                "bytes": self.wal.size_bytes,
+                "durable": self.wal.path is not None,
+            },
+            "resyncFloors": floors,
+            # Router-side progress counters (resync/catch-up/anti-entropy
+            # rounds, divergence, fan-out outcomes) all live under the
+            # replica.* prefix.
+            "routerStats": {
+                k: v for k, v in router_stats.items()
+                if k.startswith("replica.")
+            },
+            "partial": scraped_ok < len(table),
+            "groups": groups_out,
+        }
+        return 200, "application/json", (json.dumps(payload) + "\n").encode(), {}
 
     # -- health probe + catch-up ------------------------------------------
 

@@ -68,6 +68,12 @@ VERSION = "0.1.0-tpu"
 
 PROTOBUF = "application/x-protobuf"
 
+# Tenant seed tag: the index an /index/<name>/... request addresses is
+# the per-tenant unit (ROADMAP multi-tenancy seam) — stamped on every
+# trace root so traces, slow-query log lines, and the cost ledger all
+# attribute to their tenant.
+_TENANT_RX = re.compile(r"^/index/([^/]+)")
+
 
 class HTTPError(Exception):
     def __init__(self, status: int, message: str):
@@ -90,7 +96,7 @@ class Handler:
     def __init__(self, holder, executor, cluster=None, host="", broadcaster=None, stats=None, client_factory=None,
                  admission=None, default_deadline_ms: float = 0.0, tracer=None,
                  group: str = "", applied_seq=None,
-                 ingest_chunk_bytes: int = 4 << 20):
+                 ingest_chunk_bytes: int = 4 << 20, costs=None):
         self.holder = holder
         self.executor = executor
         self.cluster = cluster
@@ -108,6 +114,9 @@ class Handler:
         # at all (embedders) — the server always passes one so the
         # X-Pilosa-Trace force override works without a restart.
         self.tracer = tracer
+        # Per-fingerprint cost ledger (costs.CostLedger), served at
+        # /debug/costs; None = ledger disabled (endpoint answers empty).
+        self.costs = costs
         # Replica serving-group identity ("name" or "name@epoch",
         # [replica] group): stamped on every response as X-Pilosa-Group
         # so the router can record which group answered and detect
@@ -172,6 +181,8 @@ class Handler:
             ("POST", re.compile(r"^/fragment/import-roaring$"), self.post_fragment_import_roaring),
             ("GET", re.compile(r"^/debug/vars$"), self.get_expvar),
             ("GET", re.compile(r"^/debug/traces$"), self.get_debug_traces),
+            ("GET", re.compile(r"^/debug/costs$"), self.get_debug_costs),
+            ("GET", re.compile(r"^/metrics$"), self.get_metrics),
             ("GET", re.compile(r"^/debug/pprof(?:/(?P<path>.*))?$"), self.get_pprof),
             ("POST", re.compile(r"^/debug/profile/start$"), self.post_profile_start),
             ("POST", re.compile(r"^/debug/profile/stop$"), self.post_profile_stop),
@@ -221,8 +232,19 @@ class Handler:
         )
         dt_ms = (time.perf_counter() - t0) * 1e3
         self._note_applied(headers, out)
+        # An UNSAMPLED request crossing slow-ms synthesizes a root-only
+        # trace inside finish_request; hand it the QoS class + tenant
+        # tags it never got from _dispatch_qos (computed only on the
+        # slow path — the fast path stays one comparison).
+        tags = None
+        if trace is None and tracer.slow_ms > 0.0 and dt_ms >= tracer.slow_ms:
+            tags = {"qos_class": qos.classify_request(method, path, body)}
+            tm = _TENANT_RX.match(path)
+            if tm is not None:
+                tags["tenant"] = tm.group(1)
         extra = tracer.finish_request(
-            trace, name=f"{method} {path}", dt_ms=dt_ms, body=body, status=out[0]
+            trace, name=f"{method} {path}", dt_ms=dt_ms, body=body,
+            status=out[0], tags=tags,
         )
         if extra:
             merged = dict(out[3]) if len(out) > 3 else {}
@@ -269,6 +291,14 @@ class Handler:
         """
         deadline = qos.deadline_from_headers(headers, self.default_deadline_ms)
         cls = qos.classify_request(method, path, body)
+        if span is not None:
+            # QoS class + per-index tenant seed tag: the multi-tenancy
+            # seam — every trace (and slow-query log line, which
+            # surfaces root tags flat) attributes to its tenant.
+            span.tags["qos_class"] = cls
+            tm = _TENANT_RX.match(path)
+            if tm is not None:
+                span.tags["tenant"] = tm.group(1)
         t0 = time.perf_counter()
         try:
             if self.admission is not None:
@@ -561,18 +591,42 @@ class Handler:
     def get_debug_traces(self, params=None, **kw):
         """Finished request traces, newest-first (bounded ring).
         ``?min-ms=`` filters by total duration, ``?limit=`` caps the
-        page (default 64)."""
+        page (default 64).  Malformed or out-of-range filter values
+        clamp to their defaults instead of 400ing — a debug endpoint a
+        dashboard polls must never fail on a mistyped filter."""
         if self.tracer is None:
             return self._json({"traces": []})
         params = params or {}
-        try:
-            min_ms = float(self._param(params, "min-ms", 0) or 0)
-            limit = int(self._param(params, "limit", 64) or 64)
-        except ValueError:
-            raise HTTPError(400, "bad min-ms/limit")
+        from pilosa_tpu import metrics as metrics_mod
+
+        min_ms = metrics_mod.clamp_float(self._param(params, "min-ms"), 0.0)
+        limit = metrics_mod.clamp_int(self._param(params, "limit"), 64, lo=0)
         return self._json(
             {"traces": self.tracer.traces_json(min_ms=min_ms, limit=limit)}
         )
+
+    def get_debug_costs(self, params=None, **kw):
+        """The per-fingerprint cost ledger (costs.CostLedger snapshot):
+        EWMA cost/bandwidth per (index, frame, fingerprint, lane),
+        highest cost first.  ``?limit=`` caps the page."""
+        from pilosa_tpu import metrics as metrics_mod
+
+        limit = metrics_mod.clamp_int(
+            self._param(params or {}, "limit"), 0, lo=0
+        )
+        if self.costs is None:
+            return self._json({"cap": 0, "alpha": 0.0, "entries": []})
+        return self._json(self.costs.snapshot(limit=limit))
+
+    def get_metrics(self, **kw):
+        """Prometheus text exposition of the whole stats registry
+        (metrics.render): every counter/gauge/histogram the expvar
+        client holds, names mapped mechanically from the COUNTERS.md
+        registry (the stats-registry analysis rule gates the mapping)."""
+        from pilosa_tpu import metrics as metrics_mod
+
+        text = metrics_mod.render(self.stats) if self.stats is not None else ""
+        return 200, metrics_mod.CONTENT_TYPE, text.encode("utf-8")
 
     def get_pprof(self, path="", params=None, **kw):
         """/debug/pprof with net/http/pprof semantics (handler.go:99):

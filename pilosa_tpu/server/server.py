@@ -79,6 +79,18 @@ class Server:
             if self.config.qcache_enabled
             else None
         )
+        # Device-side cost attribution + per-fingerprint cost ledger
+        # (costs.py): the meter instruments the executor's engine
+        # dispatch seams, the ledger folds finished traces and serves
+        # /debug/costs.  PILOSA_TPU_COSTS=0 disables both (the bench
+        # overhead gate's A/B lever).
+        from pilosa_tpu import costs as costs_mod
+
+        self.costs = (
+            costs_mod.CostLedger(stats=stats)
+            if costs_mod.enabled_from_env()
+            else None
+        )
         self.executor = Executor(
             self.holder,
             engine=self.config.engine,
@@ -95,6 +107,7 @@ class Server:
             # fragment pass + WAL append); opt out via env for A/B runs.
             write_queue=os.environ.get("PILOSA_TPU_WRITE_QUEUE", "1").lower()
             not in ("0", "false", "no"),
+            stats=stats if self.costs is not None else None,
         )
         self.broadcaster, self.receiver = self._build_broadcast()
         # Request-scoped span tracer ([trace] sample-rate / slow-ms /
@@ -103,7 +116,8 @@ class Server:
         # override (and the slow-query log, when slow-ms is set) live.
         from pilosa_tpu import trace as trace_mod
 
-        self.tracer = trace_mod.from_config(self.config, stats=stats)
+        self.tracer = trace_mod.from_config(self.config, stats=stats,
+                                            costs=self.costs)
         from pilosa_tpu.qos import CLASS_ADMIN, CLASS_READ, CLASS_WRITE, AdmissionController
 
         self.admission = AdmissionController(
@@ -145,6 +159,7 @@ class Server:
             # [ingest] chunk-bytes: the streaming bulk-ingest door's
             # per-chunk ceiling.
             ingest_chunk_bytes=self.config.ingest_chunk_bytes,
+            costs=self.costs,
         )
         self.syncer = HolderSyncer(
             self.holder, self.cluster, self.host, self.client_factory, stats=stats

@@ -58,7 +58,7 @@ class ExpvarStatsClient:
         self._gauges: dict[str, float] = {}
         self._sets: dict[str, str] = {}
         # Bounded reservoirs (RESERVOIR_CAP samples) + exact running
-        # metadata per series: [count, min, max] for histograms,
+        # metadata per series: [count, min, max, sum] for histograms,
         # [count, sum] for timings.
         self._histograms: dict[str, list[float]] = defaultdict(list)
         self._hist_meta: dict[str, list[float]] = {}
@@ -113,10 +113,11 @@ class ExpvarStatsClient:
             key = self._key(name)
             meta = self._hist_meta.get(key)
             if meta is None:
-                meta = self._hist_meta[key] = [0, value, value]
+                meta = self._hist_meta[key] = [0, value, value, 0.0]
             meta[0] += 1
             meta[1] = min(meta[1], value)
             meta[2] = max(meta[2], value)
+            meta[3] += value
             self._reservoir_add(self._histograms[key], meta[0], value)
 
     def set(self, name: str, value: str) -> None:
@@ -144,7 +145,7 @@ class ExpvarStatsClient:
                     # (p50/p95/p99 — the dashboard set, so consumers of
                     # e.g. qos.latency_ms.<class> never re-derive them
                     # from raw samples) read the bounded reservoir.
-                    n_total, lo, hi = self._hist_meta[name]
+                    n_total, lo, hi = self._hist_meta[name][:3]
                     s = sorted(vals)
                     out[name] = {
                         "count": int(n_total),
@@ -159,6 +160,42 @@ class ExpvarStatsClient:
                     n_total, total = self._timing_meta[name]
                     out[name + ".avg_ms"] = total / n_total * 1000
             return out
+
+    def snapshot_typed(self) -> dict:
+        """Kind-preserving snapshot for the Prometheus exposition
+        (metrics.py): /debug/vars' flat snapshot() merges counters,
+        gauges and sets into one dict, which cannot be mapped back to
+        Prometheus metric types mechanically — this keeps each family
+        separate.  Histogram entries carry the exact running
+        count/min/max/sum plus reservoir percentiles; timings carry
+        count/sum."""
+        with self._lock:
+            hists: dict = {}
+            for name, vals in self._histograms.items():
+                if vals:
+                    n_total, lo, hi, total = self._hist_meta[name]
+                    s = sorted(vals)
+                    hists[name] = {
+                        "count": int(n_total),
+                        "min": lo,
+                        "max": hi,
+                        "sum": total,
+                        "p50": s[len(s) // 2],
+                        "p95": s[min(len(s) - 1, int(len(s) * 0.95))],
+                        "p99": s[min(len(s) - 1, int(len(s) * 0.99))],
+                    }
+            timings = {
+                name: {"count": int(meta[0]), "sum": meta[1]}
+                for name, meta in self._timing_meta.items()
+                if meta[0]
+            }
+            return {
+                "counters": dict(self._counters),
+                "gauges": dict(self._gauges),
+                "sets": dict(self._sets),
+                "histograms": hists,
+                "timings": timings,
+            }
 
 
 class StatsdStatsClient:
@@ -235,6 +272,12 @@ class MultiStatsClient:
         for c in self.clients:
             if hasattr(c, "snapshot"):
                 return c.snapshot()
+        return {}
+
+    def snapshot_typed(self) -> dict:
+        for c in self.clients:
+            if hasattr(c, "snapshot_typed"):
+                return c.snapshot_typed()
         return {}
 
 

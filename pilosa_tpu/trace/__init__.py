@@ -220,12 +220,17 @@ class Tracer:
         ring: int = DEFAULT_RING,
         stats=None,
         rng: Optional[random.Random] = None,
+        costs=None,
     ):
         from pilosa_tpu.stats import NOP_STATS
 
         self.sample_rate = max(0.0, min(1.0, float(sample_rate)))
         self.slow_ms = max(0.0, float(slow_ms))
         self.stats = stats if stats is not None else NOP_STATS
+        # Per-fingerprint cost ledger (costs.CostLedger): every recorded
+        # trace folds into EWMA cost/bandwidth estimates keyed by
+        # (index, frame, fingerprint, lane).  None = ledger disabled.
+        self.costs = costs
         self._rng = rng if rng is not None else random.Random()
         self._mu = lockcheck.named_lock("trace._mu")
         self._ring: "deque[dict]" = deque(maxlen=max(1, int(ring)))
@@ -296,6 +301,8 @@ class Tracer:
         if tags:
             root.tags.update(tags)
         self.record(trace)
+        if self.costs is not None:
+            self.costs.fold(trace, dt_ms, body)
         if slow:
             self._log_slow(trace, dt_ms, body)
         if trace.propagate:
@@ -349,7 +356,7 @@ class Tracer:
 _TRACE_HEADER_L = TRACE_HEADER.lower()
 
 
-def from_config(cfg, stats=None) -> Tracer:
+def from_config(cfg, stats=None, costs=None) -> Tracer:
     """Build the server's tracer from Config ([trace] TOML +
     PILOSA_TPU_TRACE_* env, resolved by Config itself).  Always returns
     a Tracer: with the all-zero defaults only force-header requests
@@ -359,10 +366,11 @@ def from_config(cfg, stats=None) -> Tracer:
         slow_ms=getattr(cfg, "trace_slow_ms", 0.0),
         ring=getattr(cfg, "trace_ring", DEFAULT_RING),
         stats=stats,
+        costs=costs,
     )
 
 
-def from_env(stats=None) -> Optional[Tracer]:
+def from_env(stats=None, costs=None) -> Optional[Tracer]:
     """Env-only construction for direct embedders (the lockstep service
     when no ctor args are given); None when tracing is fully off so the
     service skips even the per-request header lookup."""
@@ -373,4 +381,5 @@ def from_env(stats=None) -> Optional[Tracer]:
     ring = int(os.environ.get("PILOSA_TPU_TRACE_RING", str(DEFAULT_RING)))
     if rate <= 0 and slow <= 0:
         return None
-    return Tracer(sample_rate=rate, slow_ms=slow, ring=ring, stats=stats)
+    return Tracer(sample_rate=rate, slow_ms=slow, ring=ring, stats=stats,
+                  costs=costs)

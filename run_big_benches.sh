@@ -44,6 +44,76 @@ if ! python -m pilosa_tpu.analysis --explore all; then
   echo "replay the printed schedule: python -m pilosa_tpu.analysis --explore <scenario> --schedule <string>" >&2
   exit 1
 fi
+# PREFLIGHT 4: the observability plane must scrape clean before an hour
+# of telemetry rides it — stand up a 3-group bench-shaped cluster with
+# one group DOWN, strict-parse every /metrics exposition (group + router)
+# and require /debug/fleet to serve a PARTIAL aggregate with the dead
+# group stamped stale.  Unparseable exposition or a fleet view that
+# drops the dead group fails here, not in the dashboard at hour two.
+if ! python - <<'PYEOF'
+import json, sys, tempfile, urllib.request
+
+from pilosa_tpu import metrics
+from pilosa_tpu.config import Config
+from pilosa_tpu.replica import ReplicaRouter
+from pilosa_tpu.server.server import Server
+from pilosa_tpu.stats import ExpvarStatsClient
+
+with tempfile.TemporaryDirectory() as tmp:
+    servers = []
+    for i in range(3):
+        cfg = Config(data_dir=f"{tmp}/g{i}", host="127.0.0.1:0",
+                     engine="numpy", stats="expvar", qcache_enabled=False,
+                     replica_group=f"g{i}")
+        srv = Server(cfg)
+        srv.open()
+        servers.append(srv)
+    router = ReplicaRouter(
+        [f"g{i}={s.host}" for i, s in enumerate(servers)],
+        probe_interval_s=0.1, stats=ExpvarStatsClient(),
+    ).serve()
+    base = f"http://127.0.0.1:{router.port}"
+    try:
+        def req(method, path, body=None):
+            rq = urllib.request.Request(base + path, data=body, method=method)
+            with urllib.request.urlopen(rq, timeout=30) as resp:
+                return resp.read()
+
+        req("POST", "/index/i", b"{}")
+        req("POST", "/index/i/frame/f", b"{}")
+        req("POST", "/index/i/query", b'SetBit(rowID=1, frame="f", columnID=1)')
+        req("POST", "/index/i/query", b'Count(Bitmap(rowID=1, frame="f"))')
+        # Strict-parse every exposition in the fleet: each group's and
+        # the router's own.
+        for s in servers:
+            fams = metrics.parse_exposition(
+                urllib.request.urlopen(
+                    f"http://{s.host}/metrics", timeout=30).read().decode())
+            assert fams, f"empty exposition from group {s.host}"
+        metrics.parse_exposition(req("GET", "/metrics").decode())
+        # Kill one group; the fleet view must degrade to PARTIAL with
+        # the dead group still present, stamped with its error.
+        servers[2].close()
+        fleet = json.loads(req("GET", "/debug/fleet?timeout-ms=300"))
+        assert len(fleet["groups"]) == 3, fleet
+        assert fleet["partial"] is True, "fleet view not marked partial"
+        dead = [g for g in fleet["groups"] if g["name"] == "g2"][0]
+        assert dead.get("error") and dead["staleScrape"], dead
+        live = [g for g in fleet["groups"] if g["name"] != "g2"]
+        assert all(g["scrape"] is not None for g in live), "live scrape missing"
+        print("observability preflight OK:",
+              sum(1 for g in fleet['groups'] if not g['staleScrape']),
+              "of 3 groups scraped live")
+    finally:
+        router.close()
+        for s in servers[:2]:
+            s.close()
+PYEOF
+then
+  echo "observability preflight failed: /metrics unparseable or /debug/fleet" >&2
+  echo "did not degrade to a partial aggregate; fix before burning bench hours" >&2
+  exit 1
+fi
 run() {
   echo "=== $* $(date +%H:%M:%S)" >> $OUT
   timeout 3600 env "$@" python bench.py >> $OUT 2>>big_bench_errors.log
@@ -103,7 +173,10 @@ run BENCH_CONFIG=qcache BENCH_ZIPF_S=0.0
 #    Tracing on/off A/B rides the qcache config (trace_overhead /
 #    trace_ok in the qcache_on tier): head sampling at 0.01 must stay
 #    within 5% of tracing disabled — bigger loop for a tighter bound.
-run BENCH_CONFIG=qcache BENCH_TRACE_ITERS=40000
+#    The observability-plane A/B rides the same config (costs_overhead /
+#    costs_ok): dispatch meter + cost ledger + a scrape every n/4
+#    requests must also stay within 5% of fully disabled.
+run BENCH_CONFIG=qcache BENCH_TRACE_ITERS=40000 BENCH_COSTS_ITERS=40000
 # 10) Request-lifecycle QoS under overload: a real HTTP server at 2x door
 #    capacity, QoS on (bounded admission + deadlines; shed 429s, p99 near
 #    presat) vs off (unbounded; p99 degrades with the queue).  The second
