@@ -30,6 +30,8 @@ RULES = (
     "deadline-propagation",
     "guarded-fields",
     "native-abi",
+    "global-mutable-state",
+    "check-then-act",
     "stale-suppression",
 )
 

@@ -582,6 +582,185 @@ def guarded(obj, attr: str, lock: str) -> None:
     guarded_class(cls)
 
 
+# -- named globals (registered module-level mutable state) -------------------
+#
+# GENERATION 3 — the sanctioned seam for module-level mutable state in
+# serving-reachable code (the free-threading readiness contract,
+# ROADMAP item 2).  A bare module-level memo dict relies on the GIL for
+# every one of its compound operations; the static
+# ``global-mutable-state`` rule (analysis/rules.py) flags those, and
+# this factory is the fix it points at:
+#
+#     _PARSE_MEMO = lockcheck.named_global("pql.parse_memo",
+#                                          max_entries=512)
+#
+# Each NamedGlobal is a bounded LRU mapping whose every mutation runs
+# under its own NAMED lock (so the order/blocking checks see it), is
+# registered in a process-wide registry (``named_globals()`` — the
+# debug inventory, and the /metrics publication seam), and feeds the
+# lockset race detector on every mutation: a future code path that
+# mutated the store without the named lock empties the per-(object,
+# field) candidate lockset exactly like an undisciplined guarded-field
+# write.  Under an active exploration run the memo BYPASSES itself
+# (every get is a miss, every put a no-op) so execution #1 and #N of a
+# scenario have identical yield structure — this is what retires the
+# PR 12 driver-thread warm-up workaround in analysis/scenarios.py.
+
+_named_globals: dict[str, "NamedGlobal"] = {}
+_named_globals_mu = threading.Lock()  # leaf: guards the registry dict only
+
+
+class _GlobalLock:
+    """The mutex inside a NamedGlobal.  Module-level globals are built
+    at import time — usually BEFORE enable() runs in a test process —
+    so unlike named_lock() this wrapper consults the enable state per
+    acquisition instead of freezing it at construction: the same
+    process-lifetime lock is invisible in production and fully checked
+    the moment the checker turns on."""
+
+    __slots__ = ("name", "_inner")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._inner = threading.Lock()
+
+    def __enter__(self):
+        self._inner.acquire()
+        if _enabled:
+            _checker.note_acquired(self.name)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        # Unconditional: note_released tolerates a name it never saw
+        # acquired (enable() flipping mid-hold must not strand a held
+        # entry on this thread).
+        _checker.note_released(self.name)
+        self._inner.release()
+
+
+class NamedGlobal:
+    """A registered, bounded, lock-named LRU — the only sanctioned
+    shape for module-level mutable state on serving paths.  Values are
+    computed OUTSIDE the lock by the caller (get -> miss -> compute ->
+    put), so a slow fill never serializes readers; the worst case of
+    two racing fills is a double compute with last-writer-wins, never
+    a torn structure."""
+
+    def __init__(self, name: str, max_entries: int = 256,
+                 max_key_len: int = 0):
+        self.name = name
+        self.max_entries = int(max_entries)
+        # 0 = unbounded; nonzero keys longer than this bypass the memo
+        # entirely (don't pin megabyte bodies).
+        self.max_key_len = int(max_key_len)
+        self._mu = _GlobalLock(name)
+        self._store: "dict" = {}
+        self.stat_hits = 0
+        self.stat_misses = 0
+        self.stat_evictions = 0
+        # Lockset-detector registration: a rebind of the store without
+        # the named lock is a violation like any guarded field.
+        guarded(self, "_store", lock=name)
+
+    def _note_mutation(self) -> None:
+        """Feed the lockset detector one store mutation (called with
+        ``self._mu`` held, so the candidate lockset always contains the
+        global's own name on disciplined paths)."""
+        if _enabled:
+            _checker.note_field_write(self, "NamedGlobal", "_store", self.name)
+
+    def _bypass(self, key) -> bool:
+        if _sched is not None:
+            return True  # exploration: identical structure every execution
+        return bool(self.max_key_len) and len(key) > self.max_key_len
+
+    def get(self, key, default=None):
+        if self._bypass(key):
+            return default
+        with self._mu:
+            try:
+                v = self._store.pop(key)
+            except KeyError:
+                self.stat_misses += 1
+                return default
+            self._store[key] = v  # re-insert = move to MRU end
+            self.stat_hits += 1
+            return v
+
+    def put(self, key, value) -> None:
+        if self._bypass(key):
+            return
+        with self._mu:
+            self._store.pop(key, None)
+            self._store[key] = value
+            while len(self._store) > self.max_entries:
+                self._store.pop(next(iter(self._store)))
+                self.stat_evictions += 1
+            self._note_mutation()
+
+    def clear(self) -> None:
+        with self._mu:
+            self._store.clear()
+            self._note_mutation()
+
+    def __len__(self) -> int:
+        with self._mu:
+            return len(self._store)
+
+    def __contains__(self, key) -> bool:
+        with self._mu:
+            return key in self._store
+
+    def stats_snapshot(self) -> dict:
+        with self._mu:
+            return {
+                "entries": len(self._store),
+                "max_entries": self.max_entries,
+                "hits": self.stat_hits,
+                "misses": self.stat_misses,
+                "evictions": self.stat_evictions,
+            }
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"<NamedGlobal {self.name} entries={len(self)}>"
+
+
+def named_global(name: str, max_entries: int = 256,
+                 max_key_len: int = 0) -> NamedGlobal:
+    """The registered-memo factory.  Idempotent per name (a module
+    re-import gets the SAME store back — registry identity is the
+    point); the first caller's bounds win."""
+    with _named_globals_mu:
+        g = _named_globals.get(name)
+        if g is None:
+            g = _named_globals[name] = NamedGlobal(
+                name, max_entries=max_entries, max_key_len=max_key_len
+            )
+        return g
+
+
+def named_globals() -> dict[str, NamedGlobal]:
+    """Snapshot of the registry: the process's full inventory of
+    sanctioned module-level mutable state (debug endpoints, tests)."""
+    with _named_globals_mu:
+        return dict(_named_globals)
+
+
+def publish_global_stats(stats) -> None:
+    """Fold every registered named-global's counters into a stats
+    client as gauges tagged ``global:<name>`` — the /metrics handlers
+    call this before rendering so memo behavior is scrapeable."""
+    gs = named_globals()
+    stats.gauge("analysis.globals.registered", len(gs))
+    for name in sorted(gs):
+        snap = gs[name].stats_snapshot()
+        g_stats = stats.with_tags(f"global:{name}")
+        g_stats.gauge("analysis.globals.entries", snap["entries"])
+        g_stats.gauge("analysis.globals.hits", snap["hits"])
+        g_stats.gauge("analysis.globals.misses", snap["misses"])
+        g_stats.gauge("analysis.globals.evictions", snap["evictions"])
+
+
 # -- blocking-call patches -------------------------------------------------
 
 

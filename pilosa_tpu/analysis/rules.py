@@ -1,11 +1,11 @@
-"""The project-invariant rules (generation 2: eight of them).
+"""The project-invariant rules (generation 3: ten of them).
 
 Each rule returns Finding objects; the engine applies suppressions,
 fingerprints, and the baseline.  See DEVELOPMENT.md ("Static analysis &
-concurrency checking" and "Race detection & native conformance") for
-the catalog and the rationale per rule.  (The ninth check,
-``stale-suppression``, lives in the engine itself: it needs the
-post-suppression state of every other rule's findings.)
+concurrency checking", "Race detection & native conformance", and
+"Free-threading readiness") for the catalog and the rationale per rule.
+(The eleventh check, ``stale-suppression``, lives in the engine itself:
+it needs the post-suppression state of every other rule's findings.)
 """
 
 from __future__ import annotations
@@ -43,6 +43,8 @@ def run_rule(rule: str, files, root: str) -> list[Finding]:
         "deadline-propagation": rule_deadline_propagation,
         "guarded-fields": rule_guarded_fields,
         "native-abi": rule_native_abi,
+        "global-mutable-state": rule_global_mutable_state,
+        "check-then-act": rule_check_then_act,
     }[rule]
     return fn(files, root)
 
@@ -691,4 +693,337 @@ def rule_native_abi(files, root: str) -> list[Finding]:
                 issue.message,
             )
         )
+    return out
+
+
+# -- 8/9. the GIL-dependence analyzer (generation 3) --------------------------
+#
+# Both hot lanes now do their heavy lifting GIL-released; the next
+# multiplier is free-threaded or multi-worker serving (ROADMAP item 2),
+# and that refactor is only safe once every place the code silently
+# relies on the GIL is found.  Two rules split the hazard space:
+#
+# ``global-mutable-state`` — a module-level container binding that some
+# function mutates at runtime has no lock contract at all: under the
+# GIL each individual dict op is atomic, free-threaded it is a torn
+# structure.  The fix the finding points at is the
+# ``lockcheck.named_global`` registered-memo seam (bounded, lock-named,
+# lockset-detector-fed), freezing the binding at import, or a reasoned
+# suppression.
+#
+# ``check-then-act`` — a compound test-then-use on SHARED state
+# (``if k in d: d[k]``, ``d.get(k)`` ... ``d[k] = ``, ``d.setdefault``,
+# ``self.f += 1``) is atomic only because the GIL never switches
+# threads mid-statement-pair.  Scope: functions reachable from the
+# handler/lockstep/router entry points through a chain that never
+# acquires a lock (the same name-based graph guarded-fields uses);
+# receivers limited to ``self.<attr>`` and module-level globals (locals
+# are thread-private by construction).
+#
+# Both rules share the documented over-approximation trades: name-based
+# reachability errs toward MORE findings (absorbed by suppressions);
+# the function-wide lock-acquisition check errs toward FEWER (a
+# function locking ANYTHING anywhere exempts all its shapes — the same
+# honesty trade as guarded-fields' lock-name shape matching).
+# ``self.stat_*`` read-modify-writes are exempt by convention: the
+# project's approximate counters lose increments under free threading,
+# never correctness, and the convention is inventoried in
+# DEVELOPMENT.md ("Free-threading readiness").
+
+# Entry files whose every function is a seed: each is executed by a
+# distinct thread population in a serving process (HTTP worker threads,
+# lockstep rank threads, router probe/forward threads).
+SERVING_ENTRY_FILES = ("server/handler.py", "parallel/service.py",
+                       "replica/router.py")
+
+_MUTABLE_FACTORIES = frozenset({
+    "dict", "list", "set", "bytearray", "OrderedDict", "defaultdict",
+    "deque", "Counter", "WeakKeyDictionary", "WeakValueDictionary",
+})
+
+
+def _serving_reachable(graph: CallGraph) -> set[tuple]:
+    """Forward-reachable set from every serving entry function, with
+    lifecycle methods excluded from the SEEDS (construction/open run
+    once on one thread) but not from traversal."""
+    seeds = []
+    for rel in SERVING_ENTRY_FILES:
+        seeds.extend(
+            f for f in graph.seeds_matching(rel, "")
+            if f.bare not in _LIFECYCLE_EXEMPT
+        )
+    if not seeds:
+        return set()
+    return graph.reachable_from(seeds)
+
+
+def _module_mutable_bindings(sf) -> dict[str, int]:
+    """Module-level ``name = <mutable container>`` bindings: dict/list/
+    set displays and comprehensions, and the stdlib container factory
+    calls.  A binding whose RHS is ``lockcheck.named_global(...)`` is
+    the sanctioned seam and is not a container display, so it never
+    becomes a candidate."""
+    out: dict[str, int] = {}
+    for stmt in sf.tree.body:
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+            tgt, value = stmt.targets[0], stmt.value
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            tgt, value = stmt.target, stmt.value
+        else:
+            continue
+        if not isinstance(tgt, ast.Name):
+            continue
+        mutable = isinstance(
+            value, (ast.Dict, ast.List, ast.Set, ast.DictComp, ast.ListComp,
+                    ast.SetComp)
+        )
+        if not mutable and isinstance(value, ast.Call):
+            fn = value.func
+            name = fn.id if isinstance(fn, ast.Name) else (
+                fn.attr if isinstance(fn, ast.Attribute) else ""
+            )
+            mutable = name in _MUTABLE_FACTORIES
+        if mutable:
+            out[tgt.id] = stmt.lineno
+    return out
+
+
+def _global_mutations(fn_node: ast.AST, names) -> list[tuple[str, str, int]]:
+    """(name, kind, lineno) for every runtime mutation of a module-level
+    binding inside one function body: item stores/deletes, in-place
+    mutator calls, and ``global``-declared rebinds/augments."""
+    declared_global: set[str] = set()
+    for sub in ast.walk(fn_node):
+        if isinstance(sub, ast.Global):
+            declared_global.update(n for n in sub.names if n in names)
+    hits: list[tuple[str, str, int]] = []
+
+    def bare(expr) -> str | None:
+        if isinstance(expr, ast.Subscript):
+            expr = expr.value
+        if isinstance(expr, ast.Name) and expr.id in names:
+            return expr.id
+        return None
+
+    for sub in ast.walk(fn_node):
+        if isinstance(sub, ast.Assign):
+            for tgt in sub.targets:
+                if isinstance(tgt, ast.Subscript):
+                    n = bare(tgt)
+                    if n:
+                        hits.append((n, "item", sub.lineno))
+                elif isinstance(tgt, ast.Name) and tgt.id in declared_global:
+                    hits.append((tgt.id, "rebind", sub.lineno))
+        elif isinstance(sub, ast.AugAssign):
+            if isinstance(sub.target, ast.Subscript):
+                n = bare(sub.target)
+                if n:
+                    hits.append((n, "item", sub.lineno))
+            elif (isinstance(sub.target, ast.Name)
+                  and sub.target.id in declared_global):
+                hits.append((sub.target.id, "rebind", sub.lineno))
+        elif isinstance(sub, ast.Delete):
+            for tgt in sub.targets:
+                if isinstance(tgt, ast.Subscript):
+                    n = bare(tgt)
+                    if n:
+                        hits.append((n, "item", sub.lineno))
+        elif isinstance(sub, ast.Call):
+            fn = sub.func
+            if isinstance(fn, ast.Attribute) and fn.attr in _MUTATOR_METHODS:
+                n = bare(fn.value)
+                if n:
+                    hits.append((n, "call", sub.lineno))
+    return hits
+
+
+def rule_global_mutable_state(files, root: str) -> list[Finding]:
+    graph = CallGraph(files)
+    reachable = _serving_reachable(graph)
+    out: list[Finding] = []
+    for sf in files:
+        if sf.rel.startswith("analysis/"):
+            continue
+        bindings = _module_mutable_bindings(sf)
+        if not bindings:
+            continue
+        # name -> first serving-reachable mutation (scope, kind, line)
+        witness: dict[str, tuple[str, str, int]] = {}
+        for key, info in sorted(graph.funcs.items()):
+            if info.rel != sf.rel or key not in reachable:
+                continue
+            for name, kind, lineno in _global_mutations(info.node, bindings):
+                if name not in witness:
+                    witness[name] = (info.scope, kind, lineno)
+        for name in sorted(witness):
+            scope, kind, lineno = witness[name]
+            out.append(
+                Finding(
+                    "global-mutable-state", sf.rel, bindings[name], "<module>",
+                    f"module-level mutable `{name}` is mutated at runtime "
+                    f"({kind} in {scope}:{lineno}, serving-reachable) with no "
+                    "lock contract — a free-threaded host tears it: freeze "
+                    "it at import, register it via lockcheck.named_global("
+                    "...), or tag why it is safe",
+                )
+            )
+    return out
+
+
+class _CheckThenActVisitor(ast.NodeVisitor):
+    """Scans ONE function body for compound test-then-use shapes on
+    shared receivers (``self.<attr>`` / module globals).  Nested defs
+    are their own call-graph nodes; their hits are deduped by line."""
+
+    def __init__(self, rel: str, scope: str, module_names, out: list):
+        self.rel = rel
+        self.scope = scope
+        self.module_names = module_names
+        self.out = out
+        self._gets: dict[str, int] = {}       # recv text -> first .get line
+        self._stores: dict[str, int] = {}     # recv text -> first d[k]= line
+
+    def _recv(self, expr) -> str | None:
+        """Shared-receiver filter: self.<attr> or a module-level name.
+        Lock-ish receivers are the serialization mechanism itself."""
+        if (
+            isinstance(expr, ast.Attribute)
+            and isinstance(expr.value, ast.Name)
+            and expr.value.id == "self"
+            and not _is_lockish_name(expr.attr)
+        ):
+            return f"self.{expr.attr}"
+        if isinstance(expr, ast.Name) and expr.id in self.module_names:
+            return expr.id
+        return None
+
+    def _flag(self, lineno: int, msg: str) -> None:
+        self.out.append(
+            Finding("check-then-act", self.rel, lineno, self.scope, msg)
+        )
+
+    def visit_If(self, node: ast.If) -> None:
+        test = node.test
+        if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+            test = test.operand
+        if (
+            isinstance(test, ast.Compare)
+            and len(test.ops) == 1
+            and isinstance(test.ops[0], (ast.In, ast.NotIn))
+        ):
+            recv = self._recv(test.comparators[0])
+            if recv is not None:
+                # Either branch acting on the tested receiver is the
+                # race: `if k in d: use d[k]` reads an entry a peer can
+                # delete; `if k not in d: d[k] = ...` double-fills.
+                hit = False
+                for stmt in node.body + node.orelse:
+                    for sub in ast.walk(stmt):
+                        if (
+                            isinstance(sub, ast.Subscript)
+                            and self._recv(sub.value) == recv
+                        ):
+                            hit = True
+                            break
+                    if hit:
+                        break
+                if hit:
+                    self._flag(
+                        node.lineno,
+                        f"membership test on `{recv}` guards a subscript "
+                        "use — the entry can appear/vanish between test "
+                        "and use without the GIL; hold a named lock "
+                        "across the pair (or use one atomic operation)",
+                    )
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        fn = node.func
+        if isinstance(fn, ast.Attribute):
+            recv = self._recv(fn.value)
+            if recv is not None:
+                if fn.attr == "setdefault":
+                    self._flag(
+                        node.lineno,
+                        f"`{recv}.setdefault(...)` on shared state — the "
+                        "default may be constructed and inserted twice "
+                        "free-threaded; hold a named lock across the "
+                        "lookup-or-create",
+                    )
+                elif fn.attr == "get":
+                    self._gets.setdefault(recv, node.lineno)
+        self.generic_visit(node)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Subscript):
+                recv = self._recv(tgt.value)
+                if recv is not None:
+                    self._stores.setdefault(recv, node.lineno)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        tgt = node.target
+        if isinstance(tgt, ast.Subscript):
+            recv = self._recv(tgt.value)
+            if recv is not None:
+                self._stores.setdefault(recv, node.lineno)
+        elif isinstance(tgt, ast.Attribute):
+            recv = self._recv(tgt)
+            # self.stat_* counters are approximate by convention
+            # (inventoried in DEVELOPMENT.md): a torn increment loses a
+            # count, never correctness.
+            if recv is not None and not tgt.attr.startswith("stat"):
+                self._flag(
+                    node.lineno,
+                    f"unlocked read-modify-write of shared `{recv}` — the "
+                    "load and store can interleave with another thread's "
+                    "free-threaded; hold a named lock (approximate stat_* "
+                    "counters are the documented exception)",
+                )
+        self.generic_visit(node)
+
+    def finish(self) -> None:
+        """Pair the recorded .get() probes with item stores on the same
+        receiver: the with_tags-style lazy-singleton shape."""
+        for recv, gline in sorted(self._gets.items()):
+            if recv in self._stores:
+                self._flag(
+                    gline,
+                    f"`{recv}.get(...)` at line {gline} paired with "
+                    f"`{recv}[...] = ` at line {self._stores[recv]} — the "
+                    "get-then-store races free-threaded (two threads both "
+                    "miss, both store); hold a named lock across the pair",
+                )
+
+
+def rule_check_then_act(files, root: str) -> list[Finding]:
+    graph = CallGraph(files)
+    reachable = _serving_reachable(graph)
+    out: list[Finding] = []
+    for sf in files:
+        if sf.rel.startswith("analysis/"):
+            continue
+        module_names = _module_mutable_bindings(sf)
+        raw: list[Finding] = []
+        seen_lines: set[int] = set()
+        for key, info in sorted(graph.funcs.items()):
+            if info.rel != sf.rel or key not in reachable:
+                continue
+            if info.bare in _LIFECYCLE_EXEMPT:
+                continue
+            if _acquires_lock(info.node):
+                # The function serializes SOMETHING itself; its compound
+                # shapes are assumed covered (documented fewer-findings
+                # trade — same shape-matching honesty as guarded-fields).
+                continue
+            v = _CheckThenActVisitor(sf.rel, info.scope, module_names, raw)
+            v.visit(info.node)
+            v.finish()
+        for f in raw:
+            # Nested defs re-walk enclosing statements: keep the first
+            # finding per line.
+            if f.line not in seen_lines:
+                seen_lines.add(f.line)
+                out.append(f)
     return out

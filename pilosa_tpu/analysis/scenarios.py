@@ -348,14 +348,15 @@ class _QcacheStoreVsWriteCtx:
     stored result under ANY interleaving is a read-your-writes break."""
 
     def __init__(self):
-        from pilosa_tpu import pql, qcache
+        from pilosa_tpu import qcache
+        # Warm the executor import on the driver thread: a first-thread
+        # import inside the reader would give execution #1 a different
+        # yield structure than #2..N.  The parse memo needs no warm-up
+        # anymore — a NamedGlobal bypasses itself under an active
+        # exploration run, so every execution takes the identical
+        # miss-parse path by construction.
         from pilosa_tpu.executor import DEFAULT_FRAME  # noqa: F401
 
-        # Warm the GLOBAL memos (parse cache, executor import) on the
-        # driver thread: a first-execution warmup inside the reader
-        # thread would give execution #1 a different yield structure
-        # than #2..N, breaking the determinism contract.
-        pql.parse_cached(_QUERY)
         self.frag = _FakeFragment()
         self.holder = _FakeHolder(self.frag)
         self.cache = qcache.QueryCache(min_cost_ms=0)

@@ -905,8 +905,10 @@ class Fragment:
             self._row_cache.pop(row_id, None)
             dropped = self._row_dev_cache.pop(row_id, None)
             if dropped is not None:
+                # analysis-ok: check-then-act: every caller holds fragment._mu (locked-suffix convention; the rule sees only function-local locks)
                 self._row_dev_cache_arrays -= len(dropped)
             self._checksums.pop(row_id // HASH_BLOCK_SIZE, None)
+            # analysis-ok: check-then-act: every caller holds fragment._mu (locked-suffix convention; the rule sees only function-local locks)
             cached = self._row_counts.get(row_id)
             if cached is not None:
                 rc = cached + delta
@@ -1085,6 +1087,7 @@ class Fragment:
 
     def _row_count_locked(self, row_id: int) -> int:
         """Cached row cardinality; sole owner of the count+store logic."""
+        # analysis-ok: check-then-act: every caller holds fragment._mu (locked-suffix convention; the rule sees only function-local locks)
         rc = self._row_counts.get(row_id)
         if rc is None:
             rc = self.storage.count_range(
@@ -1288,6 +1291,7 @@ class Fragment:
         out = []
         for bid in np.unique(block_ids):
             bid = int(bid)
+            # analysis-ok: check-then-act: _blocks runs only under fragment._mu (checksum() takes it; the rule sees only function-local locks)
             chk = self._checksums.get(bid)
             if chk is None:
                 block = positions[block_ids == bid]

@@ -68,6 +68,7 @@ class _Measure:
         self.dev_span = None
 
     def add_bytes(self, n: int) -> None:
+        # analysis-ok: check-then-act: _Measure is a per-request stack object; it never crosses threads
         self.extra_bytes += int(n)
 
     def __enter__(self) -> "_Measure":
@@ -121,6 +122,7 @@ class DispatchMeter:
         self.stats.gauge("engine.hbm_bytes", int(hbm_bytes))
 
 
+@lockcheck.guarded_class
 class CostLedger:
     """Bounded LRU of EWMA cost/bandwidth estimates keyed by
     (index, frame, fingerprint, lane) — the /debug/costs payload."""

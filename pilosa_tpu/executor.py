@@ -722,6 +722,7 @@ class Executor:
         if m is None:
             return None
         fname = m.group(1) or m.group(2) or m.group(3)
+        # analysis-ok: check-then-act: idempotent derived arm, identity-revalidated on every use; a double-arm is a wasted rebuild, last-writer-wins (free-threading move under a lane lock inventoried in DEVELOPMENT.md)
         st = self._writelane_arm.get((index, fname))
         if st is None or self.holder.index(index) is not st["idx_obj"]:
             self._writelane_arm.pop((index, fname), None)
@@ -841,6 +842,7 @@ class Executor:
         if m is None:
             return None
         name, k1, v1, fname, k2, v2 = m.groups()
+        # analysis-ok: check-then-act: idempotent derived arm, identity-revalidated on every use; a double-arm is a wasted rebuild, last-writer-wins (free-threading move under a lane lock inventoried in DEVELOPMENT.md)
         cached = self._fastwrite_cache.get((index, fname))
         if cached is None or self.holder.index(index) is not cached[0]:
             self._fastwrite_cache.pop((index, fname), None)  # no dead pins

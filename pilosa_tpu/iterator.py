@@ -51,6 +51,7 @@ class SliceIterator:
         if self._i >= len(self._rows):
             return None
         p = (int(self._rows[self._i]), int(self._cols[self._i]))
+        # analysis-ok: check-then-act: iterators are per-execution objects, owned by one thread
         self._i += 1
         return p
 
@@ -71,6 +72,7 @@ class RoaringIterator:
         if self._i >= len(self._positions):
             return None
         pos = int(self._positions[self._i])
+        # analysis-ok: check-then-act: iterators are per-execution objects, owned by one thread
         self._i += 1
         return pos // SLICE_WIDTH, pos % SLICE_WIDTH
 

@@ -513,6 +513,7 @@ class Bitmap:
         added_groups = []
         for key, group in zip(uniq_keys.tolist(), groups):
             lows = (group & np.uint64(0xFFFF)).astype(np.uint32)
+            # analysis-ok: check-then-act: Bitmap is externally synchronized (Roaring-library contract): every mutating call site holds the owning fragment's _mu
             c = self.containers.get(key)
             if c is None:
                 self.containers[key] = Container.from_values(lows)
@@ -574,14 +575,17 @@ class Bitmap:
             self.op_writer.write(
                 b"".join(encode_op(OP_ADD, int(v)) for v in added)
             )
+            # analysis-ok: check-then-act: Bitmap is externally synchronized (Roaring-library contract): every mutating call site holds the owning fragment's _mu
             self.op_n += len(added)
             return
         types = np.zeros(len(added), dtype=np.uint8)  # OP_ADD
         self.op_writer.write(native.oplog_encode(types, added))
+        # analysis-ok: check-then-act: Bitmap is externally synchronized (Roaring-library contract): every mutating call site holds the owning fragment's _mu
         self.op_n += len(added)
 
     def _container_for(self, v: int) -> Container:
         key = highbits(v)
+        # analysis-ok: check-then-act: Bitmap is externally synchronized (Roaring-library contract): every mutating call site holds the owning fragment's _mu
         c = self.containers.get(key)
         if c is None:
             c = Container()
@@ -970,6 +974,7 @@ class Bitmap:
                 c = self.containers.get(highbits(value))
                 if c is not None and c.remove(lowbits(value)) and c.n == 0:
                     del self.containers[highbits(value)]
+            # analysis-ok: check-then-act: Bitmap is externally synchronized (Roaring-library contract): every mutating call site holds the owning fragment's _mu
             self.op_n += 1
 
     @classmethod

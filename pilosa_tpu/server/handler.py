@@ -624,7 +624,12 @@ class Handler:
         client holds, names mapped mechanically from the COUNTERS.md
         registry (the stats-registry analysis rule gates the mapping)."""
         from pilosa_tpu import metrics as metrics_mod
+        from pilosa_tpu.analysis import lockcheck
 
+        if self.stats is not None:
+            # Refresh the named-global gauges (parse memo & friends) at
+            # scrape time — they are pull-model state, not event counters.
+            lockcheck.publish_global_stats(self.stats)
         text = metrics_mod.render(self.stats) if self.stats is not None else ""
         return 200, metrics_mod.CONTENT_TYPE, text.encode("utf-8")
 

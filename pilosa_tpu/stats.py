@@ -73,21 +73,25 @@ class ExpvarStatsClient:
 
     def with_tags(self, *tags: str) -> "ExpvarStatsClient":
         key = tuple(sorted(set(self._tags) | set(tags)))
-        child = self._children.get(key)
-        if child is None:
-            child = ExpvarStatsClient(tags=key)
-            # share the top-level maps so /debug/vars sees everything
-            child._lock = self._lock
-            child._counters = self._counters
-            child._gauges = self._gauges
-            child._sets = self._sets
-            child._histograms = self._histograms
-            child._hist_meta = self._hist_meta
-            child._timings = self._timings
-            child._timing_meta = self._timing_meta
-            child._rng = self._rng
-            self._children[key] = child
-        return child
+        # Locked lookup-or-create: every handler thread reaches here
+        # (tenant/class tags), and the unlocked get-then-store lost a
+        # child — or tears _children outright without the GIL.
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                child = ExpvarStatsClient(tags=key)
+                # share the top-level maps so /debug/vars sees everything
+                child._lock = self._lock
+                child._counters = self._counters
+                child._gauges = self._gauges
+                child._sets = self._sets
+                child._histograms = self._histograms
+                child._hist_meta = self._hist_meta
+                child._timings = self._timings
+                child._timing_meta = self._timing_meta
+                child._rng = self._rng
+                self._children[key] = child
+            return child
 
     def count(self, name: str, value: int = 1) -> None:
         with self._lock:

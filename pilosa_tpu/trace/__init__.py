@@ -205,6 +205,7 @@ def fingerprint(body: bytes, max_snippet: int = 120) -> dict:
     }
 
 
+@lockcheck.guarded_class
 class Tracer:
     """Sampling gate + bounded trace ring + slow-query log.
 
@@ -212,6 +213,12 @@ class Tracer:
     ``slow_ms=0`` only force-header requests trace (the production
     default — an operator can still ``X-Pilosa-Trace: 1`` a repro
     without a restart)."""
+
+    _guarded_by_ = {
+        "stat_sampled": "trace._mu",
+        "stat_slow": "trace._mu",
+        "_ring": "trace._mu",
+    }
 
     def __init__(
         self,

@@ -114,6 +114,19 @@ then
   echo "did not degrade to a partial aggregate; fix before burning bench hours" >&2
   exit 1
 fi
+# PREFLIGHT 5: the native kernels must be THREAD-sanitizer clean before
+# the concurrent configs drive them from real overlapping threads for an
+# hour — build the TSAN flavor and run the true-concurrency harness
+# (clean per-fragment leg + the seeded shared-table race fixture that
+# proves the leg can see a race at all).  Skips itself with a logged
+# reason when the toolchain or the TSAN runtime is missing, same
+# contract as the ASAN leg; a real data race fails HERE with the TSAN
+# report, not as silent corruption in hour two.
+if ! python -m pytest tests/test_native_threaded.py -q -p no:cacheprovider; then
+  echo "TSAN native leg failed: a data race (or a blind TSAN fixture) in the" >&2
+  echo "concurrent kernel paths; fix the race before burning bench hours" >&2
+  exit 1
+fi
 run() {
   echo "=== $* $(date +%H:%M:%S)" >> $OUT
   timeout 3600 env "$@" python bench.py >> $OUT 2>>big_bench_errors.log

@@ -1,0 +1,226 @@
+"""True-concurrency driver for the native TSAN leg (not pytest-collected).
+
+Run in a SUBPROCESS by tests/test_native_threaded.py with
+``PILOSA_TPU_NATIVE_LIB`` pointing at the ``-fsanitize=thread`` build
+and libtsan LD_PRELOADed.  Drives the GIL-released native kernels from
+genuinely concurrent threads:
+
+- the armed-table write lane (``pn_write_batch``) against a hand-built
+  container table (sorted keys + slack buffers + in-place ns[]),
+- the one-call serving lane (``pn_serve_pairs``) against a per-thread
+  Gram table,
+- streaming-ingest decode (varint / oplog / CSV) round trips,
+- roaring kernels (popcount, fnv1a64, in-place array insert) and the
+  flat PQL parser.
+
+Two modes prove both sides of the threading contract:
+
+``--mode clean``   — per-fragment threads: every thread owns ALL of its
+                     buffers/tables (the documented contract: a fragment
+                     and its armed table belong to one writer at a time,
+                     enforced by fragment._mu in the real stack).  TSAN
+                     must stay silent.
+``--mode shared``  — the same write-lane driver with sharing
+                     deliberately enabled: two threads hammer ONE armed
+                     table through a barrier so the GIL-released
+                     ``pn_write_batch`` calls overlap inside the .so.
+                     The concurrent ns[] read-modify-writes and slack
+                     buffer memmoves are a REAL data race; TSAN must
+                     report it (the leg's seeded known-race fixture).
+
+Deliberately imports only numpy + the ctypes bridge — no jax, no
+server stack — so the TSAN shadow state covers a small, fully
+understood process.
+"""
+
+import argparse
+import os
+import sys
+import threading
+
+import numpy as np
+
+# Runs as a bare script (python tests/_tsan_harness.py): the package
+# root is the repo checkout, not the scripts directory.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from pilosa_tpu import native
+from pilosa_tpu.pilosa import SLICE_WIDTH
+
+W = SLICE_WIDTH
+NCONT = 4  # containers per table: rows 0..3, cols < 65536 (slice 0)
+
+
+def make_table(bufcap: int = 1 << 13) -> dict:
+    """A minimal armed container table (the fragment._writelane_state
+    shape): sorted u64 keys, slack-buffer addresses, in-place element
+    counts, capacities.  Each container is seeded with one value."""
+    keys = np.array([r * (W >> 16) for r in range(NCONT)], dtype=np.uint64)
+    bufs = [np.zeros(bufcap, dtype=np.uint32) for _ in range(NCONT)]
+    for b in bufs:
+        b[0] = 1
+    addrs = np.array([b.ctypes.data for b in bufs], dtype=np.uint64)
+    ns = np.ones(NCONT, dtype=np.int64)
+    caps = np.array([len(b) for b in bufs], dtype=np.int64)
+    return {
+        "keys": keys, "bufs": bufs, "addrs": addrs, "ns": ns, "caps": caps,
+        "ptrs": (keys.ctypes.data, addrs.ctypes.data,
+                 ns.ctypes.data, caps.ctypes.data),
+    }
+
+
+def drive_write_lane(table: dict, rounds: int, stride: int, base: int,
+                     barrier=None) -> None:
+    """Repeated canonical SetBit bodies through native.write_batch.
+    ``base``/``stride`` pick per-caller column sets (disjoint per thread
+    in clean mode; interleaved in shared mode so inserts memmove past
+    each other)."""
+    kp, ap, np_, cp = table["ptrs"]
+    for rnd in range(rounds):
+        lo = base + rnd * stride * 24
+        src = "".join(
+            f'SetBit(rowID={r}, frame="f", columnID={c})'
+            for r in range(NCONT)
+            for c in range(lo, lo + stride * 24, stride)
+        ).encode()
+        if barrier is not None:
+            barrier.wait()
+        res = native.write_batch(
+            src, b"f", b"rowID", b"columnID", 0, W,
+            kp, ap, np_, cp, NCONT, -1, 1 << 30,
+        )
+        assert res is not None, "write lane fell back"
+        types, rows, cols, _changed = res
+        assert len(types) == NCONT * 24
+
+
+def drive_serve(seed: int, rounds: int) -> None:
+    """pn_serve_pairs against a per-thread Gram table, result checked
+    against the count identity every round."""
+    rng = np.random.default_rng(seed)
+    R = 8
+    bits = rng.integers(0, 2, size=(R, 64))
+    gram = np.ascontiguousarray((bits @ bits.T).astype(np.int64))
+    rows_sorted = np.arange(2, 2 + R, dtype=np.int64)
+    pos = np.arange(R, dtype=np.int32)
+    raw = (
+        b'Count(Intersect(Bitmap(rowID=2, frame="f"), '
+        b'Bitmap(rowID=5, frame="f")))'
+        b'Count(Union(Bitmap(rowID=3, frame="f"), '
+        b'Bitmap(rowID=4, frame="f")))'
+    )
+    g = gram
+    want = [int(g[0, 3]), int(g[1, 1] + g[2, 2] - g[1, 2])]
+    for _ in range(rounds):
+        counts = native.serve_pairs(
+            raw, b"f", True, b"rowID", rows_sorted, pos, gram
+        )
+        assert counts is not None and counts.tolist() == want
+
+
+def drive_ingest(seed: int, rounds: int) -> None:
+    """Varint / oplog / CSV decode round trips on per-thread data."""
+    rng = np.random.default_rng(seed)
+    values = rng.integers(0, 1 << 40, size=512, dtype=np.uint64)
+    types = rng.integers(0, 2, size=256, dtype=np.uint8).astype(np.uint8)
+    ops = rng.integers(0, 1 << 30, size=256, dtype=np.uint64)
+    csv = b"".join(
+        b"%d,%d\n" % (int(rng.integers(0, 50)), int(rng.integers(0, 1 << 20)))
+        for _ in range(200)
+    )
+    for _ in range(rounds):
+        got = native.varint_decode(native.varint_encode(values))
+        assert np.array_equal(got, values)
+        t2, v2 = native.oplog_decode(native.oplog_encode(types, ops))
+        assert np.array_equal(v2, ops)
+        parsed = native.parse_csv(csv)
+        assert parsed is None or len(parsed[0]) == 200
+
+
+def drive_kernels(seed: int, rounds: int) -> None:
+    """Roaring kernels + flat PQL parse on per-thread buffers."""
+    lib = native.load()
+    rng = np.random.default_rng(seed)
+    words = rng.integers(0, 1 << 32, size=2048, dtype=np.uint64).astype(np.uint32)
+    blob = bytes(rng.integers(0, 256, size=4096, dtype=np.uint8))
+    pql = b'TopN(frame="f", n=12)Count(Bitmap(rowID=7, frame="f"))'
+    buf = np.zeros(1 << 12, dtype=np.uint32)
+    addr = buf.ctypes.data
+    for rnd in range(rounds):
+        native.popcount_words(words)
+        native.fnv1a64(blob)
+        assert native.pql_parse_flat(pql) is not None
+        n = 0
+        for v in range(rnd * 64, rnd * 64 + 48):
+            newn = lib.pn_array_insert_u32(addr, n, v)
+            if newn > 0:
+                n = newn
+
+
+def run_clean(threads: int, rounds: int) -> None:
+    """Per-fragment threads: zero sharing — the documented contract."""
+    errors: list = []
+
+    def worker(k: int) -> None:
+        try:
+            table = make_table()
+            drive_write_lane(table, rounds, stride=1, base=2)
+            drive_serve(seed=100 + k, rounds=rounds * 4)
+            drive_ingest(seed=200 + k, rounds=rounds)
+            drive_kernels(seed=300 + k, rounds=rounds)
+        except Exception as e:  # surfaced after join; threads can't fail pytest
+            errors.append((k, repr(e)))
+
+    ts = [threading.Thread(target=worker, args=(k,)) for k in range(threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    if errors:
+        raise SystemExit(f"worker errors: {errors}")
+    print("tsan-harness-ok")
+
+
+def run_shared(rounds: int) -> None:
+    """The seeded known-race fixture: TWO threads, ONE armed table, a
+    barrier per round so the GIL-released pn_write_batch calls overlap
+    inside the .so.  Interleaved column sets (base k, stride 2) force
+    each insert to memmove past the other thread's values."""
+    table = make_table(bufcap=1 << 15)
+    barrier = threading.Barrier(2)
+    errors: list = []
+
+    def worker(k: int) -> None:
+        try:
+            drive_write_lane(table, rounds, stride=2, base=2 + k,
+                             barrier=barrier)
+        except Exception as e:
+            errors.append((k, repr(e)))
+
+    ts = [threading.Thread(target=worker, args=(k,)) for k in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    # A torn table can legitimately make a worker trip an assert; the
+    # fixture's contract is only that TSAN REPORTS the race.
+    print(f"tsan-harness-shared-done errors={len(errors)}")
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--mode", choices=("clean", "shared"), default="clean")
+    ap.add_argument("--threads", type=int, default=4)
+    ap.add_argument("--rounds", type=int, default=12)
+    args = ap.parse_args()
+    if not native.available():
+        print("native-unavailable", file=sys.stderr)
+        raise SystemExit(3)
+    if args.mode == "clean":
+        run_clean(args.threads, args.rounds)
+    else:
+        run_shared(args.rounds)
+
+
+if __name__ == "__main__":
+    main()

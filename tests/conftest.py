@@ -96,7 +96,8 @@ def _native_library_build():
 # and self-enable at import (violations print to their stderr at exit).
 
 _LOCKCHECK_MODULES = ("test_concurrency", "test_replica", "test_qos",
-                      "test_writelane", "test_ingest", "test_qcache")
+                      "test_writelane", "test_ingest", "test_qcache",
+                      "test_freethread")
 
 
 def _lockcheck_wanted(item) -> bool:

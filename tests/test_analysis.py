@@ -547,6 +547,169 @@ def test_deadline_propagation_accepts_timeout_s_budget(tmp_path):
     assert _new(_run(root, ("deadline-propagation",))) == []
 
 
+# -- rules 9+10: global-mutable-state + check-then-act ---------------------
+
+_GIL_FIRING = {
+    "server/handler.py": """
+    def handle_query(req):
+        return lookup(req)
+    """,
+    "mod.py": """
+    _CACHE = {}
+    _FROZEN = ("a", "b")
+
+    def lookup(key):
+        if key in _CACHE:
+            return _CACHE[key]
+        v = probe(key)
+        _CACHE[key] = v
+        return v
+
+    def probe(key):
+        return key
+    """,
+}
+
+
+def test_global_mutable_state_fires_on_serving_reachable_mutation(tmp_path):
+    root = _mkpkg(tmp_path, _GIL_FIRING)
+    fs = _new(_run(root, ("global-mutable-state",)))
+    assert len(fs) == 1
+    f = fs[0]
+    assert f.scope == "<module>" and f.path == "mod.py"
+    assert "module-level mutable `_CACHE`" in f.message
+    assert "lockcheck.named_global" in f.message
+
+
+def test_global_mutable_state_passes_seam_frozen_and_unreachable(tmp_path):
+    files = {
+        "server/handler.py": """
+        def handle_query(req):
+            return lookup(req)
+        """,
+        "mod.py": """
+        from pilosa_tpu.analysis import lockcheck
+
+        _MEMO = lockcheck.named_global("mod.memo", max_entries=64)
+        _TABLE = {"a": 1}      # read-only at runtime: frozen at import
+        _OFFLINE = {}          # mutated only by an unreachable tool path
+
+        def lookup(key):
+            v = _MEMO.get(key)
+            if v is None:
+                v = _TABLE.get(key)
+                _MEMO.put(key, v)
+            return v
+
+        def offline_rebuild():
+            _OFFLINE["x"] = 1
+        """,
+    }
+    root = _mkpkg(tmp_path, files)
+    assert _new(_run(root, ("global-mutable-state",))) == []
+
+
+def test_global_mutable_state_suppression_tags(tmp_path):
+    files = dict(_GIL_FIRING)
+    files["mod.py"] = """
+    # analysis-ok: global-mutable-state: fixture reason — import-time only in production
+    _CACHE = {}
+
+    def lookup(key):
+        if key in _CACHE:
+            return _CACHE[key]
+        _CACHE[key] = key
+        return key
+    """
+    root = _mkpkg(tmp_path, files)
+    fs = _run(root, ("global-mutable-state",))
+    assert _new(fs) == [] and any(f.suppressed for f in fs)
+
+
+def test_check_then_act_fires_all_four_shapes(tmp_path):
+    files = {
+        "server/handler.py": """
+        def handle_query(req, h):
+            return h.serve(req)
+        """,
+        "mod.py": """
+        class Handler:
+            def serve(self, req):
+                self.total += 1
+                self.stat_requests += 1
+                if req in self.seen:
+                    return self.seen[req]
+                v = self.table.get(req)
+                if v is None:
+                    self.table[req] = object()
+                self.pending.setdefault(req, [])
+                return v
+        """,
+    }
+    root = _mkpkg(tmp_path, files)
+    msgs = [f.message for f in _new(_run(root, ("check-then-act",)))]
+    assert any("read-modify-write of shared `self.total`" in m for m in msgs)
+    assert any("membership test on `self.seen`" in m for m in msgs)
+    assert any("`self.table.get(...)`" in m and "paired" in m for m in msgs)
+    assert any("`self.pending.setdefault(...)`" in m for m in msgs)
+    # The approximate-counter convention: stat_* increments are exempt.
+    assert not any("stat_requests" in m for m in msgs)
+
+
+def test_check_then_act_passes_locked_lifecycle_and_locals(tmp_path):
+    files = {
+        "server/handler.py": """
+        def handle_query(req, h):
+            return h.serve(req)
+        """,
+        "mod.py": """
+        from pilosa_tpu.analysis import lockcheck
+
+        class Handler:
+            def __init__(self):
+                self.table = {}          # lifecycle-exempt
+                self._mu = lockcheck.named_lock("h._mu")
+
+            def serve(self, req):
+                local = {}
+                if req in local:         # thread-private: no receiver
+                    return local[req]
+                return self._serve_locked(req)
+
+            def _serve_locked(self, req):
+                with self._mu:
+                    if req in self.table:
+                        return self.table[req]
+                    self.table[req] = object()
+                    return self.table[req]
+
+        def never_served(h):
+            h.counter += 1               # unreachable from the entries
+        """,
+    }
+    root = _mkpkg(tmp_path, files)
+    assert _new(_run(root, ("check-then-act",))) == []
+
+
+def test_check_then_act_suppression_tag(tmp_path):
+    files = {
+        "server/handler.py": """
+        def handle_query(req, h):
+            return h.serve(req)
+        """,
+        "mod.py": """
+        class Handler:
+            def serve(self, req):
+                # analysis-ok: check-then-act: fixture reason — externally synchronized
+                self.total += 1
+                return self.total
+        """,
+    }
+    root = _mkpkg(tmp_path, files)
+    fs = _run(root, ("check-then-act",))
+    assert _new(fs) == [] and any(f.suppressed for f in fs)
+
+
 # -- suppression + baseline round-trips ------------------------------------
 
 

@@ -1,0 +1,311 @@
+"""Free-threading readiness: true-concurrency stress gates.
+
+The dynamic half of the generation-3 analysis pair (the static half is
+the GIL-dependence analyzer in analysis/rules.py; the native half is
+the TSAN leg in test_native_threaded.py).  Every test in this module
+runs under ``PILOSA_TPU_LOCK_CHECK=1`` — the conftest gate enables the
+lockset race detector and FAILS the test on any recorded violation —
+so the assertions here are twofold: the Python-visible invariants hold
+under genuine thread interleaving, AND no guarded field was ever
+written with an empty candidate lockset while it happened.
+
+Gates:
+
+- a multi-threaded HTTP hammer against a real server — reads + writes
+  + streaming ingest + ``/metrics`` scrapes concurrently, with STRICT
+  ``parse_exposition`` on every scrape (a torn registry iteration
+  renders garbage or raises ``RuntimeError: dict changed size``);
+- concurrent ``/metrics`` render vs. live stats mutation without a
+  server in the loop (the satellite-3 unit shape);
+- concurrent qcache store/evict/purge churn;
+- the ``lockcheck.named_global`` seam: LRU bounds, bypass rules, the
+  PQL parse memo riding it, and the detector catching a writer that
+  subverts the seam's lock.
+"""
+
+import json
+import threading
+import traceback
+import urllib.request
+
+import numpy as np
+import pytest
+
+from pilosa_tpu import metrics
+from pilosa_tpu.analysis import lockcheck
+from pilosa_tpu.stats import ExpvarStatsClient
+
+
+def _join_all(threads, errors):
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not errors, "worker errors:\n" + "\n".join(errors)
+
+
+def _catching(fn, errors):
+    def run():
+        try:
+            fn()
+        except Exception:
+            errors.append(traceback.format_exc())
+
+    return run
+
+
+# -- the hammer: one real server, all four traffic kinds at once -----------
+
+
+def test_server_hammer_reads_writes_ingest_metrics(tmp_path):
+    from pilosa_tpu.config import Config
+    from pilosa_tpu.server.client import Client
+    from pilosa_tpu.server.server import Server
+
+    cfg = Config(
+        data_dir=str(tmp_path / "hammer"), host="127.0.0.1:0",
+        engine="numpy", stats="expvar", qcache_enabled=True,
+    )
+    s = Server(cfg)
+    s.open()
+    errors: list = []
+    try:
+        c = Client(s.host)
+        c.create_index("i")
+        c.create_frame("i", "f")
+        # Warm the parse memo through the named-global seam so the
+        # /metrics scrape below has non-zero gauges to publish.
+        c.execute_query("i", 'SetBit(rowID=1, frame="f", columnID=1)')
+
+        def writer():
+            wc = Client(s.host)
+            for rnd in range(15):
+                body = "".join(
+                    f'SetBit(rowID={r}, frame="f", columnID={rnd * 64 + j})'
+                    for r in range(4) for j in range(16)
+                )
+                wc.execute_query("i", body)
+
+        def reader():
+            rc = Client(s.host)
+            for rnd in range(30):
+                r = rc.execute_query(
+                    "i", f'Count(Bitmap(rowID={rnd % 4}, frame="f"))'
+                )
+                assert "results" in r
+
+        def ingester():
+            ic = Client(s.host)
+            rng = np.random.default_rng(7)
+            for _ in range(4):
+                rows = rng.integers(0, 8, size=2000).astype(np.uint64)
+                cols = rng.integers(0, 1 << 16, size=2000).astype(np.uint64)
+                out = ic.ingest_stream("i", "f", rows, cols,
+                                       chunk_pairs=512)
+                assert out["done"]
+
+        def scraper():
+            for _ in range(25):
+                with urllib.request.urlopen(
+                    f"http://{s.host}/metrics", timeout=30
+                ) as r:
+                    text = r.read().decode("utf-8")
+                # STRICT: any torn snapshot (dict-changed-size, a half
+                # rendered family, a bad label) raises here.
+                fams = metrics.parse_exposition(text)
+                assert "pilosa_analysis_globals_registered" in fams, (
+                    "named-global gauges missing from /metrics"
+                )
+                with urllib.request.urlopen(
+                    f"http://{s.host}/debug/vars", timeout=30
+                ) as r:
+                    json.loads(r.read())
+
+        threads = [
+            threading.Thread(target=_catching(fn, errors), name=name)
+            for name, fn in (
+                ("ft-writer", writer), ("ft-reader-1", reader),
+                ("ft-reader-2", reader), ("ft-ingester", ingester),
+                ("ft-scraper", scraper),
+            )
+        ]
+        _join_all(threads, errors)
+    finally:
+        s.close()
+
+
+# -- satellite 3: /metrics render vs. live mutation, no server -------------
+
+
+def test_concurrent_metrics_render_vs_stats_mutation():
+    """metrics.render iterates every registry map while mutators add
+    NEW series (structural dict growth) and bump existing ones; every
+    snapshot must stay a valid exposition and never raise."""
+    stats = ExpvarStatsClient()
+    stop = threading.Event()
+    errors: list = []
+
+    def mutator(k: int):
+        i = 0
+        while not stop.is_set():
+            stats.count(f"ft.m{k}.c{i % 97}")
+            stats.gauge(f"ft.m{k}.g{i % 89}", i)
+            stats.histogram(f"ft.m{k}.h{i % 13}", float(i % 7))
+            stats.with_tags(f"shard:{i % 11}").count(f"ft.m{k}.tagged")
+            i += 1
+
+    def renderer():
+        try:
+            for _ in range(60):
+                text = metrics.render(stats)
+                metrics.parse_exposition(text)  # strict, every snapshot
+        finally:
+            stop.set()
+
+    threads = [
+        threading.Thread(target=_catching(lambda k=k: mutator(k), errors))
+        for k in range(3)
+    ]
+    threads.append(threading.Thread(target=_catching(renderer, errors)))
+    _join_all(threads, errors)
+    # One final quiescent render parses and contains the mutated series.
+    fams = metrics.parse_exposition(metrics.render(stats))
+    assert any(name.startswith("pilosa_ft_m0_c") for name in fams)
+
+
+def test_concurrent_qcache_store_evict_purge(tmp_path):
+    """qcache store/evict churn from several threads with concurrent
+    purges: byte accounting and the LRU stay consistent, and every
+    ``_guarded_by_`` field write holds qcache._mu."""
+    from pilosa_tpu.core.frame import FrameOptions
+    from pilosa_tpu.core.holder import Holder
+    from pilosa_tpu.qcache import QueryCache
+
+    h = Holder(str(tmp_path / "qc"))
+    h.open()
+    errors: list = []
+    try:
+        h.create_index("i").create_frame("f", FrameOptions())
+        qc = QueryCache(max_bytes=1 << 12, min_cost_ms=0.0)
+
+        def storer(k: int):
+            for i in range(120):
+                q = f'Count(Bitmap(rowID={k * 200 + i}, frame="f"))'
+                results, pending = qc.lookup(h, "i", q, (0,))
+                if pending is not None:
+                    qc.commit(h, pending, [{"n": i}] * 8)
+                elif results is not None:
+                    assert results[0]["n"] >= 0
+
+        def purger():
+            for i in range(40):
+                if i % 8 == 7:
+                    qc.clear()
+                else:
+                    qc.purge_frame("i", "f")
+                len(qc)
+
+        threads = [
+            threading.Thread(target=_catching(lambda k=k: storer(k), errors))
+            for k in range(3)
+        ]
+        threads.append(threading.Thread(target=_catching(purger, errors)))
+        _join_all(threads, errors)
+        with qc._mu:
+            assert qc.bytes >= 0
+            assert qc.stores >= 1
+            assert qc.bytes <= qc.max_bytes
+    finally:
+        h.close()
+
+
+# -- the named-global seam -------------------------------------------------
+
+
+def test_named_global_lru_bounds_and_bypass():
+    ng = lockcheck.named_global("ft.test.lru", max_entries=3)
+    assert lockcheck.named_global("ft.test.lru") is ng  # idempotent
+    ng.clear()
+    ng.put("a", 1)
+    ng.put("b", 2)
+    ng.put("c", 3)
+    assert ng.get("a") == 1  # MRU move: order is now b, c, a
+    ng.put("d", 4)  # evicts b (the LRU)
+    assert len(ng) == 3
+    assert "b" not in ng and "a" in ng and "d" in ng
+    snap = ng.stats_snapshot()
+    assert snap["hits"] >= 1 and snap["evictions"] >= 1
+
+    big = lockcheck.named_global("ft.test.keylen", max_entries=8,
+                                 max_key_len=4)
+    big.clear()
+    big.put("toolongkey", 1)  # over the key bound: bypassed, not stored
+    assert len(big) == 0 and big.get("toolongkey") is None
+    big.put("ok", 2)
+    assert big.get("ok") == 2
+
+
+def test_parse_memo_rides_the_seam_concurrently():
+    """parse_cached through the named-global seam from several threads:
+    identical sources share one Query object, the registry sees the
+    memo, and the checker observes only locked mutations."""
+    from pilosa_tpu.pql import parser
+
+    assert "pql.parse_memo" in lockcheck.named_globals()
+    srcs = [f'Count(Bitmap(rowID={i}, frame="f"))' for i in range(20)]
+    results: dict = {}
+    errors: list = []
+    mu = threading.Lock()
+
+    def worker():
+        for i, src in enumerate(srcs):
+            q = parser.parse_cached(src)
+            with mu:
+                prev = results.setdefault(i, q)
+            assert prev is q or prev == q
+
+    threads = [
+        threading.Thread(target=_catching(worker, errors)) for _ in range(4)
+    ]
+    _join_all(threads, errors)
+    # Steady state: the memoized object is returned by identity.
+    q1 = parser.parse_cached(srcs[0])
+    assert parser.parse_cached(srcs[0]) is q1
+    # The seam publishes its gauges through any stats client.
+    stats = ExpvarStatsClient()
+    lockcheck.publish_global_stats(stats)
+    snap = stats.snapshot()
+    assert snap.get("analysis.globals.registered", 0) >= 1
+
+
+def test_named_global_detects_seam_subversion():
+    """A writer that mutates the backing store WITHOUT the named lock
+    must produce a lockset-race violation (and a locked writer on the
+    same global must not)."""
+    ng = lockcheck.named_global("ft.test.subvert")
+    ng.clear()
+    done = threading.Barrier(2)
+
+    def locked_writer():
+        for i in range(50):
+            ng.put(f"k{i}", i)
+        done.wait()
+
+    def unlocked_writer():
+        done.wait()  # order the phases: shared state, disjoint locksets
+        for i in range(50):
+            ng._store[f"raw{i}"] = i  # bypasses the _GlobalLock
+            ng._note_mutation()
+
+    t1 = threading.Thread(target=locked_writer)
+    t2 = threading.Thread(target=unlocked_writer)
+    t1.start()
+    t2.start()
+    t1.join()
+    t2.join()
+    violations = lockcheck.take_violations()  # consumed: the conftest
+    # gate must not fail this test for the violation we seeded.
+    assert any(
+        v.kind == "lockset-race" and "NamedGlobal._store" in v.detail
+        for v in violations
+    ), f"seam subversion went undetected: {[v.kind for v in violations]}"

@@ -1,0 +1,165 @@
+"""ThreadSanitizer leg of the free-threading readiness gate.
+
+Builds the TSAN flavor of the native library (``make -C native tsan`` →
+``libpilosa_native-tsan.so``) and runs tests/_tsan_harness.py in a
+SUBPROCESS against it: ``PILOSA_TPU_NATIVE_LIB`` points the ctypes
+bridge at the TSAN build and ``LD_PRELOAD`` puts the TSAN runtime first
+(plus ``libstdc++`` so interceptors resolve before anything else
+loads).  The harness drives the armed-table write lane, the
+``pn_serve_pairs`` serving lane, streaming-ingest decode, and the
+roaring kernels from genuinely concurrent threads — ctypes releases
+the GIL, so the calls truly overlap inside the .so.
+
+Two legs prove the gate cuts both ways:
+
+- **clean** — per-fragment threads (every thread owns its buffers, the
+  contract fragment._mu enforces in the real stack) must produce ZERO
+  TSAN reports.
+- **seeded race** — the same driver with sharing deliberately enabled
+  (two threads, one armed table, a barrier so the native calls overlap)
+  MUST produce a ``WARNING: ThreadSanitizer: data race`` report.  This
+  fixture proves the leg can actually see a race; without it a silent
+  mis-preload would pass the clean leg while sanitizing nothing.
+
+Mirrors the ASAN leg's environmental contract: no toolchain / no TSAN
+runtime / no TSAN-capable kernel → SKIP with the reason logged, never
+an environmental failure.  ``PILOSA_TPU_NO_TSAN_LEG=1`` opts out.
+"""
+
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_NATIVE = os.path.join(_REPO, "native")
+_TSAN_SO = os.path.join(_NATIVE, "libpilosa_native-tsan.so")
+_HARNESS = os.path.join(_REPO, "tests", "_tsan_harness.py")
+
+# TSAN aborts the whole process on some container/kernel configs
+# (ASLR-heavy mappings) before main() runs; that is environmental.
+_TSAN_FATAL = "FATAL: ThreadSanitizer"
+
+
+def _skip(reason: str) -> None:
+    sys.stderr.write(f"\n[test_native_threaded] skipping: {reason}\n")
+    pytest.skip(reason)
+
+
+def _resolve_runtime(lib: str) -> str:
+    """Real path of a gcc runtime library (``libtsan.so`` prints as a
+    linker-script/symlink path; LD_PRELOAD needs the actual DSO)."""
+    out = subprocess.run(
+        ["g++", f"-print-file-name={lib}"], capture_output=True, text=True,
+        timeout=30,
+    )
+    path = out.stdout.strip()
+    if not path or path == lib or not os.path.exists(path):
+        return ""
+    return os.path.realpath(path)
+
+
+def _tsan_env() -> dict:
+    """Build the TSAN .so + subprocess env, skipping (reason logged) on
+    any environmental miss — shared preamble of both legs."""
+    if os.environ.get("PILOSA_TPU_NO_TSAN_LEG"):
+        _skip("PILOSA_TPU_NO_TSAN_LEG set")
+    if os.environ.get("PILOSA_TPU_NO_NATIVE"):
+        _skip("PILOSA_TPU_NO_NATIVE set; nothing native to sanitize")
+    missing = [t for t in ("make", "g++") if shutil.which(t) is None]
+    if missing:
+        _skip(f"toolchain missing: {', '.join(missing)}")
+
+    build = subprocess.run(
+        ["make", "-C", _NATIVE, "tsan"],
+        capture_output=True, text=True, timeout=240,
+    )
+    if build.returncode != 0 or not os.path.exists(_TSAN_SO):
+        _skip(
+            "make tsan failed (no TSAN-capable toolchain?): "
+            + (build.stderr or build.stdout)[-400:]
+        )
+
+    tsan_rt = _resolve_runtime("libtsan.so")
+    stdcxx_rt = _resolve_runtime("libstdc++.so.6")
+    if not tsan_rt or not stdcxx_rt:
+        _skip("libtsan/libstdc++ runtime not resolvable for LD_PRELOAD")
+
+    env = dict(os.environ)
+    env.update(
+        {
+            "PILOSA_TPU_NATIVE_LIB": _TSAN_SO,
+            "PILOSA_TPU_NO_TSAN_LEG": "1",
+            "LD_PRELOAD": f"{tsan_rt} {stdcxx_rt}",
+            # halt_on_error off: the seeded-race leg wants the harness
+            # to finish so the report count is deterministic; a clean
+            # run still exits 0, a racy one exits 66.
+            "TSAN_OPTIONS": "halt_on_error=0 exitcode=66",
+        }
+    )
+    # The harness never imports jax, but keep any inherited platform
+    # pinning consistent with the rest of tier-1.
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    return env
+
+
+def _run_harness(env: dict, *args: str) -> subprocess.CompletedProcess:
+    res = subprocess.run(
+        [sys.executable, _HARNESS, *args],
+        capture_output=True, text=True, timeout=420, env=env, cwd=_REPO,
+    )
+    out = (res.stdout or "") + (res.stderr or "")
+    if _TSAN_FATAL in out:
+        _skip("TSAN runtime unsupported here: " + out.splitlines()[0][-200:])
+    return res
+
+
+def test_concurrent_kernels_clean_under_tsan():
+    """Per-fragment threads (zero sharing) over the write lane,
+    serve_pairs, ingest decode, and roaring kernels: no TSAN report."""
+    env = _tsan_env()
+
+    # Preamble: prove the subprocess really serves from the TSAN .so —
+    # a silent fallback to the Python lanes would pass while
+    # sanitizing nothing.
+    probe = subprocess.run(
+        [
+            sys.executable, "-c",
+            "import sys; sys.path.insert(0, '.');"
+            "from pilosa_tpu import native; p = native.loaded_path(); "
+            f"assert p == {_TSAN_SO!r}, f'loaded {{p}}'; print('tsan-lib-ok')",
+        ],
+        capture_output=True, text=True, timeout=120, env=env, cwd=_REPO,
+    )
+    out = (probe.stdout or "") + (probe.stderr or "")
+    if _TSAN_FATAL in out:
+        _skip("TSAN runtime unsupported here: " + out.splitlines()[0][-200:])
+    assert probe.returncode == 0 and "tsan-lib-ok" in probe.stdout, (
+        "TSAN .so did not load in the subprocess:\n"
+        + probe.stdout[-800:] + probe.stderr[-1600:]
+    )
+
+    res = _run_harness(env, "--mode", "clean", "--threads", "4",
+                       "--rounds", "8")
+    out = (res.stdout or "") + (res.stderr or "")
+    if res.returncode != 0 or "WARNING: ThreadSanitizer" in out:
+        pytest.fail(
+            "TSAN reported under the per-fragment (no sharing) contract "
+            f"(exit {res.returncode}):\n" + out[-5000:],
+            pytrace=False,
+        )
+    assert "tsan-harness-ok" in res.stdout
+
+
+def test_seeded_shared_fragment_race_detected():
+    """The known-race fixture: sharing one armed table across threads
+    MUST produce a TSAN data-race report — proves the leg has teeth."""
+    env = _tsan_env()
+    res = _run_harness(env, "--mode", "shared", "--rounds", "25")
+    out = (res.stdout or "") + (res.stderr or "")
+    assert "WARNING: ThreadSanitizer: data race" in out, (
+        "seeded shared-fragment race was NOT detected "
+        f"(exit {res.returncode}) — the TSAN leg is blind:\n" + out[-3000:]
+    )
