@@ -16,6 +16,7 @@
 #include <cstring>
 #include <string>
 #include <unistd.h>
+#include <vector>
 
 // The ctypes bridge (pilosa_tpu/native.py) and the native-abi
 // conformance rule (pilosa_tpu/analysis/abi.py) reduce every extern "C"
@@ -985,6 +986,504 @@ int64_t pn_write_batch(const char* src, int64_t len,
     }
     *applied = 1;
     return n;
+}
+
+}  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Serve-lane breadth (the multi-core serving PR): three more request
+// shapes answered in ONE GIL-released crossing each, extending
+// pn_serve_pairs' single-frame pair lane.
+//
+//   pn_serve_multi   — pair-count batches spanning SEVERAL armed frames
+//                      (each call evaluated against its frame's glut).
+//   pn_pql_match_range — matcher for all-Count(Range(...)) bodies; the
+//                      Python side rides the existing fused multi-view
+//                      evaluator with the parse already done.
+//   pn_serve_tree    — arbitrarily nested Count(op-tree over Bitmap)
+//                      batches evaluated straight off the fragment's
+//                      armed container table: matcher and evaluator are
+//                      fused per container block, so intermediate row-id
+//                      arrays never materialize.
+//
+// Every kernel keeps the lane contract: PN_PQL_FALLBACK for ANYTHING
+// outside its exact shape, so the Python paths keep all behaviors and
+// error messages.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+// Sorted-u32 set merges (two-pointer).  Output must not alias inputs for
+// or/xor (the write cursor can run ahead of the read cursor); and/andnot
+// only shrink, but callers keep output disjoint anyway (ping-pong
+// buffers), so no aliasing case exists at all.
+static int64_t pn_merge_and(const uint32_t* a, int64_t na,
+                            const uint32_t* b, int64_t nb, uint32_t* o) {
+    int64_t i = 0, j = 0, k = 0;
+    while (i < na && j < nb) {
+        if (a[i] < b[j]) i++;
+        else if (a[i] > b[j]) j++;
+        else { o[k++] = a[i]; i++; j++; }
+    }
+    return k;
+}
+
+static int64_t pn_merge_or(const uint32_t* a, int64_t na,
+                           const uint32_t* b, int64_t nb, uint32_t* o) {
+    int64_t i = 0, j = 0, k = 0;
+    while (i < na && j < nb) {
+        if (a[i] < b[j]) o[k++] = a[i++];
+        else if (a[i] > b[j]) o[k++] = b[j++];
+        else { o[k++] = a[i]; i++; j++; }
+    }
+    while (i < na) o[k++] = a[i++];
+    while (j < nb) o[k++] = b[j++];
+    return k;
+}
+
+static int64_t pn_merge_xor(const uint32_t* a, int64_t na,
+                            const uint32_t* b, int64_t nb, uint32_t* o) {
+    int64_t i = 0, j = 0, k = 0;
+    while (i < na && j < nb) {
+        if (a[i] < b[j]) o[k++] = a[i++];
+        else if (a[i] > b[j]) o[k++] = b[j++];
+        else { i++; j++; }
+    }
+    while (i < na) o[k++] = a[i++];
+    while (j < nb) o[k++] = b[j++];
+    return k;
+}
+
+static int64_t pn_merge_andnot(const uint32_t* a, int64_t na,
+                               const uint32_t* b, int64_t nb, uint32_t* o) {
+    int64_t i = 0, j = 0, k = 0;
+    while (i < na && j < nb) {
+        if (a[i] < b[j]) o[k++] = a[i++];
+        else if (a[i] > b[j]) j++;
+        else { i++; j++; }
+    }
+    while (i < na) o[k++] = a[i++];
+    return k;
+}
+
+// Nested-tree lane bounds: preorder program size per Count call and op
+// nesting depth.  Deeper/larger shapes fall back (the Python tree lane
+// has its own depth cap and the sequential path covers the rest).
+enum { PN_TREE_MAX_NODES = 128, PN_TREE_MAX_DEPTH = 6 };
+// One container's 16-bit value domain bounds every intermediate result.
+enum { PN_TREE_BLOCK = 65536 };
+
+struct PnTreeNode {
+    int8_t op;       // -1 = Bitmap leaf; else 0=and 1=or 2=xor 3=andnot
+    int16_t nchild;  // >= 2 for op nodes
+    int64_t row;     // leaf row id
+};
+
+// Recursive-descent parse of one op-tree expression into a preorder
+// program.  Grammar (frame/row-key labels must match the armed frame):
+//   expr := Bitmap(<rowkey>=INT[, frame=F])
+//         | Intersect|Union|Xor|Difference '(' expr {',' expr} ')'
+// Left-fold evaluation makes n-ary Difference a &~ b &~ c — identical
+// to the executor's a &~ (b | c | ...) rewrite.
+static bool pn_tree_parse(PairMatcher& p, const char* src, int64_t len,
+                          const char* frame, int64_t flen, int allow_default,
+                          const char* rowkey, int64_t klen,
+                          PnTreeNode* nodes, int64_t* n_nodes, int depth) {
+    if (*n_nodes >= PN_TREE_MAX_NODES || depth > PN_TREE_MAX_DEPTH) return false;
+    int64_t me = (*n_nodes)++;
+    if (!p.ws()) return false;
+    int8_t op;
+    if (p.lit("Intersect", 9)) op = 0;
+    else if (p.lit("Union", 5)) op = 1;
+    else if (p.lit("Xor", 3)) op = 2;
+    else if (p.lit("Difference", 10)) op = 3;
+    else if (p.lit("Bitmap", 6)) op = -1;
+    else return false;
+    if (op < 0) {
+        // Bitmap leaf: (<rowkey>=INT[, frame=...]), args in either order.
+        if (!p.ws() || !p.ch('(')) return false;
+        int64_t row = -1;
+        bool have_frame = false;
+        for (int a = 0; a < 2; a++) {
+            if (!p.ws()) return false;
+            int32_t ks, ke;
+            if (!p.ident(&ks, &ke)) return false;
+            if (!p.ws() || !p.ch('=')) return false;
+            if (!p.ws()) return false;
+            if (ke - ks == 5 && memcmp(src + ks, "frame", 5) == 0) {
+                if (have_frame) return false;
+                int32_t fs, fe;
+                char q = src[p.i];
+                if (q == '"' || q == '\'') {
+                    p.i++;
+                    fs = (int32_t)p.i;
+                    while (p.i < len && src[p.i] != q) {
+                        if (src[p.i] == '\\') return false;
+                        p.i++;
+                    }
+                    if (p.i >= len) return false;
+                    fe = (int32_t)p.i;
+                    p.i++;
+                } else if (!p.ident(&fs, &fe)) {
+                    return false;
+                }
+                if (fe - fs != flen || memcmp(src + fs, frame, (size_t)flen) != 0)
+                    return false;
+                have_frame = true;
+            } else {
+                if (row >= 0) return false;
+                if (ke - ks != klen || memcmp(src + ks, rowkey, (size_t)klen) != 0)
+                    return false;
+                if (!p.integer(&row)) return false;
+            }
+            if (!p.ws()) return false;
+            if (src[p.i] == ',') { p.i++; continue; }
+            break;
+        }
+        if (!p.ws() || !p.ch(')')) return false;
+        if (row < 0) return false;
+        if (!have_frame && !allow_default) return false;
+        nodes[me].op = -1;
+        nodes[me].nchild = 0;
+        nodes[me].row = row;
+        return true;
+    }
+    if (!p.ws() || !p.ch('(')) return false;
+    int16_t nchild = 0;
+    for (;;) {
+        if (!pn_tree_parse(p, src, len, frame, flen, allow_default, rowkey, klen,
+                           nodes, n_nodes, depth + 1))
+            return false;
+        nchild++;
+        if (!p.ws()) return false;
+        if (src[p.i] == ',') { p.i++; continue; }
+        break;
+    }
+    if (!p.ch(')')) return false;
+    if (nchild < 2) return false;
+    nodes[me].op = op;
+    nodes[me].nchild = nchild;
+    nodes[me].row = 0;
+    return true;
+}
+
+// Per-block tree evaluator over the fragment's armed container table.
+// Leaves read container arrays in place (no copy); op nodes fold
+// children through per-depth ping-pong buffers.  A leaf whose container
+// is a BITMAP (present in bkeys, absent from the array table) sets
+// decline — the armed table has no byte view of bitmap containers, so
+// the whole request falls back.
+struct PnTreeEval {
+    const PnTreeNode* nodes;
+    const uint64_t* keys;
+    const uint64_t* addrs;
+    const int64_t* ns;
+    int64_t n_containers;
+    const uint64_t* bkeys;
+    int64_t n_bkeys;
+    uint32_t* arena;  // (PN_TREE_MAX_DEPTH + 2) * 2 * PN_TREE_BLOCK
+    int64_t cursor;
+    uint64_t block;   // container offset within the row span, 0..15
+    bool decline;
+
+    const uint32_t* leaf(uint64_t row, int64_t* n_out) {
+        uint64_t key = row * 16 + block;
+        int64_t t = pn_tab_pos(keys, n_containers, key);
+        if (t >= 0) {
+            *n_out = ns[t];
+            return reinterpret_cast<const uint32_t*>((uintptr_t)addrs[t]);
+        }
+        if (pn_tab_pos(bkeys, n_bkeys, key) >= 0) decline = true;
+        *n_out = 0;  // absent container: empty row segment
+        return nullptr;
+    }
+
+    const uint32_t* eval(int depth, int64_t* n_out) {
+        const PnTreeNode& nd = nodes[cursor++];
+        if (nd.op < 0) return leaf((uint64_t)nd.row, n_out);
+        uint32_t* bufA = arena + (size_t)depth * 2 * PN_TREE_BLOCK;
+        uint32_t* bufB = bufA + PN_TREE_BLOCK;
+        bool c0_op = nodes[cursor].op >= 0;
+        int64_t na;
+        const uint32_t* a = eval(depth + 1, &na);
+        if (decline) { *n_out = 0; return nullptr; }
+        if (c0_op) {
+            // Child 0's result lives in the depth+1 arena, which the next
+            // child's evaluation reuses: park it in this depth's spare.
+            memcpy(bufB, a, (size_t)na * sizeof(uint32_t));
+            a = bufB;
+        }
+        uint32_t* out_b = bufA;
+        for (int k = 1; k < nd.nchild; k++) {
+            int64_t nb;
+            const uint32_t* b = eval(depth + 1, &nb);
+            if (decline) { *n_out = 0; return nullptr; }
+            int64_t no;
+            switch (nd.op) {
+                case 0: no = pn_merge_and(a, na, b, nb, out_b); break;
+                case 1: no = pn_merge_or(a, na, b, nb, out_b); break;
+                case 2: no = pn_merge_xor(a, na, b, nb, out_b); break;
+                default: no = pn_merge_andnot(a, na, b, nb, out_b); break;
+            }
+            a = out_b;
+            na = no;
+            out_b = (out_b == bufA) ? bufB : bufA;
+        }
+        *n_out = na;
+        return a;
+    }
+};
+
+// "YYYY-MM-DDTHH:MM" (pql.TIME_FORMAT) -> Y*1e8 + M*1e6 + D*1e4 + h*1e2 + m.
+// Digits-and-separators only; calendar validity stays with the Python
+// side (datetime raises there, preserving the sequential error text).
+static bool pn_match_time(const char* p, int64_t n, int64_t* out) {
+    if (n != 16) return false;
+    for (int k = 0; k < 16; k++) {
+        char c = p[k];
+        if (k == 4 || k == 7) { if (c != '-') return false; }
+        else if (k == 10) { if (c != 'T') return false; }
+        else if (k == 13) { if (c != ':') return false; }
+        else if (c < '0' || c > '9') return false;
+    }
+    int64_t Y = (p[0]-'0')*1000 + (p[1]-'0')*100 + (p[2]-'0')*10 + (p[3]-'0');
+    int64_t M = (p[5]-'0')*10 + (p[6]-'0');
+    int64_t D = (p[8]-'0')*10 + (p[9]-'0');
+    int64_t h = (p[11]-'0')*10 + (p[12]-'0');
+    int64_t m = (p[14]-'0')*10 + (p[15]-'0');
+    *out = Y*100000000LL + M*1000000LL + D*10000LL + h*100LL + m;
+    return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Multi-frame serving lane: pn_serve_pairs generalized to K armed frame
+// states.  names/rlabels are concatenated frame-name and row-label bytes
+// with K+1 offset fences; rs/ps/gram_addrs are RAW base addresses of
+// each state's glut arrays (sorted row ids, positions, Gram), n_rows and
+// gram_dims their extents.  default_sid maps an absent frame= arg (< 0
+// = no armed default frame -> fallback).  Returns the call count with
+// counts in out[], or PN_PQL_FALLBACK (unknown frame, label mismatch,
+// unknown row, parse mismatch).
+int64_t pn_serve_multi(const char* src, int64_t len,
+                       const char* names, const int64_t* name_offs,
+                       const char* rlabels, const int64_t* rlabel_offs,
+                       int64_t n_states, int64_t default_sid,
+                       const uint64_t* rs_addrs, const uint64_t* ps_addrs,
+                       const uint64_t* gram_addrs, const int64_t* n_rows,
+                       const int64_t* gram_dims,
+                       int64_t* out, int64_t cap) {
+    enum { MAXC = 4096, TAB = 16 };
+    static thread_local uint8_t op_ids[MAXC];
+    static thread_local int32_t frame_ids[MAXC], key_ids[MAXC];
+    static thread_local int64_t r1[MAXC], r2[MAXC];
+    int32_t uf_s[TAB], uf_e[TAB], uk_s[TAB], uk_e[TAB];
+    int32_t n_frames = 0, n_keys = 0;
+    int64_t n = pn_pql_match_pairs(src, len, op_ids, frame_ids, key_ids, r1, r2,
+                                   cap < MAXC ? cap : MAXC,
+                                   uf_s, uf_e, &n_frames, uk_s, uk_e, &n_keys,
+                                   TAB);
+    if (n < 0) return PN_PQL_FALLBACK;
+    // Resolve each interned frame span to an armed state by content.
+    int32_t f_sid[TAB];
+    for (int32_t t = 0; t < n_frames; t++) {
+        f_sid[t] = -1;
+        int32_t l = uf_e[t] - uf_s[t];
+        for (int64_t sid = 0; sid < n_states; sid++) {
+            int64_t nl = name_offs[sid + 1] - name_offs[sid];
+            if (nl == l &&
+                memcmp(src + uf_s[t], names + name_offs[sid], (size_t)l) == 0) {
+                f_sid[t] = (int32_t)sid;
+                break;
+            }
+        }
+        if (f_sid[t] < 0) return PN_PQL_FALLBACK;
+    }
+    for (int64_t i = 0; i < n; i++) {
+        int64_t sid = frame_ids[i] < 0 ? default_sid : f_sid[frame_ids[i]];
+        if (sid < 0) return PN_PQL_FALLBACK;
+        // The call's row-key label must be ITS frame's row label.
+        int32_t kt = key_ids[i];
+        int64_t kl = rlabel_offs[sid + 1] - rlabel_offs[sid];
+        if (uk_e[kt] - uk_s[kt] != kl ||
+            memcmp(src + uk_s[kt], rlabels + rlabel_offs[sid], (size_t)kl) != 0)
+            return PN_PQL_FALLBACK;
+        const int64_t* rs = reinterpret_cast<const int64_t*>((uintptr_t)rs_addrs[sid]);
+        const int32_t* ps = reinterpret_cast<const int32_t*>((uintptr_t)ps_addrs[sid]);
+        const int64_t* gram = reinterpret_cast<const int64_t*>((uintptr_t)gram_addrs[sid]);
+        int64_t nr = n_rows[sid], gd = gram_dims[sid];
+        int64_t i1 = pn_row_pos(rs, nr, r1[i]);
+        int64_t i2 = pn_row_pos(rs, nr, r2[i]);
+        if (i1 < 0 || i2 < 0) return PN_PQL_FALLBACK;
+        int64_t p1 = ps[i1], p2 = ps[i2];
+        int64_t g = gram[p1 * gd + p2];
+        switch (op_ids[i]) {
+            case 0: out[i] = g; break;
+            case 1: out[i] = gram[p1 * gd + p1] + gram[p2 * gd + p2] - g; break;
+            case 2: out[i] = gram[p1 * gd + p1] + gram[p2 * gd + p2] - 2 * g; break;
+            case 3: out[i] = gram[p1 * gd + p1] - g; break;
+            default: return PN_PQL_FALLBACK;
+        }
+    }
+    return n;
+}
+
+// Matcher for an all-Count(Range(...)) request: per call the frame id
+// (interned; -1 = default), row-key label id (interned), row id, and the
+// start/end timestamps packed as digit integers (see pn_match_time).
+// Args accepted in any order; each exactly once; start/end must be
+// quoted.  Returns the call count or PN_PQL_FALLBACK; like the pair
+// matcher, a single-call body falls back (fusing buys nothing there).
+int64_t pn_pql_match_range(const char* src, int64_t len,
+                           int32_t* frame_ids, int32_t* key_ids, int64_t* rows,
+                           int64_t* starts, int64_t* ends, int64_t call_cap,
+                           int32_t* uf_s, int32_t* uf_e, int32_t* n_frames,
+                           int32_t* uk_s, int32_t* uk_e, int32_t* n_keys,
+                           int32_t tab_cap) {
+    PairMatcher p = {src, len, 0};
+    int64_t n = 0;
+    *n_frames = 0;
+    *n_keys = 0;
+    while (p.ws()) {
+        if (n >= call_cap) return PN_PQL_FALLBACK;
+        if (!p.lit("Count", 5)) return PN_PQL_FALLBACK;
+        if (!p.ws() || !p.ch('(')) return PN_PQL_FALLBACK;
+        if (!p.ws() || !p.lit("Range", 5)) return PN_PQL_FALLBACK;
+        if (!p.ws() || !p.ch('(')) return PN_PQL_FALLBACK;
+        int32_t f_s = -1, f_e = -1, k_s = -1, k_e = -1;
+        int64_t rv = -1, start = -1, end = -1;
+        for (int a = 0; a < 4; a++) {
+            if (!p.ws()) return PN_PQL_FALLBACK;
+            int32_t ks, ke;
+            if (!p.ident(&ks, &ke)) return PN_PQL_FALLBACK;
+            if (!p.ws() || !p.ch('=')) return PN_PQL_FALLBACK;
+            if (!p.ws()) return PN_PQL_FALLBACK;
+            bool is_frame = (ke - ks == 5 && memcmp(src + ks, "frame", 5) == 0);
+            bool is_start = (ke - ks == 5 && memcmp(src + ks, "start", 5) == 0);
+            bool is_end = (ke - ks == 3 && memcmp(src + ks, "end", 3) == 0);
+            if (is_frame) {
+                if (f_s >= 0) return PN_PQL_FALLBACK;
+                char q = src[p.i];
+                if (q == '"' || q == '\'') {
+                    p.i++;
+                    f_s = (int32_t)p.i;
+                    while (p.i < len && src[p.i] != q) {
+                        if (src[p.i] == '\\') return PN_PQL_FALLBACK;
+                        p.i++;
+                    }
+                    if (p.i >= len) return PN_PQL_FALLBACK;
+                    f_e = (int32_t)p.i;
+                    p.i++;
+                } else if (!p.ident(&f_s, &f_e)) {
+                    return PN_PQL_FALLBACK;
+                }
+            } else if (is_start || is_end) {
+                if ((is_start ? start : end) >= 0) return PN_PQL_FALLBACK;
+                char q = src[p.i];
+                if (q != '"' && q != '\'') return PN_PQL_FALLBACK;
+                p.i++;
+                int64_t vs = p.i;
+                while (p.i < len && src[p.i] != q) {
+                    if (src[p.i] == '\\') return PN_PQL_FALLBACK;
+                    p.i++;
+                }
+                if (p.i >= len) return PN_PQL_FALLBACK;
+                int64_t packed;
+                if (!pn_match_time(src + vs, p.i - vs, &packed))
+                    return PN_PQL_FALLBACK;
+                p.i++;
+                if (is_start) start = packed; else end = packed;
+            } else {
+                if (rv >= 0) return PN_PQL_FALLBACK;
+                if (!p.integer(&rv)) return PN_PQL_FALLBACK;
+                k_s = ks;
+                k_e = ke;
+            }
+            if (!p.ws()) return PN_PQL_FALLBACK;
+            if (src[p.i] == ',') {
+                p.i++;
+                continue;
+            }
+            break;
+        }
+        if (!p.ws() || !p.ch(')')) return PN_PQL_FALLBACK;  // close Range
+        if (!p.ws() || !p.ch(')')) return PN_PQL_FALLBACK;  // close Count
+        if (rv < 0 || start < 0 || end < 0) return PN_PQL_FALLBACK;
+        int32_t fid = (f_s < 0)
+                          ? -1
+                          : intern_span(src, f_s, f_e, uf_s, uf_e, n_frames, tab_cap);
+        int32_t kid = intern_span(src, k_s, k_e, uk_s, uk_e, n_keys, tab_cap);
+        if (fid == -2 || kid == -2) return PN_PQL_FALLBACK;
+        frame_ids[n] = fid;
+        key_ids[n] = kid;
+        rows[n] = rv;
+        starts[n] = start;
+        ends[n] = end;
+        n++;
+    }
+    return n >= 2 ? n : PN_PQL_FALLBACK;
+}
+
+// Fused nested-tree serving lane: parse an all-Count(op-tree) body and
+// evaluate every call straight off the fragment's armed container table
+// (single-slice frames; the caller holds the fragment lock so the
+// buffers cannot move mid-read).  keys/addrs/ns describe the ARRAY
+// containers (pn_write_batch's table); bkeys is the sorted key set of
+// BITMAP containers — a leaf touching one declines (the table carries no
+// byte view of bitmaps).  Absent keys are empty row segments.  Returns
+// the call count with counts in out[], or PN_PQL_FALLBACK.
+int64_t pn_serve_tree(const char* src, int64_t len,
+                      const char* frame, int64_t flen, int64_t allow_default,
+                      const char* rowkey, int64_t klen,
+                      const uint64_t* keys_sorted, const uint64_t* buf_addrs,
+                      const int64_t* ns, int64_t n_containers,
+                      const uint64_t* bkeys, int64_t n_bkeys,
+                      int64_t* out, int64_t cap) {
+    PairMatcher p = {src, len, 0};
+    static thread_local std::vector<uint32_t> arena;
+    int64_t n = 0;
+    while (p.ws()) {
+        if (n >= cap) return PN_PQL_FALLBACK;
+        if (!p.lit("Count", 5)) return PN_PQL_FALLBACK;
+        if (!p.ws() || !p.ch('(')) return PN_PQL_FALLBACK;
+        PnTreeNode nodes[PN_TREE_MAX_NODES];
+        int64_t n_nodes = 0;
+        if (!pn_tree_parse(p, src, len, frame, flen, (int)allow_default,
+                           rowkey, klen, nodes, &n_nodes, 0))
+            return PN_PQL_FALLBACK;
+        if (!p.ws() || !p.ch(')')) return PN_PQL_FALLBACK;  // close Count
+        // integer() bounds rows below 1e18, so row*16+15 fits uint64.
+        if (nodes[0].op < 0) {
+            // Plain Count(Bitmap): the row's cardinality straight off
+            // the table — no merges, no scratch.
+            uint64_t row = (uint64_t)nodes[0].row;
+            int64_t total = 0;
+            for (uint64_t j = 0; j < 16; j++) {
+                uint64_t key = row * 16 + j;
+                int64_t t = pn_tab_pos(keys_sorted, n_containers, key);
+                if (t >= 0) total += ns[t];
+                else if (pn_tab_pos(bkeys, n_bkeys, key) >= 0)
+                    return PN_PQL_FALLBACK;
+            }
+            out[n++] = total;
+            continue;
+        }
+        if (arena.empty())
+            arena.resize((size_t)(PN_TREE_MAX_DEPTH + 2) * 2 * PN_TREE_BLOCK);
+        int64_t total = 0;
+        for (uint64_t j = 0; j < 16; j++) {
+            PnTreeEval ev = {nodes, keys_sorted, buf_addrs, ns, n_containers,
+                             bkeys, n_bkeys, arena.data(), 0, j, false};
+            int64_t rn;
+            ev.eval(0, &rn);
+            if (ev.decline) return PN_PQL_FALLBACK;
+            total += rn;
+        }
+        out[n++] = total;
+    }
+    return n >= 1 ? n : PN_PQL_FALLBACK;
 }
 
 }  // extern "C"

@@ -31,6 +31,41 @@ def _load_config(args) -> "Config":
 
 # -- server (cmd/server.go) -------------------------------------------------
 
+def _spawn_reuseport_workers(cfg, server, args) -> list:
+    """[server] workers > 1: the multi-core fallback for GIL builds.
+
+    The parent has already bound with SO_REUSEPORT (Server.open turns
+    it on when workers > 1); N-1 sibling server processes bind the same
+    resolved port and the kernel spreads accepted connections across
+    them.  On a free-threaded build (GIL disabled) the in-process
+    worker pool already serves N cores, so nothing is forked.  Each
+    sibling is a full server over the same data-dir: read-path scaling
+    only — route writes through the replica router (DEVELOPMENT.md
+    "Multi-core serving") when multi-process write consistency matters.
+    """
+    import os
+    import subprocess
+
+    n = int(getattr(cfg, "server_workers", 0) or 0)
+    if n <= 1 or os.environ.get("PILOSA_TPU_SERVER_WORKER_CHILD") == "1":
+        return []
+    gil_enabled = getattr(sys, "_is_gil_enabled", lambda: True)()
+    if not gil_enabled:
+        print(f"free-threaded build: {n} workers collapse into the in-process pool")
+        return []
+    env = dict(os.environ)
+    env["PILOSA_TPU_SERVER_WORKER_CHILD"] = "1"
+    env["PILOSA_HOST"] = server.host  # the parent's RESOLVED host:port
+    env["PILOSA_TPU_SERVER_WORKERS"] = str(n)  # keeps SO_REUSEPORT on
+    env["PILOSA_DATA_DIR"] = server.data_dir
+    cmd = [sys.executable, "-m", "pilosa_tpu", "server"]
+    if getattr(args, "config", None):
+        cmd += ["--config", args.config]
+    procs = [subprocess.Popen(cmd, env=env) for _ in range(n - 1)]
+    print(f"spawned {len(procs)} SO_REUSEPORT worker processes on {server.host}")
+    return procs
+
+
 def cmd_server(args) -> int:
     from pilosa_tpu.server.server import Server
 
@@ -50,7 +85,16 @@ def cmd_server(args) -> int:
         profiler = cProfile.Profile()
         profiler.enable()
 
+    workers: list = []
+
     def _finish() -> None:
+        for p in workers:
+            p.terminate()
+        for p in workers:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
         server.close()
         if profiler is not None:
             profiler.disable()
@@ -59,6 +103,7 @@ def cmd_server(args) -> int:
 
     server = Server(cfg)
     server.open()
+    workers = _spawn_reuseport_workers(cfg, server, args)
     print(f"pilosa-tpu serving on http://{server.host} (data: {server.data_dir})")
     if args.test_exit:  # for CLI tests: start, report, stop
         _finish()

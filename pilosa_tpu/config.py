@@ -64,6 +64,17 @@ class Config:
     # Row ceiling for the cached all-pairs Gram strategy (4096 rows = a
     # 64 MiB Gram; raise on host-attached hardware).
     gram_rows_max: int = 4096
+    # -- HTTP serving ([server] TOML section) -----------------------------
+    # Connection worker-pool bound: accepted connections queue to this
+    # many pre-spawned handler threads (brief overflow wait, then a
+    # 503 + Retry-After shed).  0 = legacy unbounded thread-per-
+    # connection.
+    server_max_threads: int = 32
+    # Multi-process SO_REUSEPORT worker count for GIL builds (the CLI
+    # forks N-1 extra server processes sharing one port; free-threaded
+    # CPython serves N cores from one process via the pool instead).
+    # 0 or 1 = single process.
+    server_workers: int = 0
     # -- query result cache ([qcache] TOML section) ----------------------
     # Generation-keyed whole-query result cache in front of the
     # executor: exact (any write to a touched fragment bumps a
@@ -181,6 +192,9 @@ class Config:
         )
         cfg.repair_rows_max = int(raw.get("repair-rows-max", cfg.repair_rows_max))
         cfg.gram_rows_max = int(raw.get("gram-rows-max", cfg.gram_rows_max))
+        srv = raw.get("server", {})
+        cfg.server_max_threads = int(srv.get("max-threads", cfg.server_max_threads))
+        cfg.server_workers = int(srv.get("workers", cfg.server_workers))
         qc = raw.get("qcache", {})
         cfg.qcache_enabled = bool(qc.get("enabled", cfg.qcache_enabled))
         cfg.qcache_max_bytes = int(qc.get("max-bytes", cfg.qcache_max_bytes))
@@ -276,6 +290,10 @@ class Config:
             self.repair_rows_max = int(env["PILOSA_TPU_REPAIR_ROWS_MAX"])
         if "PILOSA_TPU_GRAM_ROWS_MAX" in env:
             self.gram_rows_max = int(env["PILOSA_TPU_GRAM_ROWS_MAX"])
+        if "PILOSA_TPU_SERVER_MAX_THREADS" in env:
+            self.server_max_threads = int(env["PILOSA_TPU_SERVER_MAX_THREADS"])
+        if "PILOSA_TPU_SERVER_WORKERS" in env:
+            self.server_workers = int(env["PILOSA_TPU_SERVER_WORKERS"])
         if "PILOSA_TPU_QCACHE" in env:
             self.qcache_enabled = env["PILOSA_TPU_QCACHE"].lower() in ("1", "true", "yes")
         if "PILOSA_TPU_QCACHE_MAX_BYTES" in env:

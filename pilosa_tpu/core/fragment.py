@@ -626,11 +626,16 @@ class Fragment:
         addrs: list[int] = []
         ns_l: list[int] = []
         caps: list[int] = []
+        bkeys_l: list[int] = []
         for key in sorted(storage.containers):
             c = storage.containers[key]
             arr = c.array
             if arr is None:
-                continue  # bitmap container: not natively insertable
+                # Bitmap container: not natively insertable — recorded in
+                # the bkeys side table so the tree READ lane can tell
+                # "bitmap here, decline" from "empty row segment".
+                bkeys_l.append(key)
+                continue
             n = len(arr)
             c._ensure_slack(n)
             keys_l.append(key)
@@ -642,6 +647,7 @@ class Fragment:
         addrs_a = np.array(addrs, dtype=np.uint64)
         ns_a = np.array(ns_l, dtype=np.int64)
         caps_a = np.array(caps, dtype=np.int64)
+        bkeys_a = np.array(bkeys_l, dtype=np.uint64)
         st = {
             "storage": storage,
             "gen": self.generation,
@@ -649,6 +655,7 @@ class Fragment:
             "addrs": addrs_a,
             "ns": ns_a,
             "caps": caps_a,
+            "bkeys": bkeys_a,
             "objs": objs,
             # Raw base addresses, cached once per rebuild: .ctypes.data
             # costs ~1.4 us per access — 4 accesses per request would
@@ -658,10 +665,43 @@ class Fragment:
                 keys_a.ctypes.data, addrs_a.ctypes.data,
                 ns_a.ctypes.data, caps_a.ctypes.data,
             ),
+            "bptr": bkeys_a.ctypes.data,
             "n": len(keys_a),
+            "n_bkeys": len(bkeys_a),
         }
         self._writelane = st
         return st
+
+    def serve_tree(self, src: bytes, frame_b: bytes, allow_default: bool,
+                   rowkey_b: bytes):
+        """Fused nested-tree READ lane: parse an all-Count(op-tree over
+        Bitmap leaves) body and evaluate it against this fragment's armed
+        container table in one GIL-released ``pn_serve_tree`` crossing —
+        the read-side use of the write lane's table.  Runs under the
+        fragment lock for the whole call: native writers mutate those
+        buffers in place, so the read must exclude them.
+
+        Returns i64[N] counts, or None for any decline (native
+        unavailable, non-canonical body, a leaf touching a bitmap
+        container, containers born since the table was built) — the
+        caller falls back to the general path.
+        """
+        with self._mu:
+            self._assert_open()
+            st = self._writelane_state()
+            if st is None or st.get("extra"):
+                # Containers created through the scalar lane since the
+                # build aren't in the table: a tree read would silently
+                # see them as empty segments.
+                return None
+            kp, ap, np_, _cp = st["ptrs"]
+            counts = native_mod.serve_tree(
+                src, frame_b, allow_default, rowkey_b,
+                kp, ap, np_, st["n"], st["bptr"], st["n_bkeys"],
+            )
+            if counts is not None:
+                self.stats.count("servelane.tree_batches", 1)
+            return counts
 
     def write_batch(self, src: bytes, frame_b: bytes, rowkey_b: bytes,
                     colkey_b: bytes):

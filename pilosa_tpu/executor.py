@@ -234,6 +234,10 @@ class Executor:
         "_multi_matrix_cache": "executor._matrix_mu",
         "_serve_states": "executor._matrix_mu",
         "_dirty_rows": "executor._dirty_mu",
+        # Monotonic invalidation counter for the per-thread armed lane
+        # tables (each thread's tables are private; only the epoch is
+        # shared, written on frame/index drops).
+        "_lane_epoch": "executor._matrix_mu",
     }
 
     def __init__(
@@ -281,17 +285,20 @@ class Executor:
         # fighting over the interpreter per request.
         self._write_queue = None
         self._serve_queue = None
-        # (index, frame) -> (index_obj, frame_obj) for the singleton-write
-        # fast lane; validated by object identity per request (frame
-        # deletion/recreation yields new objects).
-        self._fastwrite_cache: dict[tuple[str, str], tuple] = {}
-        # (index, frame) -> armed request state for the NATIVE write lane
-        # (_write_fast_lane): pre-encoded frame/label bytes + the armed
-        # fragment whose container table pn_write_batch mutates.  Object
-        # identities are revalidated per request (same rule as the
-        # fast-write cache); the per-fragment table's own validity lives
-        # in Fragment._writelane.
-        self._writelane_arm: dict[tuple[str, str], dict] = {}
+        # Per-THREAD armed tables for the write lanes (the table-per-
+        # thread registry extending PR-10's armed-table validity rule):
+        # each serving thread owns a private {(index, frame) -> arm}
+        # pair — (idx_obj, frame_obj) tuples for the singleton regex
+        # lane, armed request dicts for the native write lane — so
+        # concurrent writers neither share nor lock one table.  Every
+        # entry is still identity-revalidated per request (frame
+        # deletion/recreation yields new objects; the per-fragment
+        # container table's own validity lives in Fragment._writelane),
+        # so a stale entry is never wrong, just a wasted probe; the
+        # epoch below exists to release dead index/frame objects
+        # promptly on explicit drops.
+        self._lane_local = threading.local()
+        self._lane_epoch = 0
         self._writelane_env: Optional[bool] = None  # lazy env-gate read
         self._fastwrite_env: Optional[bool] = None  # lazy env-gate read
         # Cached serve states for the single-call native read lane
@@ -356,6 +363,26 @@ class Executor:
 
             self._write_queue = WriteQueue(self._apply_queued_writes)
             self._serve_queue = WriteQueue(self._apply_queued_reads, max_batch=64)
+
+    def _lane_tables(self):
+        """This thread's private armed write-lane tables:
+        ``(fastwrite, writelane)`` dicts keyed (index, frame).
+
+        Thread-private, so no lock and no cross-thread mutation; a
+        drop_frame_state/drop_index_state bumps ``_lane_epoch`` and
+        every thread discards its own tables at next access.  A thread
+        racing the bump may finish one more request on a stale entry —
+        harmless, because both lanes revalidate index/frame object
+        identity (and the fragment container table its generation)
+        before every use.
+        """
+        loc = self._lane_local
+        epoch = self._lane_epoch
+        if getattr(loc, "epoch", None) != epoch:
+            loc.epoch = epoch
+            loc.fastwrite = {}
+            loc.writelane = {}
+        return loc.fastwrite, loc.writelane
 
     # -- top level (executor.go:65-153) ----------------------------------
 
@@ -722,10 +749,10 @@ class Executor:
         if m is None:
             return None
         fname = m.group(1) or m.group(2) or m.group(3)
-        # analysis-ok: check-then-act: idempotent derived arm, identity-revalidated on every use; a double-arm is a wasted rebuild, last-writer-wins (free-threading move under a lane lock inventoried in DEVELOPMENT.md)
-        st = self._writelane_arm.get((index, fname))
+        _, writelane = self._lane_tables()  # this thread's private table
+        st = writelane.get((index, fname))
         if st is None or self.holder.index(index) is not st["idx_obj"]:
-            self._writelane_arm.pop((index, fname), None)
+            writelane.pop((index, fname), None)
             idx_obj = self.holder.index(index)
             if idx_obj is None:
                 return None  # general path raises in canonical order
@@ -743,10 +770,10 @@ class Executor:
                 }
             except UnicodeEncodeError:
                 return None
-            self._writelane_arm[(index, fname)] = st
+            writelane[(index, fname)] = st
         idx_obj, frame = st["idx_obj"], st["frame"]
         if idx_obj.frame(fname) is not frame:
-            self._writelane_arm.pop((index, fname), None)
+            writelane.pop((index, fname), None)
             return None
         if frame.inverse_enabled:
             return None  # dual-view writes: general path
@@ -842,10 +869,10 @@ class Executor:
         if m is None:
             return None
         name, k1, v1, fname, k2, v2 = m.groups()
-        # analysis-ok: check-then-act: idempotent derived arm, identity-revalidated on every use; a double-arm is a wasted rebuild, last-writer-wins (free-threading move under a lane lock inventoried in DEVELOPMENT.md)
-        cached = self._fastwrite_cache.get((index, fname))
+        fastwrite, _ = self._lane_tables()  # this thread's private table
+        cached = fastwrite.get((index, fname))
         if cached is None or self.holder.index(index) is not cached[0]:
-            self._fastwrite_cache.pop((index, fname), None)  # no dead pins
+            fastwrite.pop((index, fname), None)  # no dead pins
             idx_obj = self.holder.index(index)
             if idx_obj is None:
                 return None  # general path raises in canonical order
@@ -853,10 +880,10 @@ class Executor:
             if frame is None:
                 return None
             cached = (idx_obj, frame)
-            self._fastwrite_cache[(index, fname)] = cached
+            fastwrite[(index, fname)] = cached
         idx_obj, frame = cached
         if idx_obj.frame(fname) is not frame:
-            self._fastwrite_cache.pop((index, fname), None)
+            fastwrite.pop((index, fname), None)
             return None
         if (
             frame.inverse_enabled
@@ -953,8 +980,32 @@ class Executor:
                         if (index, fname) in self._serve_states:
                             self._serve_states.move_to_end((index, fname))
                     return counts.tolist()
+            # Multi-frame breadth: a batch spanning SEVERAL armed frames
+            # (the single-state path above only ever serves one) still
+            # answers in one crossing — pn_serve_multi evaluates each
+            # call against its frame's glut.  Also covers the case where
+            # the sniffed frame's state was just invalidated but the
+            # batch's other frames are warm: the native validator simply
+            # declines on the missing frame and the general lane re-arms.
+            # analysis-ok: lockstep-determinism: deployment config, launcher sets identical env on every rank
+            if len(self._serve_states) > 1 and os.environ.get(
+                "PILOSA_TPU_NO_SERVEMULTI", ""
+            ).lower() not in ("1", "true", "yes"):
+                counts = self._serve_multi_counts(index, raw, opt)
+                if counts is not None:
+                    return counts
         m = native.pql_match_pairs(raw)
         if m is None:
+            # Not an all-pairs body: the breadth lanes own the other
+            # compiled shapes before the tokenizer runs — nested op
+            # trees straight off the armed container table, then
+            # all-Count(Range(...)) batches through the fused multi-view
+            # evaluator with the parse already native.
+            if local:
+                tree = self._tree_fast_path(index, raw, src, opt)
+                if tree is not None:
+                    return tree
+                return self._range_fast_path(index, raw, opt)
             return None
         op_ids, frame_ids, key_ids, r1, r2, frames_b, keys_b = m
 
@@ -1038,6 +1089,171 @@ class Executor:
             if f is not frag or (f is not None and f.generation != gen):
                 return False
         return True
+
+    # -- serve-lane breadth (multi-frame / Range / nested-tree) -----------
+
+    def _serve_multi_counts(self, index: str, raw: bytes, opt) -> Optional[list]:
+        """Multi-frame one-call serving: bundle every VALID armed state
+        for the index (names, row labels, glut base addresses) and hand
+        the whole request to ``pn_serve_multi`` — parse, per-frame
+        validation, and Gram count identities in one GIL-released
+        crossing.  Any decline (unknown frame, cold frame, unknown row)
+        returns None and the general lane re-arms per frame.
+        """
+        from pilosa_tpu import native
+
+        with self._matrix_mu:
+            cands = [st for k, st in self._serve_states.items() if k[0] == index]
+        states = [st for st in cands if self._serve_state_valid(st)][:16]
+        if len(states) < 2:
+            return None
+        name_offs = np.zeros(len(states) + 1, dtype=np.int64)
+        rlabel_offs = np.zeros(len(states) + 1, dtype=np.int64)
+        default_sid = -1
+        for i, st in enumerate(states):
+            name_offs[i + 1] = name_offs[i] + len(st["frame_b"])
+            rlabel_offs[i + 1] = rlabel_offs[i] + len(st["rowkey_b"])
+            if st["allow_default"]:
+                default_sid = i
+        names_cat = b"".join(st["frame_b"] for st in states)
+        rlabels_cat = b"".join(st["rowkey_b"] for st in states)
+        # Raw glut addresses: the `states` list keeps every array alive
+        # across the call; entries evicted concurrently stay pinned here.
+        rs_addrs = np.array([st["rs"].ctypes.data for st in states], dtype=np.uint64)
+        ps_addrs = np.array([st["ps"].ctypes.data for st in states], dtype=np.uint64)
+        gram_addrs = np.array(
+            [st["gram"].ctypes.data for st in states], dtype=np.uint64
+        )
+        n_rows = np.array([len(st["rs"]) for st in states], dtype=np.int64)
+        gram_dims = np.array([st["gram"].shape[0] for st in states], dtype=np.int64)
+        if self.meter is not None:
+            with self.meter.measure("native", opt.span) as d:
+                counts = native.serve_multi(
+                    raw, names_cat, name_offs, rlabels_cat, rlabel_offs,
+                    default_sid, rs_addrs, ps_addrs, gram_addrs, n_rows, gram_dims,
+                )
+                d.add_bytes(len(raw))
+        else:
+            counts = native.serve_multi(
+                raw, names_cat, name_offs, rlabels_cat, rlabel_offs,
+                default_sid, rs_addrs, ps_addrs, gram_addrs, n_rows, gram_dims,
+            )
+        if counts is None:
+            return None
+        with self._matrix_mu:
+            for st in states:
+                k = (index, st["fname"])
+                if self._serve_states.get(k) is st:
+                    self._serve_states.move_to_end(k)
+        return counts.tolist()
+
+    def _tree_fast_path(self, index: str, raw: bytes, src: str, opt) -> Optional[list]:
+        """Nested-tree serving: an all-Count(op-tree over Bitmap leaves)
+        body evaluated straight off the fragment's armed container table
+        (``pn_serve_tree`` — matcher and evaluator fused, intermediate id
+        arrays never materialize).  Single-slice local indexes only: the
+        armed table is per fragment and the whole call runs under that
+        fragment's lock.  None for anything outside the shape.
+        """
+        # analysis-ok: lockstep-determinism: deployment config, launcher sets identical env on every rank
+        if os.environ.get("PILOSA_TPU_NO_SERVETREE", "").lower() in (
+            "1", "true", "yes",
+        ):
+            return None
+        idx_obj = self.holder.index(index)
+        if idx_obj is None or idx_obj.max_slice() != 0:
+            return None
+        sn = _FRAME_SNIFF_RX.search(src, 0, 512)
+        fname = sn.group(1) or sn.group(2) or sn.group(3) if sn else DEFAULT_FRAME
+        fr = self.holder.frame(index, fname)
+        if fr is None:
+            return None
+        frag = self.holder.fragment(index, fname, VIEW_STANDARD, 0)
+        if frag is None:
+            return None
+        try:
+            frame_b = fname.encode("ascii")
+            rowkey_b = fr.row_label.encode("ascii")
+        except UnicodeEncodeError:
+            return None
+        if self.meter is not None:
+            with self.meter.measure("native", opt.span) as d:
+                counts = frag.serve_tree(
+                    raw, frame_b, fname == DEFAULT_FRAME, rowkey_b
+                )
+                d.add_bytes(len(raw))
+        else:
+            counts = frag.serve_tree(raw, frame_b, fname == DEFAULT_FRAME, rowkey_b)
+        if counts is None:
+            return None
+        if opt.span is not None:
+            opt.span.tags["frame"] = fname
+        return counts.tolist()
+
+    def _range_fast_path(self, index: str, raw: bytes, opt) -> Optional[list]:
+        """Native Range cover lane: ``pn_pql_match_range`` parses an
+        all-Count(Range(...)) body (rows + packed digit timestamps) so
+        the batch skips the Python tokenizer and rides the existing fused
+        multi-view evaluator.  Validation mirrors the AST fused path —
+        any decline (unknown frame, label mismatch, calendar error,
+        over-budget cover set) returns None so the sequential path keeps
+        every behavior and error message.
+        """
+        # analysis-ok: lockstep-determinism: deployment config, launcher sets identical env on every rank
+        if os.environ.get("PILOSA_TPU_NO_RANGELANE", "").lower() in (
+            "1", "true", "yes",
+        ):
+            return None
+        from pilosa_tpu import native
+
+        m = native.pql_match_range(raw)
+        if m is None:
+            return None
+        frame_ids, key_ids, rows, starts, ends, frames_b, keys_b = m
+        frame_names = [b.decode("utf-8") for b in frames_b]
+        key_names = [b.decode("utf-8") for b in keys_b]
+        frames: dict[int, tuple] = {}
+        for f_id, k_id in sorted(set(zip(frame_ids.tolist(), key_ids.tolist()))):
+            fname = frame_names[f_id] if f_id >= 0 else DEFAULT_FRAME
+            fr = self.holder.frame(index, fname)
+            if fr is None or key_names[k_id] != fr.row_label:
+                return None
+            frames[f_id] = (fname, fr)
+        idx_obj = self.holder.index(index)
+        if idx_obj is None:
+            return None
+        std_slices = list(range(idx_obj.max_slice() + 1))
+        if len(std_slices) > _INT32_SAFE_SLICES:
+            return None
+        matched: dict[int, tuple[str, int, list[str]]] = {}
+        for i in range(len(rows)):
+            fname, fr = frames[int(frame_ids[i])]
+            s, e = int(starts[i]), int(ends[i])
+            try:
+                # Packed digits -> datetime: calendar validation happens
+                # HERE, so an invalid date declines to the Python parser
+                # and surfaces its exact error.
+                start = datetime(
+                    s // 10**8, s // 10**6 % 100, s // 10**4 % 100,
+                    s // 100 % 100, s % 100,
+                )
+                end = datetime(
+                    e // 10**8, e // 10**6 % 100, e // 10**4 % 100,
+                    e // 100 % 100, e % 100,
+                )
+            except ValueError:
+                return None
+            views = (
+                tq.views_by_time_range(VIEW_STANDARD, start, end, fr.time_quantum)
+                if fr.time_quantum
+                else []
+            )
+            matched[i] = (fname, int(rows[i]), views)
+        combos = {(f, v, r) for f, r, views in matched.values() for v in views}
+        if len(combos) > self._matrix_rows_max:
+            return None
+        idxs = list(range(len(rows)))
+        return self._fused_local_range_counts(index, matched, idxs, std_slices)
 
     # -- warm-state repair (delta patch instead of invalidate) ------------
 
@@ -1174,7 +1390,10 @@ class Executor:
             ]:
                 del self._multi_matrix_cache[k]
             self._serve_states.pop((index, frame), None)
-        self._fastwrite_cache.pop((index, frame), None)
+            # Per-thread armed lane tables can't be reached from here;
+            # the epoch bump makes every thread clear its own at next
+            # access (identity revalidation keeps the interim safe).
+            self._lane_epoch += 1
         with self._dirty_mu:
             self._dirty_rows.pop((index, frame), None)
         if self.qcache is not None:
@@ -1192,8 +1411,7 @@ class Executor:
                 del self._multi_matrix_cache[k]
             for k in [k for k in list(self._serve_states) if k[0] == index]:
                 self._serve_states.pop(k, None)
-        for k in [k for k in list(self._fastwrite_cache) if k[0] == index]:
-            self._fastwrite_cache.pop(k, None)
+            self._lane_epoch += 1  # see drop_frame_state
         with self._dirty_mu:
             for k in [k for k in self._dirty_rows if k[0] == index]:
                 del self._dirty_rows[k]
@@ -1383,12 +1601,13 @@ class Executor:
                         # Arm the single-call serve lane: this exact
                         # state (frame + glut) just served natively, so
                         # subsequent requests can skip straight to
-                        # pn_serve_pairs.  Single-frame full batches
+                        # pn_serve_pairs — or, when a batch spans several
+                        # frames, to pn_serve_multi (each frame group
+                        # arms its own state here).  Unpaged working sets
                         # only; re-capture only when the glut changed.
                         st = self._serve_states.get((index, fname))
                         if (
                             len(qparts) == 1
-                            and bool(fmask0.all())
                             and (st is None or st["glut_id"] is not glut)
                         ):
                             self._capture_serve_state(index, fname, slices, glut, box)

@@ -177,6 +177,34 @@ def load() -> Optional[ctypes.CDLL]:
             ctypes.c_void_p, ctypes.c_void_p,       # cols_out, changed_out
             ctypes.c_int64, ctypes.POINTER(ctypes.c_int64),  # cap, applied
         ]
+        lib.pn_serve_multi.restype = ctypes.c_int64
+        lib.pn_serve_multi.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64,        # src
+            ctypes.c_char_p, ctypes.c_void_p,       # names, name_offs
+            ctypes.c_char_p, ctypes.c_void_p,       # rlabels, rlabel_offs
+            ctypes.c_int64, ctypes.c_int64,         # n_states, default_sid
+            ctypes.c_void_p, ctypes.c_void_p,       # rs_addrs, ps_addrs
+            ctypes.c_void_p, ctypes.c_void_p,       # gram_addrs, n_rows
+            ctypes.c_void_p,                        # gram_dims
+            ctypes.c_void_p, ctypes.c_int64,        # out, cap
+        ]
+        lib.pn_pql_match_range.restype = ctypes.c_int64
+        lib.pn_pql_match_range.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64,
+            i32p, i32p, i64p, i64p, i64p, ctypes.c_int64,
+            i32p, i32p, i32p, i32p, i32p, i32p,
+            ctypes.c_int32,
+        ]
+        lib.pn_serve_tree.restype = ctypes.c_int64
+        lib.pn_serve_tree.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64,        # src
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64,  # frame, allow_default
+            ctypes.c_char_p, ctypes.c_int64,        # rowkey
+            ctypes.c_void_p, ctypes.c_void_p,       # keys_sorted, buf_addrs
+            ctypes.c_void_p, ctypes.c_int64,        # ns, n_containers
+            ctypes.c_void_p, ctypes.c_int64,        # bkeys, n_bkeys
+            ctypes.c_void_p, ctypes.c_int64,        # out, cap
+        ]
         _lib = lib
         return _lib
 
@@ -545,6 +573,110 @@ def serve_pairs(raw, frame_b, allow_default, rowkey_b, rows_sorted, pos, gram):
         rowkey_b, len(rowkey_b),
         rows_sorted.ctypes.data, pos.ctypes.data, len(rows_sorted),
         gram.ctypes.data, gram.shape[0], out.ctypes.data, len(out),
+    )
+    if n < 0:
+        return None
+    return out[:n]
+
+
+def serve_multi(raw, names_cat, name_offs, rlabels_cat, rlabel_offs,
+                default_sid, rs_addrs, ps_addrs, gram_addrs, n_rows, gram_dims):
+    """Multi-frame one-call serving lane (``pn_serve_multi``): the
+    serve_pairs crossing generalized to K armed frame states, so a
+    dashboard batch spanning several frames still parses, validates, and
+    Gram-evaluates in ONE GIL-released native call.
+
+    names_cat/rlabels_cat: concatenated frame-name / row-label bytes with
+    i64[K+1] offset fences; rs/ps/gram_addrs: u64[K] RAW base addresses
+    of each state's glut arrays; n_rows/gram_dims: i64[K] extents;
+    default_sid: state index serving an absent ``frame=`` arg (-1 =
+    none).  Returns i64[N] counts or None (caller runs the general path).
+    """
+    lib = load()
+    if lib is None:
+        return None
+    out = np.empty(4096, dtype=np.int64)
+    n = lib.pn_serve_multi(
+        raw, len(raw),
+        names_cat, name_offs.ctypes.data,
+        rlabels_cat, rlabel_offs.ctypes.data,
+        len(n_rows), default_sid,
+        rs_addrs.ctypes.data, ps_addrs.ctypes.data, gram_addrs.ctypes.data,
+        n_rows.ctypes.data, gram_dims.ctypes.data,
+        out.ctypes.data, len(out),
+    )
+    if n < 0:
+        return None
+    return out[:n]
+
+
+def pql_match_range(src: bytes):
+    """Native matcher for an all-Count(Range(...)) request body.
+
+    Returns None (fall back to the slower paths) or
+    (frame_ids i32[N] (-1 = default frame), key_ids i32[N], rows i64[N],
+    starts i64[N], ends i64[N], frames list[bytes], keys list[bytes])
+    where starts/ends are Y*1e8+M*1e6+D*1e4+h*1e2+m packed minutes —
+    digit-validated only; the caller's datetime() conversion keeps the
+    sequential path's calendar errors.
+    """
+    lib = load()
+    if lib is None or not src:
+        return None
+    if not src.lstrip()[:5] == b"Count":
+        return None
+    call_cap = src.count(b"Count") + 1
+    frame_ids = np.empty(call_cap, dtype=np.int32)
+    key_ids = np.empty(call_cap, dtype=np.int32)
+    rows = np.empty(call_cap, dtype=np.int64)
+    starts = np.empty(call_cap, dtype=np.int64)
+    ends = np.empty(call_cap, dtype=np.int64)
+    uf_s = np.empty(_PAIR_TAB_CAP, dtype=np.int32)
+    uf_e = np.empty(_PAIR_TAB_CAP, dtype=np.int32)
+    uk_s = np.empty(_PAIR_TAB_CAP, dtype=np.int32)
+    uk_e = np.empty(_PAIR_TAB_CAP, dtype=np.int32)
+    n_frames = ctypes.c_int32(0)
+    n_keys = ctypes.c_int32(0)
+    i32 = ctypes.POINTER(ctypes.c_int32)
+    i64 = ctypes.POINTER(ctypes.c_int64)
+    n = lib.pn_pql_match_range(
+        src, len(src),
+        frame_ids.ctypes.data_as(i32), key_ids.ctypes.data_as(i32),
+        rows.ctypes.data_as(i64), starts.ctypes.data_as(i64),
+        ends.ctypes.data_as(i64), call_cap,
+        uf_s.ctypes.data_as(i32), uf_e.ctypes.data_as(i32), ctypes.byref(n_frames),
+        uk_s.ctypes.data_as(i32), uk_e.ctypes.data_as(i32), ctypes.byref(n_keys),
+        _PAIR_TAB_CAP,
+    )
+    if n < 0:
+        return None
+    frames = [src[uf_s[t]:uf_e[t]] for t in range(n_frames.value)]
+    keys = [src[uk_s[t]:uk_e[t]] for t in range(n_keys.value)]
+    return frame_ids[:n], key_ids[:n], rows[:n], starts[:n], ends[:n], frames, keys
+
+
+def serve_tree(raw, frame_b, allow_default, rowkey_b,
+               keys_p, addrs_p, ns_p, n_containers, bkeys_p, n_bkeys):
+    """Fused nested-tree serving lane (``pn_serve_tree``): parse an
+    all-Count(op-tree over Bitmap leaves) body and evaluate it straight
+    off the fragment's armed container table, matcher and evaluator
+    fused per container block — intermediate row-id arrays never
+    materialize.  The caller holds the fragment lock for the whole call
+    (the table's buffers must not move mid-read).
+
+    ``keys_p/addrs_p/ns_p/bkeys_p`` are RAW base-address ints of the
+    armed table arrays (see fragment._writelane_state); n_bkeys may be 0.
+    Returns i64[N] counts or None (caller runs the general path).
+    """
+    lib = load()
+    if lib is None:
+        return None
+    out = np.empty(4096, dtype=np.int64)
+    n = lib.pn_serve_tree(
+        raw, len(raw), frame_b, len(frame_b), 1 if allow_default else 0,
+        rowkey_b, len(rowkey_b),
+        keys_p, addrs_p, ns_p, n_containers, bkeys_p, n_bkeys,
+        out.ctypes.data, len(out),
     )
     if n < 0:
         return None

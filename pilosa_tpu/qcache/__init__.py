@@ -246,15 +246,27 @@ class QueryCache:
 
     # -- fingerprinting ---------------------------------------------------
 
+    # Distinguishes "never memoized" from the memoized-None of an
+    # ineligible query on the lock-free probe below.
+    _CANON_MISS = object()
+
     def _canonical(self, query_str: str) -> Optional[tuple]:
         """(fingerprint, frames) for an eligible query string, None for
         write-bearing / non-cacheable / unparseable ones.  Memoized: the
         steady-state repeated request pays one dict lookup, not a parse
-        + render."""
-        with self._mu:
-            if query_str in self._canon:
-                self._canon.move_to_end(query_str)
-                return self._canon[query_str]
+        + render.
+
+        The hit probe is LOCK-FREE: memo values are immutable once
+        stored (a tuple or None), so a concurrent insert/evict at worst
+        misses and re-parses.  The trade is that a lock-free hit skips
+        the LRU recency touch — a hot entry churned out by a flood of
+        unique queries just re-parses and re-inserts itself.  All
+        mutation stays under ``_mu`` (the lockset detector's contract
+        for ``_canon``).
+        """
+        val = self._canon.get(query_str, self._CANON_MISS)
+        if val is not self._CANON_MISS:
+            return val
         info = None
         if len(query_str) <= _FINGERPRINT_MAX_LEN:
             from pilosa_tpu import pql
@@ -315,8 +327,12 @@ class QueryCache:
             return None, None
         fp, frames = info
         key = (index, fp, slices_key, remote)
-        with self._mu:
-            entry = self._store.get(key)
+        # Lock-free probe: entries are immutable (_Entry is never
+        # mutated after store) and the generation-vector re-check below
+        # IS the validity gate, so reading a just-evicted or torn-LRU
+        # view costs at most a spurious miss.  Store/evict (and the hit
+        # accounting) stay under ``_mu``.
+        entry = self._store.get(key)
         vec = generation_vector(holder, index, frames)
         if entry is not None:
             if vec is not None and vec == entry.vec:

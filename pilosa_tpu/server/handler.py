@@ -43,7 +43,9 @@ from __future__ import annotations
 import io
 import json
 import os
+import queue
 import re
+import socket
 import threading
 import time
 import traceback
@@ -585,8 +587,17 @@ class Handler:
     def get_expvar(self, **kw):
         stats = {}
         if self.stats is not None and hasattr(self.stats, "snapshot"):
+            self._publish_shard_gauge()
+            # One consistent snapshot under one short lock hold (the
+            # striped client drains every write shard in the same hold).
             stats = self.stats.snapshot()
         return self._json(stats)
+
+    def _publish_shard_gauge(self) -> None:
+        """Pull-model gauge: live stats write shards at scrape time."""
+        shard_count = getattr(self.stats, "shard_count", None)
+        if shard_count is not None:
+            self.stats.gauge("stats.shards", float(shard_count()))
 
     def get_debug_traces(self, params=None, **kw):
         """Finished request traces, newest-first (bounded ring).
@@ -630,6 +641,10 @@ class Handler:
             # Refresh the named-global gauges (parse memo & friends) at
             # scrape time — they are pull-model state, not event counters.
             lockcheck.publish_global_stats(self.stats)
+            self._publish_shard_gauge()
+        # render() reads one snapshot_typed() — the striped client
+        # drains and renders under a single lock hold, so a scrape is
+        # consistent against concurrent mutation by construction.
         text = metrics_mod.render(self.stats) if self.stats is not None else ""
         return 200, metrics_mod.CONTENT_TYPE, text.encode("utf-8")
 
@@ -1119,10 +1134,120 @@ class _HTTPRequestHandler(BaseHTTPRequestHandler):
         pass
 
 
-def serve(handler: Handler, host: str = "127.0.0.1", port: int = 0) -> ThreadingHTTPServer:
-    """Start an HTTP server for the handler; returns the (running) server."""
+# Default connection-worker pool size: enough for every in-tree client
+# rig (benches cap at 16 client threads) with headroom for keep-alive
+# connections that pin a worker between requests.
+DEFAULT_MAX_THREADS = 32
+
+_POOL_STOP = object()
+
+
+class PooledHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer with a BOUNDED connection worker pool.
+
+    Accepted connections are queued to ``max_threads`` pre-spawned
+    workers instead of spawning one thread per connection; a full queue
+    waits ``overflow_wait_s`` then sheds the connection with a raw
+    503 + Retry-After (the same contract the QoS door gives an admitted
+    request, issued before a worker is ever consumed, so clients retry
+    through the normal budget).  ``reuse_port=True`` sets SO_REUSEPORT
+    before bind — the multi-process worker mode on GIL builds runs N
+    such servers on one port and lets the kernel spread accepts.
+    """
+
+    def __init__(self, addr, cls, max_threads: int = DEFAULT_MAX_THREADS,
+                 overflow_wait_s: float = 0.05, retry_after_s: float = 0.25,
+                 reuse_port: bool = False, stats=None):
+        self._reuse_port = reuse_port
+        self.pool_stats = stats
+        self._overflow_wait_s = overflow_wait_s
+        self._retry_after = max(1, int(retry_after_s + 0.999))
+        self._max_threads = max(1, int(max_threads))
+        self._conn_q: "queue.Queue" = queue.Queue(maxsize=self._max_threads * 2)
+        self._workers = [
+            threading.Thread(target=self._worker, daemon=True,
+                             name=f"http-pool-{i}")
+            for i in range(self._max_threads)
+        ]
+        super().__init__(addr, cls)
+        for t in self._workers:
+            t.start()
+        stats = self.pool_stats
+        if stats is not None:
+            stats.gauge("server.pool.workers", float(self._max_threads))
+
+    def server_bind(self):
+        if self._reuse_port:
+            if not hasattr(socket, "SO_REUSEPORT"):
+                raise OSError("SO_REUSEPORT unsupported on this platform")
+            self.socket.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+        super().server_bind()
+
+    def _worker(self) -> None:
+        while True:
+            item = self._conn_q.get()
+            if item is _POOL_STOP:
+                return
+            request, client_address = item
+            # The mixin's per-connection body: finish_request +
+            # handle_error + shutdown_request, minus the thread spawn.
+            self.process_request_thread(request, client_address)
+
+    def process_request(self, request, client_address):
+        try:
+            self._conn_q.put((request, client_address),
+                             timeout=self._overflow_wait_s)
+        except queue.Full:
+            self._shed(request)
+
+    def _shed(self, request) -> None:
+        stats = self.pool_stats
+        if stats is not None:
+            stats.count("server.pool.shed")
+            stats.gauge("server.pool.queue_depth", float(self._conn_q.qsize()))
+        try:
+            request.sendall(
+                (
+                    "HTTP/1.1 503 Service Unavailable\r\n"
+                    f"Retry-After: {self._retry_after}\r\n"
+                    "Content-Length: 0\r\nConnection: close\r\n\r\n"
+                ).encode()
+            )
+        except OSError:
+            pass
+        self.shutdown_request(request)
+
+    def server_close(self):
+        super().server_close()
+        # Unblock every worker, then close any connection still queued.
+        for _ in self._workers:
+            self._conn_q.put(_POOL_STOP)
+        while True:
+            try:
+                item = self._conn_q.get_nowait()
+            except queue.Empty:
+                break
+            if item is not _POOL_STOP:
+                self.shutdown_request(item[0])
+
+
+def serve(handler: Handler, host: str = "127.0.0.1", port: int = 0,
+          max_threads: int = DEFAULT_MAX_THREADS, reuse_port: bool = False,
+          retry_after_s: float = 0.25) -> ThreadingHTTPServer:
+    """Start an HTTP server for the handler; returns the (running) server.
+
+    ``max_threads`` bounds the connection worker pool (0 = the legacy
+    unbounded thread-per-connection server).
+    """
     cls = type("BoundHandler", (_HTTPRequestHandler,), {"handler": handler})
-    httpd = ThreadingHTTPServer((host, port), cls)
+    if max_threads and max_threads > 0:
+        httpd: ThreadingHTTPServer = PooledHTTPServer(
+            (host, port), cls, max_threads=max_threads,
+            retry_after_s=retry_after_s, reuse_port=reuse_port,
+            stats=getattr(handler, "stats", None),
+        )
+    else:
+        httpd = ThreadingHTTPServer((host, port), cls)
     t = threading.Thread(target=httpd.serve_forever, daemon=True)
     t.start()
     return httpd

@@ -225,7 +225,14 @@ class Server:
         self.holder.open()
         self.holder.on_new_fragment = self._on_new_fragment
         host, port = self._split_host(self.host)
-        self._httpd = serve(self.handler, host=host, port=port)
+        # workers > 1 implies SO_REUSEPORT so sibling worker processes
+        # (spawned at the CLI level on GIL builds) can share the port.
+        self._httpd = serve(
+            self.handler, host=host, port=port,
+            max_threads=self.config.server_max_threads,
+            reuse_port=self.config.server_workers > 1,
+            retry_after_s=self.config.qos_retry_after_ms / 1000.0,
+        )
         actual_port = self._httpd.server_address[1]
         if port == 0:
             self.host = f"{host}:{actual_port}"
@@ -250,6 +257,9 @@ class Server:
         self._closing.set()
         if self._httpd is not None:
             self._httpd.shutdown()
+            # Release the listening socket and stop the pool workers
+            # (a REUSEPORT sibling must not inherit a half-dead port).
+            self._httpd.server_close()
             self._httpd = None
         if self.receiver is not None:
             self.receiver.close()

@@ -23,6 +23,52 @@ from typing import Iterable
 # appending every observation forever.
 RESERVOIR_CAP = 4096
 
+# A write shard self-flushes into the base maps once it holds this many
+# pending histogram/timing samples, bounding per-thread memory between
+# snapshots.
+SHARD_FLUSH_CAP = 512
+
+
+@lockcheck.guarded_class
+class _StatsShard:
+    """One thread's private write buffer inside ExpvarStatsClient.
+
+    Writers touch only their own shard under its (uncontended) shard
+    lock; the base maps are only reached by a drain, which holds the
+    client lock THEN the shard lock.  The drain moves-and-zeroes the
+    shard state in one shard-lock hold, so a given delta is merged into
+    the base maps exactly once — a shard self-flushing mid-snapshot
+    serializes on the client lock and cannot be double-counted.
+    """
+
+    _guarded_by_ = {
+        "counters": "stats._shard",
+        "hist_meta": "stats._shard",
+        "hist_pending": "stats._shard",
+        "timing_meta": "stats._shard",
+        "timing_pending": "stats._shard",
+        "pending_n": "stats._shard",
+    }
+
+    __slots__ = (
+        "lock", "counters", "hist_meta", "hist_pending",
+        "timing_meta", "timing_pending", "pending_n",
+    )
+
+    def __init__(self):
+        self.lock = lockcheck.named_lock("stats._shard")
+        with self.lock:
+            self.counters: dict[str, int] = {}
+            # Exact per-series deltas since the last drain: [count, min,
+            # max, sum] for histograms, [count, sum] for timings, plus
+            # every pending sample (fed through the base reservoir at
+            # drain so sampling odds match the serialized client).
+            self.hist_meta: dict[str, list[float]] = {}
+            self.hist_pending: dict[str, list[float]] = {}
+            self.timing_meta: dict[str, list[float]] = {}
+            self.timing_pending: dict[str, list[float]] = {}
+            self.pending_n = 0
+
 
 class NopStatsClient:
     def with_tags(self, *tags: str) -> "NopStatsClient":
@@ -50,7 +96,16 @@ NOP_STATS = NopStatsClient()
 
 
 class ExpvarStatsClient:
-    """In-process stats exposed at /debug/vars (stats.go:70-130)."""
+    """In-process stats exposed at /debug/vars (stats.go:70-130).
+
+    Counter/histogram/timing writes land in per-thread shards
+    (_StatsShard) so N serving threads don't serialize on one client
+    lock; snapshot()/snapshot_typed() drain every shard under the
+    client lock and render from the merged base maps in the same hold —
+    one consistent snapshot, totals exactly equal to the serialized
+    client's.  Gauges and sets are last-writer-wins and stay under the
+    client lock (cross-shard write ordering would be meaningless).
+    """
 
     def __init__(self, tags: tuple[str, ...] = ()):
         self._lock = lockcheck.named_lock("stats._lock")
@@ -67,6 +122,11 @@ class ExpvarStatsClient:
         self._rng = random.Random(0)
         self._tags = tags
         self._children: dict[tuple[str, ...], ExpvarStatsClient] = {}
+        # Per-thread write shards; the registry list is guarded by
+        # _lock, each shard's contents by its own lock.  Tagged children
+        # share both (keys embed the tags before they reach a shard).
+        self._shards: list[_StatsShard] = []
+        self._shard_local = threading.local()
 
     def _key(self, name: str) -> str:
         return f"{name}[{','.join(self._tags)}]" if self._tags else name
@@ -90,12 +150,31 @@ class ExpvarStatsClient:
                 child._timings = self._timings
                 child._timing_meta = self._timing_meta
                 child._rng = self._rng
+                child._shards = self._shards
+                child._shard_local = self._shard_local
                 self._children[key] = child
             return child
 
-    def count(self, name: str, value: int = 1) -> None:
+    def _shard(self) -> _StatsShard:
+        sh = getattr(self._shard_local, "shard", None)
+        if sh is None:
+            sh = _StatsShard()
+            with self._lock:
+                self._shards.append(sh)
+            self._shard_local.shard = sh
+        return sh
+
+    def shard_count(self) -> int:
+        """Live write shards (== threads that have emitted); exported
+        as the ``stats.shards`` gauge by the metrics endpoints."""
         with self._lock:
-            self._counters[self._key(name)] += value
+            return len(self._shards)
+
+    def count(self, name: str, value: int = 1) -> None:
+        sh = self._shard()
+        with sh.lock:
+            key = self._key(name)
+            sh.counters[key] = sh.counters.get(key, 0) + value
 
     def gauge(self, name: str, value: float) -> None:
         with self._lock:
@@ -113,33 +192,105 @@ class ExpvarStatsClient:
             samples[j] = value
 
     def histogram(self, name: str, value: float) -> None:
-        with self._lock:
+        sh = self._shard()
+        with sh.lock:
             key = self._key(name)
-            meta = self._hist_meta.get(key)
+            meta = sh.hist_meta.get(key)
             if meta is None:
-                meta = self._hist_meta[key] = [0, value, value, 0.0]
+                meta = sh.hist_meta[key] = [0, value, value, 0.0]
             meta[0] += 1
             meta[1] = min(meta[1], value)
             meta[2] = max(meta[2], value)
             meta[3] += value
-            self._reservoir_add(self._histograms[key], meta[0], value)
+            sh.hist_pending.setdefault(key, []).append(value)
+            sh.pending_n += 1
+            flush = sh.pending_n >= SHARD_FLUSH_CAP
+        if flush:
+            self._flush_shard(sh)
 
     def set(self, name: str, value: str) -> None:
         with self._lock:
             self._sets[self._key(name)] = value
 
     def timing(self, name: str, value: float) -> None:
-        with self._lock:
+        sh = self._shard()
+        with sh.lock:
             key = self._key(name)
-            meta = self._timing_meta.get(key)
+            meta = sh.timing_meta.get(key)
             if meta is None:
-                meta = self._timing_meta[key] = [0, 0.0]
+                meta = sh.timing_meta[key] = [0, 0.0]
             meta[0] += 1
             meta[1] += value
-            self._reservoir_add(self._timings[key], meta[0], value)
+            sh.timing_pending.setdefault(key, []).append(value)
+            sh.pending_n += 1
+            flush = sh.pending_n >= SHARD_FLUSH_CAP
+        if flush:
+            self._flush_shard(sh)
+
+    def _flush_shard(self, sh: _StatsShard) -> None:
+        """Writer-side self-flush (pending cap reached).  Same client →
+        shard lock order as the snapshot drain, so a flush racing a
+        snapshot merges the shard's deltas exactly once."""
+        with self._lock:
+            self._drain_shard_locked(sh)
+
+    def _drain_shard_locked(self, sh: _StatsShard) -> None:
+        """Merge one shard into the base maps.  Caller holds _lock; the
+        shard state is moved-and-zeroed in a single shard-lock hold so
+        no delta can be observed (or merged) twice."""
+        with sh.lock:
+            if not sh.counters and not sh.hist_meta and not sh.timing_meta:
+                return
+            counters = sh.counters
+            sh.counters = {}
+            hist_meta = sh.hist_meta
+            sh.hist_meta = {}
+            hist_pending = sh.hist_pending
+            sh.hist_pending = {}
+            timing_meta = sh.timing_meta
+            sh.timing_meta = {}
+            timing_pending = sh.timing_pending
+            sh.timing_pending = {}
+            sh.pending_n = 0
+        for key, v in counters.items():
+            self._counters[key] += v
+        for key, d in hist_meta.items():
+            meta = self._hist_meta.get(key)
+            if meta is None:
+                self._hist_meta[key] = list(d)
+            else:
+                meta[0] += d[0]
+                meta[1] = min(meta[1], d[1])
+                meta[2] = max(meta[2], d[2])
+                meta[3] += d[3]
+        for key, vals in hist_pending.items():
+            # Replay through the reservoir at the merged running count
+            # (every observation since the last drain is pending, so
+            # base + i + 1 is the true stream position).
+            samples = self._histograms[key]
+            base = int(self._hist_meta[key][0]) - len(vals)
+            for i, v in enumerate(vals):
+                self._reservoir_add(samples, base + i + 1, v)
+        for key, d in timing_meta.items():
+            meta = self._timing_meta.get(key)
+            if meta is None:
+                self._timing_meta[key] = list(d)
+            else:
+                meta[0] += d[0]
+                meta[1] += d[1]
+        for key, vals in timing_pending.items():
+            samples = self._timings[key]
+            base = int(self._timing_meta[key][0]) - len(vals)
+            for i, v in enumerate(vals):
+                self._reservoir_add(samples, base + i + 1, v)
+
+    def _drain_all_locked(self) -> None:
+        for sh in self._shards:
+            self._drain_shard_locked(sh)
 
     def snapshot(self) -> dict:
         with self._lock:
+            self._drain_all_locked()
             out: dict = dict(self._counters)
             out.update(self._gauges)
             out.update(self._sets)
@@ -172,8 +323,10 @@ class ExpvarStatsClient:
         Prometheus metric types mechanically — this keeps each family
         separate.  Histogram entries carry the exact running
         count/min/max/sum plus reservoir percentiles; timings carry
-        count/sum."""
+        count/sum.  Shards are drained first, under the same single
+        lock hold the render reads from — one consistent snapshot."""
         with self._lock:
+            self._drain_all_locked()
             hists: dict = {}
             for name, vals in self._histograms.items():
                 if vals:

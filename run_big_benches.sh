@@ -203,6 +203,15 @@ run BENCH_CONFIG=overload BENCH_QOS_DEPTH=8 BENCH_THREADS=64
 #    asserted in-run.  The second line scales the group fleet.
 run BENCH_CONFIG=replica
 run BENCH_CONFIG=replica BENCH_GROUPS=4 BENCH_THREADS=32
+# 11b) Multi-core host serving: one host's front door at 1 vs 2 workers
+#    (free-threaded pool threads, or SO_REUSEPORT processes on GIL
+#    builds) from 1/2/4 client threads — scaling_1_to_2 asserted >= 1.6
+#    in-run on a multi-core host — plus the serve-lane-breadth A/B
+#    (native multi-frame / tree / Range one-crossing lanes vs the
+#    Python general lane, parity + win asserted in-run).  The second
+#    line sizes bigger batches over more rows (dashboard shape).
+run BENCH_CONFIG=multicore
+run BENCH_CONFIG=multicore BENCH_ROWS=64 BENCH_BATCH=128 BENCH_BITS_PER_ROW=50000
 # 12) Durable write log + recovery: write throughput with 3 groups vs a
 #    SIGKILLed group on the degraded quorum (zero failed writes asserted
 #    in-run — the WAL's availability headline) and catch-up time for the

@@ -182,6 +182,34 @@ def test_bench_replica_emits_json():
     assert result["scaling_1_to_2"] > 0 and result["cpus"] >= 1
 
 
+def test_bench_multicore_emits_json():
+    """The multi-core host-serving bench must keep working: a real CLI
+    server at 1 vs 2 workers (in-process pool threads on free-threaded
+    builds, SO_REUSEPORT processes on GIL builds) driven from 1/2/4
+    client threads, plus the serve-lane-breadth A/B (native multi-frame
+    / tree / Range one-crossing lanes vs the Python general lane,
+    byte-parity + speedup > 1 asserted in-run).  The worker-scaling
+    RATIO is asserted in-run only on a multi-core host; a 1-cpu box
+    records the ratio and the skip reason (``cpus`` disambiguates)."""
+    stdout = _run({"BENCH_CONFIG": "multicore", "BENCH_SMOKE": "1"}, timeout=600)
+    result = json.loads(stdout.strip().splitlines()[-1])
+    assert result["metric"] == "multicore_read_qps" and result["value"] > 0
+    names = [t["tier"] for t in result["tiers"]]
+    assert names == ["serve_1w", "clients_1", "clients_2", "clients_4",
+                     "breadth_multiframe", "breadth_tree", "breadth_range"]
+    by = {t["tier"]: t for t in result["tiers"]}
+    for t in ("serve_1w", "clients_1", "clients_2", "clients_4"):
+        assert by[t]["read_qps"] > 0 and by[t]["served"] > 0
+    # The breadth A/B asserted parity + win in-run; the fields record it.
+    for t in ("breadth_multiframe", "breadth_tree", "breadth_range"):
+        assert by[t]["speedup"] > 1.0
+        assert by[t]["native_ms"] > 0 and by[t]["python_ms"] > 0
+    assert result["scaling_1_to_2"] > 0 and result["cpus"] >= 1
+    assert result["worker_mode"] in ("threads", "processes")
+    if result["cpus"] == 1:
+        assert result["scaling_skip"]  # ratio assert skipped WITH a reason
+
+
 def test_bench_recovery_emits_json():
     """The durable-write-log recovery bench must keep working: 3 group
     subprocesses behind a durable-WAL CLI router, a group SIGKILLed

@@ -309,3 +309,193 @@ def test_named_global_detects_seam_subversion():
         v.kind == "lockset-race" and "NamedGlobal._store" in v.detail
         for v in violations
     ), f"seam subversion went undetected: {[v.kind for v in violations]}"
+
+
+# -- striped stats: shard totals byte-identical to the serialized client ----
+
+
+def test_striped_stats_totals_match_serialized():
+    """A deterministic workload written from 4 threads through the
+    striped client must snapshot EXACTLY the totals the same workload
+    produces single-threaded: counters, histogram count/min/max/sum,
+    timing count/sum.  (Reservoir percentiles are sampling-order
+    dependent by design; the exact fields are the contract.)"""
+    striped = ExpvarStatsClient()
+    serial = ExpvarStatsClient()
+    n_threads, per_thread = 4, 700  # crosses SHARD_FLUSH_CAP mid-run
+
+    def workload(client, tid: int):
+        tagged = client.with_tags(f"t:{tid % 2}")
+        for i in range(per_thread):
+            client.count("ft.reads", 1)
+            tagged.count("ft.tagged", 2)
+            client.histogram("ft.lat", float((tid * per_thread + i) % 97))
+            client.timing("ft.exec", 0.001 * ((i + tid) % 11))
+
+    errors: list = []
+    threads = [
+        threading.Thread(target=_catching(lambda tid=t: workload(striped, tid), errors))
+        for t in range(n_threads)
+    ]
+    _join_all(threads, errors)
+    for t in range(n_threads):
+        workload(serial, t)
+
+    got = striped.snapshot_typed()
+    want = serial.snapshot_typed()
+    assert got["counters"] == want["counters"]
+    for name in want["histograms"]:
+        for field in ("count", "min", "max", "sum"):
+            assert got["histograms"][name][field] == pytest.approx(
+                want["histograms"][name][field]
+            ), (name, field)
+    assert set(got["timings"]) == set(want["timings"])
+    for name in want["timings"]:
+        assert got["timings"][name]["count"] == want["timings"][name]["count"]
+        assert got["timings"][name]["sum"] == pytest.approx(want["timings"][name]["sum"])
+    # The flat snapshot agrees with itself after a second drain (no
+    # residue left in shards, nothing merged twice).
+    assert striped.snapshot()["ft.reads"] == n_threads * per_thread
+
+
+def test_shard_flush_mid_snapshot_no_double_count():
+    """The ISSUE-16 small fix, pinned deterministically: a shard whose
+    self-flush (SHARD_FLUSH_CAP reached) races a snapshot drain must
+    merge its deltas exactly once.  The schedule is forced: the main
+    thread holds the client lock, the writer hits the cap and blocks in
+    its flush, the snapshot drain runs first, then the flush proceeds
+    over the already-zeroed shard."""
+    from pilosa_tpu import stats as stats_mod
+
+    c = ExpvarStatsClient()
+    cap = stats_mod.SHARD_FLUSH_CAP
+    buffered = threading.Event()   # writer parked CAP-1 samples
+    flushing = threading.Event()   # writer entered its self-flush
+    release = threading.Event()    # main finished the mid-snapshot drain
+
+    orig_flush = c._flush_shard
+
+    def traced_flush(sh):
+        flushing.set()
+        orig_flush(sh)  # blocks on the client lock the main thread holds
+
+    c._flush_shard = traced_flush
+    errors: list = []
+
+    def writer():
+        for i in range(cap - 1):
+            c.timing("ft.race", float(i))
+        buffered.set()
+        release.wait(timeout=60)
+        c.timing("ft.race", float(cap - 1))  # reaches the cap -> flush
+
+    t = threading.Thread(target=_catching(writer, errors))
+    t.start()
+    assert buffered.wait(timeout=60)
+    with c._lock:
+        release.set()
+        assert flushing.wait(timeout=60), "writer never reached its flush"
+        # Mid-snapshot drain wins the race: every pending sample (the
+        # full CAP) merges here, under this single lock hold.
+        c._drain_all_locked()
+        mid_count = int(c._timing_meta["ft.race"][0])
+    t.join(timeout=60)
+    assert not errors, errors
+    assert mid_count == cap
+    snap = c.snapshot_typed()
+    assert snap["timings"]["ft.race"]["count"] == cap  # NOT 2x
+    assert snap["timings"]["ft.race"]["sum"] == pytest.approx(
+        sum(range(cap))
+    )
+
+
+# -- multicore smoke: a 2-thread server pool serving concurrent reads ------
+
+
+def test_multicore_two_thread_pool_smoke(tmp_path):
+    """ISSUE-16 multicore smoke: a server with a 2-thread worker pool
+    serves concurrent readers correctly (striped stats + per-thread
+    armed tables underneath), publishes the pool gauges, and sheds
+    nothing at this load."""
+    from pilosa_tpu.config import Config
+    from pilosa_tpu.server.client import Client
+    from pilosa_tpu.server.server import Server
+
+    cfg = Config(
+        data_dir=str(tmp_path / "mc"), host="127.0.0.1:0",
+        engine="numpy", stats="expvar", qcache_enabled=False,
+        server_max_threads=2,
+    )
+    s = Server(cfg)
+    s.open()
+    errors: list = []
+    try:
+        c = Client(s.host)
+        c.create_index("i")
+        c.create_frame("i", "f")
+        body = "".join(
+            f'SetBit(rowID={r}, frame="f", columnID={r * 7 + j})'
+            for r in range(4) for j in range(30)
+        )
+        c.execute_query("i", body)
+        q = " ".join(
+            f'Count(Intersect(Bitmap(rowID={a}, frame="f"), Bitmap(rowID={b}, frame="f")))'
+            for a in range(4) for b in range(4)
+        )
+        want = c.execute_query("i", q)["results"]
+
+        def reader():
+            rc = Client(s.host)
+            for _ in range(25):
+                assert rc.execute_query("i", q)["results"] == want
+
+        _join_all([
+            threading.Thread(target=_catching(reader, errors), name=f"mc-{i}")
+            for i in range(2)
+        ], errors)
+
+        with urllib.request.urlopen(f"http://{s.host}/debug/vars", timeout=30) as r:
+            snap = json.loads(r.read())
+        assert snap.get("server.pool.workers") == 2.0
+        assert snap.get("server.pool.shed", 0) == 0
+        assert snap.get("stats.shards", 0) >= 1  # striped client live
+    finally:
+        s.close()
+
+
+def test_reuseport_two_servers_share_port(tmp_path):
+    """[server] workers mode's kernel seam: two in-process servers bind
+    the SAME port via SO_REUSEPORT (server_workers > 1 turns it on) and
+    both front doors answer — the per-process shape the CLI's worker
+    fallback runs N of on GIL builds."""
+    import socket
+
+    if not hasattr(socket, "SO_REUSEPORT"):
+        pytest.skip("no SO_REUSEPORT on this platform")
+    from pilosa_tpu.config import Config
+    from pilosa_tpu.server.server import Server
+
+    cfg1 = Config(data_dir=str(tmp_path / "a"), host="127.0.0.1:0",
+                  engine="numpy", stats="expvar", server_workers=2)
+    s1 = Server(cfg1)
+    s1.open()
+    s2 = None
+    try:
+        # Second server on the RESOLVED port: only SO_REUSEPORT lets
+        # this bind succeed.
+        cfg2 = Config(data_dir=str(tmp_path / "b"), host=s1.host,
+                      engine="numpy", stats="expvar", server_workers=2)
+        s2 = Server(cfg2)
+        s2.open()
+        assert s2.host == s1.host
+        # The kernel spreads connections between the two sockets; every
+        # request must be answered whichever server accepts it.
+        for _ in range(10):
+            with urllib.request.urlopen(
+                f"http://{s1.host}/debug/vars", timeout=30
+            ) as r:
+                json.loads(r.read())
+    finally:
+        if s2 is not None:
+            s2.close()
+        s1.close()
