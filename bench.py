@@ -2536,6 +2536,317 @@ def bench_resync() -> dict:
     }
 
 
+def bench_shard() -> dict:
+    """Partitioned replica groups tier: WRITE throughput at 1 shard vs
+    2 shards, plus a LIVE RESHARD leg.  Each shard is its own replica
+    set with its own sequencer lock and WAL sequence space, so adding a
+    shard multiplies write capacity — two shards sequence concurrently
+    where one shard serializes everything through a single lock AND a
+    single group process:
+
+    - ``router_1s``: one shard, one subprocess group — every write
+      through one sequencer (the PR 6-16 write ceiling);
+    - ``router_2s``: two shards (slice ranges [0,4) / [4,inf)), one
+      subprocess group each — clients split across the ranges, each
+      request body stays within one range so it routes whole to its
+      owner; acceptance ``scaling_1s_to_2s >= BENCH_SHARD_MIN_SCALING``
+      (default 1.5) is ASSERTED in-run on a multi-core host (shards are
+      separate processes: a 1-cpu box records the ratio with
+      ``skip_reason`` instead — scaling needs cores);
+    - ``reshard``: a single open-ended shard splits at slice 4 onto a
+      standby group WHILE writer threads hammer the router — zero
+      failed writes asserted in-run (fence-held writes just block
+      briefly), then digest convergence: the old group's /replica/digest
+      holds no moved-range fragment, the new group's holds them all,
+      and the router-merged count equals exactly the acked writes.
+
+    BENCH_SMOKE=1 shrinks phases for CI."""
+    import subprocess
+    import sys
+    import tempfile
+    import urllib.error
+    import urllib.request
+    from concurrent.futures import ThreadPoolExecutor
+
+    from pilosa_tpu.pilosa import SLICE_WIDTH
+    from pilosa_tpu.replica.digest import parse_fragment_path
+
+    smoke = os.environ.get("BENCH_SMOKE", "").lower() in ("1", "true", "yes")
+    n_clients = int(os.environ.get("BENCH_THREADS", "4" if smoke else "12"))
+    phase_s = float(os.environ.get("BENCH_SHARD_SECS", "1.0" if smoke else "6"))
+    batch = int(os.environ.get("BENCH_BATCH", "8" if smoke else "32"))
+    n_rows = int(os.environ.get("BENCH_ROWS", "8" if smoke else "16"))
+    min_scaling = float(os.environ.get("BENCH_SHARD_MIN_SCALING", "1.5"))
+    split_at = 4  # slices [0, 4) stay, [4, inf) move / shard away
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    worker = os.path.join(repo, "tests", "replica_group_worker.py")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = repo
+    env.pop("PILOSA_TPU_QCACHE", None)
+
+    def free_port():
+        import socket
+
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        p = s.getsockname()[1]
+        s.close()
+        return p
+
+    def spawn_group(name, errfile):
+        p = subprocess.Popen(
+            [sys.executable, worker, name],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE, stderr=errfile,
+            cwd=repo, env=env, text=True)
+        line = json.loads(p.stdout.readline())
+        assert line.get("ready"), line
+        return p, line["host"]
+
+    def spawn_router(args, errfile):
+        port = free_port()
+        p = subprocess.Popen(
+            [sys.executable, "-m", "pilosa_tpu", "replica-router",
+             "--port", str(port), *args],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE, stderr=errfile,
+            cwd=repo, env=env, text=True)
+        line = p.stdout.readline()
+        assert "replica-router" in line, line
+        return p, port
+
+    def stop_group(p):
+        try:
+            p.stdin.write("\n")
+            p.stdin.flush()
+        except Exception:  # noqa: BLE001 — already dead
+            pass
+        try:
+            p.wait(timeout=30)
+        except Exception:  # noqa: BLE001
+            p.kill()
+
+    def stop_router(p):
+        try:
+            p.terminate()
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            p.wait(timeout=30)
+        except Exception:  # noqa: BLE001
+            p.kill()
+
+    def post(host, path, body, timeout=60):
+        req = urllib.request.Request(
+            f"http://{host}{path}", data=body, method="POST")
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                return resp.status, resp.read()
+        except urllib.error.HTTPError as e:
+            return e.code, e.read()
+
+    def seed_schema(host):
+        assert post(host, "/index/w", b"{}")[0] == 200
+        assert post(host, "/index/w/frame/f", b"{}")[0] == 200
+
+    def query(host, q, qs=""):
+        st, body = post(host, f"/index/w/query{qs}", q.encode())
+        assert st == 200, body
+        return json.loads(body)["results"]
+
+    # Closed-loop write load: client i owns slice (i % len(ranges)) of
+    # its range set, every request body stays inside ONE slice range so
+    # a 2-shard map routes it whole (the fast path, no splitting), and
+    # every columnID is unique per client so acked bits == set bits.
+    def write_phase(host, dur_s, row=1):
+        t_end = time.perf_counter() + dur_s
+
+        def client(i):
+            served = errors = 0
+            sl = split_at + (i % split_at) if i % 2 else i % split_at
+            k = 0
+            while time.perf_counter() < t_end:
+                base = sl * SLICE_WIDTH + (i * 1_000_000 + k * batch) % (SLICE_WIDTH - batch)
+                body = " ".join(
+                    f'SetBit(rowID={(k + j) % n_rows}, frame="f", '
+                    f'columnID={base + j})'
+                    for j in range(batch)
+                ).encode()
+                k += 1
+                st, _ = post(host, "/index/w/query", body)
+                if st == 200:
+                    served += 1
+                else:
+                    errors += 1
+            return served, errors
+
+        t0 = time.perf_counter()
+        with ThreadPoolExecutor(n_clients) as pool:
+            outs = list(pool.map(client, range(n_clients)))
+        dt = time.perf_counter() - t0
+        served = sum(s for s, _ in outs)
+        errors = sum(e for _, e in outs)
+        assert errors == 0, f"write phase saw {errors} failed writes"
+        return {"write_qps": round(served / dt, 1), "served": served,
+                "clients": n_clients, "batch": batch}
+
+    errs = [tempfile.NamedTemporaryFile("w+", delete=False) for _ in range(8)]
+    tiers = []
+    try:
+        # -- tier 1: one shard, one group ---------------------------------
+        g0, h0 = spawn_group("gA", errs[0])
+        r1, p1 = spawn_router(["--groups", f"gA={h0}"], errs[1])
+        host1 = f"127.0.0.1:{p1}"
+        seed_schema(host1)
+        write_phase(host1, 0.2)  # warm the lane
+        tiers.append({"tier": "router_1s", "shards": 1, **write_phase(host1, phase_s)})
+        stop_router(r1)
+        stop_group(g0)
+
+        # -- tier 2: two shards, one group each ---------------------------
+        g1, h1 = spawn_group("gA", errs[2])
+        g2, h2 = spawn_group("gB", errs[3])
+        r2, p2 = spawn_router(
+            ["--shard-map", f"s0=0-{split_at}:gA={h1};s1={split_at}-:gB={h2}"],
+            errs[4])
+        host2 = f"127.0.0.1:{p2}"
+        seed_schema(host2)
+        write_phase(host2, 0.2)
+        tiers.append({"tier": "router_2s", "shards": 2, **write_phase(host2, phase_s)})
+        stop_router(r2)
+        stop_group(g1)
+        stop_group(g2)
+
+        # -- tier 3: live reshard under write load ------------------------
+        g3, h3 = spawn_group("gA", errs[5])
+        g4, h4 = spawn_group("gB", errs[6])  # standby split target
+        r3, p3 = spawn_router(["--groups", f"gA={h3}"], errs[7])
+        host3 = f"127.0.0.1:{p3}"
+        seed_schema(host3)
+        # Pre-load both halves of the future split so fragments move.
+        for sl in range(2 * split_at):
+            assert post(
+                host3, "/index/w/query",
+                f'SetBit(rowID=0, frame="f", columnID={sl * SLICE_WIDTH})'.encode(),
+            )[0] == 200
+
+        import threading
+
+        failures, acks = [], [0]
+        stop_flag = threading.Event()
+
+        def writer(i):
+            k = 0
+            while not stop_flag.is_set():
+                sl = k % (2 * split_at)  # keep the moved range hot
+                col = sl * SLICE_WIDTH + 8 + (i * 500_000 + k) % 400_000
+                st, body = post(
+                    host3, "/index/w/query",
+                    f'SetBit(rowID=2, frame="f", columnID={col})'.encode(),
+                )
+                if st != 200:
+                    failures.append((st, body[:200]))
+                elif json.loads(body)["results"] == [True]:
+                    acks[0] += 1  # count NEW bits only (dups ack False)
+                k += 1
+
+        writers = [threading.Thread(target=writer, args=(i,), daemon=True)
+                   for i in range(max(2, n_clients // 4))]
+        for t in writers:
+            t.start()
+        time.sleep(0.3)  # writers in flight before the fence
+        t0 = time.perf_counter()
+        st, body = post(
+            host3, "/replica/reshard",
+            json.dumps({
+                "shard": "s0", "at": split_at, "name": "s1",
+                "groups": [f"gB={h4}"],
+            }).encode(),
+            timeout=120,
+        )
+        reshard_ms = round((time.perf_counter() - t0) * 1e3, 1)
+        assert st == 200, body
+        flip = json.loads(body)
+        time.sleep(0.3)  # post-flip writes land through the new map
+        stop_flag.set()
+        for t in writers:
+            t.join(timeout=30)
+        assert not failures, (
+            f"{len(failures)} writes failed during the live reshard: "
+            f"{failures[:3]}"
+        )
+        # Zero lost writes: router-merged count == acked new bits.
+        assert query(host3, 'Count(Bitmap(rowID=2, frame="f"))') == [acks[0]]
+        # Digest convergence: the moved range lives ONLY on the new
+        # group now — old digest has no moved-range fragment, new
+        # digest holds nothing else.
+        with urllib.request.urlopen(f"http://{h3}/replica/digest", timeout=30) as resp:
+            old_frags = json.loads(resp.read()).get("fragments") or {}
+        with urllib.request.urlopen(f"http://{h4}/replica/digest", timeout=30) as resp:
+            new_frags = json.loads(resp.read()).get("fragments") or {}
+        old_slices = {parse_fragment_path(p)[3] for p in old_frags}
+        new_slices = {parse_fragment_path(p)[3] for p in new_frags}
+        assert all(s < split_at for s in old_slices), sorted(old_slices)
+        assert new_slices and all(s >= split_at for s in new_slices), (
+            sorted(new_slices))
+        tiers.append({
+            "tier": "reshard", "shards": 2,
+            "reshard_ms": reshard_ms,
+            "fence_ms": flip["fenceMs"],
+            "moved_fragments": flip["moved"]["fragments"],
+            "moved_bytes": flip["moved"]["bytes"],
+            "writes_during_reshard": acks[0],
+            "failed_writes": len(failures),
+            "map_epoch": flip["mapEpoch"],
+        })
+        stop_router(r3)
+        stop_group(g3)
+        stop_group(g4)
+    finally:
+        for f in errs:
+            f.close()
+            os.unlink(f.name)
+
+    by = {t["tier"]: t for t in tiers}
+    qps_1 = by["router_1s"]["write_qps"]
+    qps_2 = by["router_2s"]["write_qps"]
+    scaling = round(qps_2 / qps_1, 3) if qps_1 else None
+    # Shards are separate PROCESSES: the scaling acceptance needs
+    # physical cores (2 groups + router + clients).  A starved box
+    # records the ratio and the reason instead of a meaningless assert.
+    cpus = os.cpu_count() or 1
+    skip_reason = None
+    if cpus < 3:
+        skip_reason = f"only {cpus} cpu(s): shard scaling needs >= 3 cores"
+    elif smoke:
+        skip_reason = "BENCH_SMOKE: phases too short for a stable ratio"
+    if skip_reason is None:
+        assert scaling is not None and scaling >= min_scaling, (
+            f"2-shard write scaling x{scaling} < x{min_scaling} "
+            f"(router_1s {qps_1} q/s, router_2s {qps_2} q/s on {cpus} cpus)"
+        )
+    return {
+        "metric": "shard_write_qps",
+        "value": qps_2,
+        "unit": (
+            f"write requests/sec via the replica router over 2 slice-shards "
+            f"({n_clients} clients, batch {batch}; 1-shard router {qps_1} q/s "
+            f"= x{scaling} scaling on {cpus} cpus; live reshard moved "
+            f"{by['reshard']['moved_fragments']} fragments with "
+            f"{by['reshard']['failed_writes']} failed writes, fence "
+            f"{by['reshard']['fence_ms']} ms; zero-loss + digest "
+            f"convergence asserted in-run)"
+        ),
+        "vs_baseline": scaling,
+        "scaling_1s_to_2s": scaling,
+        "scaling_asserted": skip_reason is None,
+        "skip_reason": skip_reason,
+        "min_scaling": min_scaling,
+        "cpus": cpus,
+        "tiers": tiers,
+    }
+
+
 def bench_qcache() -> dict:
     """Query-result-cache tier: a Zipf-skewed repeated read mix (the
     dashboard steady state — the same few queries hit over and over)
@@ -3198,6 +3509,7 @@ def main() -> None:
             "multicore": bench_multicore,
             "recovery": bench_recovery,
             "resync": bench_resync,
+            "shard": bench_shard,
             "intersect_count_stream": bench_intersect_stream,
             "intersect_count_4krows": bench_intersect_4krows,
             "topn_p50": bench_topn_p50,

@@ -229,7 +229,7 @@ class _WriteVsCatchupCtx:
         for i in (1, 2):
             r.wal.append("POST", "/index/i/query", b"w%d" % i)
             spec.emit("ack", src=id(r.wal), seq=i, status=200, applied=2)
-        r.write_seq = 2
+        r.shards[0].write_seq = 2
         g0, g1, g2 = r.groups
         for g in (g0, g2):
             g.applied_seq = 2
@@ -564,7 +564,7 @@ class _BugCompactDropsUnreplayedCtx:
         for i in (1, 2, 3):
             r.wal.append("POST", "/index/i/query", b"w%d" % i)
             spec.emit("ack", src=id(r.wal), seq=i, status=200, applied=2)
-        r.write_seq = 3
+        r.shards[0].write_seq = 3
         g0, g1, g2 = r.groups
         for g in (g0, g2):
             g.applied_seq = 3

@@ -206,6 +206,18 @@ def check_trace(events: list) -> list[str]:
                     f"{applied} < acked head {s.acked_max} — read-your-writes "
                     "broken"
                 )
+        elif kind == "reshard":
+            # Ownership-epoch fence events (src = the router).  The map
+            # epoch must strictly increase — a flip that reuses an epoch
+            # lets a group accept a stale ownership view as current.
+            epoch = f.get("epoch")
+            prev = s.marks.get("__map_epoch__")
+            if prev is not None and epoch is not None and epoch <= prev[1]:
+                out.append(
+                    f"reshard map epoch did not advance "
+                    f"({prev[1]} -> {epoch})"
+                )
+            s.marks["__map_epoch__"] = (None, epoch)
     return out
 
 
@@ -441,6 +453,355 @@ def model_check(
                     f"up to {data} but write(s) {missed} are acked "
                     f"(state {state})"
                 )
+    return res
+
+
+# -- sharded (2-D slice-shard x replica) protocol model ----------------------
+#
+# PR 17 promotes the router to a (slice-shard x replica) layout: each
+# shard owns a contiguous slice range, sequences its writes in its OWN
+# sequence space (its own WAL, its own sequencer lock), and runs the
+# PR 7/9 catch-up/resync/compaction machinery per shard unchanged.  The
+# sharded model is the PRODUCT of S per-shard instances of the
+# :func:`model_check` machine — same per-shard transitions and
+# invariants — plus the two properties that only exist ACROSS shards:
+#
+# - **exclusive ownership**: a write routed to shard k lands on shard
+#   k's groups only.  Unconstrained reads fan to every shard and merge
+#   by sum/union, so a write applied on a non-owning shard is counted
+#   twice (``break_routing`` plants exactly that bug: the foreign-data
+#   invariant must trip);
+# - **cross-shard read-your-writes**: a merged read picks one
+#   in-rotation group per shard; every shard's acked writes must be
+#   visible in the group IT contributed (per-shard read-your-writes
+#   composes — the model checks the composition explicitly at every
+#   state).
+#
+# Scope stays small on purpose: 2 shards x 2 replicas x 1 write per
+# shard x 1 shared restart explores in well under a second; the
+# per-shard machinery is already exercised at 2 writes by
+# :func:`model_check`, so the product run only needs enough writes to
+# give every shard a sequence space of its own.
+
+
+def model_check_sharded(
+    n_shards: int = 2,
+    n_groups: int = 2,
+    max_writes_per_shard: int = 1,
+    max_restarts: int = 1,
+    break_quorum: bool = False,
+    break_compaction: bool = False,
+    break_abort: bool = False,
+    break_routing: bool = False,
+    max_states: int = 400_000,
+) -> ModelResult:
+    """Exhaustively explore the sharded protocol: ``n_shards``
+    independent sequence spaces of ``n_groups`` replicas each, a shared
+    restart budget, per-shard invariants at every state plus the
+    cross-shard exclusive-ownership and merged-read checks.
+
+    ``break_quorum`` / ``break_compaction`` / ``break_abort`` mutate
+    the same per-shard rules as :func:`model_check` (applied to shard
+    0's instance — one broken shard must be enough to trip).
+    ``break_routing`` mis-routes shard 0's writes onto shard 1's groups
+    too, modeling a router that fans a bit-write across shards — the
+    double-count hazard the slice-cover routing exists to prevent."""
+    quorum = 1 if break_quorum else (n_groups // 2 + 1)
+    res = ModelResult()
+    # Per-shard sub-state mirrors model_check: (next_seq, records,
+    # acked, groups, floor).  ``foreign`` is a per-shard tuple of
+    # per-group highest FOREIGN sequence applied (data the shard does
+    # not own — always 0 unless break_routing plants it).
+    shard0 = (
+        1,
+        (),
+        (),
+        tuple((0, 0, 0, 0, True) for _ in range(n_groups)),
+        0,
+    )
+    init = (
+        tuple(shard0 for _ in range(n_shards)),
+        tuple(tuple(0 for _ in range(n_groups)) for _ in range(n_shards)),
+        0,  # shared restarts used
+    )
+    seen = {init}
+    work = [init]
+
+    def invariants(state) -> None:
+        shards, foreign, _r = state
+        for si, (next_seq, records, acked, groups, floor) in enumerate(shards):
+            live = {s for s, alive in records if alive}
+            for s in acked:
+                for gi, (data, _m, _p, _e, _rot) in enumerate(groups):
+                    if data < s and s not in live:
+                        res.violations.append(
+                            f"shard {si}: acked write {s} lost: group {gi} "
+                            f"holds data up to {data} and the record is no "
+                            f"longer replayable (state {state})"
+                        )
+                        return
+        for si, per_group in enumerate(foreign):
+            for gi, fseq in enumerate(per_group):
+                if fseq:
+                    res.violations.append(
+                        f"shard {si} group {gi} holds foreign write {fseq} "
+                        "for a slice range it does not own — an "
+                        "unconstrained fan-out read double-counts it "
+                        f"(state {state})"
+                    )
+                    return
+
+    def out_state(state):
+        if state not in seen:
+            seen.add(state)
+            invariants(state)
+            work.append(state)
+        res.transitions += 1
+
+    def write_outcomes(n):
+        if n == 0:
+            yield ()
+            return
+        for rest in write_outcomes(n - 1):
+            for o in (OUT_APPLY, OUT_SHED, OUT_FAIL):
+                yield (o,) + rest
+
+    while work:
+        if res.states >= max_states:
+            res.violations.append("state-space cap exceeded")
+            break
+        state = work.pop()
+        res.states += 1
+        if res.violations:
+            break
+        shards, foreign, restarts = state
+
+        def sub(si, new_shard, new_foreign=None):
+            sl = list(shards)
+            sl[si] = new_shard
+            fl = list(foreign) if new_foreign is None else new_foreign
+            out_state((tuple(sl), tuple(fl), restarts))
+
+        for si, (next_seq, records, acked, groups, floor) in enumerate(shards):
+            in_rot = [i for i, g in enumerate(groups) if g[4]]
+            live_seqs = sorted(s for s, alive in records if alive)
+            # The break_* knobs target shard 0's instance only.
+            b_quorum = break_quorum and si == 0
+            b_compaction = break_compaction and si == 0
+            b_abort = break_abort and si == 0
+            s_quorum = 1 if b_quorum else (n_groups // 2 + 1)
+
+            # WRITE in shard si's sequence space.
+            if len(in_rot) >= s_quorum and next_seq <= max_writes_per_shard:
+                for outs in write_outcomes(len(in_rot)):
+                    seq = next_seq
+                    applied_ct = sum(1 for o in outs if o == OUT_APPLY)
+                    shed_any = any(o == OUT_SHED for o in outs)
+                    ambiguous = any(o == OUT_FAIL for o in outs)
+                    gl = list(groups)
+                    for pos, gi in enumerate(in_rot):
+                        d, m, p, e, _rot = gl[gi]
+                        if outs[pos] == OUT_APPLY:
+                            gl[gi] = (max(d, seq), max(m, seq), p, e, True)
+                        else:
+                            gl[gi] = (d, m, p, e, bool(b_quorum))
+                    recs = records + ((seq, True),)
+                    new_acked = acked
+                    tombstoned = False
+                    if applied_ct >= s_quorum:
+                        new_acked = acked + (seq,)
+                    elif applied_ct == 0 and shed_any and not ambiguous:
+                        recs = records + ((seq, False),)
+                        tombstoned = True
+                    elif b_abort and applied_ct < s_quorum:
+                        recs = records + ((seq, False),)
+                        tombstoned = True
+                    if tombstoned and applied_ct > 0:
+                        res.violations.append(
+                            f"shard {si}: write {seq} tombstoned with "
+                            f"{applied_ct} group(s) having applied it "
+                            f"(state {state})"
+                        )
+                    fl = list(foreign)
+                    if break_routing and si == 0 and applied_ct >= s_quorum:
+                        # Mis-route: the acked write also lands on every
+                        # other shard's groups as foreign data.
+                        for oi in range(n_shards):
+                            if oi != si:
+                                fl[oi] = tuple(
+                                    max(f, seq) for f in fl[oi]
+                                )
+                    sub(si, (seq + 1, recs, new_acked, tuple(gl), floor), fl)
+
+            # PERSIST / RESTART / REPLAY / SEED / COMPACT per shard.
+            for gi, (d, m, p, e, rot) in enumerate(groups):
+                if p != m:
+                    gl = list(groups)
+                    gl[gi] = (d, m, m, e, rot)
+                    sub(si, (next_seq, records, acked, tuple(gl), floor))
+            if restarts < max_restarts:
+                for gi, (d, m, p, e, rot) in enumerate(groups):
+                    gl = list(groups)
+                    gl[gi] = (d, p, p, e + 1, False)
+                    sl = list(shards)
+                    sl[si] = (next_seq, records, acked, tuple(gl), floor)
+                    out_state((tuple(sl), foreign, restarts + 1))
+            for gi, (d, m, p, e, rot) in enumerate(groups):
+                if rot:
+                    continue
+                missing = [s for s in live_seqs if s > m]
+                if m < floor and not missing:
+                    continue
+                if missing:
+                    s0 = missing[0]
+                    gl = list(groups)
+                    gl[gi] = (max(d, s0), s0, p, e, False)
+                else:
+                    gl = list(groups)
+                    gl[gi] = (d, m, p, e, True)
+                sub(si, (next_seq, records, acked, tuple(gl), floor))
+            if in_rot:
+                donor = max(in_rot, key=lambda i: groups[i][1])
+                dd, dm = groups[donor][0], groups[donor][1]
+                for gi, (d, m, p, e, rot) in enumerate(groups):
+                    if not rot and m < dm:
+                        gl = list(groups)
+                        gl[gi] = (max(d, dd), dm, dm, e, False)
+                        sub(si, (next_seq, records, acked, tuple(gl), floor))
+            tracked = in_rot if b_compaction else range(len(groups))
+            marks = [groups[i][1] for i in tracked]
+            if marks:
+                new_floor = min(marks)
+                if new_floor > floor:
+                    recs = tuple(
+                        (s, alive) for s, alive in records if s > new_floor
+                    )
+                    sub(si, (next_seq, recs, acked, groups, new_floor))
+
+        # MERGED READ: one in-rotation group per shard (every
+        # combination); shard k's acked writes must be visible in the
+        # group shard k contributed — the cross-shard composition of
+        # read-your-writes that the fan-out merge relies on.
+        picks = [
+            [i for i, g in enumerate(sh[3]) if g[4]] for sh in shards
+        ]
+        if all(picks):
+            for si, choices in enumerate(picks):
+                _ns, _recs, acked, groups, _fl = shards[si]
+                for gi in choices:
+                    data = groups[gi][0]
+                    missed = [s for s in acked if s > data]
+                    if missed:
+                        res.violations.append(
+                            f"merged read: shard {si} contributed group "
+                            f"{gi} holding data up to {data} but write(s) "
+                            f"{missed} are acked on that shard "
+                            f"(state {state})"
+                        )
+    return res
+
+
+# -- live-reshard (split -> stream -> epoch-fenced flip) model ---------------
+#
+# Resharding splits one shard's slice range and hands the upper half to
+# a new replica set with ZERO failed writes: fragments stream to the
+# new owners while the OLD shard keeps serving, then an epoch fence
+# blocks the moved range just long enough to stream the delta and flip
+# ownership.  The model abstracts writes to the moved range as opaque
+# ids (the per-shard sequence machinery is checked by
+# :func:`model_check_sharded`); what it explores is the ORDER of
+# stream / flip / clear against concurrent writes:
+#
+# - flip only after every new-owner group holds all acked moved-range
+#   writes (``break_fence`` flips without the precondition — the
+#   read-your-writes invariant must trip);
+# - the old owner's moved-range fragments are cleared only AFTER the
+#   flip (``break_clear`` clears early — acked data is lost while the
+#   old shard still owns the range).
+
+
+def model_check_reshard(
+    max_writes: int = 2,
+    break_fence: bool = False,
+    break_clear: bool = False,
+    max_states: int = 50_000,
+) -> ModelResult:
+    """Explore the split -> stream -> epoch-fenced flip protocol for
+    one moved slice range, two groups per shard."""
+    res = ModelResult()
+    # State: (owner, next_id, acked, old0, old1, new0, new1, epoch)
+    # where acked/old*/new* are frozensets of moved-range write ids.
+    empty = frozenset()
+    init = (0, 1, empty, empty, empty, empty, empty, 0)
+    seen = {init}
+    work = [init]
+
+    def invariants(state) -> None:
+        owner, _n, acked, old0, old1, new0, new1, _e = state
+        serving = (old0, old1) if owner == 0 else (new0, new1)
+        for gi, data in enumerate(serving):
+            missed = sorted(acked - data)
+            if missed:
+                res.violations.append(
+                    f"moved-range read: owning shard {owner} group {gi} "
+                    f"is missing acked write(s) {missed} (state {state})"
+                )
+                return
+
+    def out_state(state):
+        if state not in seen:
+            seen.add(state)
+            invariants(state)
+            work.append(state)
+        res.transitions += 1
+
+    while work:
+        if res.states >= max_states:
+            res.violations.append("state-space cap exceeded")
+            break
+        state = work.pop()
+        res.states += 1
+        if res.violations:
+            break
+        owner, next_id, acked, old0, old1, new0, new1, epoch = state
+
+        # WRITE to the moved range: applies on the CURRENT owner's
+        # groups (both — quorum behavior is model_check_sharded's job),
+        # never fails (the fence holds writes, it does not fail them).
+        if next_id <= max_writes:
+            w = frozenset({next_id})
+            if owner == 0:
+                out_state((0, next_id + 1, acked | w, old0 | w, old1 | w,
+                           new0, new1, epoch))
+            else:
+                out_state((1, next_id + 1, acked | w, old0, old1,
+                           new0 | w, new1 | w, epoch))
+
+        if owner == 0:
+            # STREAM: one new-owner group copies a donor old-owner
+            # group's current moved-range bytes (each group streams
+            # independently; repeated rounds pick up the delta).
+            for donor in (old0, old1):
+                out_state((0, next_id, acked, old0, old1,
+                           new0 | donor, new1, epoch))
+                out_state((0, next_id, acked, old0, old1,
+                           new0, new1 | donor, epoch))
+            # FLIP: behind the fence — every new-owner group must hold
+            # all acked moved-range writes first (break_fence skips the
+            # precondition: the invariant must trip on the next read).
+            if break_fence or (acked <= new0 and acked <= new1):
+                out_state((1, next_id, acked, old0, old1,
+                           new0, new1, epoch + 1))
+            if break_clear:
+                # Premature clear: the old owner drops the moved range
+                # while it still owns it.
+                out_state((0, next_id, acked, empty, empty,
+                           new0, new1, epoch))
+        else:
+            # CLEAR: after the flip the old owner reclaims the moved
+            # fragments — safe, it no longer serves the range.
+            out_state((1, next_id, acked, empty, empty,
+                       new0, new1, epoch))
     return res
 
 

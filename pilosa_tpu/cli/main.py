@@ -224,11 +224,37 @@ def cmd_replica_router(args) -> int:
         cfg.replica_probe_interval = args.probe_interval
     if getattr(args, "anti_entropy_interval", None) is not None:
         cfg.replica_anti_entropy_interval = args.anti_entropy_interval
+    if getattr(args, "shards", None) is not None:
+        cfg.replica_shards = args.shards
+    if getattr(args, "shard_map", None):
+        cfg.replica_shard_map = args.shard_map
+    if getattr(args, "shard_span", None) is not None:
+        cfg.replica_shard_span = args.shard_span
+    if cfg.replica_shard_map:
+        from pilosa_tpu.replica import ShardMapError, parse_shard_map
+
+        try:
+            smap = parse_shard_map(cfg.replica_shard_map)
+        except ShardMapError as e:
+            print(f"error: bad --shard-map: {e}", file=sys.stderr)
+            return 1
+        cfg.replica_groups = [
+            g for sh in smap for g in sh.group_specs
+        ]
     if not cfg.replica_groups:
         print("error: no replica groups configured "
               "(--groups / [replica] groups / PILOSA_TPU_REPLICA_GROUPS)",
               file=sys.stderr)
         return 1
+    if not cfg.replica_shard_map and int(cfg.replica_shards or 1) > 1:
+        from pilosa_tpu.replica import ShardMapError, uniform_shard_map
+
+        try:
+            uniform_shard_map(cfg.replica_groups, int(cfg.replica_shards),
+                              span=int(cfg.replica_shard_span or 1))
+        except ShardMapError as e:
+            print(f"error: bad --shards split: {e}", file=sys.stderr)
+            return 1
     stats = new_stats_client(cfg.stats)
     router = router_from_config(
         cfg, stats=stats, tracer=trace_mod.from_config(cfg, stats=stats)
@@ -237,9 +263,12 @@ def cmd_replica_router(args) -> int:
     wal_note = (
         f", wal: {cfg.replica_wal_dir}" if cfg.replica_wal_dir else ", wal: memory"
     )
+    shard_note = (
+        f" in {len(router.shards)} shards" if len(router.shards) > 1 else ""
+    )
     print(
         f"pilosa-tpu replica-router on http://{router.host}:{router.port} "
-        f"over {len(router.groups)} groups: "
+        f"over {len(router.groups)} groups{shard_note}: "
         + ", ".join(f"{g.name}={g.base}" for g in router.groups)
         + wal_note,
         flush=True,
@@ -511,6 +540,21 @@ def build_parser() -> argparse.ArgumentParser:
         "--anti-entropy-interval", dest="anti_entropy_interval", type=float,
         help="cross-group digest-compare sweep interval in seconds, "
              "jittered; 0 disables ([replica] anti-entropy-interval)",
+    )
+    s.add_argument(
+        "--shards", type=int,
+        help="partition the slice space into N shards, splitting --groups "
+             "into N consecutive replica sets ([replica] shards)",
+    )
+    s.add_argument(
+        "--shard-map", dest="shard_map",
+        help="explicit shard map: 'name=lo-hi:g,g;...' with hi omitted on "
+             "the open-ended tail ([replica] shard-map; wins over --shards)",
+    )
+    s.add_argument(
+        "--shard-span", dest="shard_span", type=int,
+        help="slices per shard under --shards auto-split "
+             "([replica] shard-span)",
     )
     s.add_argument("--test-exit", action="store_true", help=argparse.SUPPRESS)
     s.set_defaults(fn=cmd_replica_router)

@@ -147,6 +147,16 @@ class Config:
     # and individually acked, so a killed transfer resumes at the
     # staged offset).
     replica_resync_chunk_bytes: int = 256 << 10
+    # Partitioned replica groups (the 2-D slice-shard x replica mesh).
+    # shards = N splits the flat group list into N consecutive chunks,
+    # shard i owning slices [i*shard-span, (i+1)*shard-span) (last
+    # open-ended); shard-map is the explicit form
+    # ("s0=0-4:g0=h:p,g1=h:p;s1=4-:g2=h:p,g3=h:p") and wins over
+    # shards when both are set.  1 + "" = the single-shard default:
+    # byte-for-byte the pre-shard router.
+    replica_shards: int = 1
+    replica_shard_map: str = ""
+    replica_shard_span: int = 256
     # -- streaming columnar ingest ([ingest] TOML section) ----------------
     # Per-chunk byte ceiling at the streaming bulk-ingest door
     # (POST /index/<i>/frame/<f>/ingest): a chunk past it answers 413
@@ -240,6 +250,11 @@ class Config:
         )
         cfg.replica_resync_chunk_bytes = int(
             rep.get("resync-chunk-bytes", cfg.replica_resync_chunk_bytes)
+        )
+        cfg.replica_shards = int(rep.get("shards", cfg.replica_shards))
+        cfg.replica_shard_map = str(rep.get("shard-map", cfg.replica_shard_map))
+        cfg.replica_shard_span = int(
+            rep.get("shard-span", cfg.replica_shard_span)
         )
         ing = raw.get("ingest", {})
         cfg.ingest_chunk_bytes = int(ing.get("chunk-bytes", cfg.ingest_chunk_bytes))
@@ -353,6 +368,12 @@ class Config:
             self.replica_resync_chunk_bytes = int(
                 env["PILOSA_TPU_REPLICA_RESYNC_CHUNK_BYTES"]
             )
+        if "PILOSA_TPU_REPLICA_SHARDS" in env:
+            self.replica_shards = int(env["PILOSA_TPU_REPLICA_SHARDS"])
+        if "PILOSA_TPU_REPLICA_SHARD_MAP" in env:
+            self.replica_shard_map = env["PILOSA_TPU_REPLICA_SHARD_MAP"]
+        if "PILOSA_TPU_REPLICA_SHARD_SPAN" in env:
+            self.replica_shard_span = int(env["PILOSA_TPU_REPLICA_SHARD_SPAN"])
         if "PILOSA_TPU_INGEST_CHUNK_BYTES" in env:
             self.ingest_chunk_bytes = int(env["PILOSA_TPU_INGEST_CHUNK_BYTES"])
         if "PILOSA_TPU_CLIENT_RETRY_BUDGET" in env:

@@ -151,4 +151,9 @@ def __getattr__(name):
         from pilosa_tpu.replica.mesh import build_group_mesh
 
         return build_group_mesh
+    if name in ("Shard", "ShardMap", "ShardMapError", "parse_shard_map",
+                "single_shard_map", "uniform_shard_map"):
+        from pilosa_tpu.replica import shards as _shards
+
+        return getattr(_shards, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -227,4 +227,13 @@ run BENCH_CONFIG=recovery BENCH_RECOVERY_WRITES=4000 BENCH_BATCH=16
 #    the stream phase dominates.
 run BENCH_CONFIG=resync
 run BENCH_CONFIG=resync BENCH_RESYNC_WRITES=8000 BENCH_BATCH=16
+# 14) Partitioned replica groups: write QPS through one shard vs two
+#    (the slice space split across groups, each with its own sequencer
+#    space; scaling_1s_to_2s asserted >= 1.5 in-run, needs >= 3 cores)
+#    plus a LIVE RESHARD splitting the hot range under concurrent write
+#    load — zero failed writes and digest convergence (moved slices only
+#    on the new group) asserted in-run.  The second line runs longer
+#    phases with more clients for a stabler ratio.
+run BENCH_CONFIG=shard
+run BENCH_CONFIG=shard BENCH_THREADS=24 BENCH_SHARD_SECS=10
 echo "ALL DONE $(date +%H:%M:%S)" >> $OUT

@@ -147,7 +147,7 @@ def _lockcheck_gate(request):
 # violation.  (Subprocess group events are invisible — the trace covers
 # the in-process router side, which owns every invariant checked.)
 
-_SPEC_TRACE_MODULES = ("test_replica_recovery",)
+_SPEC_TRACE_MODULES = ("test_replica_recovery", "test_replica_shard")
 
 
 @pytest.fixture(autouse=True)

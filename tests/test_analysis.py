@@ -1111,7 +1111,7 @@ def test_lockset_real_tree_fragment_declares_guarded_state():
     _guarded_by_ maps naming their real locks (spot-check the contract
     the conftest-gated suites run under)."""
     from pilosa_tpu.core.fragment import Fragment
-    from pilosa_tpu.replica.router import GroupState, ReplicaRouter
+    from pilosa_tpu.replica.router import GroupState, ReplicaRouter, ShardRuntime
     from pilosa_tpu.replica.wal import WriteAheadLog
     from pilosa_tpu.qcache import QueryCache
     from pilosa_tpu.ingest import StreamIngestor, WriteQueue
@@ -1120,7 +1120,8 @@ def test_lockset_real_tree_fragment_declares_guarded_state():
     assert Fragment._guarded_by_["storage"] == "core.fragment._mu"
     assert Fragment._guarded_by_["generation"] == "core.fragment._mu"
     assert GroupState._guarded_by_["applied_seq"] == "replica.router._mu"
-    assert ReplicaRouter._guarded_by_["write_seq"] == "replica.router._seq_mu"
+    assert ShardRuntime._guarded_by_["write_seq"] == "replica.router._seq_mu"
+    assert ReplicaRouter._guarded_by_["_fleet_cache"] == "replica.router._fleet_mu"
     assert WriteAheadLog._guarded_by_["_synced_off"] == "replica.wal._sync_cv"
     assert QueryCache._guarded_by_["_store"] == "qcache._mu"
     assert StreamIngestor._guarded_by_["_transfers"] == "ingest.stream._mu"

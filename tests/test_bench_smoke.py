@@ -255,6 +255,34 @@ def test_bench_resync_emits_json():
     assert result["cpus"] >= 1
 
 
+def test_bench_shard_emits_json():
+    """The partitioned-replica-groups bench: write throughput through
+    one shard vs two (separate subprocess groups, separate sequencer
+    spaces), then a LIVE RESHARD splitting the slice space under
+    concurrent write load — zero failed writes and digest convergence
+    (moved range only on the new group) asserted in-run.  The write
+    scaling RATIO is recorded under BENCH_SMOKE, asserted only on a
+    real multi-core run (``scaling_asserted``/``skip_reason`` say
+    which)."""
+    stdout = _run({"BENCH_CONFIG": "shard", "BENCH_SMOKE": "1"}, timeout=300)
+    result = json.loads(stdout.strip().splitlines()[-1])
+    assert result["metric"] == "shard_write_qps" and result["value"] > 0
+    names = [t["tier"] for t in result["tiers"]]
+    assert names == ["router_1s", "router_2s", "reshard"]
+    by = {t["tier"]: t for t in result["tiers"]}
+    assert by["router_1s"]["write_qps"] > 0 and by["router_1s"]["served"] > 0
+    assert by["router_2s"]["write_qps"] > 0 and by["router_2s"]["served"] > 0
+    # The bench asserted these in-run; the fields record it.
+    assert by["reshard"]["failed_writes"] == 0
+    assert by["reshard"]["writes_during_reshard"] > 0
+    assert by["reshard"]["moved_fragments"] >= 1
+    assert by["reshard"]["map_epoch"] == 1
+    assert by["reshard"]["fence_ms"] >= 0
+    assert result["scaling_1s_to_2s"] > 0 and result["cpus"] >= 1
+    if not result["scaling_asserted"]:
+        assert result["skip_reason"]  # skipped WITH a reason, never silently
+
+
 def test_star_trace_example_runs():
     stdout = _run({}, script=os.path.join("examples", "star_trace.py"))
     assert "top stargazers:" in stdout and "user 1 attrs:" in stdout

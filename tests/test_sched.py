@@ -192,6 +192,62 @@ def test_model_broken_variants_each_trip_their_invariant(knob, needle):
     assert any(needle in v for v in res.violations), res.violations[:3]
 
 
+# -- sharded (2-shard x 2-replica) model -------------------------------------
+
+
+def test_model_sharded_clean_at_issue_scope():
+    # The PR 17 acceptance scope: 2 shards x 2 replicas, each shard its
+    # own sequence space, shared restart budget — explores clean.
+    res = spec.model_check_sharded(n_shards=2, n_groups=2)
+    assert res.ok, res.violations[:3]
+    assert res.states > 1000
+
+
+def test_model_sharded_determinism():
+    a = spec.model_check_sharded()
+    b = spec.model_check_sharded()
+    assert (a.states, a.transitions) == (b.states, b.transitions)
+
+
+@pytest.mark.parametrize(
+    "knob,kwargs,needle",
+    [
+        ("break_quorum", {}, "merged read"),
+        ("break_compaction", {"n_groups": 3, "max_writes_per_shard": 2},
+         "lost"),
+        ("break_abort", {}, "tombstoned"),
+        ("break_routing", {}, "foreign"),
+    ],
+)
+def test_model_sharded_broken_variants_each_trip(knob, kwargs, needle):
+    res = spec.model_check_sharded(**{knob: True}, **kwargs)
+    assert not res.ok, f"{knob} explored clean — the checker is blind to it"
+    assert any(needle in v for v in res.violations), res.violations[:3]
+
+
+def test_model_reshard_clean_and_fence_rules_trip():
+    res = spec.model_check_reshard()
+    assert res.ok, res.violations[:3]
+    for knob in ("break_fence", "break_clear"):
+        broken = spec.model_check_reshard(**{knob: True})
+        assert not broken.ok, f"{knob} explored clean"
+        assert any("missing acked" in v for v in broken.violations)
+
+
+def test_trace_reshard_epoch_must_advance():
+    bad = [
+        ("reshard", {"src": 9, "shard": "s0", "epoch": 1}),
+        ("reshard", {"src": 9, "shard": "s0", "epoch": 1}),
+    ]
+    out = spec.check_trace(bad)
+    assert any("epoch did not advance" in v for v in out)
+    ok = [
+        ("reshard", {"src": 9, "shard": "s0", "epoch": 1}),
+        ("reshard", {"src": 9, "shard": "s0b", "epoch": 2}),
+    ]
+    assert spec.check_trace(ok) == []
+
+
 # -- trace conformance -------------------------------------------------------
 
 
