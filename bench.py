@@ -3488,6 +3488,129 @@ def bench_multicore() -> dict:
     }
 
 
+def bench_bulk() -> dict:
+    """BENCH_CONFIG=bulk: the device-build bulk door vs the PR-10
+    streamed ingest door on the SAME seeded data, over HTTP against a
+    numpy-engine server.
+
+    Three in-run contracts (assertions, not just numbers):
+    - THROUGHPUT: the bulk build commits >= BENCH_BULK_MIN_X (default
+      5) times the pairs/s of the streamed set_bits door — the whole
+      point of packing planes with the sort/segment/scatter kernel and
+      deferring roaring materialization.
+    - DIFFERENTIAL: the bulk-built frame is digest-identical to the
+      streamed frame, slice by slice (materialization happens under the
+      checksum touch — the lazy ledger is part of what's being proven).
+    - ROUND TRIP: Arrow egress of the bulk frame re-ingested through
+      the bulk door re-exports byte-identical per slice.
+    """
+    import tempfile
+    import zlib as _zlib
+
+    from pilosa_tpu.config import Config
+    from pilosa_tpu.server.client import Client
+    from pilosa_tpu.server.server import Server
+
+    # BENCH_SMOKE=1: tiny shape, throughput gate off — smoke proves the
+    # chunk wire + digest parity + arrow round trip, not perf (fixed
+    # per-request overheads swamp a 100k-pair run).
+    smoke = os.environ.get("BENCH_SMOKE") == "1"
+    n_pairs = int(
+        os.environ.get("BENCH_BULK_PAIRS", "100000" if smoke else "1000000")
+    )
+    n_rows = int(os.environ.get("BENCH_BULK_ROWS", "64"))
+    n_slices = int(os.environ.get("BENCH_BULK_SLICES", "4"))
+    min_x = float(
+        os.environ.get("BENCH_BULK_MIN_X", "0" if smoke else "5")
+    )
+    rng = np.random.default_rng(18)
+    rows = rng.integers(0, n_rows, size=n_pairs).astype(np.uint64)
+    cols = rng.integers(0, n_slices << 20, size=n_pairs).astype(np.uint64)
+
+    with tempfile.TemporaryDirectory() as d:
+        cfg = Config(
+            data_dir=d, host="127.0.0.1:0", engine="numpy", stats="expvar",
+            qcache_enabled=False,
+        )
+        srv = Server(cfg)
+        srv.open()
+        try:
+            client = Client(srv.host)
+            client.create_index("x")
+            for fr in ("s", "b", "r"):
+                client.create_frame("x", fr)
+
+            t0 = time.perf_counter()
+            res = client.ingest_stream("x", "s", rows, cols, chunk_pairs=65536)
+            stream_dt = time.perf_counter() - t0
+            assert res["done"], "streamed ingest did not complete"
+
+            t0 = time.perf_counter()
+            res = client.bulk_stream("x", "b", rows, cols, chunk_pairs=65536)
+            bulk_dt = time.perf_counter() - t0
+            assert res["done"], "bulk build did not complete"
+
+            stream_rate = n_pairs / stream_dt
+            bulk_rate = n_pairs / bulk_dt
+            ratio = bulk_rate / stream_rate
+            assert ratio >= min_x, (
+                f"bulk build only {ratio:.2f}x the streamed door "
+                f"({bulk_rate:,.0f} vs {stream_rate:,.0f} pairs/s); "
+                f"need >= {min_x}x"
+            )
+
+            # Differential: digest-identical frames, slice by slice.
+            # The checksum touch materializes the bulk frame's overlay
+            # through the lazy ledger — the contract under test.
+            idx = srv.holder.index("x")
+            for s in range(n_slices):
+                fs = idx.frame("s").view("standard").fragment(s)
+                fb = idx.frame("b").view("standard").fragment(s)
+                assert fs is not None and fb is not None, f"slice {s} missing"
+                assert fs.checksum() == fb.checksum(), (
+                    f"bulk-built slice {s} diverged from streamed"
+                )
+
+            # Round trip: Arrow egress -> bulk re-ingest -> re-export,
+            # byte-identical per slice (deterministic batch framing).
+            rt_bytes = 0
+            for s in range(n_slices):
+                a = client.export_arrow("x", "b", "standard", s)
+                crc = _zlib.crc32(a)
+                status, out = client.ingest_chunk(
+                    "x", "r", 0, len(a), crc, a, ccrc=crc,
+                    door="bulk", arrow=True,
+                )
+                assert status == 200 and out.get("done"), (
+                    f"arrow re-ingest of slice {s} failed: {status} {out}"
+                )
+                rt_bytes += len(a)
+            for s in range(n_slices):
+                a = client.export_arrow("x", "b", "standard", s)
+                b = client.export_arrow("x", "r", "standard", s)
+                assert a == b, f"arrow round trip of slice {s} not byte-identical"
+        finally:
+            srv.close()
+
+    return {
+        "metric": "bulk_build_vs_streamed_ingest",
+        "value": round(ratio, 2),
+        "unit": (
+            f"x pairs/s vs /ingest ({bulk_rate:,.0f} vs "
+            f"{stream_rate:,.0f} pairs/s over {n_pairs:,} pairs x "
+            f"{n_rows} rows x {n_slices} slices; digest-equal; arrow "
+            f"round trip {rt_bytes:,} bytes byte-identical)"
+        ),
+        "tiers": {
+            "bulk_pairs_per_s": round(bulk_rate, 1),
+            "stream_pairs_per_s": round(stream_rate, 1),
+            "bulk_vs_stream": round(ratio, 2),
+            "digest_equal": True,
+            "arrow_roundtrip_bytes": rt_bytes,
+        },
+    }
+
+
 def main() -> None:
     cfg = os.environ.get("BENCH_CONFIG", "intersect_count")
     if cfg != "intersect_count":
@@ -3509,6 +3632,7 @@ def main() -> None:
             "multicore": bench_multicore,
             "recovery": bench_recovery,
             "resync": bench_resync,
+            "bulk": bench_bulk,
             "shard": bench_shard,
             "intersect_count_stream": bench_intersect_stream,
             "intersect_count_4krows": bench_intersect_4krows,

@@ -181,6 +181,11 @@ def cmd_lockstep(args) -> int:
         trace_slow_ms=cfg.trace_slow_ms,
         group=gname,
         group_epoch=gepoch,
+        # [bulk] wiring: rank 0 decodes chunks, every rank rebuilds
+        # planes from the replicated pairs; the budget shapes each
+        # rank's lazy-materialization drain.
+        bulk_batch_slices=cfg.bulk_batch_slices,
+        bulk_materialize_budget_ms=cfg.bulk_materialize_budget_ms,
     )
     if svc.rank == 0:
         print(
@@ -326,11 +331,55 @@ def cmd_ingest(args) -> int:
     return 0
 
 
+def cmd_bulk(args) -> int:
+    """Client half of the device-build bulk door: parse CSV with the
+    native parser, stream chunks through POST .../bulk (packed-uint64
+    framing, or Arrow IPC record batches with --arrow) — the server
+    bit-packs planes on device and defers roaring materialization."""
+    from pilosa_tpu import native
+    from pilosa_tpu.server.client import Client
+
+    client = Client(args.host)
+    total = 0
+    for path in args.paths:
+        data = sys.stdin.buffer.read() if path == "-" else open(path, "rb").read()
+        rows, cols, _ts = native.parse_csv(data)
+        client.bulk_stream(
+            args.index, args.frame, rows, cols,
+            chunk_pairs=args.chunk_pairs, arrow=args.arrow,
+        )
+        total += len(rows)
+    print(f"streamed {total} bits into {args.index}/{args.frame} via /bulk")
+    return 0
+
+
 def cmd_export(args) -> int:
     from pilosa_tpu.server.client import Client, ClientError
 
     client = Client(args.host)
     max_slice = client.max_slices().get(args.index, 0)
+    if getattr(args, "format", "csv") == "arrow":
+        # Arrow egress is a byte stream (one IPC stream per slice),
+        # concatenated to the output; stdout gets the binary buffer.
+        out = sys.stdout.buffer if args.output == "-" else open(args.output, "wb")
+        try:
+            for slice_i in range(max_slice + 1):
+                try:
+                    out.write(
+                        client.export_arrow(args.index, args.frame, args.view, slice_i)
+                    )
+                except ClientError as e:
+                    if e.status != 404:
+                        raise
+                    print(
+                        f"warning: slice {slice_i} not on {args.host} (404); "
+                        "export may be partial — run against each cluster node",
+                        file=sys.stderr,
+                    )
+        finally:
+            if out is not sys.stdout.buffer:
+                out.close()
+        return 0
     out = sys.stdout if args.output == "-" else open(args.output, "w")
     try:
         for slice_i in range(max_slice + 1):
@@ -574,6 +623,27 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("paths", nargs="+")
     s.set_defaults(fn=cmd_ingest)
 
+    s = sub.add_parser(
+        "bulk",
+        help="stream CSV row,col bits through the device-build /bulk door "
+             "(sort/segment/scatter plane build on device, lazy roaring "
+             "materialization; --arrow ships Arrow IPC chunks)",
+    )
+    s.add_argument("--host", default="localhost:10101")
+    s.add_argument("--index", required=True)
+    s.add_argument("--frame", required=True)
+    s.add_argument(
+        "--chunk-pairs", type=int, default=65536,
+        help="(row, col) pairs per streamed chunk",
+    )
+    s.add_argument(
+        "--arrow", action="store_true",
+        help="encode chunks as Arrow IPC record batches instead of "
+             "packed-uint64 framing (needs pyarrow on both ends)",
+    )
+    s.add_argument("paths", nargs="+")
+    s.set_defaults(fn=cmd_bulk)
+
     s = sub.add_parser("import", help="bulk-import CSV row,col[,timestamp] bits")
     s.add_argument("--host", default="localhost:10101")
     s.add_argument("--index", required=True, dest="index")
@@ -582,11 +652,16 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("paths", nargs="+")
     s.set_defaults(fn=cmd_import)
 
-    s = sub.add_parser("export", help="export a frame as CSV")
+    s = sub.add_parser("export", help="export a frame as CSV or Arrow")
     s.add_argument("--host", default="localhost:10101")
     s.add_argument("--index", required=True)
     s.add_argument("--frame", required=True)
     s.add_argument("--view", default="standard")
+    s.add_argument(
+        "--format", choices=("csv", "arrow"), default="csv",
+        help="csv row,col lines or Arrow IPC record batches "
+             "(one stream per slice, concatenated)",
+    )
     s.add_argument("-o", "--output", default="-")
     s.set_defaults(fn=cmd_export)
 

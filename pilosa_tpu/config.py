@@ -147,6 +147,13 @@ class Config:
     # and individually acked, so a killed transfer resumes at the
     # staged offset).
     replica_resync_chunk_bytes: int = 256 << 10
+    # Columnar resync negotiation: movers may fetch a fragment the
+    # laggard lacks entirely as Arrow record batches (donor
+    # /export?format=arrow) and push it through the laggard's
+    # device-build /bulk door; any refusal degrades to the roaring
+    # byte stream.  Off by default — both sides must speak the PR-18
+    # bulk wire for the fast path to engage.
+    replica_resync_columnar: bool = False
     # Partitioned replica groups (the 2-D slice-shard x replica mesh).
     # shards = N splits the flat group list into N consecutive chunks,
     # shard i owning slices [i*shard-span, (i+1)*shard-span) (last
@@ -162,6 +169,16 @@ class Config:
     # (POST /index/<i>/frame/<f>/ingest): a chunk past it answers 413
     # instead of buffering an unbounded request body.
     ingest_chunk_bytes: int = 4 << 20
+    # -- device bulk build ([bulk] TOML section) --------------------------
+    # Slice planes committed per fragment batch at the bulk build door
+    # (POST /index/<i>/frame/<f>/bulk): bounds the per-commit lock hold
+    # and the transient plane allocation, like gram-rows-max bounds the
+    # Gram working set.
+    bulk_batch_slices: int = 8
+    # Time budget (ms) for the opportunistic overlay->roaring drain at
+    # bulk transfer completion.  0 = fully lazy: containers materialize
+    # only on a roaring-shaped touch (snapshot/digest/mutation/export).
+    bulk_materialize_budget_ms: float = 0.0
     # -- HTTP client ([client] TOML section) ------------------------------
     # Retry budget for door sheds (429/503 — both issued BEFORE any
     # execution, so writes are safe to retry): total extra attempts per
@@ -251,6 +268,9 @@ class Config:
         cfg.replica_resync_chunk_bytes = int(
             rep.get("resync-chunk-bytes", cfg.replica_resync_chunk_bytes)
         )
+        cfg.replica_resync_columnar = bool(
+            rep.get("resync-columnar", cfg.replica_resync_columnar)
+        )
         cfg.replica_shards = int(rep.get("shards", cfg.replica_shards))
         cfg.replica_shard_map = str(rep.get("shard-map", cfg.replica_shard_map))
         cfg.replica_shard_span = int(
@@ -258,6 +278,11 @@ class Config:
         )
         ing = raw.get("ingest", {})
         cfg.ingest_chunk_bytes = int(ing.get("chunk-bytes", cfg.ingest_chunk_bytes))
+        blk = raw.get("bulk", {})
+        cfg.bulk_batch_slices = int(blk.get("batch-slices", cfg.bulk_batch_slices))
+        cfg.bulk_materialize_budget_ms = float(
+            blk.get("materialize-budget-ms", cfg.bulk_materialize_budget_ms)
+        )
         cli = raw.get("client", {})
         cfg.client_retry_budget = int(
             cli.get("retry-budget", cfg.client_retry_budget)
@@ -368,6 +393,10 @@ class Config:
             self.replica_resync_chunk_bytes = int(
                 env["PILOSA_TPU_REPLICA_RESYNC_CHUNK_BYTES"]
             )
+        if "PILOSA_TPU_REPLICA_RESYNC_COLUMNAR" in env:
+            self.replica_resync_columnar = env[
+                "PILOSA_TPU_REPLICA_RESYNC_COLUMNAR"
+            ].lower() in ("1", "true", "yes")
         if "PILOSA_TPU_REPLICA_SHARDS" in env:
             self.replica_shards = int(env["PILOSA_TPU_REPLICA_SHARDS"])
         if "PILOSA_TPU_REPLICA_SHARD_MAP" in env:
@@ -376,6 +405,12 @@ class Config:
             self.replica_shard_span = int(env["PILOSA_TPU_REPLICA_SHARD_SPAN"])
         if "PILOSA_TPU_INGEST_CHUNK_BYTES" in env:
             self.ingest_chunk_bytes = int(env["PILOSA_TPU_INGEST_CHUNK_BYTES"])
+        if "PILOSA_TPU_BULK_BATCH_SLICES" in env:
+            self.bulk_batch_slices = int(env["PILOSA_TPU_BULK_BATCH_SLICES"])
+        if "PILOSA_TPU_BULK_MATERIALIZE_BUDGET_MS" in env:
+            self.bulk_materialize_budget_ms = float(
+                env["PILOSA_TPU_BULK_MATERIALIZE_BUDGET_MS"]
+            )
         if "PILOSA_TPU_CLIENT_RETRY_BUDGET" in env:
             self.client_retry_budget = int(env["PILOSA_TPU_CLIENT_RETRY_BUDGET"])
         if "PILOSA_TPU_LOCKSTEP_ACK_TIMEOUT" in env:

@@ -134,6 +134,7 @@ class Fragment:
         "_writelane_streak": "core.fragment._mu",
         "_writelane_cooldown": "core.fragment._mu",
         "_pending_rows": "core.fragment._mu",
+        "_bulk_planes": "core.fragment._mu",
         "_checksum_cache": "core.fragment._mu",
         "_opn_trigger": "core.fragment._mu",
         "_dirty_floor": "core.fragment._mu",
@@ -201,6 +202,15 @@ class Fragment:
         # Deferred (row -> bit-count delta) bookkeeping from the ingest
         # hot path; drained by _flush_row_bookkeeping before cache reads.
         self._pending_rows: dict[int, int] = {}
+        # Pending dense overlay from the device bulk builder: row id ->
+        # packed uint32[SLICE_WIDTH/32] word plane OF BITS NOT YET IN
+        # STORAGE's roaring form.  Serving reads merge it for free
+        # (row_dense ORs word planes); roaring-shaped touches (snapshot,
+        # digest, WAL-logged mutation, export of containers) MUST call
+        # _materialize_bulk_locked first so storage is always the full
+        # truth wherever its container structure is observed.  The
+        # bulk.lazy ledger tracks fragments with a non-empty overlay.
+        self._bulk_planes: dict[int, np.ndarray] = {}
         self._open = False
         self._max_opn_scale: Optional[int] = None  # lazy env read
         self._opn_trigger = 0  # cached snapshot trigger (_increment_opn)
@@ -318,6 +328,12 @@ class Fragment:
         return (data if data else None), None
 
     def close(self) -> None:
+        with self._mu:
+            # Pay any bulk-overlay debt FIRST, while the WAL is still
+            # attached: the conversion logs op records (or snapshots),
+            # and a detach-then-materialize would silently drop them.
+            if self._open and self._bulk_planes:
+                self._materialize_bulk_locked()
         with self._mu:
             if self._wal is not None:
                 # Detach + close UNDER the write lock: the fused native
@@ -442,6 +458,12 @@ class Fragment:
         the fragment-level equivalent of cache.Recalculate)."""
         with self._mu:
             self._flush_row_bookkeeping()
+            # Pending bulk-overlay rows aren't in the rank cache yet
+            # (bulk_set_planes defers all derived bookkeeping): seed
+            # them here with merged counts so a recalculated ranking
+            # reflects read-your-writes without materializing roaring.
+            for row_id in sorted(self._bulk_planes):
+                self.cache.bulk_add(row_id, self._row_count_locked(row_id))
             self.cache.recalculate()
 
     def flush_cache(self) -> None:
@@ -497,6 +519,7 @@ class Fragment:
     def set_bit(self, row_id: int, column_id: int) -> bool:
         with self._mu:
             self._assert_open()
+            self._materialize_bulk_locked()
             changed = self.storage.add(self.pos(row_id, column_id))
             if changed:
                 # Row bookkeeping (cache invalidation + rank-cache update)
@@ -535,6 +558,7 @@ class Fragment:
         if len(positions) <= 8:
             with self._mu:
                 self._assert_open()
+                self._materialize_bulk_locked()
                 changed = np.zeros(len(positions), dtype=bool)
                 added: list[int] = []
                 for i, v in enumerate(positions.tolist()):
@@ -554,6 +578,7 @@ class Fragment:
                 return changed
         with self._mu:
             self._assert_open()
+            self._materialize_bulk_locked()
             # Apply first, then choose durability by how much was actually
             # new: a batch at/over the snapshot threshold goes straight to
             # snapshot (import_bits shape, the op records would be
@@ -586,6 +611,7 @@ class Fragment:
     def clear_bit(self, row_id: int, column_id: int) -> bool:
         with self._mu:
             self._assert_open()
+            self._materialize_bulk_locked()
             changed = self.storage.remove(self.pos(row_id, column_id))
             if changed:
                 self.generation = next(_generation_counter)
@@ -599,7 +625,16 @@ class Fragment:
     def contains(self, row_id: int, column_id: int) -> bool:
         with self._mu:
             self._assert_open()
-            return self.storage.contains(self.pos(row_id, column_id))
+            pos = self.pos(row_id, column_id)
+            if self.storage.contains(pos):
+                return True
+            # A bit may still be pending in the bulk overlay: point reads
+            # merge it in word space (no materialization for a read).
+            ov = self._bulk_planes.get(row_id)
+            if ov is None:
+                return False
+            local = pos - row_id * SLICE_WIDTH
+            return bool((int(ov[local >> 5]) >> (local & 31)) & 1)
 
     # -- native write request lane (write-side twin of pn_serve_pairs) ---
 
@@ -688,6 +723,9 @@ class Fragment:
         """
         with self._mu:
             self._assert_open()
+            # The armed table reads container extents directly: pending
+            # overlay planes would be invisible to it, so pay the debt.
+            self._materialize_bulk_locked()
             st = self._writelane_state()
             if st is None or st.get("extra"):
                 # Containers created through the scalar lane since the
@@ -727,6 +765,7 @@ class Fragment:
         W = np.uint64(SLICE_WIDTH)
         with self._mu:
             self._assert_open()
+            self._materialize_bulk_locked()
             if self._writelane_cooldown > 0 and len(src) < 192:
                 # SINGLETON structural declines dominated recently: the
                 # per-op crossing is pure overhead on cold first-touch
@@ -1012,6 +1051,9 @@ class Fragment:
         """Rewrite the data file from storage; temp-file + rename."""
         with self._mu:
             self._assert_open()
+            # The snapshot file is the restore-path truth: fold any
+            # pending bulk overlay in first so no bits live only in RAM.
+            self._materialize_bulk_locked()
             self._snapshot()
 
     def _snapshot(self) -> None:
@@ -1075,6 +1117,12 @@ class Fragment:
                 self._row_cache.move_to_end(row_id)
                 return cached
             words = self.storage.to_dense_words(row_id * SLICE_WIDTH, SLICE_WIDTH)
+            ov = self._bulk_planes.get(row_id)
+            if ov is not None:
+                # Pending bulk overlay: the dense read merges it for free
+                # (one word-wise OR) — this is why bulk commits serve
+                # read-your-writes without touching roaring containers.
+                words = words | ov
             self._row_cache[row_id] = words
             while len(self._row_cache) > self._row_cache_max:
                 self._row_cache.popitem(last=False)
@@ -1115,6 +1163,9 @@ class Fragment:
         """Row as a roaring bitmap of global column positions for this slice."""
         with self._mu:
             self._assert_open()
+            # Roaring-shaped read: container structure is observed, so any
+            # pending overlay must be in storage first.
+            self._materialize_bulk_locked()
             return self.storage.offset_range(
                 self.slice * SLICE_WIDTH, row_id * SLICE_WIDTH, (row_id + 1) * SLICE_WIDTH
             )
@@ -1130,9 +1181,24 @@ class Fragment:
         # analysis-ok: check-then-act: every caller holds fragment._mu (locked-suffix convention; the rule sees only function-local locks)
         rc = self._row_counts.get(row_id)
         if rc is None:
-            rc = self.storage.count_range(
+            ov = self._bulk_planes.get(row_id)
+            if ov is None:
+                rc = self.storage.count_range(
+                    row_id * SLICE_WIDTH, (row_id + 1) * SLICE_WIDTH
+                )
+            elif self.storage.count_range(
                 row_id * SLICE_WIDTH, (row_id + 1) * SLICE_WIDTH
-            )
+            ) == 0:
+                # Bulk-into-empty row (the common build shape): the
+                # overlay IS the row; no dense expansion needed.
+                rc = bw.count_words(ov)
+            else:
+                # Overlay rows count over the merged dense view (overlap
+                # with storage bits makes count_range + popcount(ov) wrong).
+                words = self.storage.to_dense_words(
+                    row_id * SLICE_WIDTH, SLICE_WIDTH
+                )
+                rc = bw.count_words(words | ov)
             self._row_counts[row_id] = rc
             while len(self._row_counts) > self._row_counts_max:
                 self._row_counts.popitem(last=False)
@@ -1142,11 +1208,17 @@ class Fragment:
 
     def max_row(self) -> int:
         with self._mu:
-            return self.storage.max() // SLICE_WIDTH
+            m = self.storage.max() // SLICE_WIDTH
+            if self._bulk_planes:
+                m = max(m, max(self._bulk_planes))
+            return m
 
     def count(self) -> int:
         with self._mu:
             self._assert_open()
+            # Whole-fragment cardinality needs the deduplicated union;
+            # cheapest exact answer is to pay the overlay debt.
+            self._materialize_bulk_locked()
             return self.storage.count()
 
     # -- TopN (fragment.go:493-659) -------------------------------------
@@ -1257,6 +1329,7 @@ class Fragment:
         """Bulk load; WAL detached, one snapshot at the end."""
         with self._mu:
             self._assert_open()
+            self._materialize_bulk_locked()
             self._import_bits(row_ids, column_ids)
 
     def _import_bits(self, row_ids, column_ids) -> None:
@@ -1282,6 +1355,186 @@ class Fragment:
         self.cache.recalculate()
         self.snapshot()
 
+    # -- device bulk build commit (pilosa_tpu/bulk) ----------------------
+
+    def bulk_set_planes(self, row_ids, planes) -> int:
+        """Commit packed word planes from the device bulk builder as a
+        PENDING dense overlay — no roaring conversion here (that is the
+        lazy half; see bulk/lazy.py and _materialize_bulk_locked).
+
+        ``planes[i]`` is a uint32[SLICE_WIDTH/32] plane of bits to OR
+        into row ``row_ids[i]``.  Serving reads (row_dense, contains,
+        row counts, TopN scoring) merge the overlay immediately, so
+        read-your-writes holds from the moment this returns; any
+        roaring-shaped touch materializes first.  Returns the number of
+        planes committed.
+        """
+        planes = np.asarray(planes, dtype=np.uint32)
+        if planes.ndim != 2 or planes.shape[1] != _WORDS:
+            raise ValueError("planes must be (G, SLICE_WIDTH/32) uint32")
+        if len(row_ids) != len(planes):
+            raise ValueError("row/plane length mismatch")
+        with self._mu:
+            self._assert_open()
+            if len(planes) == 0:
+                return 0
+            was_empty = not self._bulk_planes
+            ov = self._bulk_planes
+            rows = [int(r) for r in row_ids]
+            for row_id, plane in zip(rows, planes):
+                cur = ov.get(row_id)
+                if cur is None:
+                    ov[row_id] = plane.copy()
+                else:
+                    np.bitwise_or(cur, plane, out=cur)
+                self._bulk_drop_row_caches_locked(row_id)
+            self._bulk_commit_tail_locked(rows, was_empty)
+            return len(rows)
+
+    def bulk_or_words(self, row_ids, counts, word_idx, word_vals) -> int:
+        """Sparse twin of :meth:`bulk_set_planes`: OR individual plane
+        words into the overlay from the builder's CSR form
+        (``counts[i]`` words for ``row_ids[i]``; ``word_idx`` in-plane
+        word indices, UNIQUE within each group — the builder's segment
+        stage guarantees it, and the fancy-indexed OR below silently
+        drops duplicates; ``word_vals`` their uint32 values).
+
+        A chunk's pairs touch a few hundred words per plane, so this
+        avoids materializing and merging full 32768-word planes per
+        chunk — each overlay plane is allocated once and only its
+        touched words are written.  Semantics are identical to
+        committing the equivalent dense planes."""
+        counts = np.asarray(counts, dtype=np.int64)
+        word_idx = np.asarray(word_idx, dtype=np.int64)
+        word_vals = np.asarray(word_vals, dtype=np.uint32)
+        if len(row_ids) != len(counts):
+            raise ValueError("row/count length mismatch")
+        if len(word_idx) != len(word_vals) or int(counts.sum()) != len(word_idx):
+            raise ValueError("word CSR length mismatch")
+        if len(word_idx) and (
+            int(word_idx.min()) < 0 or int(word_idx.max()) >= _WORDS
+        ):
+            raise ValueError("word index out of plane range")
+        offs = np.concatenate([np.zeros(1, dtype=np.int64), np.cumsum(counts)])
+        with self._mu:
+            self._assert_open()
+            if len(counts) == 0:
+                return 0
+            was_empty = not self._bulk_planes
+            ov = self._bulk_planes
+            rows = [int(r) for r in row_ids]
+            for i, row_id in enumerate(rows):
+                cur = ov.get(row_id)
+                if cur is None:
+                    cur = ov[row_id] = np.zeros(_WORDS, dtype=np.uint32)
+                lo, hi = offs[i], offs[i + 1]
+                cur[word_idx[lo:hi]] |= word_vals[lo:hi]
+                self._bulk_drop_row_caches_locked(row_id)
+            self._bulk_commit_tail_locked(rows, was_empty)
+            return len(rows)
+
+    def _bulk_drop_row_caches_locked(self, row_id: int) -> None:
+        """An overlay commit changes the row by an UNKNOWN delta (the
+        committed bits may overlap existing ones), which the deferred
+        (row -> delta) bookkeeping cannot express — drop the derived
+        caches for the row outright instead."""
+        self._row_cache.pop(row_id, None)
+        dropped = self._row_dev_cache.pop(row_id, None)
+        if dropped is not None:
+            # analysis-ok: check-then-act: every caller holds fragment._mu (locked-suffix convention; the rule sees only function-local locks)
+            self._row_dev_cache_arrays -= len(dropped)
+        self._checksums.pop(row_id // HASH_BLOCK_SIZE, None)
+        self._row_counts.pop(row_id, None)
+
+    def _bulk_commit_tail_locked(self, rows, was_empty: bool) -> None:
+        """Shared overlay-commit bookkeeping: eager generation bump
+        (armed write-lane tables, engine row matrices, and qcache
+        vectors keyed on the old generation must not serve pre-overlay
+        state), dirty-row journal, stats, and the lazy ledger's pending
+        note on the empty -> non-empty transition."""
+        self.generation = next(_generation_counter)
+        self._log_dirty(rows)
+        self.stats.count("bulk.commit_rows", len(rows))
+        if was_empty:
+            from pilosa_tpu.bulk.lazy import LEDGER
+
+            LEDGER.note_pending(self)
+
+    def materialize_bulk(self) -> int:
+        """Convert any pending bulk overlay into roaring storage (the
+        materialization ledger's drain entry point).  Returns the number
+        of overlay rows folded in; 0 on a closed fragment (close()
+        already paid the debt)."""
+        with self._mu:
+            if not self._open:
+                return 0
+            return self._materialize_bulk_locked()
+
+    def _materialize_bulk_locked(self) -> int:
+        """Pay the overlay debt: fold every pending plane into roaring
+        storage, WAL-or-snapshot durable, generation bumped (the
+        conversion restructures containers, so armed write-lane tables
+        and zero-copy readers must revalidate).  Call with the lock
+        held.  Reentrancy-safe: the overlay detaches first, so the
+        snapshot trigger's re-entry through snapshot() sees no debt.
+        A no-op (one dict truthiness check) when there is no overlay —
+        every guarded touch path calls this unconditionally."""
+        ov = self._bulk_planes
+        if not ov:
+            return 0
+        import time as _time
+
+        from pilosa_tpu.bulk.build import plane_positions
+        from pilosa_tpu.bulk.lazy import LEDGER
+
+        t0 = _time.perf_counter()
+        self._bulk_planes = {}
+        rows = sorted(ov)
+        positions = np.concatenate(
+            [plane_positions(ov[r], base=r * SLICE_WIDTH) for r in rows]
+        )
+        added = self.storage.add_many_unlogged(positions)
+        if len(added):
+            self.generation = next(_generation_counter)
+            self._log_dirty(rows)
+            if len(added) >= self._effective_max_opn():
+                self._snapshot()
+            else:
+                self.storage.log_add_ops(added)
+                self._increment_opn()
+        # Row-level derived caches stay: the fragment's LOGICAL content
+        # is unchanged by materialization (reads merged the overlay all
+        # along) — only the container structure moved.
+        self.stats.count("bulk.materialized_rows", len(rows))
+        self.stats.timing("bulk.materialize", _time.perf_counter() - t0)
+        LEDGER.note_materialized(self)
+        return len(rows)
+
+    def export_pairs(self) -> tuple[np.ndarray, np.ndarray]:
+        """All set bits as global (row_ids, col_ids) uint64 columns in
+        ascending position order — the columnar egress source.  Merges
+        any pending bulk overlay in position space WITHOUT materializing
+        roaring containers: egress is a dense read, and staying lazy
+        here is the point of the columnar door."""
+        with self._mu:
+            self._assert_open()
+            positions = np.asarray(self.storage.to_array(), dtype=np.uint64)
+            if self._bulk_planes:
+                from pilosa_tpu.bulk.build import plane_positions
+
+                extra = np.concatenate(
+                    [
+                        plane_positions(plane, base=r * SLICE_WIDTH)
+                        for r, plane in sorted(self._bulk_planes.items())
+                    ]
+                )
+                positions = np.union1d(positions, extra)
+        rows = positions // np.uint64(SLICE_WIDTH)
+        cols = positions % np.uint64(SLICE_WIDTH) + np.uint64(
+            self.slice * SLICE_WIDTH
+        )
+        return rows, cols
+
     # -- block checksums & merge (fragment.go:681-920) -------------------
 
     def checksum(self) -> bytes:
@@ -1303,6 +1556,9 @@ class Fragment:
         invalidates the cache by key, never by callback)."""
         with self._mu:
             self._assert_open()
+            # Digests hash storage positions: a pending overlay must be
+            # folded in or replicas would disagree on identical content.
+            self._materialize_bulk_locked()
             self._flush_row_bookkeeping()
             gen = self.generation
             cached = self._checksum_cache
@@ -1320,6 +1576,7 @@ class Fragment:
         """(block id, sha1) for each non-empty block of HASH_BLOCK_SIZE rows."""
         with self._mu:
             self._assert_open()
+            self._materialize_bulk_locked()
             self._flush_row_bookkeeping()
             return self._blocks()
 
@@ -1347,6 +1604,7 @@ class Fragment:
         end = (block_id + 1) * HASH_BLOCK_SIZE * SLICE_WIDTH
         with self._mu:
             self._assert_open()
+            self._materialize_bulk_locked()
             positions = self.storage.slice_values(start, end)
         rows = positions // np.uint64(SLICE_WIDTH)
         cols = positions % np.uint64(SLICE_WIDTH)
@@ -1398,6 +1656,10 @@ class Fragment:
     def write_to(self, w) -> int:
         """Serialize current storage (snapshot format, no pending ops)."""
         with self._mu:
+            if self._open:
+                # Backup/resync payloads must carry the overlay bits; a
+                # closed fragment already materialized during close().
+                self._materialize_bulk_locked()
             return self.storage.write_to(w)
 
     def read_from(self, data: bytes) -> None:
@@ -1406,6 +1668,13 @@ class Fragment:
             self._read_from(data)
 
     def _read_from(self, data: bytes) -> None:
+        if self._bulk_planes:
+            # Wholesale restore supersedes the pending overlay: the
+            # incoming snapshot IS the new truth, debt and all.
+            self._bulk_planes = {}
+            from pilosa_tpu.bulk.lazy import LEDGER
+
+            LEDGER.note_materialized(self)
         self.storage = roaring.Bitmap.from_bytes(data)
         self.storage.op_n = 0
         self.generation = next(_generation_counter)

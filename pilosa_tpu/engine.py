@@ -253,6 +253,26 @@ class NumpyEngine:
         out[np.ix_(list(slice_idxs), list(slots))] = block
         return out
 
+    def build_planes(self, rows, cols):
+        """Bulk sort/segment/scatter build: (row, col) uint64 columns ->
+        ``(slice_ids, row_ids, planes uint32[G, W])`` — the device-layout
+        word planes the bulk ingest door commits into fragments.  Host
+        twin (vectorized numpy); the jax engine runs the same contract on
+        device."""
+        from pilosa_tpu.bulk.build import build_planes_numpy
+
+        return build_planes_numpy(rows, cols)
+
+    def build_words(self, rows, cols):
+        """Sparse form of :meth:`build_planes` (CSR over nonzero plane
+        words) — the commit path prefers it on host, where scattering
+        a chunk's few-hundred touched words per plane beats
+        materializing full planes.  The jax engines deliberately do NOT
+        implement this: their scatter output is born dense on device."""
+        from pilosa_tpu.bulk.build import build_words_numpy
+
+        return build_words_numpy(rows, cols)
+
     def pair_gram(self, matrix):
         """All-pairs AND-count Gram, or None when unsupported (host
         all-pairs popcount would dwarf the direct path)."""
@@ -583,6 +603,15 @@ class JaxEngine:
         return matrix.at[si[:, None], sl[None, :]].set(
             self._match_block(matrix, block)
         )
+
+    def build_planes(self, rows, cols):
+        """Bulk sort/segment/scatter build on device: the jitted pack
+        kernel sorts, dedups, and scatters the bit columns under jax.jit
+        on padded power-of-two shapes (see bulk/build.py); the group
+        table computes on host, where the fragment commit needs it."""
+        from pilosa_tpu.bulk.build import build_planes_jax
+
+        return build_planes_jax(rows, cols, jnp=self._jnp)
 
     def pair_gram(self, matrix):
         """All-pairs AND-count Gram via one MXU int8 matmul (exact)."""

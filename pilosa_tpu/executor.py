@@ -1287,8 +1287,12 @@ class Executor:
 
     def note_external_write(self, index: str, fname: str, rows) -> None:
         """Public hook for non-executor write paths (the streaming
-        ingest door) to feed the dirty-row ledger, so warm serve state
-        patches instead of rebuilding after an ingest burst."""
+        ingest door and the device bulk-build door) to feed the
+        dirty-row ledger, so warm serve state patches instead of
+        rebuilding after an ingest burst.  Bulk overlay commits also
+        journal their rows inside the fragment (``_log_dirty``), so the
+        patch lane can rank-k-update exactly the planes a bulk batch
+        touched even though the write bypassed the executor."""
         self._note_dirty_rows(index, fname, rows)
 
     def _journal_dirty_rows(self, frags, old_gens, new_gens) -> Optional[dict]:

@@ -107,25 +107,65 @@ def decode_packed(body: bytes):
     return rows, cols
 
 
+def _arrow_u64_column(pa, table, name):
+    """One named column of an Arrow table as a uint64 numpy array.
+
+    Tolerant of real producer variety: chunked columns concatenate,
+    dictionary-encoded columns decode to their value type, and any
+    integer type casts (safely) to uint64.  A missing column or a
+    non-integer type raises a POINTED 400 naming the problem — schema
+    mistakes at 100M rows must not read as 'bad arrow chunk: KeyError'.
+    """
+    import numpy as np
+
+    if name not in table.column_names:
+        raise IngestError(
+            400,
+            f"bad arrow chunk: missing required column {name!r} "
+            f"(present: {table.column_names})",
+        )
+    col = table.column(name)
+    if isinstance(col, pa.ChunkedArray):
+        col = col.combine_chunks()
+    if pa.types.is_dictionary(col.type):
+        col = col.dictionary_decode()
+    if not pa.types.is_integer(col.type):
+        raise IngestError(
+            400,
+            f"bad arrow chunk: column {name!r} has type {col.type}, "
+            "expected an integer type castable to uint64",
+        )
+    try:
+        col = col.cast(pa.uint64())
+    except pa.ArrowInvalid as e:
+        raise IngestError(
+            400, f"bad arrow chunk: column {name!r} not castable to uint64: {e}"
+        )
+    return np.ascontiguousarray(
+        col.to_numpy(zero_copy_only=False), dtype=np.uint64
+    )
+
+
 def decode_arrow(body: bytes):
-    """Decode an Arrow IPC stream chunk -> (rows, cols) uint64 arrays."""
+    """Decode an Arrow IPC stream chunk -> (rows, cols) uint64 arrays.
+
+    Requires uint64-castable ``row`` and ``col`` columns; extra columns
+    are ignored (producers often ship their full table), dictionary
+    encoding and multi-chunk columns are accepted.  415 without pyarrow,
+    pointed 400s for schema mistakes."""
     try:
         import pyarrow as pa
     except ImportError:
         raise IngestError(
             415, "arrow ingest unavailable: pyarrow not importable on this server"
         )
-    import numpy as np
-
     try:
         table = pa.ipc.open_stream(body).read_all()
-        rows = table.column("row").to_numpy(zero_copy_only=False)
-        cols = table.column("col").to_numpy(zero_copy_only=False)
-    except (pa.ArrowInvalid, KeyError, ValueError) as e:
+    except (pa.ArrowInvalid, ValueError) as e:
         raise IngestError(400, f"bad arrow chunk: {e}")
     return (
-        np.ascontiguousarray(rows, dtype=np.uint64),
-        np.ascontiguousarray(cols, dtype=np.uint64),
+        _arrow_u64_column(pa, table, "row"),
+        _arrow_u64_column(pa, table, "col"),
     )
 
 

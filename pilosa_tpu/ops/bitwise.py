@@ -459,6 +459,19 @@ def np_popcount(x: np.ndarray) -> np.ndarray:
     return np.unpackbits(x.view(np.uint8)).reshape(*x.shape, 32).sum(-1)
 
 
+# Byte-popcount lookup table for count_words: one gather + sum beats the
+# 8x unpackbits expansion by ~20x when only the TOTAL is wanted.
+_POP8 = np_popcount(np.arange(256, dtype=np.uint32)).astype(np.uint16)
+
+
+def count_words(x: np.ndarray) -> int:
+    """Total set-bit count of a packed word array (any uint dtype).
+    The fast lane for cardinality-only callers — np_popcount stays the
+    per-word reference (property tests hold this to it)."""
+    x = np.ascontiguousarray(x)
+    return int(_POP8[x.view(np.uint8)].sum(dtype=np.int64))
+
+
 def np_count(x: np.ndarray) -> int:
     return int(np_popcount(x).sum())
 

@@ -102,6 +102,16 @@ _LEN = struct.Struct("<I")
 # sentinel directly just triggers a harmless recalc.
 INGEST_RECALC_PREFIX = "\x00ingest-recalc\x00"
 
+# Reserved internal entries for the device-build bulk door: rank 0
+# decodes each chunk once and replays the decoded pairs through the
+# total order as base64(packed-uint64) bodies — every rank runs the
+# SAME build kernel over the SAME pairs, so the committed plane
+# overlays are replicated without rank-0 shipping any derived state.
+# The recalc sentinel runs the completion hook (rank-cache recalc +
+# budgeted materialization) identically on every rank.
+BULK_APPLY_PREFIX = "\x00bulk-apply\x00"
+BULK_RECALC_PREFIX = "\x00bulk-recalc\x00"
+
 
 class DegradedError(PilosaError):
     """The lockstep control plane lost a rank — the replicas can no
@@ -159,6 +169,8 @@ class LockstepService:
         trace_slow_ms: Optional[float] = None,
         group: Optional[str] = None,
         group_epoch: Optional[int] = None,
+        bulk_batch_slices: Optional[int] = None,
+        bulk_materialize_budget_ms: Optional[float] = None,
     ):
         import jax
 
@@ -351,6 +363,27 @@ class LockstepService:
         self._ingestor = ingest_mod.StreamIngestor(
             self._ingest_apply, complete=self._ingest_complete,
         )
+        # Device-build bulk door: chunks decode on rank 0 and the
+        # decoded PAIRS replay through the total order (base64 packed
+        # bodies) — every rank runs the build kernel itself, so the
+        # plane overlays are a pure function of the replicated pairs.
+        # The materialize budget only shapes WHEN each rank folds its
+        # overlay into roaring storage (physical representation, not
+        # logical content), so wall-clock divergence across ranks is
+        # benign.  [bulk] config > PILOSA_TPU_BULK_* env > defaults.
+        if bulk_batch_slices is None:
+            bulk_batch_slices = int(
+                os.environ.get("PILOSA_TPU_BULK_BATCH_SLICES", "8")
+            )
+        if bulk_materialize_budget_ms is None:
+            bulk_materialize_budget_ms = float(
+                os.environ.get("PILOSA_TPU_BULK_MATERIALIZE_BUDGET_MS", "0")
+            )
+        self.bulk_batch_slices = bulk_batch_slices
+        self.bulk_materialize_budget_ms = bulk_materialize_budget_ms
+        self._bulk_ingestor = ingest_mod.StreamIngestor(
+            self._bulk_apply, complete=self._bulk_complete,
+        )
 
     # -- rank 0 ----------------------------------------------------------
 
@@ -504,6 +537,89 @@ class LockstepService:
         fr = self.holder.frame(index, fname)
         if fr is not None:
             ingest_mod.recalc_frame_caches(fr)
+        return True
+
+    # -- bulk build (front-end half) ---------------------------------------
+
+    # Pairs per replicated bulk body: each entry carries base64(packed
+    # uint64 pairs), so at 16 bytes/pair + 4/3 base64 overhead this is
+    # ~350 KiB per control-plane entry — large enough to amortize the
+    # ship/ack round, small enough to stay well under socket comfort.
+    _BULK_SUBBATCH = _INGEST_SUBBATCH * 4
+
+    def _bulk_apply(self, key, rows, cols, deadline) -> int:
+        """One decoded bulk chunk -> packed-pair bodies through the
+        replicated total order.  Unlike the streamed door's SetBit
+        translation, the pairs ship VERBATIM (base64 of the same PI64
+        packing the wire uses) and every rank runs the bulk build
+        kernel over them itself — the committed overlays are a pure
+        function of replicated input."""
+        import base64
+
+        from pilosa_tpu import ingest as ingest_mod
+
+        index, fname = key
+        idx = self.holder.index(index)
+        if idx is None:
+            raise ErrIndexNotFound(index)
+        if idx.frame(fname) is None:
+            raise ErrFrameNotFound(fname)
+        rlist, clist = rows, cols
+        for i in range(0, len(rlist), self._BULK_SUBBATCH):
+            payload = base64.b64encode(
+                ingest_mod.encode_packed(
+                    rlist[i : i + self._BULK_SUBBATCH],
+                    clist[i : i + self._BULK_SUBBATCH],
+                )
+            ).decode("ascii")
+            self._execute(
+                index,
+                BULK_APPLY_PREFIX + fname + "\x00" + payload,
+                deadline=deadline,
+            )
+        return len(rlist)
+
+    def _bulk_complete(self, key) -> None:
+        index, fname = key
+        self._execute(index, BULK_RECALC_PREFIX + fname)
+
+    def _do_bulk_apply(self, index: str, body: str) -> int:
+        """Executed identically on every rank: decode the replicated
+        packed pairs and run the device build + overlay commit through
+        this rank's own engine (jax and numpy builds are bit-identical,
+        so replicas stay digest-equal regardless of backend)."""
+        import base64
+
+        from pilosa_tpu import ingest as ingest_mod
+        from pilosa_tpu.bulk import ingress
+
+        fname, _, payload = body.partition("\x00")
+        fr = self.holder.frame(index, fname)
+        if fr is None:
+            raise ErrFrameNotFound(fname)
+        rows, cols = ingest_mod.decode_packed(base64.b64decode(payload))
+        return ingress.apply_bulk(
+            fr, rows, cols,
+            engine=self.engine,
+            executor=self.executor,
+            index=index,
+            batch_slices=self.bulk_batch_slices,
+            stats=self.stats,
+        )
+
+    def _do_bulk_recalc(self, index: str, fname: str) -> bool:
+        """Executed identically on every rank: rank-cache recalc plus
+        the budgeted lazy-materialization drain.  The drain's wall-clock
+        budget is rank-local, so ranks may fold different AMOUNTS of
+        overlay into roaring storage here — that divergence is physical
+        representation only (logical content, digests and query results
+        are already identical), and any residue materializes on first
+        touch."""
+        from pilosa_tpu.bulk import ingress
+
+        fr = self.holder.frame(index, fname)
+        if fr is not None:
+            ingress.complete_bulk(fr, self.bulk_materialize_budget_ms)
         return True
 
     def _ship_batch(self, items) -> tuple[int, list[bool], list]:
@@ -690,6 +806,21 @@ class LockstepService:
                     # deterministic function of replicated state.
                     deliver(pos, self._do_ingest_recalc(
                         index, query[len(INGEST_RECALC_PREFIX):]
+                    ))
+                    continue
+                if query.startswith(BULK_APPLY_PREFIX):
+                    # Reserved bulk-build entry: every rank builds the
+                    # same planes from the same replicated pairs.
+                    try:
+                        deliver(pos, self._do_bulk_apply(
+                            index, query[len(BULK_APPLY_PREFIX):]
+                        ))
+                    except PilosaError as e:
+                        deliver(pos, e)  # deterministic: isolated
+                    continue
+                if query.startswith(BULK_RECALC_PREFIX):
+                    deliver(pos, self._do_bulk_recalc(
+                        index, query[len(BULK_RECALC_PREFIX):]
                     ))
                     continue
                 try:
@@ -923,13 +1054,16 @@ class LockstepService:
             self.end_headers()
             self.wfile.write(body)
 
-        def _do_ingest(self, index: str, frame: str, params: dict) -> None:
+        def _do_ingest(self, index: str, frame: str, params: dict,
+                       ingestor=None) -> None:
             """Streaming columnar ingest through the lockstep front
             end: same wire contract as the full server's route (off/
             total/crc/ccrc/probe params, packed-uint64 or Arrow chunk
             bodies); chunks replay on every rank as batched SetBit
             bodies and the completion recalc ships through the same
-            total order."""
+            total order.  ``ingestor`` selects the door sharing this
+            wire contract (default the streamed-SetBit one; the /bulk
+            route passes the device-build ingestor)."""
             from pilosa_tpu.ingest import IngestError
             from pilosa_tpu.replica.catchup import note_applied_from_headers
 
@@ -947,6 +1081,8 @@ class LockstepService:
             status = 200
             retry_after = None
             key = (index, frame)
+            if ingestor is None:
+                ingestor = self.service._ingestor
             try:
                 off = int(p("off", 0))
                 total = int(p("total", 0))
@@ -954,10 +1090,10 @@ class LockstepService:
                 ccrc_s = p("ccrc")
                 ccrc = int(ccrc_s) if ccrc_s is not None else None
                 if p("probe") == "1":
-                    out = self.service._ingestor.probe(key, total, crc)
+                    out = ingestor.probe(key, total, crc)
                 else:
                     arrow = "arrow" in (self.headers.get("Content-Type") or "")
-                    out = self.service._ingestor.chunk(
+                    out = ingestor.chunk(
                         key, off, total, crc, body, chunk_crc=ccrc,
                         arrow=arrow, deadline=deadline,
                     )
@@ -1007,9 +1143,15 @@ class LockstepService:
                 len(parts) == 5
                 and parts[0] == "index"
                 and parts[2] == "frame"
-                and parts[4] == "ingest"
+                and parts[4] in ("ingest", "bulk")
             ):
-                self._do_ingest(parts[1], parts[3], parse_qs(parsed_url.query))
+                self._do_ingest(
+                    parts[1], parts[3], parse_qs(parsed_url.query),
+                    ingestor=(
+                        self.service._bulk_ingestor
+                        if parts[4] == "bulk" else None
+                    ),
+                )
                 return
             if len(parts) != 3 or parts[0] != "index" or parts[2] != "query":
                 self.send_error(404)

@@ -66,11 +66,13 @@ def classify_request(method: str, path: str, body: bytes = b"") -> str:
             path in ("/fragment/data", "/fragment/block/diff")
             or path.endswith("/restore")
             or path.endswith("/ingest")
+            or path.endswith("/bulk")
         )
     ):
-        # /ingest: the streaming columnar bulk-ingest door — a write,
-        # so the admission bound backpressures each chunk and the
-        # replica router sequences + WAL-logs it like any other write.
+        # /ingest and /bulk: the streamed and device-build columnar
+        # ingest doors — writes, so the admission bound backpressures
+        # each chunk and the replica router sequences + WAL-logs it
+        # like any other write.
         return CLASS_WRITE
     if path == "/export" or path.startswith("/fragment/") or path.endswith("/attr/diff"):
         return CLASS_READ

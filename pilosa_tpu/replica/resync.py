@@ -62,6 +62,7 @@ from typing import Optional
 from pilosa_tpu.replica.digest import (
     diff_digests,
     fragment_query,
+    parse_fragment_path,
 )
 from pilosa_tpu.stats import NOP_STATS
 
@@ -82,7 +83,7 @@ class ResyncManager:
     """Drives fragment-level resync rounds for the router (probe thread)."""
 
     def __init__(self, router, wal, stats=None, chunk_bytes: int = 256 << 10,
-                 locked_seed_s: float = 5.0):
+                 locked_seed_s: float = 5.0, columnar: bool = False):
         self.router = router
         self.wal = wal
         self.stats = stats if stats is not None else NOP_STATS
@@ -94,6 +95,12 @@ class ResyncManager:
         # same rationale as CatchupManager.locked_drain_s: a laggard
         # that hangs mid-handoff must not stall every write.
         self.locked_seed_s = locked_seed_s
+        # Columnar negotiation (PR-18 bulk wire): fragments the laggard
+        # lacks ENTIRELY may move as Arrow record batches through its
+        # device-build /bulk door — the bulk OR equals replacement only
+        # over an empty target, so non-empty targets always take the
+        # roaring byte stream.
+        self.columnar = columnar
 
     # -- triggers ---------------------------------------------------------
 
@@ -206,12 +213,71 @@ class ResyncManager:
 
     # -- the fragment stream ----------------------------------------------
 
-    def _stream_fragment(self, donor, g, path_key: str, start_epoch) -> int:
+    def _stream_fragment_columnar(self, donor, g, path_key: str,
+                                  start_epoch) -> Optional[int]:
+        """Try the negotiated columnar move: fetch the donor fragment
+        as Arrow record batches (``/export?format=arrow``) and push the
+        stream through the laggard's device-build ``/bulk`` door in ONE
+        CRC-framed chunk.  Returns bytes moved, or ``None`` when either
+        side declines (no Arrow egress on the donor, no bulk door or
+        chunk ceiling on the laggard) — the caller degrades to the
+        roaring byte stream.  Only standard-view fragments the laggard
+        LACKS are eligible: the bulk door ORs pairs in, which equals
+        replacement only over an empty target (and feeds the inverse
+        view itself, so inverse fragments never move columnar)."""
+        index, frame, view, _slice_i = parse_fragment_path(path_key)
+        if view != "standard":
+            return None
+        qs = fragment_query(path_key)
+        self.router.faults.hit("resync.fetch", key=donor.name)
+        status, _ct, data, _h = self.router._forward(
+            donor, "GET", f"/export?{qs}&format=arrow", b"", {}, timeout_s=60.0
+        )
+        if status != 200 or not data:
+            return None  # no Arrow egress (or empty): roaring path
+        total, crc = len(data), zlib.crc32(data)
+        base = (f"/index/{index}/frame/{frame}/bulk"
+                f"?total={total}&crc={crc}&ccrc={crc}&off=0")
+        self.router.faults.hit("resync.chunk", key=g.name)
+        try:
+            status, payload = self._push(
+                g, "POST", base, data, start_epoch,
+                ctype="application/vnd.apache.arrow.stream",
+                timeout_s=120.0,
+            )
+        except ResyncAbort:
+            # 404/405 (no bulk door), 413 (chunk ceiling), 415 (no
+            # pyarrow on the laggard), ...: negotiate down, never
+            # abort the round over the optional fast path.
+            return None
+        try:
+            done = bool(json.loads(payload).get("done"))
+        except (ValueError, TypeError):
+            done = False
+        if not done:
+            return None
+        self.stats.count("replica.resync_fragments")
+        self.stats.count("replica.resync_columnar")
+        return total
+
+    def _stream_fragment(self, donor, g, path_key: str, start_epoch,
+                         laggard_empty: bool = False) -> int:
         """Replace one fragment on ``g`` with the donor's serialized
         roaring payload — chunked, CRC-framed, resumable.  Returns the
         bytes actually pushed (a resumed transfer skips the staged
         prefix).  A donor 404 streams as a CLEAR (total=0): the donor
-        no longer holds the fragment, so the laggard's copy empties."""
+        no longer holds the fragment, so the laggard's copy empties.
+
+        With columnar negotiation on and an empty target
+        (``laggard_empty``), the Arrow fast path is tried first and any
+        refusal degrades here."""
+        if self.columnar and laggard_empty:
+            moved = self._stream_fragment_columnar(
+                donor, g, path_key, start_epoch
+            )
+            if moved is not None:
+                return moved
+            self.stats.count("replica.resync_columnar_fallback")
         qs = fragment_query(path_key)
         self.router.faults.hit("resync.fetch", key=donor.name)
         status, _ct, data, _h = self.router._forward(
@@ -344,8 +410,12 @@ class ResyncManager:
                     g, "DELETE", f"/index/{index}/frame/{frame}", b"", start_epoch
                 )
             sent = 0
+            l_frags = laggard_digest.get("fragments") or {}
             for path_key in plan.stream:
-                sent += self._stream_fragment(donor, g, path_key, start_epoch)
+                sent += self._stream_fragment(
+                    donor, g, path_key, start_epoch,
+                    laggard_empty=path_key not in l_frags,
+                )
             # SEED under the sequencer lock: no write can be sequenced
             # between "the bytes match seed_seq" and "the applied mark
             # says so", so catch-up's arithmetic is exact.  Bounded

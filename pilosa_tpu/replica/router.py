@@ -390,6 +390,7 @@ class ShardRuntime:
         self.resync = ResyncManager(
             self, wal, stats=router.stats,
             chunk_bytes=router.resync_chunk_bytes,
+            columnar=router.resync_columnar,
         )
         # A (re)start over a non-empty log: no group may be assumed
         # current (see ReplicaRouter.__init__).
@@ -761,6 +762,7 @@ class ReplicaRouter:
         tracer=None,
         anti_entropy_interval_s: float = 0.0,
         resync_chunk_bytes: int = 256 << 10,
+        resync_columnar: bool = False,
         shard_map: Optional[ShardMap] = None,
         wal_dir: Optional[str] = None,
         wal_max_bytes: Optional[int] = None,
@@ -786,6 +788,12 @@ class ReplicaRouter:
             FaultInjector.from_env() or NOP_FAULTS
         )
         self.resync_chunk_bytes = resync_chunk_bytes
+        # Columnar resync negotiation: movers may fetch a fragment the
+        # laggard lacks ENTIRELY as Arrow record batches and push it
+        # through the laggard's device-build /bulk door (the bulk OR
+        # equals replacement only over an empty target); any refusal on
+        # either side degrades to the roaring byte stream.
+        self.resync_columnar = resync_columnar
         # Where NEW shard WALs land (auto-split maps, live resharding);
         # None keeps them in-memory like the default single WAL.
         self._wal_dir = wal_dir
@@ -1937,7 +1945,11 @@ class ReplicaRouter:
             for p, chk in sorted(pre.items()):
                 if have.get(p) == chk:
                     continue  # a resumed attempt already moved it
-                moved_bytes += old.resync._stream_fragment(donor, g, p, None)
+                # A fragment the target lacks entirely may negotiate
+                # the columnar (Arrow -> /bulk) path when enabled.
+                moved_bytes += old.resync._stream_fragment(
+                    donor, g, p, None, laggard_empty=p not in have
+                )
                 moved_fragments += 1
         # PHASE 2 — the epoch fence: hold new routed requests at the
         # gate, drain the in-flight ones, stream the (small) delta,
@@ -2144,6 +2156,7 @@ def router_from_config(cfg, stats=None, tracer=None) -> ReplicaRouter:
         tracer=tracer,
         anti_entropy_interval_s=cfg.replica_anti_entropy_interval,
         resync_chunk_bytes=cfg.replica_resync_chunk_bytes,
+        resync_columnar=cfg.replica_resync_columnar,
     )
     if shard_map is not None and len(shard_map) > 1:
         return ReplicaRouter(

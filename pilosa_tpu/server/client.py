@@ -355,18 +355,26 @@ class Client:
 
     def ingest_chunk(self, index: str, frame: str, off: int, total: int,
                      crc: int, body: bytes, ccrc: Optional[int] = None,
-                     probe: bool = False, deadline=None):
+                     probe: bool = False, deadline=None,
+                     door: str = "ingest", arrow: bool = False):
         """One chunk of a streaming ingest transfer; returns
         ``(status, parsed-json)`` — 409 answers (offset gaps / resume
         hints) come back as data, not exceptions, so the streamer can
-        adopt the server's ``staged`` frontier."""
-        q = f"/index/{index}/frame/{frame}/ingest?off={off}&total={total}&crc={crc}"
+        adopt the server's ``staged`` frontier.  ``door`` selects the
+        endpoint (``ingest`` = streamed set_bits, ``bulk`` = device
+        build); ``arrow`` marks the chunk as an Arrow IPC stream."""
+        from pilosa_tpu.ingest import ARROW_CONTENT_TYPE
+
+        q = f"/index/{index}/frame/{frame}/{door}?off={off}&total={total}&crc={crc}"
         if ccrc is not None:
             q += f"&ccrc={ccrc}"
         if probe:
             q += "&probe=1"
         status, payload = self._request(
-            "POST", q, body=body, content_type="application/octet-stream",
+            "POST", q, body=body,
+            content_type=(
+                ARROW_CONTENT_TYPE if arrow else "application/octet-stream"
+            ),
             deadline=deadline,
         )
         try:
@@ -378,26 +386,35 @@ class Client:
         return status, out
 
     def ingest_stream(self, index: str, frame: str, rows, cols,
-                      chunk_pairs: int = 65536, deadline=None) -> dict:
-        """Stream (row, col) columns through the bulk-ingest door as
-        packed-uint64 chunks, resuming at the server's staged frontier
-        on offset gaps (a restarted transfer probes first).  Chunk
-        boundaries are a pure function of (rows, cols, chunk_pairs), so
-        a resumed stream re-frames identically."""
+                      chunk_pairs: int = 65536, deadline=None,
+                      door: str = "ingest", arrow: bool = False) -> dict:
+        """Stream (row, col) columns through a columnar ingest door as
+        packed-uint64 (or, with ``arrow``, Arrow IPC) chunks, resuming
+        at the server's staged frontier on offset gaps (a restarted
+        transfer probes first).  Chunk boundaries are a pure function
+        of (rows, cols, chunk_pairs), so a resumed stream re-frames
+        identically."""
         import zlib as _zlib
 
         from pilosa_tpu.ingest import encode_packed
 
+        if arrow:
+            from pilosa_tpu.bulk.egress import encode_arrow_pairs
+
+            def _enc(r, c):
+                return encode_arrow_pairs(r, c)
+        else:
+            _enc = encode_packed
         frames = [
-            encode_packed(rows[i : i + chunk_pairs], cols[i : i + chunk_pairs])
+            _enc(rows[i : i + chunk_pairs], cols[i : i + chunk_pairs])
             for i in range(0, len(rows), chunk_pairs)
-        ] or [encode_packed([], [])]
+        ] or [_enc([], [])]
         total = sum(len(f) for f in frames)
         crc = 0
         for f in frames:
             crc = _zlib.crc32(f, crc)
         _, out = self.ingest_chunk(index, frame, 0, total, crc, b"", probe=True,
-                                   deadline=deadline)
+                                   deadline=deadline, door=door, arrow=arrow)
         staged = int(out.get("staged", 0))
         cur = 0
         result: dict = {"staged": staged, "done": False}
@@ -407,7 +424,8 @@ class Client:
                 continue
             status, result = self.ingest_chunk(
                 index, frame, cur, total, crc, fb,
-                ccrc=_zlib.crc32(fb), deadline=deadline,
+                ccrc=_zlib.crc32(fb), deadline=deadline, door=door,
+                arrow=arrow,
             )
             if status == 409:
                 # Adopt the server's frontier once; anything else
@@ -422,6 +440,32 @@ class Client:
                 raise ClientError(409, result.get("error", "ingest gap"))
             cur += len(fb)
         return result
+
+    def bulk_stream(self, index: str, frame: str, rows, cols,
+                    chunk_pairs: int = 65536, deadline=None,
+                    arrow: bool = False) -> dict:
+        """Stream (row, col) columns through the device-first bulk
+        build door (``POST .../bulk``): same wire and resume semantics
+        as :meth:`ingest_stream`, but the server packs the bits into
+        fragment word planes with its engine's sort/segment/scatter
+        kernel and leaves roaring materialization lazy."""
+        return self.ingest_stream(
+            index, frame, rows, cols, chunk_pairs=chunk_pairs,
+            deadline=deadline, door="bulk", arrow=arrow,
+        )
+
+    def export_arrow(self, index: str, frame: str, view: str,
+                     slice_i: int) -> bytes:
+        """One fragment as an Arrow IPC stream of uint64 row/col
+        columns — the exact schema the ingest doors accept."""
+        status, payload = self._request(
+            "GET",
+            f"/export?index={index}&frame={frame}&view={view}"
+            f"&slice={slice_i}&format=arrow",
+        )
+        if status >= 400:
+            raise ClientError(status, payload.decode(errors="replace"))
+        return payload
 
     def export_csv(self, index: str, frame: str, view: str, slice_i: int) -> str:
         status, payload = self._request(

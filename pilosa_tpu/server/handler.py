@@ -98,7 +98,9 @@ class Handler:
     def __init__(self, holder, executor, cluster=None, host="", broadcaster=None, stats=None, client_factory=None,
                  admission=None, default_deadline_ms: float = 0.0, tracer=None,
                  group: str = "", applied_seq=None,
-                 ingest_chunk_bytes: int = 4 << 20, costs=None):
+                 ingest_chunk_bytes: int = 4 << 20, costs=None,
+                 bulk_batch_slices: int = 8,
+                 bulk_materialize_budget_ms: float = 0.0):
         self.holder = holder
         self.executor = executor
         self.cluster = cluster
@@ -154,6 +156,18 @@ class Handler:
             stats=stats,
             max_chunk_bytes=ingest_chunk_bytes,
         )
+        # Device-first bulk build door (POST .../bulk): same chunk wire
+        # as the streamed door, but chunks run the engine's
+        # sort/segment/scatter build and commit word planes as pending
+        # fragment overlays — roaring stays lazy (pilosa_tpu/bulk).
+        self.bulk_batch_slices = bulk_batch_slices
+        self.bulk_materialize_budget_ms = bulk_materialize_budget_ms
+        self._bulk_ingestor = ingest_mod.StreamIngestor(
+            self._bulk_apply,
+            complete=self._bulk_complete,
+            stats=stats,
+            max_chunk_bytes=ingest_chunk_bytes,
+        )
         self.version = VERSION
         self._routes = self._build_routes()
 
@@ -172,6 +186,7 @@ class Handler:
             ("DELETE", re.compile(r"^/index/(?P<index>[^/]+)/frame/(?P<frame>[^/]+)$"), self.delete_frame),
             ("POST", re.compile(r"^/index/(?P<index>[^/]+)/query$"), self.post_query),
             ("POST", re.compile(r"^/index/(?P<index>[^/]+)/frame/(?P<frame>[^/]+)/ingest$"), self.post_frame_ingest),
+            ("POST", re.compile(r"^/index/(?P<index>[^/]+)/frame/(?P<frame>[^/]+)/bulk$"), self.post_frame_bulk),
             ("POST", re.compile(r"^/index/(?P<index>[^/]+)/frame/(?P<frame>[^/]+)/attr/diff$"), self.post_frame_attr_diff),
             ("POST", re.compile(r"^/index/(?P<index>[^/]+)/frame/(?P<frame>[^/]+)/restore$"), self.post_frame_restore),
             ("PATCH", re.compile(r"^/index/(?P<index>[^/]+)/frame/(?P<frame>[^/]+)/time-quantum$"), self.patch_frame_time_quantum),
@@ -881,6 +896,34 @@ class Handler:
         if frame is not None:
             ingest_mod.recalc_frame_caches(frame)
 
+    def _bulk_apply(self, key, rows, cols, deadline):
+        """One decoded bulk chunk -> device build + overlay commit
+        (pilosa_tpu/bulk): the chunk's columns sort/segment/scatter into
+        word planes on the executor's engine and land as pending dense
+        overlays — no roaring container churn on the ingest path."""
+        from pilosa_tpu.bulk import ingress
+
+        index, fname = key
+        frame = self.holder.frame(index, fname)
+        if frame is None:
+            raise errors.ErrFrameNotFound(fname)
+        engine = getattr(self.executor, "engine", None)
+        return ingress.apply_bulk(
+            frame, rows, cols, engine=engine, executor=self.executor,
+            index=index, deadline=deadline,
+            batch_slices=self.bulk_batch_slices, stats=self.stats,
+        )
+
+    def _bulk_complete(self, key) -> None:
+        """Bulk transfer done: rankings fresh (import parity), then the
+        opportunistic overlay drain under the configured budget."""
+        from pilosa_tpu.bulk import ingress
+
+        index, fname = key
+        frame = self.holder.frame(index, fname)
+        if frame is not None:
+            ingress.complete_bulk(frame, self.bulk_materialize_budget_ms)
+
     def post_frame_ingest(self, index=None, frame=None, params=None, body=b"",
                           headers=None, deadline=None, **kw):
         """Streaming columnar bulk ingest: ``(row, col)`` column chunks
@@ -902,6 +945,31 @@ class Handler:
         replica router sequences + WAL-logs chunks like any other
         write — replay is idempotent.  On completion the frame's rank
         caches recalculate immediately (import parity)."""
+        return self._stream_door(
+            self._ingestor, index, frame, params, body, headers, deadline
+        )
+
+    def post_frame_bulk(self, index=None, frame=None, params=None, body=b"",
+                        headers=None, deadline=None, **kw):
+        """Device-first bulk build door: the SAME chunk/resume/CRC wire
+        as ``POST .../ingest`` (probe, offsets, 409 + staged, per-chunk
+        ccrc, PI64 or Arrow IPC payloads), but each chunk's columns run
+        the engine's jitted sort/segment/scatter build and commit
+        packed word planes as pending fragment overlays — roaring
+        containers and rank caches materialize lazily on first
+        snapshot/sync/digest touch, or under the
+        ``[bulk] materialize-budget-ms`` drain at completion.  QoS
+        classifies the route as a write; the replica router sequences
+        and WAL-logs chunks like any other write (replay idempotent —
+        the overlay OR converges)."""
+        return self._stream_door(
+            self._bulk_ingestor, index, frame, params, body, headers, deadline
+        )
+
+    def _stream_door(self, ingestor, index, frame, params, body, headers,
+                     deadline):
+        """Shared chunk-wire plumbing for the streamed and bulk doors:
+        parse the transfer params, answer probes, push the chunk."""
         headers = headers or {}
         params = params or {}
         idx = self.holder.index(index)
@@ -922,10 +990,10 @@ class Handler:
 
         key = (index, frame)
         if self._param(params, "probe") == "1":
-            return self._json(self._ingestor.probe(key, total, crc))
+            return self._json(ingestor.probe(key, total, crc))
         arrow = "arrow" in (headers.get("content-type") or "")
         try:
-            out = self._ingestor.chunk(
+            out = ingestor.chunk(
                 key, off, total, crc, body, chunk_crc=ccrc, arrow=arrow,
                 deadline=deadline,
             )
@@ -960,6 +1028,12 @@ class Handler:
     # -- export (handler.go:990-1030) --------------------------------------
 
     def get_export(self, params=None, headers=None, **kw):
+        """Fragment contents as CSV (default) or, with ``format=arrow``,
+        as an Arrow IPC stream of uint64 ``row``/``col`` columns — the
+        exact schema the bulk/ingest doors accept, so an export can be
+        re-ingested byte-identically.  Both formats read the fragment's
+        merged dense view (``export_pairs``): a pending bulk overlay is
+        visible without materializing roaring containers."""
         params = params or {}
         index = self._param(params, "index")
         frame = self._param(params, "frame")
@@ -968,10 +1042,20 @@ class Handler:
         frag = self.holder.fragment(index, frame, view, slice_i)
         if frag is None:
             raise HTTPError(404, "fragment not found")
+        fmt = self._param(params, "format", "csv")
+        if fmt == "arrow":
+            from pilosa_tpu import ingest as ingest_mod
+            from pilosa_tpu.bulk import egress
+
+            try:
+                payload = egress.export_fragment_arrow(frag, stats=self.stats)
+            except ingest_mod.IngestError as e:
+                return self._json({"error": str(e)}, status=e.status)
+            return 200, ingest_mod.ARROW_CONTENT_TYPE, payload
+        if fmt != "csv":
+            raise HTTPError(400, f"unknown export format {fmt!r}")
         out = io.StringIO()
-        positions = frag.storage.to_array()
-        rows = positions // np.uint64(SLICE_WIDTH)
-        cols = positions % np.uint64(SLICE_WIDTH) + np.uint64(slice_i * SLICE_WIDTH)
+        rows, cols = frag.export_pairs()
         for r, c in zip(rows.tolist(), cols.tolist()):
             out.write(f"{r},{c}\n")
         return 200, "text/csv", out.getvalue().encode()

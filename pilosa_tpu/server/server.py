@@ -160,6 +160,10 @@ class Server:
             # per-chunk ceiling.
             ingest_chunk_bytes=self.config.ingest_chunk_bytes,
             costs=self.costs,
+            # [bulk]: device bulk build door (POST .../bulk) commit
+            # batching + lazy-materialization drain budget.
+            bulk_batch_slices=self.config.bulk_batch_slices,
+            bulk_materialize_budget_ms=self.config.bulk_materialize_budget_ms,
         )
         self.syncer = HolderSyncer(
             self.holder, self.cluster, self.host, self.client_factory, stats=stats

@@ -236,4 +236,11 @@ run BENCH_CONFIG=resync BENCH_RESYNC_WRITES=8000 BENCH_BATCH=16
 #    phases with more clients for a stabler ratio.
 run BENCH_CONFIG=shard
 run BENCH_CONFIG=shard BENCH_THREADS=24 BENCH_SHARD_SECS=10
+# 15) Device-first bulk build vs streamed ingest: the SAME seeded pairs
+#    through both doors over HTTP (>= 5x pairs/s, digest-identical
+#    fragments, and a byte-identical arrow export -> re-ingest round
+#    trip all asserted in-run).  The second line sizes a wider slice
+#    span so the per-slice commit and egress paths dominate the sort.
+run BENCH_CONFIG=bulk
+run BENCH_CONFIG=bulk BENCH_BULK_PAIRS=4000000 BENCH_BULK_SLICES=16 BENCH_BULK_ROWS=256
 echo "ALL DONE $(date +%H:%M:%S)" >> $OUT

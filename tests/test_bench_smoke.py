@@ -358,6 +358,20 @@ def test_bench_lockstep_coalesce_emits_json():
     assert all(t["rps"] > 0 and t["per_request_ms"] > 0 for t in result["tiers"])
 
 
+def test_bench_bulk_smoke():
+    """The device-build bulk door vs streamed ingest A/B: the digest
+    parity and Arrow round-trip contracts are asserted INSIDE the bench
+    (a nonzero exit fails _run); BENCH_SMOKE relaxes only the 5x
+    throughput gate, which tiny shapes can't meaningfully hold."""
+    stdout = _run({"BENCH_CONFIG": "bulk", "BENCH_SMOKE": "1"}, timeout=300)
+    result = json.loads(stdout.strip().splitlines()[-1])
+    assert result["metric"] == "bulk_build_vs_streamed_ingest"
+    t = result["tiers"]
+    assert t["bulk_pairs_per_s"] > 0 and t["stream_pairs_per_s"] > 0
+    assert t["digest_equal"] is True
+    assert t["arrow_roundtrip_bytes"] > 0
+
+
 def test_bench_executor_gather_smoke():
     stdout = _run({
         "BENCH_CONFIG": "executor_gather", "BENCH_ROWS": "32",

@@ -72,6 +72,60 @@ def test_arrow_roundtrip():
     assert r2.tolist() == rows.tolist() and c2.tolist() == cols.tolist()
 
 
+def _arrow_body(table):
+    import io as _io
+
+    import pyarrow as pa
+
+    sink = _io.BytesIO()
+    with pa.ipc.new_stream(sink, table.schema) as w:
+        w.write_table(table)
+    return sink.getvalue()
+
+
+@pytest.mark.skipif(not ingest.arrow_available(), reason="pyarrow unavailable")
+def test_arrow_decode_producer_variety():
+    """Real producers ship their whole table: extra columns are ignored,
+    dictionary-encoded ids decode, multi-chunk columns concatenate, and
+    any integer type casts to uint64."""
+    import pyarrow as pa
+
+    rows = np.array([1, 2, 3], dtype=np.uint64)
+    cols = np.array([7, 8, 9], dtype=np.uint64)
+    t = pa.table({
+        "row": pa.array(rows.tolist(), type=pa.int16()).dictionary_encode(),
+        "col": pa.chunked_array([cols[:2], cols[2:]]),
+        "label": ["a", "b", "c"],  # extra column: ignored
+    })
+    r2, c2 = ingest.decode_arrow(_arrow_body(t))
+    assert r2.tolist() == rows.tolist() and c2.tolist() == cols.tolist()
+    assert r2.dtype == np.uint64 and c2.dtype == np.uint64
+
+
+@pytest.mark.skipif(not ingest.arrow_available(), reason="pyarrow unavailable")
+def test_arrow_decode_pointed_400s():
+    """Schema mistakes answer pointed 400s naming the column — not a
+    bare 'bad arrow chunk: KeyError' at 100M rows."""
+    import pyarrow as pa
+
+    with pytest.raises(ingest.IngestError) as ei:
+        ingest.decode_arrow(_arrow_body(pa.table({"row": [1, 2]})))
+    assert ei.value.status == 400 and "'col'" in str(ei.value)
+    with pytest.raises(ingest.IngestError) as ei:
+        ingest.decode_arrow(_arrow_body(
+            pa.table({"row": [1.5, 2.5], "col": [1, 2]})
+        ))
+    assert ei.value.status == 400 and "'row'" in str(ei.value)
+    with pytest.raises(ingest.IngestError) as ei:
+        ingest.decode_arrow(_arrow_body(
+            pa.table({"row": [-1], "col": [2]})
+        ))
+    assert ei.value.status == 400
+    with pytest.raises(ingest.IngestError) as ei:
+        ingest.decode_arrow(b"\x00not arrow\x00")
+    assert ei.value.status == 400
+
+
 def test_ingest_route_classifies_as_write():
     assert classify_request("POST", "/index/i/frame/f/ingest", b"") == CLASS_WRITE
 
