@@ -3611,6 +3611,222 @@ def bench_bulk() -> dict:
     }
 
 
+def bench_planner() -> dict:
+    """BENCH_CONFIG=planner: the cost-based adaptive planner's closed
+    loop (planner/core.py) vs hand-pinned static lanes, on the exact
+    front-door path the server handler runs (plan_for -> ExecOptions.plan
+    -> executor decision sites -> record fold-back).
+
+    Three query shapes over frames of different row counts stress the
+    gram/rmgather trade differently; each shape's ground-truth lane
+    comes from two PINNED runs (pin="gram", pin="rmgather") over the
+    same mixed schedule.  The adaptive run starts from an EMPTY ledger
+    (static-parity start), warms until exploration has sampled both
+    lanes past the confidence gate, then a measured phase counts — via
+    the ledger's own per-lane fold counts — the fraction of dispatches
+    that ran each shape's empirically fastest lane.  Asserts >= 90%
+    convergence per shape (shapes whose pinned p50s sit within 10% are
+    ties: either lane counts).  Mixed-schedule p50 is reported against
+    the best pinned run; BENCH_STRICT=1 additionally asserts it lands
+    within 5% (wall-clock -> strict-only, CI boxes are noisy).
+    BENCH_SMOKE=1 shrinks the shapes for CI."""
+    smoke = os.environ.get("BENCH_SMOKE", "").lower() in ("1", "true", "yes")
+    strict = os.environ.get("BENCH_STRICT", "").lower() in ("1", "true", "yes")
+    n_slices = int(os.environ.get("BENCH_SLICES", "2" if smoke else "4"))
+    queries_per_shape = int(os.environ.get("BENCH_QUERY_POOL", "2" if smoke else "4"))
+    measure_passes = int(os.environ.get("BENCH_ITERS", "6" if smoke else "24"))
+    bits_per_row = int(
+        os.environ.get("BENCH_BITS_PER_ROW", "50" if smoke else "5000")
+    )
+    # Bench-paced exploration: a tighter tick than the serving default
+    # only shortens warm-up (3 alternate-lane samples arrive in
+    # 3*explore_every consults); the decision machinery is identical.
+    explore_every = int(os.environ.get("BENCH_EXPLORE_EVERY", "6"))
+    import tempfile
+
+    from pilosa_tpu import planner as planner_mod
+    from pilosa_tpu.core.frame import FrameOptions
+    from pilosa_tpu.core.holder import Holder
+    from pilosa_tpu.costs import CostLedger
+    from pilosa_tpu.executor import ExecOptions, Executor
+    from pilosa_tpu.pilosa import SLICE_WIDTH
+    from pilosa_tpu.trace import fingerprint
+
+    # Shapes: (frame, n_rows, batch pairs) — small/medium/large working
+    # sets so the static ladder and the measured costs can disagree.
+    shapes = [
+        ("fa", 16, 4),
+        ("fb", 64, 16 if smoke else 24),
+        ("fc", 128, 24 if smoke else 48),
+    ]
+
+    rng = np.random.default_rng(11)
+
+    def make_pools():
+        pools = {}
+        for fname, n_rows, batch in shapes:
+            pool = []
+            for seed in range(queries_per_shape):
+                prs = np.random.default_rng(4000 + seed).integers(
+                    0, n_rows, size=(batch, 2)
+                )
+                pool.append(" ".join(
+                    f'Count(Intersect(Bitmap(rowID={a}, frame="{fname}"), '
+                    f'Bitmap(rowID={b}, frame="{fname}")))'
+                    for a, b in prs.tolist()
+                ))
+            pools[fname] = pool
+        return pools
+
+    pools = make_pools()
+    # One mixed schedule, shared verbatim by every run (pinned and
+    # adaptive see byte-identical request streams).
+    schedule = []
+    for i in range(measure_passes):
+        for fname, _, _ in shapes:
+            for q in pools[fname]:
+                schedule.append((fname, q))
+
+    state = {"engine": "?"}
+
+    def run(pin: str) -> dict:
+        """One full tier: fresh holder + empty ledger + planner (pinned
+        or adaptive), front-door consult per request, per-shape p50s and
+        per-(fp, lane) ledger fold counts from the measured phase."""
+        with tempfile.TemporaryDirectory() as d:
+            h = Holder(d)
+            h.open()
+            idx = h.create_index("p")
+            for fname, n_rows, _ in shapes:
+                idx.create_frame(fname, FrameOptions())
+                fr = h.index("p").frame(fname)
+                rows = np.repeat(
+                    np.arange(n_rows, dtype=np.uint64), bits_per_row
+                )
+                for s in range(n_slices):
+                    cols = rng.integers(
+                        0, SLICE_WIDTH, size=len(rows)
+                    ).astype(np.uint64) + np.uint64(s * SLICE_WIDTH)
+                    fr.import_bits(rows, cols)
+            ledger = CostLedger()
+            planner = planner_mod.Planner(
+                ledger, pin=pin, explore_every=explore_every,
+            )
+            ex = Executor(h)
+            ex.planner = planner
+            state["engine"] = ex.engine.name
+
+            def door(fname: str, q: str) -> float:
+                plan = planner.plan_for("p", q.encode())
+                t1 = time.perf_counter()
+                ex.execute("p", q, opt=ExecOptions(plan=plan))
+                return time.perf_counter() - t1
+
+            # Warm-up: jit shapes, device pools, serve states — and for
+            # the adaptive run, enough consults that exploration pushes
+            # BOTH lanes past the confidence gate (min_samples, default
+            # 3, needs 3*explore_every consults per key).
+            warm_passes = 3 * explore_every + 2
+            for _ in range(warm_passes):
+                for fname, q in schedule[: len(shapes) * queries_per_shape]:
+                    door(fname, q)
+            # Ledger fold counts at the measured phase's start: the
+            # delta below counts which lane each dispatch ACTUALLY ran.
+            def lane_counts() -> dict:
+                out = {}
+                for fname, _, _ in shapes:
+                    for q in pools[fname]:
+                        fp = fingerprint(q.encode())["fp"]
+                        for ln in planner_mod.PLAN_LANES:
+                            e = ledger.peek(index="p", frame="", fp=fp, lane=ln)
+                            out[(fname, fp, ln)] = e["n"] if e else 0
+                return out
+
+            before = lane_counts()
+            lat: dict[str, list] = {fname: [] for fname, _, _ in shapes}
+            mixed: list = []
+            for fname, q in schedule:
+                dt = door(fname, q)
+                lat[fname].append(dt)
+                mixed.append(dt)
+            delta = {
+                k: n - before[k] for k, n in lane_counts().items()
+            }
+            return {
+                "p50": {
+                    fname: float(np.percentile(np.array(v), 50) * 1e3)
+                    for fname, v in lat.items()
+                },
+                "mixed_p50": float(np.percentile(np.array(mixed), 50) * 1e3),
+                "delta": delta,
+                "snapshot": planner.snapshot(limit=16),
+            }
+
+    pinned = {ln: run(ln) for ln in planner_mod.PLAN_LANES}
+    adaptive = run("")
+
+    # Ground truth per shape: the pinned run with the lower p50; within
+    # 10% the lanes tie (on hosts where an eligibility veto degrades a
+    # pinned rmgather to gram, both pins measure the same lane and tie
+    # by construction).
+    convergence = {}
+    for fname, _, _ in shapes:
+        pg = pinned["gram"]["p50"][fname]
+        pr = pinned["rmgather"]["p50"][fname]
+        tie = abs(pg - pr) / max(min(pg, pr), 1e-9) < 0.10
+        fast = {ln for ln in planner_mod.PLAN_LANES} if tie else (
+            {"gram"} if pg <= pr else {"rmgather"}
+        )
+        on_fast = total = 0
+        for (fn, fp, ln), n in adaptive["delta"].items():
+            if fn != fname:
+                continue
+            total += n
+            if ln in fast:
+                on_fast += n
+        frac = on_fast / total if total else 0.0
+        convergence[fname] = {
+            "fastest": sorted(fast),
+            "fraction_on_fastest": round(frac, 3),
+            "pinned_p50_ms": {"gram": round(pg, 3), "rmgather": round(pr, 3)},
+        }
+        assert frac >= 0.90, (
+            f"planner converged to the fastest lane on only {frac:.0%} of "
+            f"{fname} dispatches (fastest={sorted(fast)}, "
+            f"delta={ {k: v for k, v in adaptive['delta'].items() if k[0] == fname} })"
+        )
+
+    best_static = min(r["mixed_p50"] for r in pinned.values())
+    ratio = adaptive["mixed_p50"] / best_static if best_static > 0 else 1.0
+    if strict:
+        assert ratio <= 1.05, (
+            f"adaptive mixed p50 {adaptive['mixed_p50']:.3f} ms is "
+            f"{ratio:.2f}x the best pinned static {best_static:.3f} ms"
+        )
+    worst = min(
+        c["fraction_on_fastest"] for c in convergence.values()
+    )
+    return {
+        "metric": "planner_convergence",
+        "value": round(worst, 3),
+        "unit": (
+            f"min fraction of dispatches on the empirically fastest lane "
+            f"across {len(shapes)} shapes (>=0.90 asserted; mixed p50 "
+            f"{adaptive['mixed_p50']:.2f} ms vs best pinned "
+            f"{best_static:.2f} ms = {ratio:.2f}x; engine "
+            f"{state['engine']})"
+        ),
+        "vs_baseline": round(ratio, 3),
+        "tiers": {
+            "convergence": convergence,
+            "mixed_p50_ms": round(adaptive["mixed_p50"], 3),
+            "best_pinned_mixed_p50_ms": round(best_static, 3),
+            "adaptive_vs_best_pinned": round(ratio, 3),
+            "strict": strict,
+        },
+    }
+
+
 def main() -> None:
     cfg = os.environ.get("BENCH_CONFIG", "intersect_count")
     if cfg != "intersect_count":
@@ -3633,6 +3849,7 @@ def main() -> None:
             "recovery": bench_recovery,
             "resync": bench_resync,
             "bulk": bench_bulk,
+            "planner": bench_planner,
             "shard": bench_shard,
             "intersect_count_stream": bench_intersect_stream,
             "intersect_count_4krows": bench_intersect_4krows,

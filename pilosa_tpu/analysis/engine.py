@@ -32,6 +32,7 @@ RULES = (
     "native-abi",
     "global-mutable-state",
     "check-then-act",
+    "env-knob-outside-config",
     "stale-suppression",
 )
 

@@ -1,10 +1,10 @@
-"""The project-invariant rules (generation 3: ten of them).
+"""The project-invariant rules (generation 4: eleven of them).
 
 Each rule returns Finding objects; the engine applies suppressions,
 fingerprints, and the baseline.  See DEVELOPMENT.md ("Static analysis &
 concurrency checking", "Race detection & native conformance", and
 "Free-threading readiness") for the catalog and the rationale per rule.
-(The eleventh check, ``stale-suppression``, lives in the engine itself:
+(The twelfth check, ``stale-suppression``, lives in the engine itself:
 it needs the post-suppression state of every other rule's findings.)
 """
 
@@ -45,6 +45,7 @@ def run_rule(rule: str, files, root: str) -> list[Finding]:
         "native-abi": rule_native_abi,
         "global-mutable-state": rule_global_mutable_state,
         "check-then-act": rule_check_then_act,
+        "env-knob-outside-config": rule_env_knob_outside_config,
     }[rule]
     return fn(files, root)
 
@@ -1026,4 +1027,119 @@ def rule_check_then_act(files, root: str) -> list[Finding]:
             if f.line not in seen_lines:
                 seen_lines.add(f.line)
                 out.append(f)
+    return out
+
+
+# -- 10. env-knob-outside-config (generation 4) -------------------------------
+#
+# The knob-plumbing contract (planner PR): every tuning knob that
+# ``config.py`` owns flows CLI > env > config file > default through a
+# Config field and arrives at its consumer as a constructor argument.
+# A raw ``os.environ`` read of an owned knob anywhere else creates a
+# second, precedence-free spelling that silently shadows the config
+# file — exactly the drift the unification removed.  The owned set is
+# DERIVED from config.py's own env reads (no second list to maintain):
+# add a knob to ``Config.apply_env`` and every stray read of it
+# becomes a finding.  Deliberate exceptions carry suppressions: the
+# executor's deprecated direct-construction fallbacks, and the
+# lockstep service's rank-process reads (ranks inherit the launcher's
+# env wholesale; no config file is plumbed to them).  Gate/diagnostic
+# variables config.py does not read (PILOSA_TPU_LOCK_CHECK,
+# PILOSA_TPU_FAULT_SPEC, ...) are out of scope by construction.
+
+CONFIG_REL = "config.py"
+_ENV_GET_CALLS = ("os.getenv", "os.environ.get")
+
+
+def _env_read_name(node: ast.AST) -> str | None:
+    """The constant env-var name a node reads, or None: matches
+    ``os.getenv("X")`` / ``os.environ.get("X"[, d])`` /
+    ``os.environ["X"]``."""
+    if isinstance(node, ast.Call):
+        if _unparse(node.func) in _ENV_GET_CALLS and node.args:
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                return arg.value
+    elif isinstance(node, ast.Subscript):
+        if _unparse(node.value) == "os.environ":
+            sl = node.slice
+            if isinstance(sl, ast.Constant) and isinstance(sl.value, str):
+                return sl.value
+    return None
+
+
+def _config_owned_knobs(sf) -> set[str]:
+    """Constant PILOSA_TPU_* names config.py consumes.  Config reads
+    env through ``apply_env``'s injected mapping (``env["X"]``,
+    ``"X" in env``, ``env.get("X")``) as well as ``os.environ``
+    directly; match all four shapes."""
+
+    def const_str(expr) -> str | None:
+        if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+            return expr.value
+        return None
+
+    out: set[str] = set()
+    for node in ast.walk(sf.tree):
+        name = _env_read_name(node)
+        if name is None:
+            if isinstance(node, ast.Subscript) and _unparse(node.value) == "env":
+                name = const_str(node.slice)
+            elif (
+                isinstance(node, ast.Call)
+                and _unparse(node.func) == "env.get"
+                and node.args
+            ):
+                name = const_str(node.args[0])
+            elif (
+                isinstance(node, ast.Compare)
+                and len(node.ops) == 1
+                and isinstance(node.ops[0], (ast.In, ast.NotIn))
+                and _unparse(node.comparators[0]) == "env"
+            ):
+                name = const_str(node.left)
+        if name and name.startswith("PILOSA_TPU_"):
+            out.add(name)
+    return out
+
+
+def rule_env_knob_outside_config(files, root: str) -> list[Finding]:
+    owned: set[str] = set()
+    for sf in files:
+        if sf.rel == CONFIG_REL:
+            owned = _config_owned_knobs(sf)
+            break
+    if not owned:
+        return []  # tree without a config module (fixture packages)
+    out: list[Finding] = []
+    for sf in files:
+        if sf.rel == CONFIG_REL or sf.rel.startswith("analysis/"):
+            continue
+
+        from pilosa_tpu.analysis.engine import ScopedVisitor
+
+        class V(ScopedVisitor):
+            def _check(inner, node):
+                name = _env_read_name(node)
+                if name in owned:
+                    out.append(
+                        Finding(
+                            "env-knob-outside-config", sf.rel, node.lineno,
+                            inner.scope_name(),
+                            f"raw environment read of `{name}` — a "
+                            "config-owned tuning knob (CLI > env > config "
+                            "file > default); take it as a constructor/"
+                            "Config value, or tag the deprecated fallback",
+                        )
+                    )
+
+            def visit_Call(inner, node):
+                inner._check(node)
+                inner.generic_visit(node)
+
+            def visit_Subscript(inner, node):
+                inner._check(node)
+                inner.generic_visit(node)
+
+        V().visit(sf.tree)
     return out

@@ -155,6 +155,7 @@ class CostLedger:
         Bandwidth (MB/s) only updates when the observation actually
         moved bytes, so transfer-free warm hits don't decay it."""
         key = (index, frame, fp, lane)
+        # analysis-ok: lockstep-determinism: display-only last_ts metadata; lockstep folds happen on rank 0 alone (workers carry no planner) and never feed a wire decision
         ts = wall_ts if wall_ts is not None else time.time()
         a = self.alpha
         with self._mu:
@@ -233,6 +234,46 @@ class CostLedger:
             device_ms=device_ms,
             wall_ts=trace.wall_ts,
         )
+
+    def peek(
+        self, *, index: str = "", frame: str = "", fp: str = "", lane: str = ""
+    ) -> Optional[dict]:
+        """One entry's current estimates (a copy), or None.  Pure read:
+        the LRU order is NOT bumped — the planner consults on every
+        request and must not pin its own keys hot."""
+        with self._mu:
+            e = self._entries.get((index, frame, fp, lane))
+            return dict(e) if e is not None else None
+
+    def entries(self, lane: Optional[str] = None) -> list[dict]:
+        """Entry copies (optionally one lane's), unsorted and unrounded
+        — the adaptive-budget derivations read these."""
+        with self._mu:
+            return [
+                {"index": k[0], "frame": k[1], "fp": k[2], "lane": k[3], **v}
+                for k, v in self._entries.items()
+                if lane is None or k[3] == lane
+            ]
+
+    def state(self) -> dict:
+        """Full restorable state (entries in LRU order).  With
+        :meth:`restore` this makes the EWMA fold deterministic across a
+        snapshot/restore cycle: folding the same observations into a
+        restored ledger yields bit-identical estimates."""
+        with self._mu:
+            return {
+                "cap": self.cap,
+                "alpha": self.alpha,
+                "entries": [[list(k), dict(v)] for k, v in self._entries.items()],
+            }
+
+    def restore(self, st: dict) -> None:
+        self.cap = max(1, int(st.get("cap", self.cap)))
+        self.alpha = min(1.0, max(0.01, float(st.get("alpha", self.alpha))))
+        with self._mu:
+            self._entries.clear()
+            for k, v in st.get("entries", []):
+                self._entries[tuple(k)] = dict(v)
 
     def snapshot(self, limit: int = 0) -> dict:
         """The /debug/costs payload: entries sorted by EWMA cost

@@ -23,7 +23,9 @@ from __future__ import annotations
 import os
 import re
 import threading
+import time
 from collections import OrderedDict
+from functools import partial
 from dataclasses import dataclass, field, replace as dc_replace
 from datetime import datetime
 from typing import Any, Optional, Sequence
@@ -176,6 +178,13 @@ class ExecOptions:
     # instrumentation site a single branch — the tracing-off path adds
     # no objects and no calls.
     span: Any = None
+    # Strategy plan from the cost-based planner (planner.Planner
+    # plan_for): {"fp", "lane", "src", "confidence"}, JSON-clean so the
+    # lockstep service ships it on the batch wire entry like the expiry
+    # and sampling flags — the executor APPLIES plans but never makes
+    # them, so every rank runs rank 0's decision.  None (and a plan
+    # whose lane is None) keeps the static strategy ladder bit-exact.
+    plan: Any = None
 
 
 class QueryBitmap:
@@ -252,6 +261,11 @@ class Executor:
         serve_state_cache: int = 0,
         repair_rows_max: Optional[int] = None,
         gram_rows_max: int = 0,
+        no_gram: Optional[bool] = None,
+        stream_bytes: int = 0,
+        slice_chunk: int = 0,
+        matrix_cache_entries: int = 0,
+        matrix_rows_max: int = 0,
         qcache: Any = "env",
         stats=None,
     ):
@@ -270,11 +284,15 @@ class Executor:
         # (index, frame, views, slices); validated the same way.
         self._multi_matrix_cache: "OrderedDict[tuple, tuple]" = OrderedDict()
         self._matrix_mu = lockcheck.named_lock("executor._matrix_mu")
-        self._matrix_cache_entries = int(
-            os.environ.get("PILOSA_TPU_MATRIX_CACHE_ENTRIES", "4")
+        # Tuning-knob precedence, uniform across every routed knob below:
+        # constructor arg (the server passes Config fields, which already
+        # fold CLI > env > config file) > raw env var (deprecated spelling
+        # for directly-constructed executors) > default.
+        self._matrix_cache_entries = matrix_cache_entries or int(
+            os.environ.get("PILOSA_TPU_MATRIX_CACHE_ENTRIES", "4")  # analysis-ok: env-knob-outside-config: deprecated spelling for directly-constructed executors
         )
-        self._matrix_rows_max = int(
-            os.environ.get("PILOSA_TPU_MATRIX_ROWS_MAX", "1024")
+        self._matrix_rows_max = matrix_rows_max or int(
+            os.environ.get("PILOSA_TPU_MATRIX_ROWS_MAX", "1024")  # analysis-ok: env-knob-outside-config: deprecated spelling for directly-constructed executors
         )
         # Group-commit micro-batching for singleton SetBit requests (the
         # server enables this; see pilosa_tpu/ingest.py), and read
@@ -324,11 +342,26 @@ class Executor:
         # Config.repair_rows_max) > PILOSA_TPU_REPAIR_ROWS_MAX env >
         # default 64 (None = not configured; 0 is meaningful).
         if repair_rows_max is None:
+            # analysis-ok: env-knob-outside-config: deprecated spelling for directly-constructed executors
             repair_rows_max = int(os.environ.get("PILOSA_TPU_REPAIR_ROWS_MAX", "64"))
         self._repair_rows_max = repair_rows_max
         # Gram row ceiling override (same precedence; 0 = env/default,
         # resolved lazily in _gram_env alongside the NO_GRAM switch).
         self._gram_rows_max_cfg = gram_rows_max
+        # Routed strategy knobs (ctor > env > default; None/0 = fall
+        # through to the deprecated env spelling).
+        self._no_gram_cfg = no_gram
+        self._stream_bytes_cfg = int(stream_bytes)
+        self._slice_chunk_cfg = int(slice_chunk)
+        # Cost-based strategy planner (planner.Planner) and background
+        # pre-armer (planner.PreArmer).  The executor never CONSULTS the
+        # planner — plans arrive on ExecOptions.plan from the front door
+        # — it only folds outcomes back (record) and signals the
+        # pre-armer from its serve/invalidate seams.  None (the default
+        # everywhere but the configured server) keeps each seam one
+        # branch, the same contract as the meter and tracing.
+        self.planner = None
+        self.prearmer = None
         # Per-(index, frame) dirty-row ledger fed by the write paths: the
         # serve-state patch lane's cheap budget precheck (the exact
         # generation-anchored delta comes from the fragment dirty-row
@@ -920,6 +953,17 @@ class Executor:
             return None
         opt = opt or ExecOptions()
         local = slices is None and not self._is_distributed(opt)
+        # Planner plan, applied at every exit of this lane: the armed
+        # native serve path IS the gram strategy family, so a forced
+        # "rmgather" plan must skip it (or the alternate lane could
+        # never run once a state arms) and every native answer folds
+        # back under lane "gram" — steady-state costs keep flowing into
+        # the ledger after arming, not just the cold passes.  A lane of
+        # None (static/empty ledger) leaves every branch below exactly
+        # as it was — the static-parity contract.
+        plan = opt.plan
+        forced = plan.get("lane") if plan is not None else None
+        rec = self.planner is not None and plan is not None
         # Single-call serving lane: with a valid cached serve state the
         # WHOLE request — parse, frame/row-label validation, Gram count
         # identities — runs inside one GIL-released native call
@@ -933,7 +977,7 @@ class Executor:
         # decline falls through to the general lane, which refreshes the
         # state.  The serve QUEUE below only coalesces the cold/unarmed
         # path, where per-request Python still dominates.
-        if local and self._serve_states:
+        if local and self._serve_states and forced != "rmgather":
             # Pick the candidate state by SNIFFING the first frame
             # reference (cheap regex over the request head) instead of
             # trying every armed state — each native attempt re-parses
@@ -954,6 +998,7 @@ class Executor:
                     with self._matrix_mu:
                         self._serve_states.pop((index, fname), None)
             if st is not None:
+                t0 = time.perf_counter() if rec else 0.0
                 if self.meter is not None:
                     with self.meter.measure("native", opt.span) as d:
                         counts = native.serve_pairs(
@@ -979,6 +1024,11 @@ class Executor:
                     with self._matrix_mu:
                         if (index, fname) in self._serve_states:
                             self._serve_states.move_to_end((index, fname))
+                    if rec:
+                        self.planner.record(
+                            index=index, fp=plan.get("fp", ""), lane="gram",
+                            ms=(time.perf_counter() - t0) * 1e3, plan=plan,
+                        )
                     return counts.tolist()
             # Multi-frame breadth: a batch spanning SEVERAL armed frames
             # (the single-state path above only ever serves one) still
@@ -991,8 +1041,14 @@ class Executor:
             if len(self._serve_states) > 1 and os.environ.get(
                 "PILOSA_TPU_NO_SERVEMULTI", ""
             ).lower() not in ("1", "true", "yes"):
+                t0 = time.perf_counter() if rec else 0.0
                 counts = self._serve_multi_counts(index, raw, opt)
                 if counts is not None:
+                    if rec:
+                        self.planner.record(
+                            index=index, fp=plan.get("fp", ""), lane="gram",
+                            ms=(time.perf_counter() - t0) * 1e3, plan=plan,
+                        )
                     return counts
         m = native.pql_match_pairs(raw)
         if m is None:
@@ -1038,7 +1094,12 @@ class Executor:
             # against per-chunk upload costs anyway.
             return None
 
-        if self._serve_queue is not None and local:
+        # A plan with a FORCED lane bypasses the coalescing queue: the
+        # queue's fused evaluation is shared across requests (so it runs
+        # planless, like the lockstep multi-request join), and a
+        # planner-made pick must actually run — and fold back — on its
+        # own lane.  Static plans (lane None) keep the queue, bit-exact.
+        if self._serve_queue is not None and local and forced is None:
             # Read coalescing: hand the matched arrays to the serve queue;
             # the current leader concatenates every queued request with
             # the same (index, name tables, slice set) into one vectorized
@@ -1067,10 +1128,13 @@ class Executor:
             return self._fused_dispatch(
                 index, idxs, std_slices, opt,
                 lambda: pql.parse_cached(src),
-                lambda node_slices: self._fused_local_counts(index, matched, idxs, node_slices),
+                lambda node_slices: self._fused_local_counts(
+                    index, matched, idxs, node_slices, plan=opt.plan
+                ),
             )
         return self._fused_local_counts_arrays(
-            index, frame_names, op_ids, frame_ids, r1, r2, std_slices
+            index, frame_names, op_ids, frame_ids, r1, r2, std_slices,
+            plan=opt.plan,
         )
 
     def _serve_state_valid(self, st: dict) -> bool:
@@ -1269,6 +1333,11 @@ class Executor:
         (pure-ingest workloads pay zero here) and when repair is
         disabled (the ledger's only consumer, _serve_state_repair, can
         never use it with a zero budget)."""
+        if self.prearmer is not None:
+            # Queue a background re-arm for this shape (cheap no-op when
+            # the shape was never registered) BEFORE the repair gates:
+            # pre-arming covers exactly the writes repair can't absorb.
+            self.prearmer.note_invalidate(index, fname)
         if self._repair_rows_max <= 0:
             return
         if not self._serve_states and not self._matrix_cache:
@@ -1400,6 +1469,8 @@ class Executor:
             self._lane_epoch += 1
         with self._dirty_mu:
             self._dirty_rows.pop((index, frame), None)
+        if self.prearmer is not None:
+            self.prearmer.forget(index, frame)
         if self.qcache is not None:
             # A recreated namesake frame gets fresh generations (the
             # counter never repeats), so validity already prevents stale
@@ -1419,6 +1490,8 @@ class Executor:
         with self._dirty_mu:
             for k in [k for k in self._dirty_rows if k[0] == index]:
                 del self._dirty_rows[k]
+        if self.prearmer is not None:
+            self.prearmer.forget_index(index)
         if self.qcache is not None:
             self.qcache.purge_index(index)
 
@@ -1516,7 +1589,8 @@ class Executor:
         return results
 
     def _fused_local_counts_arrays(
-        self, index: str, frame_names, op_ids, frame_ids, r1, r2, slices
+        self, index: str, frame_names, op_ids, frame_ids, r1, r2, slices,
+        plan=None, _prearm=False,
     ) -> list[int]:
         """Vectorized local evaluator for the compiled-query lane: group by
         (frame, op) with numpy masks, map row ids to matrix positions via
@@ -1525,10 +1599,20 @@ class Executor:
         whole batch collapses further into ONE native call
         (pn_gram_counts: binary-search position mapping + count
         identities in C++), the steady-state serving loop.
+
+        ``plan`` is the front door's planner decision (ExecOptions.plan):
+        a forced lane overrides the static rm_pool ladder below (the
+        eligibility gates still apply), lane None changes nothing, and
+        either way each chunk's observed cost folds back through
+        Planner.record under the lane that actually ran.  ``_prearm``
+        marks the PreArmer's background replay so it doesn't re-register
+        itself as a hot shape.
         """
         from pilosa_tpu import native
         from pilosa_tpu.native import PQL_PAIR_OPS
 
+        forced = plan.get("lane") if plan is not None else None
+        rec = self.planner is not None and plan is not None and not _prearm
         out = np.zeros(len(op_ids), dtype=np.int64)
         for f_id in np.unique(frame_ids):
             fmask0 = frame_ids == f_id
@@ -1550,6 +1634,7 @@ class Executor:
                     )
                 ]
             for qpart in qparts:
+                t0 = time.perf_counter() if rec else 0.0
                 fmask = np.zeros(len(op_ids), dtype=bool)
                 fmask[qpart] = True
                 fr1, fr2 = r1[fmask], r2[fmask]
@@ -1565,17 +1650,26 @@ class Executor:
                 # cache box — so only a single-part working set may veto
                 # the row-major lane.  Effective rows mirror the
                 # slice-major pool's cap (dispatch sees the full matrix).
-                rm_pool = (
-                    getattr(self.engine, "supports_row_major_gather", False)
-                    and (
-                        len(qparts) > 1
-                        or not self._gram_could_serve(len(rows), len(slices))
+                # A planner-forced lane replaces this ladder (pin/ledger
+                # decisions); the eligibility gates below still apply.
+                if forced == "gram":
+                    rm_pool = False  # slice-major family: always feasible
+                elif forced == "rmgather":
+                    rm_pool = getattr(
+                        self.engine, "supports_row_major_gather", False
                     )
-                    and self.engine.prefer_rowmajor(
-                        max(len(rows), pool.cap), len(slices), _WORDS,
-                        int(fmask.sum()), 2,
+                else:
+                    rm_pool = (
+                        getattr(self.engine, "supports_row_major_gather", False)
+                        and (
+                            len(qparts) > 1
+                            or not self._gram_could_serve(len(rows), len(slices))
+                        )
+                        and self.engine.prefer_rowmajor(
+                            max(len(rows), pool.cap), len(slices), _WORDS,
+                            int(fmask.sum()), 2,
+                        )
                     )
-                )
                 if rm_pool and len(rows) > self._peek_pool_cap(
                     index, fname, VIEW_STANDARD, slices, lane="rmgather"
                 ):
@@ -1615,6 +1709,11 @@ class Executor:
                             and (st is None or st["glut_id"] is not glut)
                         ):
                             self._capture_serve_state(index, fname, slices, glut, box)
+                        if rec:
+                            self.planner.record(
+                                index=index, fp=plan["fp"], lane="gram",
+                                ms=(time.perf_counter() - t0) * 1e3, plan=plan,
+                            )
                         continue
                 lut = np.fromiter(
                     (id_pos[int(rv)] for rv in rows), dtype=np.int32, count=len(rows)
@@ -1639,6 +1738,26 @@ class Executor:
                         counts = self.engine.gather_count(op, matrix, pairs)
                     fout[om] = counts
                 out[fmask] = fout
+                if rec:
+                    # Fold the chunk's cost back under the lane that
+                    # ACTUALLY ran (an eligibility veto self-corrects).
+                    self.planner.record(
+                        index=index, fp=plan["fp"],
+                        lane="rmgather" if rm_pool else "gram",
+                        ms=(time.perf_counter() - t0) * 1e3, plan=plan,
+                    )
+        if self.prearmer is not None and not _prearm:
+            # Register/refresh this batch as the (index, frame) replay
+            # thunk: re-running it through the ordinary path re-arms
+            # matrix, Gram, and serve state after an invalidating write.
+            thunk = partial(
+                self._fused_local_counts_arrays,
+                index, frame_names, np.array(op_ids), np.array(frame_ids),
+                np.array(r1), np.array(r2), list(slices), _prearm=True,
+            )
+            for f_id in np.unique(frame_ids):
+                fname = frame_names[f_id] if f_id >= 0 else DEFAULT_FRAME
+                self.prearmer.note_shape(index, str(fname), thunk)
         return out.tolist()
 
     def _tree_build(self, index: str, c: pql.Call, fv_box: dict):
@@ -1804,7 +1923,9 @@ class Executor:
         totals = self._fused_dispatch(
             index, idxs, slices, opt,
             lambda: pql.Query(calls=[calls[i] for i in idxs]),
-            lambda node_slices: self._fused_local_counts(index, matched, idxs, node_slices),
+            lambda node_slices: self._fused_local_counts(
+                index, matched, idxs, node_slices, plan=opt.plan
+            ),
         )
         return dict(zip(idxs, totals))
 
@@ -2113,7 +2234,7 @@ class Executor:
         )
 
     def _fused_local_counts(
-        self, index: str, matched: dict, idxs: list[int], slices
+        self, index: str, matched: dict, idxs: list[int], slices, plan=None
     ) -> list[int]:
         """Fused counts for the given slice batch, aligned with idxs.
 
@@ -2123,7 +2244,15 @@ class Executor:
         and/or, the second for andnot) so jitted shapes stay stable.
         Batches whose unique row set exceeds the pool capacity are chunked
         (rows page through HBM per chunk) instead of falling back to host.
+
+        ``plan`` (ExecOptions.plan, see _fused_local_counts_arrays): a
+        forced lane overrides the resident-regime rm_pool ladder, and
+        each resident part's cost folds back through Planner.record.
+        The streaming regime has no lane choice to plan, so it neither
+        applies nor records plans.
         """
+        forced = plan.get("lane") if plan is not None else None
+        rec = self.planner is not None and plan is not None
         slices = list(slices or [])
         out: dict[int, int] = {}
         if not slices:
@@ -2188,18 +2317,29 @@ class Executor:
                     # but only a SINGLE-part working set may veto: in the
                     # paging regime each part switch remaps pool slots
                     # and kills the cache box, so the Gram never warms.
-                    rm_pool = (
-                        not has_tree
-                        and getattr(self.engine, "supports_row_major_gather", False)
-                        and (
-                            len(parts) > 1
-                            or not self._gram_could_serve(len(want), len(slices))
+                    # A planner-forced lane replaces this ladder; tree
+                    # groups (no row-major kernel) and engine support
+                    # still gate it.
+                    t0 = time.perf_counter() if rec else 0.0
+                    if forced == "gram":
+                        rm_pool = False  # slice-major: always feasible
+                    elif forced == "rmgather":
+                        rm_pool = not has_tree and getattr(
+                            self.engine, "supports_row_major_gather", False
                         )
-                        and self.engine.prefer_rowmajor(
-                            max(len(want), pool.cap), len(slices), _WORDS,
-                            n_pairs, max(kb for _, kb in groups),
+                    else:
+                        rm_pool = (
+                            not has_tree
+                            and getattr(self.engine, "supports_row_major_gather", False)
+                            and (
+                                len(parts) > 1
+                                or not self._gram_could_serve(len(want), len(slices))
+                            )
+                            and self.engine.prefer_rowmajor(
+                                max(len(want), pool.cap), len(slices), _WORDS,
+                                n_pairs, max(kb for _, kb in groups),
+                            )
                         )
-                    )
                     if rm_pool and len(want) > self._peek_pool_cap(
                         index, frame, view, slices, lane="rmgather"
                     ):
@@ -2228,6 +2368,13 @@ class Executor:
                         )
                         for k2, i in enumerate(op_idxs):
                             out[i] = int(counts[k2])
+                    if rec:
+                        # Lane that ACTUALLY ran (a veto self-corrects).
+                        self.planner.record(
+                            index=index, fp=plan["fp"],
+                            lane="rmgather" if rm_pool else "gram",
+                            ms=(time.perf_counter() - t0) * 1e3, plan=plan,
+                        )
                 else:
                     # Streaming regime (SURVEY §7 hard part (d) at scale):
                     # the working set exceeds the HBM pool budget, so the
@@ -2341,9 +2488,12 @@ class Executor:
         return self.engine.gather_count_multi_dev(op, matrix, idx_arr)
 
     def _stream_bytes(self) -> int:
-        """Per-chunk byte budget for slice-streaming transient matrices."""
+        """Per-chunk byte budget for slice-streaming transient matrices
+        (ctor/Config > deprecated env spelling > default)."""
+        if self._stream_bytes_cfg > 0:
+            return self._stream_bytes_cfg
         # analysis-ok: lockstep-determinism: deployment config, launcher sets identical env on every rank
-        return int(os.environ.get("PILOSA_TPU_STREAM_BYTES", str(1 << 31)))
+        return int(os.environ.get("PILOSA_TPU_STREAM_BYTES", str(1 << 31)))  # analysis-ok: env-knob-outside-config: deprecated spelling for directly-constructed executors
 
     def _slice_chunk(self, n_rows: int) -> int:
         """Slices per streaming chunk: the byte budget AND the int32
@@ -2407,12 +2557,17 @@ class Executor:
         lifetime settings; tests that toggle them build fresh Executors."""
         cached = self._gram_env_cache
         if cached is None:
-            cached = self._gram_env_cache = (
+            no_gram = self._no_gram_cfg
+            if no_gram is None:
                 # analysis-ok: lockstep-determinism: deployment config, launcher sets identical env on every rank
-                os.environ.get("PILOSA_TPU_NO_GRAM", "").lower() in ("1", "true", "yes"),
+                no_gram = os.environ.get("PILOSA_TPU_NO_GRAM", "").lower() in (  # analysis-ok: env-knob-outside-config: deprecated spelling for directly-constructed executors
+                    "1", "true", "yes",
+                )
+            cached = self._gram_env_cache = (
+                bool(no_gram),
                 self._gram_rows_max_cfg
                 # analysis-ok: lockstep-determinism: deployment config, launcher sets identical env on every rank
-                or int(os.environ.get("PILOSA_TPU_GRAM_ROWS_MAX", "4096")),
+                or int(os.environ.get("PILOSA_TPU_GRAM_ROWS_MAX", "4096")),  # analysis-ok: env-knob-outside-config: deprecated spelling for directly-constructed executors
             )
         return cached
 
@@ -3141,8 +3296,10 @@ class Executor:
             # reference's per-slice goroutine loop has no size limit
             # either (executor.go:1115-1244); this is its bounded-memory
             # analog.
-            # analysis-ok: lockstep-determinism: deployment config, launcher sets identical env on every rank
-            chunk = int(os.environ.get("PILOSA_TPU_SLICE_CHUNK", "2048"))
+            chunk = self._slice_chunk_cfg
+            if chunk <= 0:
+                # analysis-ok: lockstep-determinism: deployment config, launcher sets identical env on every rank
+                chunk = int(os.environ.get("PILOSA_TPU_SLICE_CHUNK", "2048"))  # analysis-ok: env-knob-outside-config: deprecated spelling for directly-constructed executors
             span = opt.span
             if len(node_slices) <= chunk:
                 if span is None:

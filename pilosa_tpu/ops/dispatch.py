@@ -110,7 +110,7 @@ _GRAM_SLICES_MAX = 2047
 
 def _use_gram(n_slices: int, n_rows: int, w: int, batch: int) -> bool:
     # analysis-ok: lockstep-determinism: deployment config, launcher sets identical env on every rank
-    if os.environ.get("PILOSA_TPU_NO_GRAM", "").lower() in ("1", "true", "yes"):
+    if os.environ.get("PILOSA_TPU_NO_GRAM", "").lower() in ("1", "true", "yes"):  # analysis-ok: env-knob-outside-config: kernel-layer kill switch shared with non-server embedders
         return False
     return (
         n_rows * n_rows <= _GRAM_FACTOR * batch

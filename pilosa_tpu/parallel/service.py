@@ -88,7 +88,7 @@ from urllib.parse import parse_qs, urlparse
 _now = time.perf_counter
 
 from pilosa_tpu.engine import MeshEngine
-from pilosa_tpu.executor import Executor
+from pilosa_tpu.executor import ExecOptions, Executor
 from pilosa_tpu.pilosa import ErrFrameNotFound, ErrIndexNotFound, PilosaError
 from pilosa_tpu.qos import DeadlineExceeded, ShedError, deadline_from_headers
 from pilosa_tpu.server.handler import result_to_json
@@ -193,7 +193,7 @@ class LockstepService:
         # config) > PILOSA_TPU_REPLICA_GROUP env ("name[@epoch]") > off.
         if group is None and group_epoch is None:
             group, env_epoch = parse_group(
-                os.environ.get("PILOSA_TPU_REPLICA_GROUP", "")
+                os.environ.get("PILOSA_TPU_REPLICA_GROUP", "")  # analysis-ok: env-knob-outside-config: rank-process fallback; ctor args win, ranks inherit the launcher's env
             )
             group_epoch = env_epoch
         self.group = group or ""
@@ -238,12 +238,12 @@ class LockstepService:
         # eviction stays deterministic because result sizes and the
         # serialized execution order are identical on every rank.
         if qcache_enabled is None:
-            qcache_enabled = os.environ.get("PILOSA_TPU_QCACHE", "").lower() in (
+            qcache_enabled = os.environ.get("PILOSA_TPU_QCACHE", "").lower() in (  # analysis-ok: env-knob-outside-config: rank-process fallback; ctor args win, ranks inherit the launcher's env
                 "1", "true", "yes",
             )
         if qcache_max_bytes is None:
             qcache_max_bytes = int(
-                os.environ.get(
+                os.environ.get(  # analysis-ok: env-knob-outside-config: rank-process fallback; ctor args win, ranks inherit the launcher's env
                     "PILOSA_TPU_QCACHE_MAX_BYTES", str(qcache_mod.DEFAULT_MAX_BYTES)
                 )
             )
@@ -256,6 +256,25 @@ class LockstepService:
             holder, engine=self.engine, qcache=qc,
             stats=self.stats if self.costs is not None else None,
         )
+        # Cost-based planner, RANK 0 ONLY: plans are computed once at
+        # ship time and ride the batch wire entry exactly like the
+        # expiry and trace flags, so every rank applies rank 0's lane
+        # and no rank ever consults rank-local state.  Workers carry
+        # planner=None (they read plans off the wire); the EXECUTOR
+        # planner is also rank-0-only so the ledger fold-back (wall
+        # timestamps, win/loss tallies) stays telemetry, never control
+        # flow on a worker.  PILOSA_TPU_PLANNER=0 disables.
+        self.planner = None
+        if (
+            self.rank == 0
+            and self.costs is not None
+            and os.environ.get("PILOSA_TPU_PLANNER", "").lower()  # analysis-ok: env-knob-outside-config: rank-process fallback; ctor args win, ranks inherit the launcher's env
+            not in ("0", "false", "no")
+        ):
+            from pilosa_tpu import planner as planner_mod
+
+            self.planner = planner_mod.Planner(self.costs, stats=self.stats)
+            self.executor.planner = self.planner
         self.control_addr = control_addr
         self.http_addr = http_addr
         self._workers: list[socket.socket] = []
@@ -266,13 +285,13 @@ class LockstepService:
         # precedence (PR-2 style): ctor arg (the CLI passes
         # Config.lockstep_ack_timeout) > env > default.
         if ack_timeout is None:
-            ack_timeout = float(os.environ.get("PILOSA_TPU_LOCKSTEP_ACK_TIMEOUT", "120"))
+            ack_timeout = float(os.environ.get("PILOSA_TPU_LOCKSTEP_ACK_TIMEOUT", "120"))  # analysis-ok: env-knob-outside-config: rank-process fallback; ctor args win, ranks inherit the launcher's env
         self.ack_timeout = ack_timeout
         # Worker startup: how long a worker retries connecting to rank
         # 0's control listener (the gossip seed-join startup race).
         if connect_timeout is None:
             connect_timeout = float(
-                os.environ.get("PILOSA_TPU_LOCKSTEP_CONNECT_TIMEOUT", "60")
+                os.environ.get("PILOSA_TPU_LOCKSTEP_CONNECT_TIMEOUT", "60")  # analysis-ok: env-knob-outside-config: rank-process fallback; ctor args win, ranks inherit the launcher's env
             )
         self.connect_timeout = connect_timeout
         # Admission bound on rank 0's arrival queue: requests beyond
@@ -281,12 +300,12 @@ class LockstepService:
         # and waiting clients aren't promised work the job can't do).
         # 0 = unbounded.
         if queue_depth is None:
-            queue_depth = int(os.environ.get("PILOSA_TPU_LOCKSTEP_QUEUE_DEPTH", "256"))
+            queue_depth = int(os.environ.get("PILOSA_TPU_LOCKSTEP_QUEUE_DEPTH", "256"))  # analysis-ok: env-knob-outside-config: rank-process fallback; ctor args win, ranks inherit the launcher's env
         self.queue_depth = queue_depth
         # Default per-request budget when no X-Pilosa-Deadline-Ms header
         # arrives; 0 = unbounded.
         if default_deadline_ms is None:
-            default_deadline_ms = float(os.environ.get("PILOSA_TPU_DEADLINE_MS", "0"))
+            default_deadline_ms = float(os.environ.get("PILOSA_TPU_DEADLINE_MS", "0"))  # analysis-ok: env-knob-outside-config: rank-process fallback; ctor args win, ranks inherit the launcher's env
         self.default_deadline_ms = default_deadline_ms
         # Request tracer: the sampling decision is made on rank 0 at
         # ship time and rides the batch entry as a per-request flag —
@@ -373,11 +392,11 @@ class LockstepService:
         # benign.  [bulk] config > PILOSA_TPU_BULK_* env > defaults.
         if bulk_batch_slices is None:
             bulk_batch_slices = int(
-                os.environ.get("PILOSA_TPU_BULK_BATCH_SLICES", "8")
+                os.environ.get("PILOSA_TPU_BULK_BATCH_SLICES", "8")  # analysis-ok: env-knob-outside-config: rank-process fallback; ctor args win, ranks inherit the launcher's env
             )
         if bulk_materialize_budget_ms is None:
             bulk_materialize_budget_ms = float(
-                os.environ.get("PILOSA_TPU_BULK_MATERIALIZE_BUDGET_MS", "0")
+                os.environ.get("PILOSA_TPU_BULK_MATERIALIZE_BUDGET_MS", "0")  # analysis-ok: env-knob-outside-config: rank-process fallback; ctor args win, ranks inherit the launcher's env
             )
         self.bulk_batch_slices = bulk_batch_slices
         self.bulk_materialize_budget_ms = bulk_materialize_budget_ms
@@ -481,7 +500,10 @@ class LockstepService:
                     if shipped is not None:
                         self._q_cv.release()
                         try:
-                            self._run_batch(shipped[0], batch, shipped[1], shipped[2])
+                            self._run_batch(
+                                shipped[0], batch, shipped[1], shipped[2],
+                                shipped[3],
+                            )
                         finally:
                             self._q_cv.acquire()
                     self._inflight -= 1
@@ -622,7 +644,7 @@ class LockstepService:
             ingress.complete_bulk(fr, self.bulk_materialize_budget_ms)
         return True
 
-    def _ship_batch(self, items) -> tuple[int, list[bool], list]:
+    def _ship_batch(self, items) -> tuple[int, list[bool], list, list]:
         """Assign the batch's slot in the total order and replicate it:
         one control-plane send per worker plus one ack round for the
         WHOLE batch (the per-request fixed cost this coalescing
@@ -662,6 +684,7 @@ class LockstepService:
         reqs = []
         expired: list[bool] = []
         traces: list = []
+        plans: list = []
         t_ship = _now()
         for index, query, d, tforce, t_enq in items:
             exp = bool(d is not None and d.expired())
@@ -671,6 +694,17 @@ class LockstepService:
                      "trace": traced}
             if d is not None:
                 entry["deadline_ms"] = max(0, int(d.remaining_ms()))
+            # Planner decision, made ONCE here on rank 0 and shipped on
+            # the wire like the expiry/trace flags: every rank applies
+            # the same lane, no rank consults rank-local ledger state.
+            plan = (
+                self.planner.plan_for(index, query.encode())
+                if self.planner is not None and not exp
+                else None
+            )
+            plans.append(plan)
+            if plan is not None:
+                entry["plan"] = plan
             reqs.append(entry)
             tr = None
             if traced:
@@ -713,7 +747,7 @@ class LockstepService:
                 # Covers the worker fan-out sends plus the receipt-ack
                 # barrier — the control-plane cost the batch amortizes.
                 sp.finish().annotate(ranks=self.n_ranks, batch=len(items))
-        return seq, expired, traces
+        return seq, expired, traces, plans
 
     def _exec_batch_entries(self, entries, deliver) -> None:
         """Drop expired entries (the flag decided at ship time — every
@@ -723,7 +757,7 @@ class LockstepService:
         DeadlineExceeded — deterministic, so it is safe as a
         per-request result on every rank (batch siblings unaffected).
         """
-        live: list = []  # (original position, (index, query))
+        live: list = []  # (original position, (index, query), plan)
         for pos, e in enumerate(entries):
             if e.get("trace"):
                 # Ship-time sampling flag off the wire: every rank sees
@@ -734,11 +768,14 @@ class LockstepService:
                 self.stat_expired += 1
                 deliver(pos, DeadlineExceeded("dropped at lockstep replay"))
             else:
-                live.append((pos, (e["index"], e["query"])))
+                # Planner plan off the wire (rank 0's ship-time decision;
+                # absent = static ladder) — applied, never re-derived.
+                live.append((pos, (e["index"], e["query"]), e.get("plan")))
         if live:
             self._exec_batch_units(
-                [it for _, it in live],
+                [it for _, it, _ in live],
                 lambda i, result: deliver(live[i][0], result),
+                plans=[p for _, _, p in live],
             )
 
     def _batch_units(self, items):
@@ -786,7 +823,7 @@ class LockstepService:
         flush()
         return units
 
-    def _exec_batch_units(self, items, deliver) -> None:
+    def _exec_batch_units(self, items, deliver, plans=None) -> None:
         """Execute one batch's units in order, reporting each request's
         result (or isolated PilosaError) through ``deliver(pos, r)``.
 
@@ -797,7 +834,19 @@ class LockstepService:
         are side-effect-free, so the partial re-execution is safe and
         every rank repeats the same fallback.  Any OTHER exception
         propagates to the caller (rank-local failure — fail-stop).
+
+        ``plans`` (aligned with items) carries rank 0's ship-time
+        planner decisions: solo and single-read units apply theirs via
+        ExecOptions.plan; MULTI-REQUEST fused runs execute without one
+        (the join is its own shape — no per-request fingerprint fits),
+        which is replicated because _batch_units is a pure function of
+        the request strings and the plans came off the wire.
         """
+
+        def _opt(pos):
+            p = plans[pos] if plans is not None else None
+            return ExecOptions(plan=p) if p is not None else None
+
         for unit in self._batch_units(items):
             if unit[0] == "solo":
                 _, pos, index, query = unit
@@ -824,7 +873,7 @@ class LockstepService:
                     ))
                     continue
                 try:
-                    deliver(pos, self.executor.execute(index, query))
+                    deliver(pos, self.executor.execute(index, query, opt=_opt(pos)))
                 except PilosaError as e:
                     deliver(pos, e)  # isolated: every rank resolved it too
                 continue
@@ -843,11 +892,12 @@ class LockstepService:
                     continue
             for pos, query, _n in run:
                 try:
-                    deliver(pos, self.executor.execute(index, query))
+                    deliver(pos, self.executor.execute(index, query, opt=_opt(pos)))
                 except PilosaError as e:
                     deliver(pos, e)
 
-    def _run_batch(self, seq: int, batch, expired=None, traces=None) -> None:
+    def _run_batch(self, seq: int, batch, expired=None, traces=None,
+                   plans=None) -> None:
         """Execute one shipped batch in its slot of the total order and
         fill every submitter's result slot; never raises (siblings would
         hang on an unfilled slot otherwise).  ``expired`` carries the
@@ -891,9 +941,10 @@ class LockstepService:
 
                 flags = expired or [False] * len(batch)
                 trs = traces or [None] * len(batch)
+                pls = plans or [None] * len(batch)
                 entries = [
                     {"index": it[0], "query": it[1], "expired": flags[i],
-                     "trace": trs[i] is not None}
+                     "trace": trs[i] is not None, "plan": pls[i]}
                     for i, (it, _) in enumerate(batch)
                 ]
                 exec_spans = [

@@ -227,6 +227,13 @@ class QueryCache:
 
         self.max_bytes = int(max_bytes)
         self.min_cost_ms = float(min_cost_ms)
+        # Adaptive admission floor (planner.AdaptiveBudgets): when the
+        # server wires one, commit() derives the floor from the measured
+        # cost distribution instead of the static min_cost_ms (which
+        # stays the anchor the adaptive value is clamped around).  The
+        # lockstep service NEVER sets this — its floor is forced to 0
+        # for determinism and must not regrow from rank-local wall time.
+        self.budgets = None
         self.stats = stats if stats is not None else NOP_STATS
         self._clock = clock
         self._mu = lockcheck.named_lock("qcache._mu")
@@ -359,7 +366,12 @@ class QueryCache:
         pre-write results with post-write tokens).  Returns True when
         the entry was stored."""
         cost_ms = (self._clock() - pending.t0) * 1e3
-        if cost_ms < self.min_cost_ms:
+        floor = (
+            self.budgets.qcache_min_cost_ms()
+            if self.budgets is not None
+            else self.min_cost_ms
+        )
+        if cost_ms < floor:
             return False
         vec1 = generation_vector(holder, pending.index, pending.frames)
         if vec1 is None or vec1 != pending.vec0:
@@ -438,11 +450,11 @@ def from_env(min_cost_ms: Optional[float] = None, stats=None) -> Optional[QueryC
     and a replicated decision needs a replicated input)."""
     import os
 
-    if os.environ.get("PILOSA_TPU_QCACHE", "").lower() not in ("1", "true", "yes"):
+    if os.environ.get("PILOSA_TPU_QCACHE", "").lower() not in ("1", "true", "yes"):  # analysis-ok: env-knob-outside-config: from_env is the documented opt-in for direct embedders; the server wires [qcache] config
         return None
-    max_bytes = int(os.environ.get("PILOSA_TPU_QCACHE_MAX_BYTES", str(DEFAULT_MAX_BYTES)))
+    max_bytes = int(os.environ.get("PILOSA_TPU_QCACHE_MAX_BYTES", str(DEFAULT_MAX_BYTES)))  # analysis-ok: env-knob-outside-config: from_env is the documented opt-in for direct embedders; the server wires [qcache] config
     if min_cost_ms is None:
         min_cost_ms = float(
-            os.environ.get("PILOSA_TPU_QCACHE_MIN_COST_MS", str(DEFAULT_MIN_COST_MS))
+            os.environ.get("PILOSA_TPU_QCACHE_MIN_COST_MS", str(DEFAULT_MIN_COST_MS))  # analysis-ok: env-knob-outside-config: from_env is the documented opt-in for direct embedders; the server wires [qcache] config
         )
     return QueryCache(max_bytes=max_bytes, min_cost_ms=min_cost_ms, stats=stats)

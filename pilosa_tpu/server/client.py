@@ -68,7 +68,7 @@ class Client:
         # effect.
         if retry_budget is None:
             retry_budget = int(
-                os.environ.get(
+                os.environ.get(  # analysis-ok: env-knob-outside-config: client-side fallback for directly-constructed clients; the Server passes [client] config
                     "PILOSA_TPU_CLIENT_RETRY_BUDGET", str(DEFAULT_RETRY_BUDGET)
                 )
             )

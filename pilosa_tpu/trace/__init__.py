@@ -383,9 +383,9 @@ def from_env(stats=None, costs=None) -> Optional[Tracer]:
     service skips even the per-request header lookup."""
     import os
 
-    rate = float(os.environ.get("PILOSA_TPU_TRACE_SAMPLE_RATE", "0") or 0)
-    slow = float(os.environ.get("PILOSA_TPU_TRACE_SLOW_MS", "0") or 0)
-    ring = int(os.environ.get("PILOSA_TPU_TRACE_RING", str(DEFAULT_RING)))
+    rate = float(os.environ.get("PILOSA_TPU_TRACE_SAMPLE_RATE", "0") or 0)  # analysis-ok: env-knob-outside-config: from_env is the documented opt-in for direct embedders; the server wires [trace] config
+    slow = float(os.environ.get("PILOSA_TPU_TRACE_SLOW_MS", "0") or 0)  # analysis-ok: env-knob-outside-config: from_env is the documented opt-in for direct embedders; the server wires [trace] config
+    ring = int(os.environ.get("PILOSA_TPU_TRACE_RING", str(DEFAULT_RING)))  # analysis-ok: env-knob-outside-config: from_env is the documented opt-in for direct embedders; the server wires [trace] config
     if rate <= 0 and slow <= 0:
         return None
     return Tracer(sample_rate=rate, slow_ms=slow, ring=ring, stats=stats,

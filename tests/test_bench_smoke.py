@@ -55,6 +55,9 @@ def _run(env_extra, script="bench.py", timeout=240):
         # Mixed read/write tier: BENCH_SMOKE exercises the warm-state
         # REPAIR lane end-to-end (patch + rebuild A/B) on CPU.
         ("mixed", {"BENCH_SMOKE": "1"}),
+        # Planner convergence tier: adaptive (door-loop plan_for) vs
+        # pinned-lane baselines; asserts post-warmup lane agreement.
+        ("planner", {"BENCH_SMOKE": "1"}),
         ("intersect_count_stream", {"BENCH_ITERS": "2", "BENCH_SLICES": "4",
                                     "BENCH_ROWS": "4", "BENCH_BATCH": "4",
                                     "BENCH_CHUNK_SLICES": "2"}),

@@ -1,0 +1,474 @@
+"""Cost-based adaptive planner tests: lane selection (Planner), the
+ledger it reads (CostLedger edges), adaptive budgets, predictive
+pre-arming, and the executor-side contracts — most importantly that a
+planner with an EMPTY ledger reproduces the static strategy ladder's
+results exactly (lane None plans change nothing), which is what makes
+enabling the planner by default safe.
+"""
+
+import json
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.core.frame import FrameOptions
+from pilosa_tpu.core.holder import Holder
+from pilosa_tpu.costs import CostLedger
+from pilosa_tpu.executor import ExecOptions, Executor
+from pilosa_tpu.pilosa import SLICE_WIDTH
+from pilosa_tpu.planner import AdaptiveBudgets, PLAN_LANES, Planner, PreArmer
+from pilosa_tpu.trace import fingerprint
+
+FP = "aabbccdd"
+
+
+def _fold(led, lane, ms, n=1, index="i", fp=FP):
+    for _ in range(n):
+        led.observe(index=index, frame="", fp=fp, lane=lane, ms=ms, wall_ts=0.0)
+
+
+# -- Planner: decision cascade -------------------------------------------
+
+
+def test_planner_static_until_confidence_gate():
+    """With an empty ledger every non-explore consult is the static
+    ladder (lane None), and confidence reflects the starved lane."""
+    pl = Planner(CostLedger(), min_samples=3, explore_every=4)
+    for i in range(1, 4):  # consults 1..3: no explore tick yet
+        plan = pl.choose("i", FP)
+        assert plan["lane"] is None and plan["src"] == "static"
+        assert plan["confidence"] == 0.0
+    # One lane fully sampled does NOT open the gate: confidence is
+    # min over lanes.
+    _fold(pl.ledger, "gram", 10.0, n=5)
+    plan = pl.choose("i", FP)  # consult 4 — explore tick, see below
+    plan = pl.choose("i", FP)  # consult 5
+    assert plan["lane"] is None and plan["src"] == "static"
+
+
+def test_planner_explore_tick_is_deterministic_and_starved_first():
+    pl = Planner(CostLedger(), min_samples=3, explore_every=4)
+    picks = [pl.choose("i", FP) for _ in range(8)]
+    # Consults 4 and 8 are explore ticks; both lanes tied at 0 samples
+    # breaks ties in PLAN_LANES order.
+    assert [p["src"] for p in picks] == ["static"] * 3 + ["explore"] + ["static"] * 3 + ["explore"]
+    assert picks[3]["lane"] == PLAN_LANES[0] == "gram"
+    # Once gram has samples and rmgather has none, the tick samples the
+    # starved lane.
+    _fold(pl.ledger, "gram", 10.0, n=2)
+    for _ in range(3):
+        pl.choose("i", FP)
+    plan = pl.choose("i", FP)  # consult 12
+    assert plan["src"] == "explore" and plan["lane"] == "rmgather"
+
+
+def test_planner_ledger_pick_and_hysteresis():
+    led = CostLedger()
+    pl = Planner(led, min_samples=3, hysteresis=0.15, explore_every=100)
+    _fold(led, "gram", 10.0, n=3)
+    _fold(led, "rmgather", 9.0, n=3)
+    plan = pl.choose("i", FP)
+    assert plan["src"] == "ledger" and plan["lane"] == "rmgather"
+    assert plan["confidence"] == 0.5  # 3 samples / (2 * min_samples)
+    # Challenger inside the hysteresis band keeps the incumbent: gram's
+    # EWMA folds 10.0 -> 8.5, still above 9.0 * (1 - 0.15) = 7.65.
+    _fold(led, "gram", 4.0)
+    plan = pl.choose("i", FP)
+    assert plan["lane"] == "rmgather"
+    # Clearing the band takes over: 8.5 -> 6.375 < 7.65.
+    _fold(led, "gram", 0.0)
+    plan = pl.choose("i", FP)
+    assert plan["src"] == "ledger" and plan["lane"] == "gram"
+
+
+def test_planner_pin_forces_lane():
+    pl = Planner(CostLedger(), pin="rmgather")
+    plan = pl.choose("i", FP)
+    assert plan == {"fp": FP, "lane": "rmgather", "src": "pinned", "confidence": 1.0}
+    # An unknown pin is dropped, not honored.
+    assert Planner(CostLedger(), pin="bogus").pin == ""
+
+
+def test_planner_record_scores_decisions_and_folds_actual_lane():
+    led = CostLedger()
+    pl = Planner(led, min_samples=3)
+    pl.choose("i", FP)  # create key state
+    # Planner-made pick with no alternative evidence counts as a win.
+    pl.record(index="i", fp=FP, lane="gram", ms=5.0,
+              plan={"fp": FP, "lane": "gram", "src": "ledger"})
+    # Static-ladder outcomes fold costs but are not scored.
+    pl.record(index="i", fp=FP, lane="gram", ms=5.0,
+              plan={"fp": FP, "lane": None, "src": "static"})
+    # A pick that loses to the other lane's EWMA counts as a loss.
+    _fold(led, "rmgather", 1.0)
+    pl.record(index="i", fp=FP, lane="gram", ms=5.0,
+              plan={"fp": FP, "lane": "gram", "src": "explore"})
+    snap = pl.snapshot()
+    (key,) = snap["keys"]
+    assert key["wins"] == 1 and key["losses"] == 1
+    # All three records folded under the lane that actually ran.
+    assert led.peek(index="i", frame="", fp=FP, lane="gram")["n"] == 3
+    # Junk lanes and empty fingerprints are ignored outright.
+    pl.record(index="i", fp="", lane="gram", ms=1.0)
+    pl.record(index="i", fp=FP, lane="native", ms=1.0)
+    assert led.peek(index="i", frame="", fp=FP, lane="gram")["n"] == 3
+
+
+def test_planner_snapshot_shape_and_keys_cap():
+    pl = Planner(CostLedger(), keys_cap=4)
+    for i in range(6):
+        pl.choose("i", f"fp{i}")
+    snap = pl.snapshot()
+    assert snap["lanes"] == list(PLAN_LANES)
+    assert {"min_samples", "hysteresis", "explore_every", "pin"} <= set(snap)
+    assert len(snap["keys"]) == 4  # LRU-bounded decision state
+    assert {k["fp"] for k in snap["keys"]} == {f"fp{i}" for i in range(2, 6)}
+    for k in snap["keys"]:
+        assert {"incumbent", "consults", "decided", "wins", "losses",
+                "lanes", "confidence"} <= set(k)
+
+
+def test_planner_plan_for_empty_body():
+    pl = Planner(CostLedger())
+    assert pl.plan_for("i", b"") is None
+    plan = pl.plan_for("i", b"Count(...)")
+    assert plan["fp"] == fingerprint(b"Count(...)")["fp"]
+
+
+# -- CostLedger edges (satellite: eviction, determinism, concurrency) ----
+
+
+def test_ledger_lru_eviction_under_fingerprint_churn():
+    led = CostLedger(cap=8)
+    for i in range(100):
+        led.observe(index="i", fp=f"fp{i}", lane="gram", ms=1.0, wall_ts=0.0)
+    assert len(led) == 8
+    assert led.peek(index="i", fp="fp0", lane="gram") is None
+    assert led.peek(index="i", fp="fp99", lane="gram") is not None
+    # observe() bumps recency; peek() is a pure read and must NOT (the
+    # planner consults every request and must not pin its keys hot).
+    led.observe(index="i", fp="fp92", lane="gram", ms=1.0, wall_ts=0.0)
+    led.peek(index="i", fp="fp93", lane="gram")
+    for i in range(100, 106):
+        led.observe(index="i", fp=f"fp{i}", lane="gram", ms=1.0, wall_ts=0.0)
+    assert led.peek(index="i", fp="fp92", lane="gram") is not None
+    assert led.peek(index="i", fp="fp93", lane="gram") is None
+    assert led.peek(index="i", fp="fp99", lane="gram") is not None
+
+
+def test_ledger_ewma_fold_deterministic_across_state_restore():
+    obs = [
+        (f"fp{i % 5}", PLAN_LANES[i % 2], 1.0 + 0.37 * i, 1000 * i)
+        for i in range(40)
+    ]
+    a = CostLedger(cap=16, alpha=0.25)
+    for fp, lane, ms, b in obs[:20]:
+        a.observe(index="i", fp=fp, lane=lane, ms=ms, bytes_moved=b, wall_ts=1.0)
+    b2 = CostLedger()
+    b2.restore(a.state())
+    assert b2.cap == 16 and b2.alpha == 0.25
+    # Folding the same tail into the restored ledger yields
+    # bit-identical state — EWMA folds carry no hidden host state.
+    for fp, lane, ms, by in obs[20:]:
+        a.observe(index="i", fp=fp, lane=lane, ms=ms, bytes_moved=by, wall_ts=2.0)
+        b2.observe(index="i", fp=fp, lane=lane, ms=ms, bytes_moved=by, wall_ts=2.0)
+    assert a.state() == b2.state()
+
+
+def test_ledger_snapshot_consistent_under_concurrent_folds():
+    led = CostLedger(cap=32)
+    errors = []
+    done = threading.Event()
+
+    def folder(tid):
+        try:
+            for i in range(400):
+                led.observe(index="i", fp=f"fp{tid}-{i % 40}", lane="gram",
+                            ms=1.0 + (i % 7), wall_ts=0.0)
+        except Exception as exc:  # pragma: no cover - failure path
+            errors.append(exc)
+
+    threads = [threading.Thread(target=folder, args=(t,)) for t in range(4)]
+    for t in threads:
+        t.start()
+    # Read every public surface while folds churn the LRU.
+    for _ in range(200):
+        snap = led.snapshot(limit=16)
+        assert len(snap["entries"]) <= 16
+        for e in snap["entries"]:
+            assert e["n"] >= 1 and e["ewma_ms"] > 0
+        assert len(led.entries()) <= 32
+        st = led.state()
+        assert len(st["entries"]) <= 32
+    for t in threads:
+        t.join()
+    done.set()
+    assert not errors
+    assert len(led) <= 32
+
+
+# -- AdaptiveBudgets: static-until-evidence, clamped derivations ---------
+
+
+def test_budgets_static_while_ledger_empty():
+    for ledger in (None, CostLedger()):
+        b = AdaptiveBudgets(ledger, qcache_min_cost_ms=1.0,
+                            catchup_drain_batch=64, resync_chunk_bytes=256 << 10)
+        assert b.qcache_min_cost_ms() == 1.0
+        assert b.catchup_drain_batch() == 64
+        assert b.resync_chunk_bytes() == 256 << 10
+
+
+def test_budgets_qcache_floor_p25_with_clamps():
+    led = CostLedger()
+    b = AdaptiveBudgets(led, qcache_min_cost_ms=1.0)
+    for i, ms in enumerate([0.5, 0.8, 2.0, 3.0, 4.0, 5.0, 6.0]):
+        led.observe(index="i", fp=f"f{i}", lane="gram", ms=ms, wall_ts=0.0)
+    assert b.qcache_min_cost_ms() == 1.0  # 7 entries < minimum for a p25
+    led.observe(index="i", fp="f7", lane="gram", ms=7.0, wall_ts=0.0)
+    assert b.qcache_min_cost_ms() == 2.0  # sorted[len//4] of 8 entries
+    # Clamp band: a uniformly expensive (or cheap) population can move
+    # the floor at most 10x (0.1x) off the static default.
+    for ms, want in [(1000.0, 10.0), (0.001, 0.1)]:
+        led2 = CostLedger()
+        b2 = AdaptiveBudgets(led2, qcache_min_cost_ms=1.0)
+        for i in range(8):
+            led2.observe(index="i", fp=f"f{i}", lane="gram", ms=ms, wall_ts=0.0)
+        assert b2.qcache_min_cost_ms() == want
+
+
+def test_budgets_catchup_batch_fits_half_the_locked_drain():
+    cases = [
+        (10.0, 250),    # 2500 ms budget / 10 ms per record
+        (0.5, 1024),    # fit 5000 clamps to the max batch
+        (1000.0, 16),   # fit 2 clamps to the min batch
+    ]
+    for ms, want in cases:
+        b = AdaptiveBudgets(CostLedger(), catchup_drain_batch=64,
+                            catchup_locked_drain_s=5.0)
+        b.observe_transfer("catchup", ms=ms)
+        assert b.catchup_drain_batch() == want
+
+
+def test_budgets_resync_chunk_tracks_bandwidth():
+    cases = [
+        (100.0, 2_000_000, 1_000_000),   # 20 MB/s * 50 ms
+        (10.0, 1 << 30, 4 << 20),        # fast link clamps to 4 MiB
+        (1000.0, 100, 64 << 10),         # slow link clamps to 64 KiB
+    ]
+    for ms, moved, want in cases:
+        b = AdaptiveBudgets(CostLedger(), resync_chunk_bytes=256 << 10)
+        b.observe_transfer("resync", ms=ms, bytes_moved=moved)
+        assert b.resync_chunk_bytes() == want
+    # A transfer that moved no bytes leaves bandwidth (and the chunk
+    # size) untouched.
+    b = AdaptiveBudgets(CostLedger(), resync_chunk_bytes=256 << 10)
+    b.observe_transfer("resync", ms=5.0)
+    assert b.resync_chunk_bytes() == 256 << 10
+
+
+# -- PreArmer: registration, invalidation, budgeted replay ---------------
+
+
+def test_prearmer_shape_registry_cap_and_forget():
+    pa = PreArmer(shapes_cap=2)
+    for fr in ("a", "b", "c"):
+        pa.note_shape("i", fr, lambda: None)
+    with pa._cv:
+        assert set(pa._shapes) == {("i", "b"), ("i", "c")}
+    # Invalidating an unknown shape is a cheap no-op.
+    pa.note_invalidate("i", "zzz")
+    with pa._cv:
+        assert not pa._pending
+    pa.note_invalidate("i", "b")
+    pa.forget("i", "b")
+    with pa._cv:
+        assert ("i", "b") not in pa._shapes and not pa._pending
+    pa.note_shape("j", "x", lambda: None)
+    pa.forget_index("i")
+    with pa._cv:
+        assert set(pa._shapes) == {("j", "x")}
+
+
+def test_prearmer_replays_twice_and_survives_thunk_errors():
+    calls = []
+    done = threading.Event()
+
+    def good():
+        calls.append(1)
+        if len(calls) >= 2:
+            done.set()
+
+    def bad():
+        raise RuntimeError("frame dropped mid-flight")
+
+    pa = PreArmer(budget_ms=100.0)
+    pa.note_shape("i", "bad", bad)
+    pa.note_shape("i", "good", good)
+    pa.start()
+    try:
+        pa.note_invalidate("i", "bad")
+        pa.note_invalidate("i", "good")
+        # The Gram warms on the second touch: the replay runs TWICE, and
+        # the failing thunk must not take down the worker.
+        assert done.wait(10.0)
+    finally:
+        pa.close()
+    assert len(calls) >= 2
+    assert pa.stat_armed >= 1
+
+
+# -- Executor contracts: static parity + plan application ----------------
+
+
+def _executor_env(tmp_path, rows=5, bits=60):
+    h = Holder(str(tmp_path / "data"))
+    h.open()
+    idx = h.create_index("i")
+    idx.create_frame("f", FrameOptions())
+    fr = idx.frame("f")
+    rng = np.random.default_rng(7)
+    rids, cids = [], []
+    for r in range(rows):
+        for c in rng.choice(2 * SLICE_WIDTH, size=bits, replace=False):
+            rids.append(r)
+            cids.append(int(c))
+    fr.import_bits(rids, cids)
+    return h, Executor(h, engine="numpy")
+
+
+# Batches of >=2 fused counts: the planner arbitrates the compiled
+# fused lane's strategy families; singleton Counts ride the AST path,
+# which it leaves alone.
+def _pairs(*ab):
+    return " ".join(
+        f'Count({op}(Bitmap(rowID={a}, frame="f"), Bitmap(rowID={b}, frame="f")))'
+        for op, a, b in ab
+    )
+
+
+_QUERIES = [
+    _pairs(("Intersect", 0, 1), ("Union", 1, 2)),
+    _pairs(("Difference", 3, 4), ("Xor", 0, 3)),
+    _pairs(("Intersect", 2, 4), ("Intersect", 0, 4)),
+    _pairs(("Union", 0, 4), ("Difference", 1, 3), ("Xor", 2, 3)),
+]
+
+
+def test_empty_ledger_planner_reproduces_static_decisions(tmp_path):
+    """The ISSUE's tier-1 smoke: an empty-ledger planner emits lane-None
+    plans (below the explore cadence) and the executor treats them
+    exactly like no plan at all — identical results, and the observed
+    costs still fold back under the lane the static ladder ran."""
+    h, e = _executor_env(tmp_path)
+    try:
+        baseline = [e.execute("i", q) for q in _QUERIES]
+        led = CostLedger()
+        pl = Planner(led, explore_every=1000)
+        e.planner = pl
+        for q, want in zip(_QUERIES, baseline):
+            plan = pl.plan_for("i", q.encode())
+            assert plan["lane"] is None and plan["src"] == "static"
+            got = e.execute("i", q, opt=ExecOptions(plan=plan))
+            assert got == want
+        # Static-plan dispatches still feed the ledger (frame "" —
+        # strategy choice is per request shape).
+        folds = sum(ent["n"] for ent in led.entries())
+        assert folds >= len(_QUERIES)
+        assert all(ent["lane"] in PLAN_LANES and ent["frame"] == ""
+                   for ent in led.entries())
+    finally:
+        h.close()
+
+
+def test_forced_lane_plans_match_and_record_actual_lane(tmp_path):
+    """Pinned plans force a strategy family; results stay identical and
+    every dispatch folds back under the lane that ACTUALLY ran (an
+    eligibility veto records the fallback, not the pick)."""
+    h, e = _executor_env(tmp_path)
+    try:
+        baseline = [e.execute("i", q) for q in _QUERIES]
+        for pin in PLAN_LANES:
+            led = CostLedger()
+            pl = Planner(led, pin=pin)
+            e.planner = pl
+            for q, want in zip(_QUERIES, baseline):
+                plan = pl.plan_for("i", q.encode())
+                assert plan["src"] == "pinned" and plan["lane"] == pin
+                assert e.execute("i", q, opt=ExecOptions(plan=plan)) == want
+            ents = led.entries()
+            assert sum(ent["n"] for ent in ents) >= len(_QUERIES)
+            assert all(ent["lane"] in PLAN_LANES for ent in ents)
+            if pin == "gram":
+                # The slice-major family is always feasible, so a gram
+                # pin records as gram everywhere.
+                assert {ent["lane"] for ent in ents} == {"gram"}
+            # Pinned decisions are scored win/loss.
+            snap = pl.snapshot()
+            assert sum(k["wins"] + k["losses"] for k in snap["keys"]) >= len(_QUERIES)
+    finally:
+        h.close()
+
+
+def test_door_loop_converges_on_ledger_decisions(tmp_path):
+    """Front-door loop (plan_for -> execute) on one hot fingerprint:
+    consults accumulate, folds land, and once every lane has evidence
+    the decisions come from the ledger, not the static ladder."""
+    h, e = _executor_env(tmp_path)
+    try:
+        led = CostLedger()
+        pl = Planner(led, min_samples=2, explore_every=4)
+        e.planner = pl
+        q = _QUERIES[0]
+        for _ in range(24):
+            plan = pl.plan_for("i", q.encode())
+            e.execute("i", q, opt=ExecOptions(plan=plan))
+        snap = pl.snapshot()
+        (key,) = snap["keys"]
+        assert key["consults"] == 24
+        assert sum(key["decided"].values()) == 24
+        # The actually-run lane has converged evidence; if both lanes
+        # gathered min_samples (host-dependent — a vetoed rmgather
+        # explore folds as gram), ledger-src decisions must appear.
+        lanes = key["lanes"]
+        assert lanes and max(v["n"] for v in lanes.values()) >= 2
+        if all(lanes.get(ln, {}).get("n", 0) >= 2 for ln in PLAN_LANES):
+            assert key["decided"].get("ledger", 0) > 0
+    finally:
+        h.close()
+
+
+def test_debug_planner_endpoint(tmp_path, monkeypatch):
+    """/debug/planner (satellite d): the server consults its planner per
+    query and serves the decision snapshot."""
+    from pilosa_tpu.config import Config
+    from pilosa_tpu.server.client import Client
+    from pilosa_tpu.server.server import Server
+
+    monkeypatch.setenv("PILOSA_TPU_COSTS", "1")
+    cfg = Config(data_dir=str(tmp_path / "dp"), host="127.0.0.1:0", engine="numpy")
+    s = Server(cfg)
+    s.open()
+    try:
+        assert s.planner is not None
+        c = Client(s.host)
+        c.create_index("dp")
+        c.create_frame("dp", "f")
+        c.execute_query("dp", 'SetBit(rowID=0, frame="f", columnID=1) '
+                              'SetBit(rowID=1, frame="f", columnID=1)')
+        q = ('Count(Intersect(Bitmap(rowID=0, frame="f"), Bitmap(rowID=1, frame="f"))) '
+             'Count(Union(Bitmap(rowID=0, frame="f"), Bitmap(rowID=1, frame="f")))')
+        for _ in range(3):
+            resp = c.execute_query("dp", q)
+            assert resp["results"] == [{"n": 1}, {"n": 1}]
+        with urllib.request.urlopen(f"http://{s.host}/debug/planner", timeout=30) as r:
+            snap = json.loads(r.read())
+        assert snap["lanes"] == list(PLAN_LANES)
+        want_fp = fingerprint(q.encode())["fp"]
+        by_fp = {k["fp"]: k for k in snap["keys"]}
+        assert by_fp[want_fp]["consults"] >= 3
+        assert {"incumbent", "decided", "wins", "losses", "confidence"} <= set(by_fp[want_fp])
+    finally:
+        s.close()
