@@ -186,6 +186,10 @@ def cmd_lockstep(args) -> int:
         # rank's lazy-materialization drain.
         bulk_batch_slices=cfg.bulk_batch_slices,
         bulk_materialize_budget_ms=cfg.bulk_materialize_budget_ms,
+        # [tenancy] wiring: rank 0 resolves each request's tenant once
+        # at ship time (header > this map > index name) and ships it on
+        # the batch entry like the expiry/trace flags.
+        tenancy_map=cfg.tenancy_map,
     )
     if svc.rank == 0:
         print(

@@ -229,6 +229,24 @@ class Config:
     # Bound on rank 0's arrival queue: requests beyond this shed with
     # 429 instead of growing the coalescing queue without limit.
     lockstep_queue_depth: int = 256
+    # -- multi-tenant isolation ([tenancy] TOML section) ------------------
+    # Off by default: every enforcement seam (admission doors, qcache,
+    # ingest pacer) takes its pre-tenancy path byte-identically.
+    tenancy_enabled: bool = False
+    # "gold=4,free=1" — fair-share weights; unlisted tenants get
+    # default-weight.
+    tenancy_weights: str = ""
+    tenancy_default_weight: float = 1.0
+    # "idx_a=gold,idx_b=free" — explicit index→tenant table; unmapped
+    # indexes bill to their own name.
+    tenancy_map: str = ""
+    # qcache byte quota: a bare fraction ("0.5") applied to every
+    # tenant, or per-tenant overrides ("gold=0.75,free=0.1").  Empty =
+    # no per-tenant cache quota.
+    tenancy_qcache_share: str = ""
+    # Aggregate ingest/bulk chunk bandwidth split by weight across
+    # active tenants; 0 disables the pacer.
+    tenancy_ingest_bytes_per_s: int = 0
 
     @classmethod
     def from_toml(cls, path: str) -> "Config":
@@ -353,6 +371,19 @@ class Config:
         )
         cfg.lockstep_queue_depth = int(
             ls.get("queue-depth", cfg.lockstep_queue_depth)
+        )
+        ten = raw.get("tenancy", {})
+        cfg.tenancy_enabled = bool(ten.get("enabled", cfg.tenancy_enabled))
+        cfg.tenancy_weights = str(ten.get("weights", cfg.tenancy_weights))
+        cfg.tenancy_default_weight = float(
+            ten.get("default-weight", cfg.tenancy_default_weight)
+        )
+        cfg.tenancy_map = str(ten.get("map", cfg.tenancy_map))
+        cfg.tenancy_qcache_share = str(
+            ten.get("qcache-share", cfg.tenancy_qcache_share)
+        )
+        cfg.tenancy_ingest_bytes_per_s = int(
+            ten.get("ingest-bytes-per-s", cfg.tenancy_ingest_bytes_per_s)
         )
         cl = raw.get("cluster", {})
         cfg.cluster.replica_n = cl.get("replicas", cfg.cluster.replica_n)
@@ -508,6 +539,24 @@ class Config:
             )
         if "PILOSA_TPU_LOCKSTEP_QUEUE_DEPTH" in env:
             self.lockstep_queue_depth = int(env["PILOSA_TPU_LOCKSTEP_QUEUE_DEPTH"])
+        if "PILOSA_TPU_TENANCY" in env:
+            self.tenancy_enabled = env["PILOSA_TPU_TENANCY"].lower() in (
+                "1", "true", "yes",
+            )
+        if "PILOSA_TPU_TENANCY_WEIGHTS" in env:
+            self.tenancy_weights = env["PILOSA_TPU_TENANCY_WEIGHTS"]
+        if "PILOSA_TPU_TENANCY_DEFAULT_WEIGHT" in env:
+            self.tenancy_default_weight = float(
+                env["PILOSA_TPU_TENANCY_DEFAULT_WEIGHT"]
+            )
+        if "PILOSA_TPU_TENANCY_MAP" in env:
+            self.tenancy_map = env["PILOSA_TPU_TENANCY_MAP"]
+        if "PILOSA_TPU_TENANCY_QCACHE_SHARE" in env:
+            self.tenancy_qcache_share = env["PILOSA_TPU_TENANCY_QCACHE_SHARE"]
+        if "PILOSA_TPU_TENANCY_INGEST_BYTES_PER_S" in env:
+            self.tenancy_ingest_bytes_per_s = int(
+                env["PILOSA_TPU_TENANCY_INGEST_BYTES_PER_S"]
+            )
         return self
 
     def to_toml(self) -> str:

@@ -125,9 +125,16 @@ class DispatchMeter:
 @lockcheck.guarded_class
 class CostLedger:
     """Bounded LRU of EWMA cost/bandwidth estimates keyed by
-    (index, frame, fingerprint, lane) — the /debug/costs payload."""
+    (tenant, index, frame, fingerprint, lane) — the /debug/costs
+    payload and the per-tenant ledger rows /debug/tenants bills from.
 
-    _guarded_by_ = {"_entries": "costs._mu"}
+    The tenant dimension is real (not ``tenant or index`` conflated):
+    two tenants sharing one index keep separate estimates.  Readers
+    that don't know the tenant (the planner's peeks) resolve through a
+    secondary (index, frame, fp, lane) -> full-key map that tracks the
+    most recently observed tenant for each 4-tuple."""
+
+    _guarded_by_ = {"_entries": "costs._mu", "_by4": "costs._mu"}
 
     def __init__(self, cap: int = DEFAULT_CAP, alpha: float = DEFAULT_ALPHA,
                  stats=None):
@@ -138,10 +145,13 @@ class CostLedger:
         self.stats = stats if stats is not None else NOP_STATS
         self._mu = lockcheck.named_lock("costs._mu")
         self._entries: "OrderedDict[tuple, dict]" = OrderedDict()
+        # (index, frame, fp, lane) -> full 5-tuple key, MRU tenant wins.
+        self._by4: dict[tuple, tuple] = {}
 
     def observe(
         self,
         *,
+        tenant: str = "",
         index: str = "",
         frame: str = "",
         fp: str = "",
@@ -151,10 +161,11 @@ class CostLedger:
         device_ms: float = 0.0,
         wall_ts: Optional[float] = None,
     ) -> None:
-        """Fold one observation into the (index, frame, fp, lane) entry.
-        Bandwidth (MB/s) only updates when the observation actually
-        moved bytes, so transfer-free warm hits don't decay it."""
-        key = (index, frame, fp, lane)
+        """Fold one observation into the (tenant, index, frame, fp,
+        lane) entry.  Bandwidth (MB/s) only updates when the observation
+        actually moved bytes, so transfer-free warm hits don't decay
+        it."""
+        key = (tenant, index, frame, fp, lane)
         # analysis-ok: lockstep-determinism: display-only last_ts metadata; lockstep folds happen on rank 0 alone (workers carry no planner) and never feed a wire decision
         ts = wall_ts if wall_ts is not None else time.time()
         a = self.alpha
@@ -170,7 +181,9 @@ class CostLedger:
                     "last_ts": 0.0,
                 }
                 while len(self._entries) > self.cap:
-                    self._entries.popitem(last=False)
+                    old_key, _ = self._entries.popitem(last=False)
+                    if self._by4.get(old_key[1:]) == old_key:
+                        del self._by4[old_key[1:]]
                     self.stats.count("costs.evict")
             e["n"] += 1
             e["ewma_ms"] += a * (float(ms) - e["ewma_ms"])
@@ -185,6 +198,7 @@ class CostLedger:
             e["last_ms"] = round(float(ms), 3)
             e["last_ts"] = round(ts, 3)
             self._entries.move_to_end(key)
+            self._by4[key[1:]] = key
             n_entries = len(self._entries)
         self.stats.count("costs.fold")
         self.stats.gauge("costs.entries", n_entries)
@@ -198,7 +212,10 @@ class CostLedger:
 
         root = trace.root
         tags = root.tags
-        index = str(tags.get("tenant") or tags.get("index") or "")
+        tenant = str(tags.get("tenant") or "")
+        # Embedders that only tagged "tenant" (the pre-tenancy handler
+        # wrote the index name there) keep their index keying.
+        index = str(tags.get("index") or "") or tenant
         lane = str(tags.get("lane") or "general")
         frame = str(tags.get("frame") or "")
         fp = fingerprint(body)["fp"] if body else ""
@@ -225,6 +242,7 @@ class CostLedger:
                     else:
                         stack.append(c)
         self.observe(
+            tenant=tenant,
             index=index,
             frame=frame,
             fp=fp,
@@ -236,13 +254,20 @@ class CostLedger:
         )
 
     def peek(
-        self, *, index: str = "", frame: str = "", fp: str = "", lane: str = ""
+        self, *, tenant: Optional[str] = None, index: str = "",
+        frame: str = "", fp: str = "", lane: str = ""
     ) -> Optional[dict]:
         """One entry's current estimates (a copy), or None.  Pure read:
         the LRU order is NOT bumped — the planner consults on every
-        request and must not pin its own keys hot."""
+        request and must not pin its own keys hot.  ``tenant=None``
+        (the planner's tenant-agnostic peeks) resolves through the
+        MRU-tenant map for the 4-tuple."""
         with self._mu:
-            e = self._entries.get((index, frame, fp, lane))
+            if tenant is not None:
+                e = self._entries.get((tenant, index, frame, fp, lane))
+            else:
+                full = self._by4.get((index, frame, fp, lane))
+                e = self._entries.get(full) if full is not None else None
             return dict(e) if e is not None else None
 
     def entries(self, lane: Optional[str] = None) -> list[dict]:
@@ -250,10 +275,27 @@ class CostLedger:
         — the adaptive-budget derivations read these."""
         with self._mu:
             return [
-                {"index": k[0], "frame": k[1], "fp": k[2], "lane": k[3], **v}
+                {"tenant": k[0], "index": k[1], "frame": k[2], "fp": k[3],
+                 "lane": k[4], **v}
                 for k, v in self._entries.items()
-                if lane is None or k[3] == lane
+                if lane is None or k[4] == lane
             ]
+
+    def by_tenant(self) -> dict:
+        """Per-tenant ledger aggregates for /debug/tenants: entry count
+        and total observed cost (n * ewma_ms, the billing proxy).
+        Entries folded before the tenant dimension existed bill to
+        their index (the pre-tenancy attribution)."""
+        with self._mu:
+            out: dict = {}
+            for k, e in self._entries.items():
+                t = k[0] or k[1] or ""
+                row = out.setdefault(t, {"entries": 0, "cost_ms": 0.0})
+                row["entries"] += 1
+                row["cost_ms"] += e["n"] * e["ewma_ms"]
+        for row in out.values():
+            row["cost_ms"] = round(row["cost_ms"], 3)
+        return out
 
     def state(self) -> dict:
         """Full restorable state (entries in LRU order).  With
@@ -272,15 +314,22 @@ class CostLedger:
         self.alpha = min(1.0, max(0.01, float(st.get("alpha", self.alpha))))
         with self._mu:
             self._entries.clear()
+            self._by4.clear()
             for k, v in st.get("entries", []):
-                self._entries[tuple(k)] = dict(v)
+                key = tuple(k)
+                if len(key) == 4:
+                    # Pre-tenancy snapshot: pad with an empty tenant.
+                    key = ("",) + key
+                self._entries[key] = dict(v)
+                self._by4[key[1:]] = key
 
     def snapshot(self, limit: int = 0) -> dict:
         """The /debug/costs payload: entries sorted by EWMA cost
         descending (the planner's priority order)."""
         with self._mu:
             items = [
-                {"index": k[0], "frame": k[1], "fp": k[2], "lane": k[3], **v}
+                {"tenant": k[0], "index": k[1], "frame": k[2], "fp": k[3],
+                 "lane": k[4], **v}
                 for k, v in self._entries.items()
             ]
         items.sort(key=lambda e: -e["ewma_ms"])

@@ -171,6 +171,7 @@ class LockstepService:
         group_epoch: Optional[int] = None,
         bulk_batch_slices: Optional[int] = None,
         bulk_materialize_budget_ms: Optional[float] = None,
+        tenancy_map: Optional[str] = None,
     ):
         import jax
 
@@ -369,6 +370,18 @@ class LockstepService:
         # counts the SAME number (the flag rides the wire, decided once
         # on rank 0) — the lockstep determinism probe for sampling.
         self.stat_traced = 0
+        # Per-tenant request accounting off the wire entries: the tenant
+        # is resolved ONCE on rank 0 at ship time (header > [tenancy]
+        # map > index name > "default" — the tenancy.resolve seam) and
+        # rides the batch entry like the expired/trace/plan flags, so
+        # every rank tallies identical per-tenant counts from the flag
+        # alone.  tenant -> {"requests": n, "expired": m}.
+        from pilosa_tpu import tenancy as tenancy_mod
+
+        if tenancy_map is None:
+            tenancy_map = os.environ.get("PILOSA_TPU_TENANCY_MAP", "")  # analysis-ok: env-knob-outside-config: rank-process fallback; ctor args win, ranks inherit the launcher's env
+        self.tenancy_index_map = tenancy_mod.parse_map(tenancy_map)
+        self.stat_tenants: dict = {}
         # Streaming columnar ingest on the lockstep front end: chunks
         # decode on rank 0 and replay as canonical batched SetBit
         # bodies through the normal total order (every rank applies
@@ -447,7 +460,8 @@ class LockstepService:
                         raise OSError("worker closed control connection")
                     self._acked[i] += 1
 
-    def _execute(self, index: str, query: str, deadline=None, trace_force=False):
+    def _execute(self, index: str, query: str, deadline=None, trace_force=False,
+                 tenant_hdr=None):
         """Serve one request through the coalescing queue.
 
         ADMISSION: the arrival queue is bounded (``queue_depth``) — a
@@ -476,7 +490,9 @@ class LockstepService:
                     f"lockstep arrival queue full ({self.queue_depth}); retry",
                     retry_after=0.25,
                 )
-            self._q.append(((index, query, deadline, trace_force, _now()), slot))
+            self._q.append(
+                ((index, query, deadline, trace_force, tenant_hdr, _now()), slot)
+            )
             while not slot[0]:
                 if not self._shipping and self._q and self._inflight < 2:
                     self._shipping = True
@@ -502,7 +518,7 @@ class LockstepService:
                         try:
                             self._run_batch(
                                 shipped[0], batch, shipped[1], shipped[2],
-                                shipped[3],
+                                shipped[3], shipped[4],
                             )
                         finally:
                             self._q_cv.acquire()
@@ -644,7 +660,7 @@ class LockstepService:
             ingress.complete_bulk(fr, self.bulk_materialize_budget_ms)
         return True
 
-    def _ship_batch(self, items) -> tuple[int, list[bool], list, list]:
+    def _ship_batch(self, items) -> tuple[int, list[bool], list, list, list]:
         """Assign the batch's slot in the total order and replicate it:
         one control-plane send per worker plus one ack round for the
         WHOLE batch (the per-request fixed cost this coalescing
@@ -685,13 +701,22 @@ class LockstepService:
         expired: list[bool] = []
         traces: list = []
         plans: list = []
+        tenants: list = []
         t_ship = _now()
-        for index, query, d, tforce, t_enq in items:
+        for index, query, d, tforce, thdr, t_enq in items:
             exp = bool(d is not None and d.expired())
             expired.append(exp)
             traced = self.tracer is not None and self.tracer.decide(force=tforce)
+            # Tenant resolved ONCE here on rank 0 (the tenancy.resolve
+            # precedence: X-Pilosa-Tenant header > [tenancy] map > index
+            # name) and shipped like the expiry/trace flags — every rank
+            # attributes from the wire, never from local state.
+            tenant = (thdr or "").strip() or self.tenancy_index_map.get(
+                index, index
+            )
+            tenants.append(tenant)
             entry = {"index": index, "query": query, "expired": exp,
-                     "trace": traced}
+                     "trace": traced, "tenant": tenant}
             if d is not None:
                 entry["deadline_ms"] = max(0, int(d.remaining_ms()))
             # Planner decision, made ONCE here on rank 0 and shipped on
@@ -709,6 +734,10 @@ class LockstepService:
             tr = None
             if traced:
                 tr = Trace(f"lockstep {index}", forced=tforce)
+                # Both dimensions on the root: the cost ledger keys
+                # (tenant, index, ...) without conflating them.
+                tr.root.tags["tenant"] = tenant
+                tr.root.tags["index"] = index
                 # The queue phase already happened (arrival -> ship):
                 # record it with its measured duration.
                 qsp = tr.root.child("lockstep.queue")
@@ -747,7 +776,7 @@ class LockstepService:
                 # Covers the worker fan-out sends plus the receipt-ack
                 # barrier — the control-plane cost the batch amortizes.
                 sp.finish().annotate(ranks=self.n_ranks, batch=len(items))
-        return seq, expired, traces, plans
+        return seq, expired, traces, plans, tenants
 
     def _exec_batch_entries(self, entries, deliver) -> None:
         """Drop expired entries (the flag decided at ship time — every
@@ -764,6 +793,18 @@ class LockstepService:
                 # (and counts) the same flags — the determinism probe
                 # the 2-rank trace test asserts on.
                 self.stat_traced += 1
+            ten = e.get("tenant")
+            if ten:
+                # Rank 0's ship-time tenant off the wire: every rank
+                # tallies identical per-tenant counts (the 2-rank
+                # tenancy determinism probe).
+                row = self.stat_tenants.setdefault(  # analysis-ok: check-then-act: batch replay is single-threaded per rank (the control loop); stat_tenants is read only by the post-shutdown probe
+                    ten, {"requests": 0, "expired": 0}
+                )
+                row["requests"] += 1
+                if e.get("expired"):
+                    row["expired"] += 1
+                self.stats.count(f"tenancy.admit.{ten}")
             if e.get("expired"):
                 self.stat_expired += 1
                 deliver(pos, DeadlineExceeded("dropped at lockstep replay"))
@@ -897,7 +938,7 @@ class LockstepService:
                     deliver(pos, e)
 
     def _run_batch(self, seq: int, batch, expired=None, traces=None,
-                   plans=None) -> None:
+                   plans=None, tenants=None) -> None:
         """Execute one shipped batch in its slot of the total order and
         fill every submitter's result slot; never raises (siblings would
         hang on an unfilled slot otherwise).  ``expired`` carries the
@@ -942,9 +983,11 @@ class LockstepService:
                 flags = expired or [False] * len(batch)
                 trs = traces or [None] * len(batch)
                 pls = plans or [None] * len(batch)
+                tens = tenants or [None] * len(batch)
                 entries = [
                     {"index": it[0], "query": it[1], "expired": flags[i],
-                     "trace": trs[i] is not None, "plan": pls[i]}
+                     "trace": trs[i] is not None, "plan": pls[i],
+                     "tenant": tens[i]}
                     for i, (it, _) in enumerate(batch)
                 ]
                 exec_spans = [
@@ -1054,6 +1097,19 @@ class LockstepService:
                 body = json.dumps({"version": __version__}).encode()
             elif path == "/debug/vars":
                 body = json.dumps(svc.stats.snapshot()).encode()
+            elif path == "/debug/tenants":
+                # Per-tenant wire accounting (rank 0's view; every rank
+                # holds the same tallies by the lockstep invariant) plus
+                # the ledger billing aggregate.
+                body = json.dumps({
+                    "enabled": bool(svc.tenancy_index_map),
+                    "tenants": {
+                        t: dict(row) for t, row in svc.stat_tenants.items()
+                    },
+                    "ledger": (
+                        svc.costs.by_tenant() if svc.costs is not None else {}
+                    ),
+                }).encode()
             elif path == "/metrics":
                 from pilosa_tpu import metrics as metrics_mod
 
@@ -1218,11 +1274,16 @@ class LockstepService:
             # on rank 0 at SHIP time (one place, replicated as a wire
             # flag), this only carries the client's request for it.
             trace_force = bool((headers.get("x-pilosa-trace") or "").strip())
+            # X-Pilosa-Tenant override: carried to rank 0, which
+            # RESOLVES the tenant once at ship time (the wire flag every
+            # rank reads) — this only transports the client's claim.
+            tenant_hdr = headers.get("x-pilosa-tenant")
             retry_after = None
             status = 500
             try:
                 results = self.service._execute(
-                    index, query, deadline=deadline, trace_force=trace_force
+                    index, query, deadline=deadline, trace_force=trace_force,
+                    tenant_hdr=tenant_hdr,
                 )
                 body = json.dumps(
                     {"results": [result_to_json(r) for r in results]}

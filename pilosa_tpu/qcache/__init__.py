@@ -177,14 +177,17 @@ class _Pending:
 
 
 class _Entry:
-    __slots__ = ("index", "frames", "vec", "results", "nbytes")
+    __slots__ = ("index", "frames", "vec", "results", "nbytes", "tenant")
 
-    def __init__(self, index, frames, vec, results, nbytes):
+    def __init__(self, index, frames, vec, results, nbytes, tenant=None):
         self.index = index
         self.frames = frames
         self.vec = vec
         self.results = results
         self.nbytes = nbytes
+        # Billing owner under multi-tenancy (index→tenant map), None
+        # when tenancy is off.
+        self.tenant = tenant
 
 
 @lockcheck.guarded_class
@@ -208,6 +211,7 @@ class QueryCache:
         "_store": "qcache._mu",
         "_canon": "qcache._mu",
         "bytes": "qcache._mu",
+        "tenant_bytes": "qcache._mu",
         "hits": "qcache._mu",
         "misses": "qcache._mu",
         "bypasses": "qcache._mu",
@@ -222,6 +226,7 @@ class QueryCache:
         min_cost_ms: float = DEFAULT_MIN_COST_MS,
         stats=None,
         clock=time.perf_counter,
+        tenancy=None,
     ):
         from pilosa_tpu.stats import NOP_STATS
 
@@ -235,6 +240,11 @@ class QueryCache:
         # for determinism and must not regrow from rank-local wall time.
         self.budgets = None
         self.stats = stats if stats is not None else NOP_STATS
+        # TenancyState: per-tenant byte quotas ([tenancy] qcache-share).
+        # Entries bill to the index's tenant; over-quota tenants reclaim
+        # from THEMSELVES first, so one tenant's store flood can never
+        # flush another tenant's working set.  None = no quotas.
+        self.tenancy = tenancy
         self._clock = clock
         self._mu = lockcheck.named_lock("qcache._mu")
         self._store: "OrderedDict[tuple, _Entry]" = OrderedDict()
@@ -244,6 +254,8 @@ class QueryCache:
         self._canon: "OrderedDict[str, Optional[tuple]]" = OrderedDict()
         self._canon_max = 512
         self.bytes = 0
+        # tenant -> resident bytes (entries removed at zero).
+        self.tenant_bytes: dict = {}
         self.hits = 0
         self.misses = 0
         self.bypasses = 0
@@ -379,22 +391,97 @@ class QueryCache:
         nbytes = result_nbytes(results)
         if nbytes > self.max_bytes:
             return False
-        entry = _Entry(pending.index, pending.frames, pending.vec0, list(results), nbytes)
+        tenant = (
+            self.tenancy.tenant_of_index(pending.index)
+            if self.tenancy is not None
+            else None
+        )
+        entry = _Entry(
+            pending.index, pending.frames, pending.vec0, list(results), nbytes,
+            tenant=tenant,
+        )
         with self._mu:
             old = self._store.pop(pending.key, None)
             if old is not None:
                 self.bytes -= old.nbytes
+                self._tenant_debit(old)
             self._store[pending.key] = entry
             self.bytes += nbytes
+            if tenant is not None:
+                self.tenant_bytes[tenant] = (
+                    self.tenant_bytes.get(tenant, 0) + nbytes
+                )
             self.stores += 1
+            # Per-tenant quota: the committing tenant reclaims from its
+            # OWN LRU entries first when it runs past its share, before
+            # the global loop can touch anyone else's working set.
+            if tenant is not None:
+                quota = self.tenancy.qcache_quota(tenant, self.max_bytes)
+                while quota > 0 and self.tenant_bytes.get(tenant, 0) > quota:
+                    if not self._evict_tenant_locked(tenant):
+                        break
             while self.bytes > self.max_bytes and self._store:
+                # Under the global budget too, over-quota tenants pay
+                # before anyone under quota loses an entry.
+                if self.tenancy is not None and self._evict_over_quota_locked():
+                    continue
                 _, ev = self._store.popitem(last=False)
                 self.bytes -= ev.nbytes
+                self._tenant_debit(ev)
                 self.evictions += 1
                 self.stats.count("qcache.evict")
         self.stats.count("qcache.store")
         self.stats.gauge("qcache.bytes", self.bytes)
         return True
+
+    def _tenant_debit(self, entry) -> None:
+        """Return one removed entry's bytes to its tenant (``_mu``
+        held by every caller)."""
+        t = entry.tenant
+        if t is None:
+            return
+        n = self.tenant_bytes.get(t, 0) - entry.nbytes  # analysis-ok: check-then-act: _mu held by every caller (commit/invalidate eviction paths); the _locked helper convention
+        if n <= 0:
+            self.tenant_bytes.pop(t, None)
+        else:
+            self.tenant_bytes[t] = n
+
+    def _evict_tenant_locked(self, tenant) -> bool:
+        """Evict ``tenant``'s least-recently-used entry (``_mu`` held).
+        False when the tenant holds none."""
+        for k, e in self._store.items():
+            if e.tenant == tenant:
+                self._store.pop(k)
+                self.bytes -= e.nbytes  # analysis-ok: check-then-act: _mu held by every caller; the _locked helper convention
+                self._tenant_debit(e)
+                self.evictions += 1  # analysis-ok: check-then-act: _mu held by every caller; the _locked helper convention
+                self.stats.count("qcache.evict")
+                self.stats.count(f"tenancy.qcache_evict.{tenant}")
+                return True
+        return False
+
+    def _evict_over_quota_locked(self) -> bool:
+        """Evict the LRU entry of any tenant currently over its quota
+        (``_mu`` held).  False when nobody is over."""
+        for k, e in self._store.items():
+            t = e.tenant
+            if t is None:
+                continue
+            quota = self.tenancy.qcache_quota(t, self.max_bytes)
+            if quota > 0 and self.tenant_bytes.get(t, 0) > quota:
+                self._store.pop(k)
+                self.bytes -= e.nbytes  # analysis-ok: check-then-act: _mu held by every caller; the _locked helper convention
+                self._tenant_debit(e)
+                self.evictions += 1  # analysis-ok: check-then-act: _mu held by every caller; the _locked helper convention
+                self.stats.count("qcache.evict")
+                self.stats.count(f"tenancy.qcache_evict.{t}")
+                return True
+        return False
+
+    def tenant_bytes_snapshot(self) -> dict:
+        """Per-tenant resident bytes (/debug/tenants)."""
+        with self._mu:
+            return dict(self.tenant_bytes)
 
     # -- invalidation hooks ------------------------------------------------
 
@@ -403,6 +490,7 @@ class QueryCache:
             entry = self._store.pop(key, None)
             if entry is not None:
                 self.bytes -= entry.nbytes
+                self._tenant_debit(entry)
         self.stats.gauge("qcache.bytes", self.bytes)
 
     def purge_frame(self, index: str, frame: str) -> int:
@@ -416,7 +504,9 @@ class QueryCache:
                 if e.index == index and frame in e.frames
             ]
             for k in victims:
-                self.bytes -= self._store.pop(k).nbytes
+                e = self._store.pop(k)
+                self.bytes -= e.nbytes
+                self._tenant_debit(e)
         if victims:
             self.stats.gauge("qcache.bytes", self.bytes)
         return len(victims)
@@ -426,7 +516,9 @@ class QueryCache:
         with self._mu:
             victims = [k for k, e in self._store.items() if e.index == index]
             for k in victims:
-                self.bytes -= self._store.pop(k).nbytes
+                e = self._store.pop(k)
+                self.bytes -= e.nbytes
+                self._tenant_debit(e)
         if victims:
             self.stats.gauge("qcache.bytes", self.bytes)
         return len(victims)
@@ -435,6 +527,7 @@ class QueryCache:
         with self._mu:
             self._store.clear()
             self.bytes = 0
+            self.tenant_bytes.clear()
         self.stats.gauge("qcache.bytes", 0)
 
     def __len__(self) -> int:

@@ -88,6 +88,14 @@ class AdmissionController:
     release; when the wait lane itself is full (``depth`` waiters) it
     sheds immediately — the two bounds together cap the work the
     server ever holds to 2x depth per class.
+
+    With a :class:`~pilosa_tpu.tenancy.TenancyState` attached AND a
+    resolved tenant on the acquire, the same doors enforce weighted
+    fair shares: a tenant past its weighted slice of ``depth`` waits or
+    sheds while under-share tenants keep clearing, and the wait lane is
+    bounded PER TENANT so a flooding tenant cannot fill it and shed a
+    polite one at the door.  ``tenancy is None`` or ``tenant is None``
+    takes the pre-tenancy path byte-identically.
     """
 
     def __init__(
@@ -96,11 +104,13 @@ class AdmissionController:
         queue_wait_ms: float = 100.0,
         retry_after_ms: float = 250.0,
         stats=None,
+        tenancy=None,
     ):
         self.depths = dict(depths or {})
         self.queue_wait_ms = queue_wait_ms
         self.retry_after = max(0.001, retry_after_ms / 1000.0)
         self.stats = stats if stats is not None else NOP_STATS
+        self.tenancy = tenancy
         self._cv = lockcheck.named_condition("qos.admission._cv")
         self._active = {c: 0 for c in CLASSES}
         self._waiting = {c: 0 for c in CLASSES}
@@ -108,17 +118,26 @@ class AdmissionController:
         self.stat_admitted = 0
         self.stat_shed = 0
 
-    def _shed(self, cls: str) -> ShedError:
+    def _shed(self, cls: str, tenant=None, fair=None) -> ShedError:
         self.stat_shed += 1
         self.stats.count(f"qos.shed.{cls}")
+        if fair is not None and tenant is not None:
+            fair.note_shed(cls, tenant)
+            self.stats.count(f"tenancy.shed.{tenant}")
         return ShedError(
             f"{cls} admission queue full; retry after {self.retry_after:.3f}s",
             retry_after=self.retry_after,
         )
 
-    def acquire(self, cls: str, deadline=None) -> None:
+    def acquire(self, cls: str, deadline=None, tenant=None) -> None:
         depth = self.depths.get(cls, 0)
+        fair = None
+        if tenant is not None and self.tenancy is not None:
+            fair = self.tenancy.fair
         with self._cv:
+            if fair is not None:
+                self._acquire_fair(fair, cls, depth, deadline, tenant)
+                return
             if depth <= 0 or self._active[cls] < depth:
                 self._active[cls] += 1
                 self.stat_admitted += 1
@@ -147,16 +166,78 @@ class AdmissionController:
             self.stat_admitted += 1
             self.stats.gauge(f"qos.inflight.{cls}", self._active[cls])
 
-    def release(self, cls: str) -> None:
+    def _acquire_fair(self, fair, cls: str, depth: int, deadline, tenant: str) -> None:
+        """Fair-share acquire (``self._cv`` held).  Admission requires a
+        free door slot AND the tenant under its weighted inflight cap;
+        the wait lane is bounded per tenant (each tenant queues at most
+        its own share of waiters) with a 2x-depth overall backstop."""
+        if depth <= 0:
+            # Unbounded door: nothing to share, account only.
+            self._active[cls] += 1
+            fair.note_admit(cls, tenant)
+            self.stat_admitted += 1
+            self.stats.count(f"tenancy.admit.{tenant}")
+            self.stats.gauge(f"qos.inflight.{cls}", self._active[cls])
+            return
+        if self._active[cls] < depth and not fair.over_cap(cls, tenant, depth):
+            self._active[cls] += 1
+            fair.note_admit(cls, tenant)
+            self.stat_admitted += 1
+            self.stats.count(f"tenancy.admit.{tenant}")
+            self.stats.gauge(f"qos.inflight.{cls}", self._active[cls])
+            return
+        if fair.wait_full(cls, tenant, depth) or self._waiting[cls] >= 2 * depth:
+            raise self._shed(cls, tenant=tenant, fair=fair)
+        self._waiting[cls] += 1
+        fair.note_wait(cls, tenant, 1)
+        self.stats.gauge(f"qos.queue_depth.{cls}", self._waiting[cls])
+        try:
+            budget = self.queue_wait_ms / 1000.0
+            if deadline is not None:
+                budget = min(budget, max(0.0, deadline.remaining_ms() / 1000.0))
+            import time as _time
+
+            end = _time.monotonic() + budget
+            while self._active[cls] >= depth or fair.over_cap(cls, tenant, depth):
+                left = end - _time.monotonic()
+                if left <= 0:
+                    raise self._shed(cls, tenant=tenant, fair=fair)
+                self._cv.wait(left)
+        finally:
+            self._waiting[cls] -= 1
+            fair.note_wait(cls, tenant, -1)
+            self.stats.gauge(f"qos.queue_depth.{cls}", self._waiting[cls])
+        self._active[cls] += 1
+        fair.note_admit(cls, tenant)
+        self.stat_admitted += 1
+        self.stats.count(f"tenancy.admit.{tenant}")
+        self.stats.gauge(f"qos.inflight.{cls}", self._active[cls])
+
+    def release(self, cls: str, tenant=None) -> None:
         with self._cv:
             self._active[cls] -= 1
+            if tenant is not None and self.tenancy is not None:
+                self.tenancy.fair.note_release(cls, tenant)
+                self.stats.gauge(f"qos.inflight.{cls}", self._active[cls])
+                # Waiters have heterogeneous predicates (door slot AND
+                # per-tenant cap), so a single notify could wake only an
+                # over-cap tenant and strand an eligible one.
+                self._cv.notify_all()
+                return
             self.stats.gauge(f"qos.inflight.{cls}", self._active[cls])
             self._cv.notify()
 
+    def tenants_snapshot(self) -> dict:
+        """Per-tenant fair-share accounting rows (/debug/tenants)."""
+        if self.tenancy is None:
+            return {}
+        with self._cv:
+            return self.tenancy.fair.snapshot(self.depths)
+
     @contextmanager
-    def admit(self, cls: str, deadline=None):
-        self.acquire(cls, deadline)
+    def admit(self, cls: str, deadline=None, tenant=None):
+        self.acquire(cls, deadline, tenant=tenant)
         try:
             yield
         finally:
-            self.release(cls)
+            self.release(cls, tenant=tenant)

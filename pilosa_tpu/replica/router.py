@@ -769,6 +769,8 @@ class ReplicaRouter:
         shard_map: Optional[ShardMap] = None,
         wal_dir: Optional[str] = None,
         wal_max_bytes: Optional[int] = None,
+        admission=None,
+        tenancy=None,
     ):
         if shard_map is None:
             if not groups:
@@ -787,6 +789,14 @@ class ReplicaRouter:
         self.probe_max_interval_s = probe_max_interval_s
         self.stats = stats if stats is not None else NOP_STATS
         self.tracer = tracer
+        # [tenancy]: weighted fair-share admission at the ROUTER door —
+        # the same class doors the per-server handler runs, so a hostile
+        # tenant flooding the fleet front door sheds at ITS share before
+        # its requests ever fan out to a group.  None (the default)
+        # keeps the routed path byte-identical to the pre-tenancy
+        # router: no door, no extra lock hop.
+        self.tenancy = tenancy
+        self.admission = admission
         self.faults = faults if faults is not None else (
             FaultInjector.from_env() or NOP_FAULTS
         )
@@ -1469,6 +1479,33 @@ class ReplicaRouter:
                 json.dumps({"error": "deadline exceeded (router)"}).encode(), {},
             )
         cls = qos.classify_request(method, path, body)
+        # [tenancy]: the router-door fair-share gate.  The tenant is
+        # resolved through the SAME seam the handler and the lockstep
+        # front end use (header > map > index name > default), and the
+        # door is the same AdmissionController the servers run — an
+        # over-share tenant sheds 429+Retry-After HERE, before its
+        # request costs a single group-side socket.
+        tenant = None
+        if self.admission is not None:
+            if self.tenancy is not None:
+                tenant = self.tenancy.resolve(path, headers)
+            try:
+                self.admission.acquire(cls, deadline, tenant=tenant)
+            except qos.ShedError as e:
+                self.stats.count("replica.router.shed")
+                return self._shed(e.status, str(e), retry_after=e.retry_after)
+        try:
+            return self._handle_routed(
+                method, path_qs, path, body, headers, deadline, cls
+            )
+        finally:
+            if self.admission is not None:
+                self.admission.release(cls, tenant=tenant)
+
+    def _handle_routed(self, method, path_qs, path, body, headers,
+                       deadline, cls):
+        """The routed section of ``handle`` — everything past the
+        tenancy door (the door must release on EVERY exit path)."""
         # Mutating admin (schema, deletions) must apply to EVERY group or
         # the replicas' schemas diverge; admin GETs route like reads.
         fan_all = cls == qos.CLASS_WRITE or (
@@ -1584,6 +1621,19 @@ class ReplicaRouter:
             for key, val in vars_snap.items()
             if key.startswith("qos.latency_ms.") and isinstance(val, dict)
         }
+        # Per-tenant rows off the group's own counters: every
+        # tenancy.<series>.<tenant> key pivots into tenant -> series so
+        # the fleet view answers "which tenant is this group shedding"
+        # without a per-group scrape by the operator.
+        tenants: dict = {}
+        for key, val in vars_snap.items():
+            if not key.startswith("tenancy."):
+                continue
+            rest = key.split("tenancy.", 1)[1]
+            series, _, tenant = rest.partition(".")
+            if tenant:
+                tenants.setdefault(tenant, {})[series] = val
+        out["tenants"] = tenants
         out["vars"] = vars_snap
         return out, None
 
@@ -1662,6 +1712,13 @@ class ReplicaRouter:
                 if k.startswith("replica.")
             },
             "partial": scraped_ok < len(table),
+            # Router-door fair-share state (weights, inflight, debt,
+            # shed counts per tenant) — {} when tenancy is off.
+            "tenants": (
+                self.admission.tenants_snapshot()
+                if self.admission is not None
+                else {}
+            ),
             "groups": groups_out,
         }
         return 200, "application/json", (json.dumps(payload) + "\n").encode(), {}
@@ -2163,6 +2220,27 @@ def router_from_config(cfg, stats=None, tracer=None) -> ReplicaRouter:
             span=int(cfg.replica_shard_span or 1),
         )
 
+    # [tenancy]: the router runs the SAME fair-share door the servers
+    # do, from the same config — one [tenancy] section isolates tenants
+    # at every entry point.  Disabled (the default) passes None for
+    # both, which keeps handle() on the doorless fast path.
+    from pilosa_tpu import tenancy as tenancy_mod
+
+    tenancy = tenancy_mod.from_config(cfg, stats=stats)
+    admission = None
+    if tenancy is not None:
+        admission = qos.AdmissionController(
+            depths={
+                qos.CLASS_READ: cfg.qos_read_depth,
+                qos.CLASS_WRITE: cfg.qos_write_depth,
+                qos.CLASS_ADMIN: cfg.qos_admin_depth,
+            },
+            queue_wait_ms=cfg.qos_queue_wait_ms,
+            retry_after_ms=cfg.qos_retry_after_ms,
+            stats=stats,
+            tenancy=tenancy,
+        )
+
     common = dict(
         host=host or "127.0.0.1",
         port=cfg.replica_router_port,
@@ -2176,6 +2254,8 @@ def router_from_config(cfg, stats=None, tracer=None) -> ReplicaRouter:
         anti_entropy_interval_s=cfg.replica_anti_entropy_interval,
         resync_chunk_bytes=cfg.replica_resync_chunk_bytes,
         resync_columnar=cfg.replica_resync_columnar,
+        admission=admission,
+        tenancy=tenancy,
     )
     if shard_map is not None and len(shard_map) > 1:
         return ReplicaRouter(

@@ -70,11 +70,11 @@ VERSION = "0.1.0-tpu"
 
 PROTOBUF = "application/x-protobuf"
 
-# Tenant seed tag: the index an /index/<name>/... request addresses is
-# the per-tenant unit (ROADMAP multi-tenancy seam) — stamped on every
-# trace root so traces, slow-query log lines, and the cost ledger all
-# attribute to their tenant.
-_TENANT_RX = re.compile(r"^/index/([^/]+)")
+# Tenant attribution goes through the single tenancy.resolve seam
+# (header > [tenancy] map > index name): trace tags, slow-query log
+# lines, the cost ledger, and the admission doors can never disagree
+# on a request's tenant.  See _resolve_tenant.
+from pilosa_tpu import tenancy as tenancy_mod
 
 
 class HTTPError(Exception):
@@ -101,7 +101,8 @@ class Handler:
                  ingest_chunk_bytes: int = 4 << 20, costs=None,
                  planner=None,
                  bulk_batch_slices: int = 8,
-                 bulk_materialize_budget_ms: float = 0.0):
+                 bulk_materialize_budget_ms: float = 0.0,
+                 tenancy=None):
         self.holder = holder
         self.executor = executor
         self.cluster = cluster
@@ -127,6 +128,10 @@ class Handler:
         # attaches the plan to ExecOptions; the executor only applies.
         # None = static strategy ladder everywhere (the default).
         self.planner = planner
+        # Multi-tenant isolation (tenancy.TenancyState): the resolution
+        # seam + fair-share/quota/pacer state.  None = isolation off —
+        # attribution falls back to the index name and no door enforces.
+        self.tenancy = tenancy
         # Replica serving-group identity ("name" or "name@epoch",
         # [replica] group): stamped on every response as X-Pilosa-Group
         # so the router can record which group answered and detect
@@ -206,6 +211,7 @@ class Handler:
             ("GET", re.compile(r"^/debug/traces$"), self.get_debug_traces),
             ("GET", re.compile(r"^/debug/costs$"), self.get_debug_costs),
             ("GET", re.compile(r"^/debug/planner$"), self.get_debug_planner),
+            ("GET", re.compile(r"^/debug/tenants$"), self.get_debug_tenants),
             ("GET", re.compile(r"^/metrics$"), self.get_metrics),
             ("GET", re.compile(r"^/debug/pprof(?:/(?P<path>.*))?$"), self.get_pprof),
             ("POST", re.compile(r"^/debug/profile/start$"), self.post_profile_start),
@@ -263,9 +269,11 @@ class Handler:
         tags = None
         if trace is None and tracer.slow_ms > 0.0 and dt_ms >= tracer.slow_ms:
             tags = {"qos_class": qos.classify_request(method, path, body)}
-            tm = _TENANT_RX.match(path)
-            if tm is not None:
-                tags["tenant"] = tm.group(1)
+            tenant, index = self._resolve_tenant(path, headers)
+            if tenant:
+                tags["tenant"] = tenant
+            if index:
+                tags["index"] = index
         extra = tracer.finish_request(
             trace, name=f"{method} {path}", dt_ms=dt_ms, body=body,
             status=out[0], tags=tags,
@@ -315,19 +323,24 @@ class Handler:
         """
         deadline = qos.deadline_from_headers(headers, self.default_deadline_ms)
         cls = qos.classify_request(method, path, body)
+        tenant, index = self._resolve_tenant(path, headers)
         if span is not None:
-            # QoS class + per-index tenant seed tag: the multi-tenancy
-            # seam — every trace (and slow-query log line, which
-            # surfaces root tags flat) attributes to its tenant.
+            # QoS class + tenant tag (the shared tenancy.resolve seam):
+            # every trace (and slow-query log line, which surfaces root
+            # tags flat) attributes to its tenant.
             span.tags["qos_class"] = cls
-            tm = _TENANT_RX.match(path)
-            if tm is not None:
-                span.tags["tenant"] = tm.group(1)
+            if tenant:
+                span.tags["tenant"] = tenant
+            if index:
+                span.tags["index"] = index
+        # Fair-share enforcement engages only with tenancy ON; off, the
+        # door sees tenant=None and behaves byte-identically to today.
+        door_tenant = tenant if self.tenancy is not None else None
         t0 = time.perf_counter()
         try:
             if self.admission is not None:
                 asp = span.child("qos.admit") if span is not None else None
-                with self.admission.admit(cls, deadline):
+                with self.admission.admit(cls, deadline, tenant=door_tenant):
                     if asp is not None:
                         asp.finish()
                     if deadline is not None:
@@ -355,9 +368,27 @@ class Handler:
             return 504, "application/json", json.dumps({"error": str(e)}).encode()
         finally:
             if self.stats is not None:
-                self.stats.histogram(
-                    f"qos.latency_ms.{cls}", (time.perf_counter() - t0) * 1e3
-                )
+                dt_ms = (time.perf_counter() - t0) * 1e3
+                self.stats.histogram(f"qos.latency_ms.{cls}", dt_ms)
+                if door_tenant is not None:
+                    # Per-tenant latency rides next to the per-class
+                    # series (the hostile-neighbor bench's probe).
+                    self.stats.histogram(
+                        f"tenancy.latency_ms.{door_tenant}", dt_ms
+                    )
+
+    def _resolve_tenant(self, path: str, headers):
+        """(tenant, index-tag): the deduped tenant extraction.  With
+        isolation OFF this reproduces the pre-tenancy tagging exactly —
+        tenant = the index name on /index/ paths, nothing otherwise,
+        and no separate index tag.  With isolation ON it resolves
+        through tenancy.resolve (header > [tenancy] map > index name >
+        "default") and tags the index separately so the cost ledger
+        keeps both dimensions."""
+        index = tenancy_mod.index_of(path)
+        if self.tenancy is None:
+            return (index or None), None
+        return self.tenancy.resolve(path, headers), (index or None)
 
     def _dispatch_route(self, method: str, path: str, params: dict, body: bytes,
                         headers: dict, deadline=None, span=None):
@@ -664,6 +695,36 @@ class Handler:
         if self.planner is None:
             return self._json({"lanes": [], "keys": []})
         return self._json(self.planner.snapshot(limit=limit))
+
+    def get_debug_tenants(self, **kw):
+        """Per-tenant isolation state: fair-share door accounting
+        (inflight / share / debt / admitted / shed per QoS class),
+        qcache resident bytes + quota, ingest pacer buckets, and the
+        cost-ledger billing aggregate.  ``enabled: false`` with no rows
+        when isolation is off."""
+        if self.tenancy is None:
+            return self._json({"enabled": False, "tenants": {}})
+        tenants: dict = {}
+        if self.admission is not None:
+            for t, row in self.admission.tenants_snapshot().items():
+                tenants.setdefault(t, {}).update(row)
+        qc = getattr(self.executor, "qcache", None)
+        if qc is not None:
+            for t, nbytes in qc.tenant_bytes_snapshot().items():
+                row = tenants.setdefault(t, {})
+                row["qcacheBytes"] = nbytes
+                row["qcacheQuota"] = self.tenancy.qcache_quota(t, qc.max_bytes)
+        if self.costs is not None:
+            for t, agg in self.costs.by_tenant().items():
+                tenants.setdefault(t, {})["ledger"] = agg
+        if self.tenancy.pacer is not None:
+            for t, row in self.tenancy.pacer.snapshot().items():
+                tenants.setdefault(t, {})["ingest"] = row
+        return self._json({
+            "enabled": True,
+            "defaultWeight": self.tenancy.default_weight,
+            "tenants": tenants,
+        })
 
     def get_metrics(self, **kw):
         """Prometheus text exposition of the whole stats registry
@@ -1018,6 +1079,27 @@ class Handler:
         key = (index, frame)
         if self._param(params, "probe") == "1":
             return self._json(ingestor.probe(key, total, crc))
+        # Per-tenant bandwidth pacing ([tenancy] ingest-bytes-per-s):
+        # a chunk past the tenant's token-bucket share answers 429 +
+        # Retry-After BEFORE it stages — a hostile backfill backs off
+        # while other tenants' chunks keep clearing at their share.
+        if (
+            self.tenancy is not None
+            and self.tenancy.pacer is not None
+            and body
+        ):
+            tenant = self.tenancy.resolve_for_index(index, headers)
+            wait = self.tenancy.pacer.admit(tenant, len(body))
+            if wait > 0.0:
+                if self.stats is not None:
+                    self.stats.count(f"tenancy.ingest_shed.{tenant}")
+                raise qos.ShedError(
+                    f"tenant {tenant!r} over its ingest bandwidth share;"
+                    f" retry after {wait:.3f}s",
+                    retry_after=wait,
+                )
+            if self.stats is not None:
+                self.stats.count(f"tenancy.ingest_bytes.{tenant}", len(body))
         arrow = "arrow" in (headers.get("content-type") or "")
         try:
             out = ingestor.chunk(

@@ -66,6 +66,13 @@ class Server:
         self.client_factory = lambda host: Client(
             host, retry_budget=self.config.client_retry_budget, stats=stats
         )
+        # Multi-tenant isolation ([tenancy]): the shared resolution seam
+        # + fair-share/quota/pacer state handed to the admission doors,
+        # the qcache, and the handler.  None (the default) keeps every
+        # seam on its pre-tenancy path byte-identically.
+        from pilosa_tpu import tenancy as tenancy_mod
+
+        self.tenancy = tenancy_mod.from_config(self.config, stats=stats)
         # Generation-keyed query result cache ([qcache]): sits in front
         # of the executor's read paths; None = disabled.
         from pilosa_tpu.qcache import QueryCache
@@ -75,6 +82,7 @@ class Server:
                 max_bytes=self.config.qcache_max_bytes,
                 min_cost_ms=self.config.qcache_min_cost_ms,
                 stats=stats,
+                tenancy=self.tenancy,
             )
             if self.config.qcache_enabled
             else None
@@ -172,6 +180,7 @@ class Server:
             queue_wait_ms=self.config.qos_queue_wait_ms,
             retry_after_ms=self.config.qos_retry_after_ms,
             stats=stats,
+            tenancy=self.tenancy,
         )
         # Replica durability: a group-tagged server persists its
         # last-applied router write sequence next to the data, so a
@@ -210,6 +219,9 @@ class Server:
             # batching + lazy-materialization drain budget.
             bulk_batch_slices=self.config.bulk_batch_slices,
             bulk_materialize_budget_ms=self.config.bulk_materialize_budget_ms,
+            # [tenancy]: resolution + fair-share enforcement state (None
+            # = isolation off).
+            tenancy=self.tenancy,
         )
         self.syncer = HolderSyncer(
             self.holder, self.cluster, self.host, self.client_factory, stats=stats

@@ -196,6 +196,14 @@ run BENCH_CONFIG=qcache BENCH_TRACE_ITERS=40000 BENCH_COSTS_ITERS=40000
 #    line pushes deeper overload on a wider door.
 run BENCH_CONFIG=overload
 run BENCH_CONFIG=overload BENCH_QOS_DEPTH=8 BENCH_THREADS=64
+# 10b) Multi-tenant hostile neighbor: a polite tenant at its weighted
+#    fair share of the read door vs a hostile tenant flooding at 2x the
+#    door's depth.  The hostile-flood leg asserts IN-RUN that isolation
+#    holds: polite p99 within 1.5x its isolated baseline, ZERO polite
+#    sheds, and real hostile sheds — then repeats with tenancy off for
+#    the A/B.  The second line widens the door and doubles the flood.
+run BENCH_CONFIG=tenancy
+run BENCH_CONFIG=tenancy BENCH_QOS_DEPTH=16 BENCH_THREADS=32
 # 11) Replicated serving groups: read QPS through the replica router at
 #    1 vs 2 groups (scaling_1_to_2 is the headline; needs >= 3 cores) +
 #    router on/off overhead, with cross-group read-your-writes and

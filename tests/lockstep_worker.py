@@ -154,6 +154,10 @@ def main() -> int:
                 # rides the batch entry — every rank counts the SAME
                 # wire flags, so stat_traced must agree across ranks.
                 "traced": svc.stat_traced,
+                # Per-tenant wire accounting: the tenant is resolved
+                # once on rank 0 at ship time and rides the batch entry
+                # — every rank must tally IDENTICAL per-tenant counts.
+                "tenants": svc.stat_tenants,
                 # Rank 0 records ship/execute phases into its ring.
                 "trace_ring": (
                     len(svc.tracer.traces_json(limit=10000))

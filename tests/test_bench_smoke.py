@@ -162,6 +162,29 @@ def test_bench_overload_emits_json():
     assert all(t["goodput_qps"] > 0 for t in result["tiers"])
 
 
+def test_bench_tenancy_emits_json():
+    """The multi-tenant hostile-neighbor bench must keep working: a
+    polite tenant's isolated p99 baseline, then a hostile flood at 2x
+    the door's depth with fair-share isolation ON (polite p99 within
+    1.5x baseline, zero polite sheds, hostile really sheds — all
+    asserted in-run) and OFF (the A/B degradation is recorded)."""
+    stdout = _run({"BENCH_CONFIG": "tenancy", "BENCH_SMOKE": "1"}, timeout=300)
+    result = json.loads(stdout.strip().splitlines()[-1])
+    assert result["metric"] == "tenancy_polite_p99_ms" and result["value"] > 0
+    names = [t["tier"] for t in result["tiers"]]
+    assert names == ["polite_baseline", "hostile_flood_on", "hostile_flood_off"]
+    by = {t["tier"]: t for t in result["tiers"]}
+    assert by["polite_baseline"]["served"] > 0
+    # The bench asserted these in-run; the fields record it.
+    on = by["hostile_flood_on"]
+    assert on["polite"]["shed"] == 0 and on["polite"]["served"] > 0
+    assert on["hostile"]["shed"] > 0
+    # The /debug/tenants scrape rode along: the door saw both tenants.
+    assert on["door"]["polite"]["admitted"] > 0
+    assert on["door"]["hostile"]["shed"] > 0
+    assert result["vs_baseline"] <= 1.5
+
+
 def test_bench_replica_emits_json():
     """The replicated-serving-groups bench must keep working: group
     subprocesses behind out-of-process routers, read QPS at 1 vs N

@@ -529,6 +529,55 @@ def test_lockstep_trace_sampling_decided_on_rank0():
     )
 
 
+def test_lockstep_tenant_resolved_on_rank0():
+    """Multi-tenant accounting under lockstep: the tenant is resolved
+    ONCE on rank 0 at ship time (X-Pilosa-Tenant header, else the
+    [tenancy] map, else the index name) and rides the batch wire entry
+    — every rank tallies the SAME per-tenant counts off the wire, never
+    re-resolving locally.  An expired request still bills its tenant
+    (the expired flag and the tenant ride the same entry), so per-tenant
+    expired counts agree across ranks too."""
+    import urllib.error
+
+    job = _LockstepJob(
+        2, env_extra={"PILOSA_TPU_TENANCY_MAP": "g=gold"}
+    )
+    try:
+        job.wait_ready()
+        q = 'Count(Bitmap(rowID=0, frame="f"))'
+        # Header wins over the map: these bill "acme".
+        for _ in range(3):
+            assert job.query(q, headers={"X-Pilosa-Tenant": "acme"})[
+                "results"
+            ] == [8]
+        # No header: the map sends index "g" to tenant "gold".
+        for _ in range(4):
+            assert job.query(q)["results"] == [8]
+        # An expired acme request: dropped on every rank AND billed to
+        # acme on every rank — flag and tenant ride the same entry.
+        try:
+            job.query(
+                q,
+                headers={
+                    "X-Pilosa-Tenant": "acme",
+                    "X-Pilosa-Deadline-Ms": "0",
+                },
+            )
+            raise AssertionError("expired request should 504")
+        except urllib.error.HTTPError as e:
+            assert e.code == 504
+        outs = job.shutdown_and_collect()
+    finally:
+        job.cleanup()
+    by_pid = {o["pid"]: o for o in outs}
+    # Both ranks tallied identical per-tenant counts off the wire.
+    assert by_pid[0]["tenants"] == by_pid[1]["tenants"], outs
+    assert by_pid[0]["tenants"] == {
+        "acme": {"requests": 4, "expired": 1},
+        "gold": {"requests": 4, "expired": 0},
+    }, outs
+
+
 def test_replica_router_over_two_lockstep_groups():
     """Replica serving groups at full depth: TWO 2-rank lockstep jobs
     (groups g0/g1, identities via PILOSA_TPU_REPLICA_GROUP) behind one
